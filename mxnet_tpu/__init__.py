@@ -1,0 +1,56 @@
+"""mxnet_tpu: a TPU-native deep-learning framework with MXNet's capabilities.
+
+A brand-new framework (not a port) built on JAX/XLA/PJRT for TPU, providing
+the capability surface of Apache (incubator-)MXNet v1.x — async NDArray
+runtime, autograd, Gluon Block/HybridBlock/Trainer, symbolic graphs +
+executors, declarative op registry, kvstore distributed API over XLA
+collectives, data pipelines, profiler/metric/checkpoint subsystems.
+See SURVEY.md at the repo root for the blueprint.
+
+Import convention mirrors the reference::
+
+    import mxnet_tpu as mx
+    x = mx.nd.ones((2, 3), ctx=mx.tpu(0))
+"""
+
+__version__ = "0.1.0"
+
+from . import base
+from .base import MXNetError
+from . import context
+from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, \
+    num_gpus, num_tpus
+from . import engine
+from . import autograd
+from . import ndarray
+from . import ndarray as nd
+from . import random
+from . import symbol
+from . import symbol as sym
+from .ndarray import NDArray
+from .symbol import Symbol
+
+
+def waitall():
+    """Block until all async computation completes (mx.nd.waitall)."""
+    engine.waitall()
+
+
+# Subsystems below are imported lazily-but-eagerly as they land in the build.
+import importlib as _importlib
+
+for _mod in ("initializer", "optimizer", "metric", "gluon", "io", "kvstore",
+             "callback", "profiler", "util", "runtime", "test_utils",
+             "executor", "module", "image", "contrib", "parallel", "models",
+             "np", "npx", "lr_scheduler"):
+    try:
+        globals()[_mod] = _importlib.import_module(f".{_mod}", __name__)
+    except ModuleNotFoundError as _e:
+        # tolerate only "module not built yet", never a broken module
+        if _e.name != f"{__name__}.{_mod}":
+            raise
+
+if "initializer" in globals():
+    init = getattr(initializer, "init", initializer)  # noqa: F821
+if "kvstore" in globals():
+    kv = kvstore  # noqa: F821

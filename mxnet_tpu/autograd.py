@@ -1,0 +1,354 @@
+"""Autograd: tape-based reverse-mode differentiation for the eager path.
+
+Reference surface: ``python/mxnet/autograd.py`` (record/pause scopes,
+``backward``, ``grad``, custom ``Function``) backed by ``src/imperative/``
+(``Imperative::RecordOp`` builds an nnvm tape; ``Imperative::Backward``
+builds + runs the gradient graph in ONE call — SURVEY.md 3.2).
+
+TPU-native redesign: each recorded tape node holds the pure JAX function of
+the op it recorded.  ``backward()`` walks the tape once in reverse
+topological order, obtaining per-node cotangents with ``jax.vjp`` — so the
+backward of a node is itself XLA-compiled, and the whole backward remains a
+single Python-level pass (no per-op ABI crossings, matching the reference's
+one-call design).  The hybridized path does not use this tape at all: it
+differentiates the traced program with ``jax.grad`` (see gluon/block.py).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "set_recording", "set_training", "backward",
+           "grad", "get_symbol", "Function", "mark_variables"]
+
+
+class _AGState(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+
+
+_STATE = _AGState()
+
+
+def is_recording() -> bool:
+    return _STATE.recording
+
+
+def is_training() -> bool:
+    return _STATE.training
+
+
+def set_recording(flag: bool) -> bool:
+    old, _STATE.recording = _STATE.recording, flag
+    return old
+
+
+def set_training(flag: bool) -> bool:
+    old, _STATE.training = _STATE.training, flag
+    return old
+
+
+class _RecordScope:
+    def __init__(self, recording: Optional[bool], training: Optional[bool]):
+        self._rec = recording
+        self._train = training
+        self._old = None
+
+    def __enter__(self):
+        self._old = (_STATE.recording, _STATE.training)
+        if self._rec is not None:
+            _STATE.recording = self._rec
+        if self._train is not None:
+            _STATE.training = self._train
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.recording, _STATE.training = self._old
+        return False
+
+
+def record(train_mode: bool = True) -> _RecordScope:
+    """``with autograd.record():`` — turn on tape recording."""
+    return _RecordScope(True, train_mode)
+
+
+def pause(train_mode: bool = False) -> _RecordScope:
+    """``with autograd.pause():`` — suspend recording inside record()."""
+    return _RecordScope(False, train_mode)
+
+
+def train_mode() -> _RecordScope:
+    return _RecordScope(None, True)
+
+
+def predict_mode() -> _RecordScope:
+    return _RecordScope(None, False)
+
+
+# ---------------------------------------------------------------------------
+# Tape
+# ---------------------------------------------------------------------------
+
+class TapeNode:
+    """One recorded op invocation (reference: nnvm node + AGInfo).
+
+    Holds the op's pure JAX callable and its *raw* input values so that
+    ``jax.vjp`` can re-linearize at backward time.  ``input_entries`` are
+    (TapeNode|None, output_index, NDArray) triples linking to producers.
+    """
+
+    __slots__ = ("fn", "input_entries", "n_outputs", "out_grads", "name",
+                 "_pending", "custom_backward")
+
+    def __init__(self, fn: Callable, input_entries, n_outputs: int,
+                 name: str = "", custom_backward: Optional[Callable] = None):
+        self.fn = fn
+        self.input_entries = input_entries
+        self.n_outputs = n_outputs
+        self.out_grads: List = [None] * n_outputs
+        self.name = name
+        self.custom_backward = custom_backward
+        self._pending = 0
+
+
+def _accumulate(slot_list, idx, value):
+    if slot_list[idx] is None:
+        slot_list[idx] = value
+    else:
+        slot_list[idx] = slot_list[idx] + value
+
+
+def _topo_order(root_nodes) -> List[TapeNode]:
+    """Reverse-topological order over the tape reachable from root nodes."""
+    order: List[TapeNode] = []
+    visited = set()
+
+    def visit(node):
+        stack = [(node, False)]
+        while stack:
+            n, processed = stack.pop()
+            if processed:
+                order.append(n)
+                continue
+            if id(n) in visited:
+                continue
+            visited.add(id(n))
+            stack.append((n, True))
+            for prod, _, _ in n.input_entries:
+                if prod is not None and id(prod) not in visited:
+                    stack.append((prod, False))
+
+    for n in root_nodes:
+        visit(n)
+    return order[::-1]  # producers last -> reverse gives consumers first
+
+
+def backward(heads, head_grads=None, retain_graph: bool = False,
+             train_mode: bool = True):
+    """Compute gradients of ``heads`` w.r.t. all arrays that were
+    ``attach_grad()``-ed (reference: MXAutogradBackwardEx ->
+    Imperative::Backward).  Grad arrays are written into ``arr.grad``
+    respecting each array's ``grad_req`` ('write' or 'add')."""
+    from .ndarray import NDArray, array as _mkarray
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif not isinstance(head_grads, (list, tuple)):
+        head_grads = [head_grads]
+
+    # Per-pass leaf accumulation: an array used by several ops (or twice by
+    # one op) must SUM its partials within this backward; grad_req 'write'
+    # vs 'add' only governs behavior across separate backward() calls.
+    leaf_acc = {}
+
+    def _leaf_contribute(arr, g):
+        key = id(arr)
+        if key in leaf_acc:
+            leaf_acc[key] = (arr, leaf_acc[key][1] + g)
+        else:
+            leaf_acc[key] = (arr, g)
+
+    root_nodes = []
+    for h, hg in zip(heads, head_grads):
+        info = h._autograd_node
+        if info is None:
+            if h._grad_req == "null":
+                raise MXNetError(
+                    "cannot differentiate a head that was not computed "
+                    "inside autograd.record()")
+            # head IS a leaf variable: d head / d head = ones
+            g = jax.numpy.ones_like(h._data) if hg is None else hg._data
+            _leaf_contribute(h, g)
+            continue
+        node, out_idx = info
+        g = jax.numpy.ones_like(h._data) if hg is None else hg._data
+        _accumulate(node.out_grads, out_idx, g)
+        root_nodes.append(node)
+
+    for node in _topo_order(root_nodes):
+        if all(g is None for g in node.out_grads):
+            continue
+        out_grads = [
+            g if g is not None else jax.numpy.zeros(av.shape, av.dtype)
+            for g, av in zip(node.out_grads, _node_out_avals(node))
+        ]
+        in_primals = [e[2]._data for e in node.input_entries]
+        if node.custom_backward is not None:
+            in_grads = node.custom_backward(out_grads, in_primals)
+        else:
+            _, vjp_fn = jax.vjp(node.fn, *in_primals)
+            cot = tuple(out_grads) if node.n_outputs > 1 else out_grads[0]
+            in_grads = vjp_fn(cot)
+        for (prod, oidx, arr), g in zip(node.input_entries, in_grads):
+            if g is None:
+                continue
+            if prod is not None:
+                _accumulate(prod.out_grads, oidx, g)
+            if arr._grad_req != "null" and arr._grad is not None:
+                _leaf_contribute(arr, g)
+        if not retain_graph:
+            node.out_grads = [None] * node.n_outputs
+
+    for arr, g in leaf_acc.values():
+        _write_grad(arr, g)
+
+    # Drop tape references on heads so memory frees (reference clears AGInfo)
+    if not retain_graph:
+        for h in heads:
+            h._autograd_node = None
+
+
+def _node_out_avals(node: TapeNode):
+    """Output abstract values, recovered lazily from live output refs or by
+    abstract eval of the node fn."""
+    in_avals = [jax.ShapeDtypeStruct(e[2].shape, e[2]._data.dtype)
+                for e in node.input_entries]
+    outs = jax.eval_shape(node.fn, *in_avals)
+    if node.n_outputs == 1 and not isinstance(outs, (tuple, list)):
+        return [outs]
+    return list(outs)
+
+
+def _write_grad(arr, g):
+    import jax.numpy as jnp
+    if arr._grad is None:
+        return
+    if arr._grad_req == "add":
+        arr._grad._set_data(arr._grad._data + g.astype(arr._grad._data.dtype))
+    else:
+        arr._grad._set_data(jnp.asarray(g, dtype=arr._grad._data.dtype))
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Return gradients of heads w.r.t. variables without touching ``.grad``
+    buffers (reference: autograd.grad).  ``create_graph`` is accepted for
+    API parity; higher-order via the tape is not supported — use the
+    hybridized path (jax.grad composition) for that."""
+    from .ndarray import NDArray
+    if isinstance(variables, NDArray):
+        variables = [variables]
+        single = True
+    else:
+        single = False
+    saved = [(v._grad, v._grad_req) for v in variables]
+    for v in variables:
+        v.attach_grad()
+    try:
+        backward(heads, head_grads,
+                 retain_graph=bool(retain_graph) or create_graph,
+                 train_mode=train_mode)
+        outs = [v.grad.copy() for v in variables]
+    finally:
+        for v, (g, req) in zip(variables, saved):
+            v._grad, v._grad_req = g, req
+    return outs[0] if single else outs
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Reference: autograd.mark_variables — attach explicit grad buffers."""
+    from .ndarray import NDArray
+    if isinstance(variables, NDArray):
+        variables, gradients = [variables], [gradients]
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._grad = g
+        v._grad_req = req
+
+
+def get_symbol(x):
+    """Reference: autograd.get_symbol — recover the symbolic graph of a
+    recorded computation.  Returns a Symbol replaying the tape."""
+    raise MXNetError("get_symbol: use HybridBlock tracing / mx.sym instead "
+                     "(tape-to-symbol export is not supported)")
+
+
+class Function:
+    """Custom differentiable function (reference: mx.autograd.Function).
+
+    Subclass and implement ``forward(self, *inputs)`` and
+    ``backward(self, *output_grads)``; both operate on NDArrays.
+    """
+
+    def __init__(self):
+        self._saved = ()
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray import NDArray, array as _mkarray
+        from . import ndarray as nd
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (list, tuple))
+        outs = [outputs] if single else list(outputs)
+        if is_recording():
+            func = self
+
+            def custom_backward(out_grads, in_primals):
+                ograds = [NDArray(g) for g in out_grads]
+                with pause():
+                    igrads = func.backward(*ograds)
+                if not isinstance(igrads, (list, tuple)):
+                    igrads = [igrads]
+                return [g._data if g is not None else None for g in igrads]
+
+            entries = []
+            for a in inputs:
+                prod = a._autograd_node
+                if prod is None:
+                    entries.append((None, 0, a))
+                else:
+                    entries.append((prod[0], prod[1], a))
+            node = TapeNode(fn=None, input_entries=entries,
+                            n_outputs=len(outs),
+                            name=type(self).__name__,
+                            custom_backward=custom_backward)
+            # fn=None means _node_out_avals can't eval_shape; stash avals.
+            avals = [jax.ShapeDtypeStruct(o.shape, o._data.dtype) for o in outs]
+            node.fn = lambda *xs: tuple(
+                jax.numpy.zeros(a.shape, a.dtype) for a in avals)
+            for i, o in enumerate(outs):
+                o._autograd_node = (node, i)
+        return outs[0] if single else outs

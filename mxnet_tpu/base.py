@@ -1,0 +1,168 @@
+"""Foundation utilities: errors, registries, environment knobs.
+
+TPU-native re-design of the roles played by ``dmlc-core`` in the reference
+(``3rdparty/dmlc-core`` -> ``dmlc::Registry``, ``dmlc::GetEnv``, ``LOG/CHECK``)
+and ``python/mxnet/base.py`` (error marshalling).  There is no C ABI boundary
+for Python-level errors here -- exceptions propagate natively -- but the
+public surface (``MXNetError``, registries, env-var config) matches the
+reference semantics.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = [
+    "MXNetError",
+    "NotImplementedForSymbol",
+    "Registry",
+    "get_env",
+    "env_truthy",
+    "string_types",
+    "numeric_types",
+    "integer_types",
+]
+
+logging.basicConfig()
+_LOGGER = logging.getLogger("mxnet_tpu")
+
+string_types = (str,)
+numeric_types = (float, int)
+integer_types = (int,)
+
+
+class MXNetError(RuntimeError):
+    """Default error type raised by the framework.
+
+    Mirrors ``mxnet.base.MXNetError`` (reference: python/mxnet/base.py).
+    In the reference this wraps errors marshalled across the C ABI via
+    ``MXGetLastError``; here it is raised directly.
+    """
+
+
+class NotImplementedForSymbol(MXNetError):
+    """Raised when an NDArray-only operation is attempted on a Symbol."""
+
+    def __init__(self, function, alias=None, *args):
+        super().__init__()
+        self.function = function.__name__ if callable(function) else str(function)
+        self.alias = alias
+        self.args_ = [str(type(a)) for a in args]
+
+    def __str__(self):
+        msg = f"Function {self.function}"
+        if self.alias:
+            msg += f" (alias {self.alias})"
+        if self.args_:
+            msg += " with arguments (" + ",".join(self.args_) + ")"
+        msg += " is not supported for Symbol and only available in NDArray."
+        return msg
+
+
+class Registry:
+    """Generic name -> object registry.
+
+    TPU-native equivalent of ``dmlc::Registry<T>`` (reference:
+    3rdparty/dmlc-core/include/dmlc/registry.h), which backs the op registry,
+    data-iterator registry, kvstore registry, etc. in the reference.
+    """
+
+    _registries: Dict[str, "Registry"] = {}
+
+    def __init__(self, name: str):
+        self.name = name
+        self._entries: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+        Registry._registries[name] = self
+
+    @classmethod
+    def get(cls, name: str) -> "Registry":
+        if name not in cls._registries:
+            Registry(name)
+        return cls._registries[name]
+
+    def register(self, name: str, obj: Any = None, override: bool = False):
+        """Register ``obj`` under ``name``; usable as a decorator."""
+        if obj is None:
+            def _decorator(fn):
+                self.register(name, fn, override=override)
+                return fn
+            return _decorator
+        with self._lock:
+            if name in self._entries and not override:
+                raise MXNetError(
+                    f"'{name}' already registered in registry '{self.name}'")
+            self._entries[name] = obj
+        return obj
+
+    def find(self, name: str) -> Optional[Any]:
+        return self._entries.get(name)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._entries
+
+    def __getitem__(self, name: str) -> Any:
+        if name not in self._entries:
+            raise MXNetError(
+                f"'{name}' is not registered in registry '{self.name}'. "
+                f"Known: {sorted(self._entries)[:20]}...")
+        return self._entries[name]
+
+    def list_names(self) -> List[str]:
+        return sorted(self._entries)
+
+    def items(self):
+        return self._entries.items()
+
+
+# ---------------------------------------------------------------------------
+# Environment knob registry.
+#
+# The reference scatters ~100 `dmlc::GetEnv` calls across use sites (SURVEY.md
+# 5.6); here every knob is declared once so `mxnet_tpu.util.list_env_vars()`
+# can document them all.
+# ---------------------------------------------------------------------------
+_ENV_REGISTRY: Dict[str, tuple] = {}
+
+
+def declare_env(name: str, default, doc: str = ""):
+    _ENV_REGISTRY[name] = (default, doc)
+    return name
+
+
+def list_env_vars() -> Dict[str, tuple]:
+    return dict(_ENV_REGISTRY)
+
+
+def get_env(name: str, default=None, typ: Callable = None):
+    """Read an environment knob (equivalent of ``dmlc::GetEnv``)."""
+    if name in _ENV_REGISTRY and default is None:
+        default = _ENV_REGISTRY[name][0]
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    if typ is None and default is not None:
+        typ = type(default)
+    if typ is bool:
+        return raw not in ("0", "false", "False", "")
+    return typ(raw) if typ else raw
+
+
+def env_truthy(name: str, default: bool = False) -> bool:
+    return get_env(name, default, bool)
+
+
+# Core knobs (kept name-compatible with the reference where one exists).
+declare_env("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice",
+            "Execution engine: 'NaiveEngine' forces synchronous op execution "
+            "(debug/bisection mode); default is async (XLA/PJRT async dispatch).")
+declare_env("MXNET_SEED", None, "Global RNG seed fixed at import if set.")
+declare_env("MXNET_EXEC_BULK_EXEC_INFERENCE", 1,
+            "Allow bulking consecutive eager ops (jit fusion of op segments).")
+declare_env("MXNET_KVSTORE_BIGARRAY_BOUND", 1000000,
+            "Arrays above this many elements get their own allreduce bucket.")
+declare_env("MXNET_PROFILER_AUTOSTART", 0, "Start profiler at import.")
+declare_env("MXNET_EXCEPTION_VERBOSE", 0, "Verbose async error traces.")
+declare_env("MXNET_DEFAULT_DTYPE", "float32", "Default dtype for new arrays.")

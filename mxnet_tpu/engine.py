@@ -1,0 +1,144 @@
+"""Execution-engine semantics over XLA/PJRT async dispatch.
+
+Reference: ``src/engine/`` (``ThreadedEnginePerDevice``, ``NaiveEngine``,
+``ThreadedVar`` version counting, async error propagation — SURVEY.md 2.1,
+5.5).  TPU-native redesign: PJRT already executes asynchronously and JAX
+arrays are futures, so the heavy dependency scheduler is *not* rebuilt.
+What survives is the reference's **semantic contract**:
+
+- every NDArray owns a version-counted variable (write bumps the version —
+  used by autograd staleness checks and the profiler);
+- ``wait_to_read`` / ``waitall`` sync points;
+- async errors are captured and re-raised at the next sync point on the
+  dependent array (reference: exception stored on ThreadedVar, rethrown at
+  ``WaitToRead`` — src/engine/threaded_engine.cc semantics);
+- ``MXNET_ENGINE_TYPE=NaiveEngine`` forces synchronous execution after every
+  op for debugging/bisection, exactly like the reference env knob.
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+
+from .base import get_env
+
+__all__ = ["Engine", "engine", "waitall", "is_naive", "set_bulk_size",
+           "bulk", "Var"]
+
+
+class Var:
+    """Version-counted engine variable attached to each NDArray.
+
+    Reference: ``ThreadedVar`` in src/engine/threaded_engine.h — there it
+    carries pending reader/writer queues; here XLA orders execution, so the
+    var carries the *version* (for autograd/cache invalidation) and any
+    deferred exception (for async error propagation).
+    """
+
+    __slots__ = ("version", "exc", "__weakref__")
+
+    _counter_lock = threading.Lock()
+
+    def __init__(self):
+        self.version = 0
+        self.exc = None
+
+    def bump(self):
+        self.version += 1
+
+    def set_exception(self, exc: BaseException):
+        self.exc = exc
+
+    def check(self):
+        if self.exc is not None:
+            exc, self.exc = self.exc, None
+            raise exc
+
+
+class Engine:
+    """Process-wide engine singleton (reference: Engine::Get())."""
+
+    _instance = None
+
+    def __init__(self):
+        # id-keyed so NDArray.__eq__ (an elementwise op, reference
+        # semantics) is never invoked by container bookkeeping
+        self._live = weakref.WeakValueDictionary()
+        self._bulk_size = int(get_env("MXNET_EXEC_BULK_EXEC_INFERENCE", 1))
+        self._lock = threading.Lock()
+
+    @classmethod
+    def get(cls) -> "Engine":
+        if cls._instance is None:
+            cls._instance = Engine()
+        return cls._instance
+
+    # -- tracking ----------------------------------------------------------
+    def track(self, arr):
+        """Register a live NDArray so waitall() can block on it."""
+        with self._lock:
+            self._live[id(arr)] = arr
+
+    def wait_for_all(self):
+        """Block until all tracked arrays are ready (reference:
+        Engine::WaitForAll / mx.nd.waitall)."""
+        for arr in list(self._live.values()):
+            try:
+                arr.wait_to_read()
+            except Exception:
+                # waitall re-raises the *first* pending error, like the
+                # reference rethrow-at-sync-point contract.
+                raise
+
+    def wait_for_var(self, arr):
+        arr.wait_to_read()
+
+    # -- modes -------------------------------------------------------------
+    @property
+    def is_naive(self) -> bool:
+        return get_env("MXNET_ENGINE_TYPE") == "NaiveEngine"
+
+    def set_bulk_size(self, size: int) -> int:
+        """Reference: mx.engine.set_bulk_size. Here it caps how many eager
+        ops the bulking context may fuse into one jit segment."""
+        old, self._bulk_size = self._bulk_size, int(size)
+        return old
+
+    @property
+    def bulk_size(self) -> int:
+        return self._bulk_size
+
+
+def engine() -> Engine:
+    return Engine.get()
+
+
+def waitall():
+    Engine.get().wait_for_all()
+
+
+def is_naive() -> bool:
+    return Engine.get().is_naive
+
+
+def set_bulk_size(size: int) -> int:
+    return Engine.get().set_bulk_size(size)
+
+
+class bulk:
+    """Context manager hinting that ops inside may be fused (reference:
+    mx.engine.bulk / engine bulk-exec mode).  Execution remains correct
+    without fusion; this is a performance hint consumed by the imperative
+    dispatcher."""
+
+    def __init__(self, size: int):
+        self.size = size
+        self._old = None
+
+    def __enter__(self):
+        self._old = Engine.get().set_bulk_size(self.size)
+        return self
+
+    def __exit__(self, *exc):
+        Engine.get().set_bulk_size(self._old)
+        return False

@@ -1,0 +1,260 @@
+"""Weight initializers (reference: python/mxnet/initializer.py).
+
+Same registry + string-alias UX as the reference (``init="xavier"``), drawing
+from the framework RNG so ``mx.random.seed`` controls initialization.
+"""
+from __future__ import annotations
+
+import json
+import math
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import Registry, MXNetError
+
+__all__ = ["Initializer", "Uniform", "Normal", "Constant", "Zero", "One",
+           "Xavier", "MSRAPrelu", "Orthogonal", "LSTMBias", "Bilinear",
+           "create", "register"]
+
+_REG = Registry("initializer")
+
+
+def register(klass):
+    _REG.register(klass.__name__.lower(), klass, override=True)
+    return klass
+
+
+class InitDesc(str):
+    """Parameter-name descriptor carrying attrs (reference: InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        obj = super().__new__(cls, name)
+        obj.attrs = attrs or {}
+        obj.global_init = global_init
+        return obj
+
+
+class Initializer:
+    """Base initializer; callable on (name, NDArray)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        return json.dumps([type(self).__name__.lower(), self._kwargs])
+
+    def __call__(self, name, arr):
+        from .ndarray import NDArray
+        if not isinstance(name, str):
+            name, arr = getattr(name, "name", str(name)), name
+        name_l = name.lower() if isinstance(name, str) else ""
+        if name_l.endswith("gamma"):
+            self._init_one(arr)
+        elif name_l.endswith("beta") or name_l.endswith("bias"):
+            self._init_zero(arr)
+        elif "running_mean" in name_l or "moving_mean" in name_l:
+            self._init_zero(arr)
+        elif "running_var" in name_l or "moving_var" in name_l:
+            self._init_one(arr)
+        else:
+            self._init_weight(name, arr)
+
+    def init_weight(self, name, arr):
+        self._init_weight(name, arr)
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError
+
+    @staticmethod
+    def _init_zero(arr):
+        arr._set_data(jnp.zeros_like(arr._data))
+
+    @staticmethod
+    def _init_one(arr):
+        arr._set_data(jnp.ones_like(arr._data))
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, name, arr):
+        self._init_zero(arr)
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, name, arr):
+        self._init_one(arr)
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, arr):
+        arr._set_data(jnp.full_like(arr._data, self.value))
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        from . import random as mxrand
+        k = mxrand.next_key()
+        arr._set_data(jax.random.uniform(
+            k, arr.shape, minval=-self.scale, maxval=self.scale,
+            dtype=arr._data.dtype))
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        from . import random as mxrand
+        k = mxrand.next_key()
+        arr._set_data(self.sigma * jax.random.normal(
+            k, arr.shape, dtype=arr._data.dtype))
+
+
+@register
+class Xavier(Initializer):
+    """Xavier/Glorot (reference: initializer.py Xavier)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        from . import random as mxrand
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            fan_in = fan_out = shape[0] if shape else 1
+        else:
+            if len(shape) > 2:
+                hw_scale = float(np.prod(shape[2:]))
+            fan_in = shape[1] * hw_scale
+            fan_out = shape[0] * hw_scale
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        else:
+            factor = fan_out
+        scale = math.sqrt(self.magnitude / factor)
+        k = mxrand.next_key()
+        if self.rnd_type == "uniform":
+            arr._set_data(jax.random.uniform(
+                k, shape, minval=-scale, maxval=scale,
+                dtype=arr._data.dtype))
+        else:
+            arr._set_data(scale * jax.random.normal(
+                k, shape, dtype=arr._data.dtype))
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        from . import random as mxrand
+        shape = arr.shape
+        flat = (shape[0], int(np.prod(shape[1:])))
+        a = jax.random.normal(mxrand.next_key(), flat)
+        q, r = jnp.linalg.qr(a if flat[0] <= flat[1] else a.T)
+        q = q if flat[0] <= flat[1] else q.T
+        q = q * jnp.sign(jnp.diagonal(r))[..., None] if q.shape[0] == r.shape[0] else q
+        arr._set_data((self.scale * q[:flat[0], :flat[1]]).reshape(shape)
+                      .astype(arr._data.dtype))
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias = 1 (reference: initializer.py LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        b = np.zeros(arr.shape, dtype=np.float32)
+        n = arr.shape[0] // 4
+        b[n:2 * n] = self.forget_bias  # gate order i, f, g, o
+        arr._set_data(jnp.asarray(b, dtype=arr._data.dtype))
+
+
+@register
+class Bilinear(Initializer):
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        weight = np.zeros(shape, dtype=np.float32)
+        f = math.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight.flat[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr._set_data(jnp.asarray(weight, dtype=arr._data.dtype))
+
+
+class Mixed:
+    """Per-pattern initializer dispatch (reference: Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        import re
+        self.map = [(re.compile(p), i) for p, i in zip(patterns, initializers)]
+
+    def __call__(self, name, arr):
+        for prog, init in self.map:
+            if prog.match(name):
+                init(name, arr)
+                return
+        raise MXNetError(f"parameter {name} did not match any pattern")
+
+
+def create(init, **kwargs):
+    if isinstance(init, Initializer):
+        return init
+    if callable(init):
+        return init
+    if isinstance(init, str):
+        klass = _REG.find(init.lower())
+        if klass is None:
+            raise MXNetError(f"unknown initializer {init!r}; "
+                             f"known: {_REG.list_names()}")
+        return klass(**kwargs)
+    raise MXNetError(f"cannot create initializer from {init!r}")
+
+
+# expose `mx.init.*` namespace alias
+init = types.ModuleType(__name__ + ".init")
+for _n in __all__:
+    setattr(init, _n, globals()[_n])
+init.InitDesc = InitDesc
+init.Mixed = Mixed
+import sys as _sys
+
+_sys.modules[init.__name__] = init

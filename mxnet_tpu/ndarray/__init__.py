@@ -1,0 +1,165 @@
+"""``mx.nd`` namespace: NDArray + generated op functions + creation API.
+
+Reference: ``python/mxnet/ndarray/`` — at import, op functions are
+*generated* from the registry (the MXListAllOpNames / _make_ndarray_function
+codegen pattern, SURVEY.md 2.2).
+"""
+from __future__ import annotations
+
+import sys
+import types
+
+import numpy as _np
+import jax.numpy as _jnp
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from ..engine import waitall
+from .ndarray import NDArray
+from ..ops import registry as _reg
+
+# ---------------------------------------------------------------------------
+# Generated op namespace (mx.nd.op.* and re-exported as mx.nd.*)
+# ---------------------------------------------------------------------------
+
+op = types.ModuleType(__name__ + ".op")
+op.__doc__ = "Auto-generated operator functions (one per registered op)."
+for _name in _reg.list_ops():
+    setattr(op, _name, _reg.make_frontend(_reg.get_op(_name)))
+sys.modules[op.__name__] = op
+
+_EXCLUDE = {"sum", "max", "min", "abs", "round"}  # need wrapper care below
+
+
+def _reexport():
+    g = globals()
+    for _name in _reg.list_ops():
+        if _name not in g:
+            g[_name] = getattr(op, _name)
+
+
+def invoke_by_name(name, inputs, kwargs, out=None):
+    return _reg.invoke(_reg.get_op(name), inputs, kwargs, out=out)
+
+
+# ---------------------------------------------------------------------------
+# Creation API (reference: python/mxnet/ndarray/utils.py + ndarray.py)
+# ---------------------------------------------------------------------------
+
+def array(source_array, ctx: Context = None, dtype=None) -> NDArray:
+    if isinstance(source_array, NDArray):
+        src = source_array._data
+    elif isinstance(source_array, _np.ndarray):
+        src = source_array  # keep explicit numpy dtype (reference behavior)
+    else:
+        src = _np.asarray(source_array)
+        if dtype is None and src.dtype in (_np.float64, _np.int64,
+                                           _np.int32):
+            dtype = "float32"  # reference: python lists default to float32
+    return NDArray(src, ctx=ctx, dtype=dtype)
+
+
+def empty(shape, ctx=None, dtype="float32"):
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def zeros(shape, ctx=None, dtype="float32", **kwargs):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(_jnp.zeros(shape, dtype=_jnp.dtype(dtype)), ctx=ctx)
+
+
+def ones(shape, ctx=None, dtype="float32", **kwargs):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(_jnp.ones(shape, dtype=_jnp.dtype(dtype)), ctx=ctx)
+
+
+def full(shape, val, ctx=None, dtype="float32", out=None):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(_jnp.full(shape, val, dtype=_jnp.dtype(dtype)), ctx=ctx)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32"):
+    out = _jnp.arange(start, stop, step, dtype=_jnp.dtype(dtype))
+    if repeat > 1:
+        out = _jnp.repeat(out, repeat)
+    return NDArray(out, ctx=ctx)
+
+
+def linspace(start, stop, num, endpoint=True, ctx=None, dtype="float32"):
+    return NDArray(_jnp.linspace(start, stop, num, endpoint=endpoint,
+                                 dtype=_jnp.dtype(dtype)), ctx=ctx)
+
+
+def eye(N, M=0, k=0, ctx=None, dtype="float32"):
+    return NDArray(_jnp.eye(N, M if M else None, k,
+                            dtype=_jnp.dtype(dtype)), ctx=ctx)
+
+
+def zeros_like(arr, **kw):
+    return NDArray(_jnp.zeros_like(arr._data))
+
+
+def ones_like(arr, **kw):
+    return NDArray(_jnp.ones_like(arr._data))
+
+
+def moveaxis(arr, source, destination):
+    return NDArray(_jnp.moveaxis(arr._data, source, destination))
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    return op.concat(*arrays, dim=axis)
+
+
+def stack_arrays(arrays, axis=0):
+    return op.stack(*arrays, axis=axis)
+
+
+def add_n(*arrays):
+    out = arrays[0]
+    for a in arrays[1:]:
+        out = out + a
+    return out
+
+
+ElementWiseSum = add_n
+
+
+# ---------------------------------------------------------------------------
+# Serialization (reference: MXNDArraySave/Load — the .params file format).
+# Container format here is NPZ (portable, inspectable); the save/load API
+# contract (dict-of-name->array or list) matches the reference.
+# ---------------------------------------------------------------------------
+
+def save(fname, data):
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        arrays = {k: v.asnumpy() for k, v in data.items()}
+        _np.savez(fname, __mx_format__="dict", **arrays)
+    elif isinstance(data, (list, tuple)):
+        arrays = {f"__arr_{i}": v.asnumpy() for i, v in enumerate(data)}
+        _np.savez(fname, __mx_format__="list", **arrays)
+    else:
+        raise MXNetError("save: data must be NDArray, list or dict")
+
+
+def load(fname):
+    with _np.load(fname, allow_pickle=False) as z:
+        fmt = str(z["__mx_format__"]) if "__mx_format__" in z else "dict"
+        if fmt == "list":
+            n = len([k for k in z.files if k.startswith("__arr_")])
+            return [array(z[f"__arr_{i}"]) for i in range(n)]
+        return {k: array(z[k]) for k in z.files if k != "__mx_format__"}
+
+
+# random namespace: mx.nd.random.uniform etc.
+from .. import random as random  # noqa: E402
+
+_reexport()
+
+# NumPy-ish aliases the reference exposes at nd level
+waitall = waitall  # re-export

@@ -1,0 +1,532 @@
+"""NDArray: the framework's value type, over jax.Array.
+
+Reference: ``include/mxnet/ndarray.h`` + ``src/ndarray/ndarray.cc`` and the
+Python class in ``python/mxnet/ndarray/ndarray.py`` (SURVEY.md 2.1, 3.1).
+
+TPU-native redesign: a ``jax.Array`` IS already the lazy, asynchronous,
+engine-scheduled buffer the reference hand-built (PJRT dispatch is async;
+the array is a future).  What this class adds on top:
+
+- the engine **Var** (version counter + deferred-exception slot) giving the
+  reference's ``WaitToRead`` / async-error-propagation contract;
+- autograd hooks (``attach_grad``, ``.grad``, ``backward`` — tape links);
+- the reference API surface: context placement, ``asnumpy``, rich indexing,
+  arithmetic dunders routed through the op registry (so autograd records
+  them), shape-method sugar, and save/load.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError, get_env
+from ..context import Context, current_context
+from ..engine import Var, engine
+
+__all__ = ["NDArray"]
+
+_DTYPE_ALIASES = {
+    "float32": np.float32, "float64": np.float64, "float16": np.float16,
+    "bfloat16": jnp.bfloat16, "int8": np.int8, "uint8": np.uint8,
+    "int32": np.int32, "int64": np.int64, "bool": np.bool_,
+}
+
+
+def _resolve_dtype(dtype):
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        return jnp.dtype(_DTYPE_ALIASES.get(dtype, dtype))
+    return jnp.dtype(dtype)
+
+
+class NDArray:
+    """Multi-dimensional array on a device (see module docstring)."""
+
+    __slots__ = ("_data", "_ctx", "_var", "_grad", "_grad_req",
+                 "_autograd_node", "__weakref__")
+
+    # NumPy interop precedence so ndarray + NDArray defers to us
+    __array_priority__ = 1000.0
+
+    def __init__(self, data, ctx: Context = None, dtype=None):
+        dtype = _resolve_dtype(dtype)
+        if isinstance(data, NDArray):
+            data = data._data
+        if not isinstance(data, jax.Array):
+            data = jnp.asarray(data, dtype=dtype)
+        elif dtype is not None and data.dtype != dtype:
+            data = data.astype(dtype)
+        if ctx is not None:
+            dev = ctx.jax_device()
+            if data.device != dev:
+                data = jax.device_put(data, dev)
+        self._data = data
+        self._ctx = ctx
+        self._var = Var()
+        self._grad = None
+        self._grad_req = "null"
+        self._autograd_node = None
+        engine().track(self)
+
+    # ------------------------------------------------------------------ data
+    @property
+    def data_jax(self):
+        """The underlying jax.Array (TPU-build extension point)."""
+        return self._data
+
+    def _set_data(self, new_data):
+        """In-place value replacement; bumps the engine var version
+        (reference: write op on ThreadedVar)."""
+        self._data = new_data
+        self._var.bump()
+
+    def _in_grad_graph(self):
+        return self._autograd_node is not None or (
+            self._grad is not None and self._grad_req != "null")
+
+    # ------------------------------------------------------------- properties
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._data.dtype) if self._data.dtype != jnp.bfloat16 \
+            else self._data.dtype
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self) -> Context:
+        if self._ctx is not None:
+            return self._ctx
+        dev = self._data.device
+        plat = getattr(dev, "platform", "cpu")
+        if plat == "cpu":
+            return Context("cpu", dev.id)
+        accel = [d for d in jax.devices() if d.platform != "cpu"]
+        try:
+            idx = accel.index(dev)
+        except ValueError:
+            idx = 0
+        return Context("tpu", idx)
+
+    ctx = context
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    @property
+    def stype(self):
+        return "default"
+
+    @property
+    def grad(self):
+        return self._grad
+
+    # --------------------------------------------------------------- engine
+    def wait_to_read(self):
+        """Block until computed; re-raise any deferred async error
+        (reference: NDArray::WaitToRead + exception-on-var rethrow)."""
+        self._var.check()
+        try:
+            self._data.block_until_ready()
+        except Exception as e:
+            self._var.set_exception(e)
+            raise
+        return self
+
+    wait_to_write = wait_to_read
+
+    # -------------------------------------------------------------- convert
+    def asnumpy(self) -> np.ndarray:
+        self.wait_to_read()
+        return np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise MXNetError("The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def item(self):
+        return self.asscalar()
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise MXNetError("ambiguous truth value of multi-element NDArray")
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def astype(self, dtype, copy=True):
+        dt = _resolve_dtype(dtype)
+        if not copy and self._data.dtype == dt:
+            return self
+        return self._apply_unary(lambda x: x.astype(dt), "astype")
+
+    # ------------------------------------------------------------- placement
+    def as_in_context(self, ctx: Context) -> "NDArray":
+        if ctx == self.context:
+            return self
+        return NDArray(jax.device_put(self._data, ctx.jax_device()), ctx=ctx)
+
+    as_in_ctx = as_in_context
+
+    def copyto(self, other):
+        """Copy into another NDArray (writes it) or onto a Context
+        (reference: NDArray::CopyFromTo / ndarray.py copyto)."""
+        if isinstance(other, Context):
+            return NDArray(jax.device_put(self._data, other.jax_device()),
+                           ctx=other)
+        if isinstance(other, NDArray):
+            other._set_data(jax.device_put(
+                self._data.astype(other._data.dtype),
+                other._data.device))
+            return other
+        raise MXNetError(f"copyto: unsupported target {type(other)}")
+
+    def copy(self) -> "NDArray":
+        return NDArray(self._data, ctx=self._ctx)
+
+    def detach(self) -> "NDArray":
+        out = NDArray(self._data, ctx=self._ctx)
+        return out
+
+    # -------------------------------------------------------------- autograd
+    def attach_grad(self, grad_req: str = "write", stype=None):
+        from .. import autograd
+        with autograd.pause():
+            self._grad = NDArray(jnp.zeros_like(self._data), ctx=self._ctx)
+        self._grad_req = grad_req
+        # attaching grad marks this array a leaf variable: cut upstream tape
+        self._autograd_node = None
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        from .. import autograd
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    def zero_grad(self):
+        if self._grad is not None:
+            self._grad._set_data(jnp.zeros_like(self._grad._data))
+
+    # ------------------------------------------------------- generic dispatch
+    def _apply_unary(self, fn, name):
+        from ..ops.registry import OpDef, invoke
+        op = OpDef(name, fn, 1, 1, True)
+        return invoke(op, [self], {})
+
+    def _op(self, name, *args, **kwargs):
+        from . import op as _opmod
+        return getattr(_opmod, name)(self, *args, **kwargs)
+
+    # ------------------------------------------------------------ arithmetic
+    def _binary(self, opname, scalar_opname, other, reverse=False):
+        from . import op as _opmod
+        if isinstance(other, NDArray):
+            a, b = (other, self) if reverse else (self, other)
+            return getattr(_opmod, opname)(a, b)
+        if isinstance(other, (int, float, bool, np.number)):
+            return getattr(_opmod, scalar_opname)(self, scalar=float(other))
+        if isinstance(other, (np.ndarray, list, tuple)):
+            other = NDArray(jnp.asarray(other), ctx=self._ctx)
+            a, b = (other, self) if reverse else (self, other)
+            return getattr(_opmod, opname)(a, b)
+        return NotImplemented
+
+    def __add__(self, o):
+        return self._binary("broadcast_add", "_plus_scalar", o)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary("broadcast_sub", "_minus_scalar", o)
+
+    def __rsub__(self, o):
+        if isinstance(o, (int, float, np.number)):
+            return self._op("_rminus_scalar", scalar=float(o))
+        return self._binary("broadcast_sub", "_minus_scalar", o, reverse=True)
+
+    def __mul__(self, o):
+        return self._binary("broadcast_mul", "_mul_scalar", o)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary("broadcast_div", "_div_scalar", o)
+
+    def __rtruediv__(self, o):
+        if isinstance(o, (int, float, np.number)):
+            return self._op("_rdiv_scalar", scalar=float(o))
+        return self._binary("broadcast_div", "_div_scalar", o, reverse=True)
+
+    def __mod__(self, o):
+        return self._binary("broadcast_mod", "_mod_scalar", o)
+
+    def __rmod__(self, o):
+        if isinstance(o, (int, float, np.number)):
+            return self._op("_rmod_scalar", scalar=float(o))
+        return self._binary("broadcast_mod", "_mod_scalar", o, reverse=True)
+
+    def __pow__(self, o):
+        return self._binary("broadcast_power", "_power_scalar", o)
+
+    def __rpow__(self, o):
+        if isinstance(o, (int, float, np.number)):
+            return self._op("_rpower_scalar", scalar=float(o))
+        return NotImplemented
+
+    def __neg__(self):
+        return self._op("negative")
+
+    def __abs__(self):
+        return self._op("abs")
+
+    def __matmul__(self, o):
+        return self._op("dot", o)
+
+    def __eq__(self, o):
+        if o is None:
+            return False
+        return self._binary("broadcast_equal", "_equal_scalar", o)
+
+    def __ne__(self, o):
+        if o is None:
+            return True
+        return self._binary("broadcast_not_equal", "_not_equal_scalar", o)
+
+    def __gt__(self, o):
+        return self._binary("broadcast_greater", "_greater_scalar", o)
+
+    def __ge__(self, o):
+        return self._binary("broadcast_greater_equal",
+                            "_greater_equal_scalar", o)
+
+    def __lt__(self, o):
+        return self._binary("broadcast_lesser", "_lesser_scalar", o)
+
+    def __le__(self, o):
+        return self._binary("broadcast_lesser_equal",
+                            "_lesser_equal_scalar", o)
+
+    def __hash__(self):
+        return id(self)
+
+    # in-place forms (reference: += dispatches with out=self)
+    def __iadd__(self, o):
+        res = self.__add__(o)
+        self._set_data(res._data)
+        self._autograd_node = res._autograd_node
+        return self
+
+    def __isub__(self, o):
+        res = self.__sub__(o)
+        self._set_data(res._data)
+        self._autograd_node = res._autograd_node
+        return self
+
+    def __imul__(self, o):
+        res = self.__mul__(o)
+        self._set_data(res._data)
+        self._autograd_node = res._autograd_node
+        return self
+
+    def __itruediv__(self, o):
+        res = self.__truediv__(o)
+        self._set_data(res._data)
+        self._autograd_node = res._autograd_node
+        return self
+
+    # -------------------------------------------------------------- indexing
+    def _normalize_index(self, key):
+        if isinstance(key, NDArray):
+            return key._data
+        if isinstance(key, tuple):
+            return tuple(self._normalize_index(k) for k in key)
+        return key
+
+    def __getitem__(self, key):
+        key = self._normalize_index(key)
+        from ..ops.registry import OpDef, invoke
+        from .. import autograd
+        if autograd.is_recording() and self._in_grad_graph():
+            op = OpDef("getitem", lambda x: x[key], 1, 1, True)
+            return invoke(op, [self], {})
+        return NDArray(self._data[key], ctx=self._ctx)
+
+    def __setitem__(self, key, value):
+        key = self._normalize_index(key)
+        if isinstance(value, NDArray):
+            value = value._data
+        if isinstance(key, slice) and key == slice(None):
+            new = jnp.broadcast_to(jnp.asarray(value, dtype=self._data.dtype),
+                                   self.shape)
+        else:
+            new = self._data.at[key].set(
+                jnp.asarray(value, dtype=self._data.dtype))
+        self._set_data(new)
+
+    # ------------------------------------------------------------ repr/str
+    def __repr__(self):
+        try:
+            arr = self.asnumpy()
+            body = np.array2string(arr, separator=" ", prefix="")
+        except Exception as e:  # show pending async error
+            body = f"<error: {e}>"
+        return f"\n{body}\n<NDArray {'x'.join(map(str, self.shape))} " \
+               f"@{self.context}>"
+
+    # --------------------------------------------------------- method sugar
+    # (generated op methods are attached by ndarray.register at import)
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return self._op("reshape", shape=shape, **kwargs)
+
+    def reshape_like(self, other):
+        return self.reshape(other.shape)
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return self._op("transpose", axes=axes)
+
+    def flatten(self):
+        return self._op("flatten")
+
+    def expand_dims(self, axis):
+        return self._op("expand_dims", axis=axis)
+
+    def squeeze(self, axis=None):
+        return self._op("squeeze", axis=axis)
+
+    def sum(self, axis=None, keepdims=False):
+        return self._op("sum", axis=axis, keepdims=keepdims)
+
+    def mean(self, axis=None, keepdims=False):
+        return self._op("mean", axis=axis, keepdims=keepdims)
+
+    def prod(self, axis=None, keepdims=False):
+        return self._op("prod", axis=axis, keepdims=keepdims)
+
+    def max(self, axis=None, keepdims=False):
+        return self._op("max", axis=axis, keepdims=keepdims)
+
+    def min(self, axis=None, keepdims=False):
+        return self._op("min", axis=axis, keepdims=keepdims)
+
+    def argmax(self, axis=None, keepdims=False):
+        return self._op("argmax", axis=axis, keepdims=keepdims)
+
+    def argmin(self, axis=None, keepdims=False):
+        return self._op("argmin", axis=axis, keepdims=keepdims)
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return self._op("norm", ord=ord, axis=axis, keepdims=keepdims)
+
+    def abs(self):
+        return self._op("abs")
+
+    def sqrt(self):
+        return self._op("sqrt")
+
+    def square(self):
+        return self._op("square")
+
+    def exp(self):
+        return self._op("exp")
+
+    def log(self):
+        return self._op("log")
+
+    def relu(self):
+        return self._op("relu")
+
+    def sigmoid(self):
+        return self._op("sigmoid")
+
+    def tanh(self):
+        return self._op("tanh")
+
+    def clip(self, a_min=None, a_max=None):
+        return self._op("clip", a_min=a_min, a_max=a_max)
+
+    def slice_axis(self, axis, begin, end):
+        return self._op("slice_axis", axis=axis, begin=begin, end=end)
+
+    def take(self, indices, axis=0, mode="clip"):
+        return self._op("take", indices, axis=axis, mode=mode)
+
+    def one_hot(self, depth, **kw):
+        return self._op("one_hot", depth=depth, **kw)
+
+    def tile(self, reps):
+        return self._op("tile", reps=reps)
+
+    def repeat(self, repeats, axis=None):
+        return self._op("repeat", repeats=repeats, axis=axis)
+
+    def flip(self, axis):
+        return self._op("flip", axis=axis)
+
+    def swapaxes(self, dim1, dim2):
+        return self._op("swapaxes", dim1=dim1, dim2=dim2)
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        return self._op("split", num_outputs=num_outputs, axis=axis,
+                        squeeze_axis=squeeze_axis)
+
+    def broadcast_to(self, shape):
+        return self._op("broadcast_to", shape=shape)
+
+    def broadcast_like(self, other):
+        return self._op("broadcast_like", other)
+
+    def topk(self, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+        return self._op("topk", axis=axis, k=k, ret_typ=ret_typ,
+                        is_ascend=is_ascend)
+
+    def sort(self, axis=-1, is_ascend=True):
+        return self._op("sort", axis=axis, is_ascend=is_ascend)
+
+    def argsort(self, axis=-1, is_ascend=True):
+        return self._op("argsort", axis=axis, is_ascend=is_ascend)
+
+    def dot(self, other, transpose_a=False, transpose_b=False):
+        return self._op("dot", other, transpose_a=transpose_a,
+                        transpose_b=transpose_b)
+
+    def pad(self, mode="constant", pad_width=(), constant_value=0.0):
+        return self._op("pad", mode=mode, pad_width=pad_width,
+                        constant_value=constant_value)
+
+    def tostype(self, stype):
+        if stype != "default":
+            raise MXNetError("only 'default' storage implemented")
+        return self

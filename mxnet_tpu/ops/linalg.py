@@ -1,0 +1,112 @@
+"""Linear-algebra operators (reference: src/operator/tensor/la_op.cc —
+``_linalg_*`` family over LAPACK/cuSolver).  XLA provides all decompositions
+natively on TPU."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("_linalg_gemm", num_inputs=3, aliases=["linalg_gemm"])
+def linalg_gemm(A, B, C, *, transpose_a: bool = False,
+                transpose_b: bool = False, alpha: float = 1.0,
+                beta: float = 1.0, axis: int = -2):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b) + beta * C
+
+
+@register("_linalg_gemm2", num_inputs=2, aliases=["linalg_gemm2"])
+def linalg_gemm2(A, B, *, transpose_a: bool = False, transpose_b: bool = False,
+                 alpha: float = 1.0, axis: int = -2):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b)
+
+
+@register("_linalg_potrf", aliases=["linalg_potrf"])
+def linalg_potrf(A):
+    """Cholesky factor (lower)."""
+    return jnp.linalg.cholesky(A)
+
+
+@register("_linalg_potri", aliases=["linalg_potri"])
+def linalg_potri(A):
+    """Inverse from Cholesky factor: inv(L L^T)."""
+    n = A.shape[-1]
+    eye = jnp.broadcast_to(jnp.eye(n, dtype=A.dtype), A.shape)
+    linv = jax.scipy.linalg.solve_triangular(A, eye, lower=True)
+    return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+
+
+@register("_linalg_trsm", num_inputs=2, aliases=["linalg_trsm"])
+def linalg_trsm(A, B, *, transpose: bool = False, rightside: bool = False,
+                lower: bool = True, alpha: float = 1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    low = lower != transpose
+    if rightside:
+        x = jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(a, -1, -2), jnp.swapaxes(alpha * B, -1, -2),
+            lower=not low)
+        return jnp.swapaxes(x, -1, -2)
+    return jax.scipy.linalg.solve_triangular(a, alpha * B, lower=low)
+
+
+@register("_linalg_trmm", num_inputs=2, aliases=["linalg_trmm"])
+def linalg_trmm(A, B, *, transpose: bool = False, rightside: bool = False,
+                lower: bool = True, alpha: float = 1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    tri = jnp.tril(a) if lower != transpose else jnp.triu(a)
+    return alpha * (jnp.matmul(B, tri) if rightside else jnp.matmul(tri, B))
+
+
+@register("_linalg_syrk", aliases=["linalg_syrk"])
+def linalg_syrk(A, *, transpose: bool = False, alpha: float = 1.0):
+    a_t = jnp.swapaxes(A, -1, -2)
+    return alpha * (jnp.matmul(a_t, A) if transpose else jnp.matmul(A, a_t))
+
+
+@register("_linalg_sumlogdiag", aliases=["linalg_sumlogdiag"])
+def linalg_sumlogdiag(A):
+    return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register("_linalg_extractdiag", aliases=["linalg_extractdiag"])
+def linalg_extractdiag(A, *, offset: int = 0):
+    return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
+
+
+@register("_linalg_makediag", aliases=["linalg_makediag"])
+def linalg_makediag(A, *, offset: int = 0):
+    return jnp.apply_along_axis(lambda v: jnp.diag(v, offset), -1, A)
+
+
+@register("_linalg_det", aliases=["linalg_det"])
+def linalg_det(A):
+    return jnp.linalg.det(A)
+
+
+@register("_linalg_slogdet", num_outputs=2, aliases=["linalg_slogdet"])
+def linalg_slogdet(A):
+    sign, logdet = jnp.linalg.slogdet(A)
+    return sign, logdet
+
+
+@register("_linalg_inverse", aliases=["linalg_inverse"])
+def linalg_inverse(A):
+    return jnp.linalg.inv(A)
+
+
+@register("_linalg_gelqf", num_outputs=2, aliases=["linalg_gelqf"])
+def linalg_gelqf(A):
+    """LQ factorization (via QR of A^T)."""
+    q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2))
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@register("_linalg_syevd", num_outputs=2, aliases=["linalg_syevd"])
+def linalg_syevd(A):
+    w, v = jnp.linalg.eigh(A)
+    return jnp.swapaxes(v, -1, -2), w

@@ -1,0 +1,232 @@
+"""Fused optimizer-update operators.
+
+Reference: ``src/operator/optimizer_op.cc`` (sgd_update, sgd_mom_update,
+adam_update, lamb_update_*, ftrl_update, signum, rmsprop — SURVEY.md 2.1).
+
+Purity note: the reference ops mutate weight/state in place; these are pure
+functions returning the new weight *and* new state tensors (num_outputs > 1
+where the reference mutated aux state).  ``mxnet_tpu.optimizer`` writes the
+results back, and under the hybridized/pjit training path these fuse into
+the step program so the distinction costs nothing — XLA buffer donation
+gives the in-place behavior at the memory level.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _prep_grad(grad, rescale_grad, clip_gradient, wd, weight):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g + wd * weight
+
+
+@register("sgd_update", num_inputs=2)
+def sgd_update(weight, grad, *, lr: float = 0.01, wd: float = 0.0,
+               rescale_grad: float = 1.0, clip_gradient: float = -1.0,
+               lazy_update: bool = True):
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    return weight - lr * g
+
+
+@register("sgd_mom_update", num_inputs=3, num_outputs=2)
+def sgd_mom_update(weight, grad, mom, *, lr: float = 0.01,
+                   momentum: float = 0.0, wd: float = 0.0,
+                   rescale_grad: float = 1.0, clip_gradient: float = -1.0,
+                   lazy_update: bool = True):
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    mom_new = momentum * mom - lr * g
+    return weight + mom_new, mom_new
+
+
+@register("nag_mom_update", num_inputs=3, num_outputs=2)
+def nag_mom_update(weight, grad, mom, *, lr: float = 0.01,
+                   momentum: float = 0.0, wd: float = 0.0,
+                   rescale_grad: float = 1.0, clip_gradient: float = -1.0):
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    mom_new = momentum * mom + g
+    return weight - lr * (g + momentum * mom_new), mom_new
+
+
+@register("mp_sgd_update", num_inputs=3, num_outputs=2)
+def mp_sgd_update(weight, grad, weight32, *, lr: float = 0.01, wd: float = 0.0,
+                  rescale_grad: float = 1.0, clip_gradient: float = -1.0,
+                  lazy_update: bool = True):
+    """Multi-precision SGD: fp32 master weights (reference:
+    optimizer_op.cc MP_SGD)."""
+    g = _prep_grad(grad.astype(jnp.float32), rescale_grad, clip_gradient,
+                   wd, weight32)
+    w32 = weight32 - lr * g
+    return w32.astype(weight.dtype), w32
+
+
+@register("mp_sgd_mom_update", num_inputs=4, num_outputs=3)
+def mp_sgd_mom_update(weight, grad, mom, weight32, *, lr: float = 0.01,
+                      momentum: float = 0.0, wd: float = 0.0,
+                      rescale_grad: float = 1.0, clip_gradient: float = -1.0,
+                      lazy_update: bool = True):
+    g = _prep_grad(grad.astype(jnp.float32), rescale_grad, clip_gradient,
+                   wd, weight32)
+    mom_new = momentum * mom - lr * g
+    w32 = weight32 + mom_new
+    return w32.astype(weight.dtype), mom_new, w32
+
+
+@register("adam_update", num_inputs=4, num_outputs=3)
+def adam_update(weight, grad, mean, var, *, lr: float = 0.001,
+                beta1: float = 0.9, beta2: float = 0.999,
+                epsilon: float = 1e-8, wd: float = 0.0,
+                rescale_grad: float = 1.0, clip_gradient: float = -1.0,
+                lazy_update: bool = True):
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    mean_new = beta1 * mean + (1 - beta1) * g
+    var_new = beta2 * var + (1 - beta2) * jnp.square(g)
+    w = weight - lr * mean_new / (jnp.sqrt(var_new) + epsilon)
+    return w, mean_new, var_new
+
+
+@register("adamw_update", num_inputs=5, num_outputs=3,
+          aliases=["_adamw_update", "_contrib_adamw_update"])
+def adamw_update(weight, grad, mean, var, rescale_grad_arr, *,
+                 lr: float = 0.001, beta1: float = 0.9, beta2: float = 0.999,
+                 epsilon: float = 1e-8, wd: float = 0.0, eta: float = 1.0,
+                 clip_gradient: float = -1.0):
+    """AdamW: decoupled weight decay (reference:
+    src/operator/contrib/adamw.cc)."""
+    g = grad * rescale_grad_arr
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    mean_new = beta1 * mean + (1 - beta1) * g
+    var_new = beta2 * var + (1 - beta2) * jnp.square(g)
+    w = weight - eta * (lr * mean_new / (jnp.sqrt(var_new) + epsilon)
+                        + wd * weight)
+    return w, mean_new, var_new
+
+
+@register("lamb_update_phase1", num_inputs=4)
+def lamb_update_phase1(weight, grad, mean, var, *, beta1: float = 0.9,
+                       beta2: float = 0.999, epsilon: float = 1e-6,
+                       t: int = 1, bias_correction: bool = True,
+                       wd: float = 0.0, rescale_grad: float = 1.0,
+                       clip_gradient: float = -1.0):
+    """LAMB phase 1 (reference: optimizer_op.cc lamb_update_phase1):
+    returns the raw update direction g'.  NOTE: returns only the direction;
+    phase-1 state updates come from the same formula and are recomputed by
+    the optimizer wrapper via lamb_update_states for pure-function form."""
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    mean_new = beta1 * mean + (1 - beta1) * g
+    var_new = beta2 * var + (1 - beta2) * jnp.square(g)
+    if bias_correction:
+        mean_hat = mean_new / (1.0 - beta1 ** t)
+        var_hat = var_new / (1.0 - beta2 ** t)
+    else:
+        mean_hat, var_hat = mean_new, var_new
+    return mean_hat / (jnp.sqrt(var_hat) + epsilon) + wd * weight
+
+
+@register("lamb_update_states", num_inputs=4, num_outputs=2)
+def lamb_update_states(weight, grad, mean, var, *, beta1: float = 0.9,
+                       beta2: float = 0.999, rescale_grad: float = 1.0,
+                       clip_gradient: float = -1.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return (beta1 * mean + (1 - beta1) * g,
+            beta2 * var + (1 - beta2) * jnp.square(g))
+
+
+@register("lamb_update_phase2", num_inputs=4)
+def lamb_update_phase2(weight, g, r1, r2, *, lr: float = 0.01,
+                       lower_bound: float = -1.0, upper_bound: float = -1.0):
+    """LAMB phase 2: trust-ratio scaled step (reference: lamb_update_phase2)."""
+    r1c = r1
+    if lower_bound is not None and lower_bound > 0:
+        r1c = jnp.maximum(r1c, lower_bound)
+    if upper_bound is not None and upper_bound > 0:
+        r1c = jnp.minimum(r1c, upper_bound)
+    ratio = jnp.where(jnp.logical_and(r1c > 0, r2 > 0), r1c / r2, 1.0)
+    return weight - lr * ratio * g
+
+
+@register("ftrl_update", num_inputs=4, num_outputs=3)
+def ftrl_update(weight, grad, z, n, *, lr: float = 0.1, lamda1: float = 0.01,
+                beta: float = 1.0, wd: float = 0.0, rescale_grad: float = 1.0,
+                clip_gradient: float = -1.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    n_new = n + jnp.square(g)
+    sigma = (jnp.sqrt(n_new) - jnp.sqrt(n)) / lr
+    z_new = z + g - sigma * weight
+    w = jnp.where(
+        jnp.abs(z_new) > lamda1,
+        -(z_new - jnp.sign(z_new) * lamda1)
+        / ((beta + jnp.sqrt(n_new)) / lr + wd),
+        0.0)
+    return w, z_new, n_new
+
+
+@register("rmsprop_update", num_inputs=3, num_outputs=2)
+def rmsprop_update(weight, grad, n, *, lr: float = 0.001, gamma1: float = 0.95,
+                   epsilon: float = 1e-8, wd: float = 0.0,
+                   rescale_grad: float = 1.0, clip_gradient: float = -1.0,
+                   clip_weights: float = -1.0):
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    n_new = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    w = weight - lr * g / jnp.sqrt(n_new + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, n_new
+
+
+@register("rmspropalex_update", num_inputs=5, num_outputs=4)
+def rmspropalex_update(weight, grad, n, g_acc, delta, *, lr: float = 0.001,
+                       gamma1: float = 0.95, gamma2: float = 0.9,
+                       epsilon: float = 1e-8, wd: float = 0.0,
+                       rescale_grad: float = 1.0, clip_gradient: float = -1.0,
+                       clip_weights: float = -1.0):
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    n_new = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    g_new = gamma1 * g_acc + (1 - gamma1) * g
+    delta_new = gamma2 * delta - lr * g / jnp.sqrt(
+        n_new - jnp.square(g_new) + epsilon)
+    w = weight + delta_new
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, n_new, g_new, delta_new
+
+
+@register("signsgd_update", num_inputs=2)
+def signsgd_update(weight, grad, *, lr: float = 0.01, wd: float = 0.0,
+                   rescale_grad: float = 1.0, clip_gradient: float = -1.0):
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    return weight - lr * jnp.sign(g)
+
+
+@register("signum_update", num_inputs=3, num_outputs=2)
+def signum_update(weight, grad, mom, *, lr: float = 0.01,
+                  momentum: float = 0.0, wd: float = 0.0,
+                  rescale_grad: float = 1.0, clip_gradient: float = -1.0,
+                  wd_lh: float = 0.0):
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    mom_new = momentum * mom - (1 - momentum) * g
+    w = (1 - lr * wd_lh) * weight + lr * jnp.sign(mom_new)
+    return w, mom_new
+
+
+@register("_contrib_multi_lars", num_inputs=4, aliases=["multi_lars"])
+def multi_lars(lrs, weights_sum_sq, grads_sum_sq, wds, *, eta: float = 0.001,
+               eps: float = 1e-8, rescale_grad: float = 1.0):
+    """LARS learning-rate scaling over stacked norms (reference:
+    contrib/multi_lars.cc)."""
+    w_norm = jnp.sqrt(weights_sum_sq)
+    g_norm = jnp.sqrt(grads_sum_sq) * rescale_grad
+    trust = jnp.where(
+        jnp.logical_and(w_norm > 0, g_norm > 0),
+        eta * w_norm / (g_norm + wds * w_norm + eps), 1.0)
+    return lrs * trust

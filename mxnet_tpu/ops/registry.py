@@ -1,0 +1,239 @@
+"""Operator registry + imperative dispatch.
+
+Reference design being re-created (SURVEY.md 2.1, 3.1):
+
+- ``NNVM_REGISTER_OP(name).set_attr<FCompute>(...)`` — a single registry both
+  the imperative and symbolic paths consult (``src/operator/``, nnvm op
+  registry).
+- ``dmlc::Parameter<XParam>`` declarative op schemas — single source of truth
+  for argument parsing, docstring generation and serialization
+  (SURVEY.md 5.6, "keystone pattern").
+- ``MXListAllOpNames`` + Python codegen (``python/mxnet/ndarray/register.py``)
+  — frontend functions are *generated* from the registry at import.
+
+TPU-native redesign: an op's FCompute is a **pure JAX function** (traceable,
+differentiable, shardable).  The same function serves four consumers:
+
+1. eager dispatch (``invoke`` below) — XLA async execution, NDArray in/out;
+2. the autograd tape — ``jax.vjp`` of the same function gives FGradient;
+3. symbolic/graph mode — Symbol nodes store the op name; executors interpret
+   the graph by calling the same functions under ``jax.jit``;
+4. hybridize/CachedOp — the traced program embeds these functions directly.
+
+There is no CPU/GPU kernel split: XLA owns code generation for every
+backend; Pallas kernels slot in as alternative FCompute bodies (ops/pallas).
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ..base import MXNetError, Registry
+
+__all__ = ["OpDef", "register", "get_op", "list_ops", "invoke", "OP_REGISTRY",
+           "alias"]
+
+OP_REGISTRY = Registry("op")
+
+
+class OpDef:
+    """A registered operator.
+
+    Attributes mirror the reference's nnvm attrs:
+      fn           : FCompute — pure jax function (arrays..., **params)
+      num_inputs   : FListInputNames arity (None = variadic first arg list)
+      num_outputs  : 1 or a callable(kwargs)->int for output_mean_var-style ops
+      differentiable : False cuts the autograd tape (integer/compare ops)
+      params       : declarative schema harvested from the fn signature
+                     (dmlc::Parameter equivalent)
+    """
+
+    __slots__ = ("name", "fn", "num_inputs", "num_outputs", "differentiable",
+                 "params", "doc", "aliases", "mutates_rng")
+
+    def __init__(self, name: str, fn: Callable, num_inputs, num_outputs,
+                 differentiable: bool, mutates_rng: bool = False):
+        self.name = name
+        self.fn = fn
+        self.num_inputs = num_inputs
+        self.num_outputs = num_outputs
+        self.differentiable = differentiable
+        self.mutates_rng = mutates_rng
+        self.aliases: List[str] = []
+        sig = inspect.signature(fn)
+        self.params: Dict[str, inspect.Parameter] = {
+            k: p for k, p in sig.parameters.items()
+            if p.kind == inspect.Parameter.KEYWORD_ONLY
+        }
+        self.doc = inspect.getdoc(fn) or f"Operator {name}."
+
+    def n_outputs(self, kwargs) -> int:
+        if callable(self.num_outputs):
+            return self.num_outputs(kwargs)
+        return self.num_outputs
+
+    def validate_kwargs(self, kwargs: Dict[str, Any]):
+        for k in kwargs:
+            if k not in self.params:
+                raise MXNetError(
+                    f"operator {self.name}: unknown argument {k!r}; "
+                    f"schema: {sorted(self.params)}")
+
+    def __repr__(self):
+        return f"OpDef({self.name})"
+
+
+def register(name: str, num_inputs=1, num_outputs=1, differentiable=True,
+             mutates_rng=False, aliases: Sequence[str] = ()):
+    """Decorator: register a pure JAX function as an operator.
+
+    The function's positional args are the data inputs; keyword-only args
+    (with defaults) form the declarative parameter schema.
+    """
+
+    def _decorator(fn):
+        opdef = OpDef(name, fn, num_inputs, num_outputs, differentiable,
+                      mutates_rng)
+        OP_REGISTRY.register(name, opdef)
+        for a in aliases:
+            opdef.aliases.append(a)
+            OP_REGISTRY.register(a, opdef)
+        return fn
+
+    return _decorator
+
+
+def alias(existing: str, new: str):
+    opdef = OP_REGISTRY[existing]
+    opdef.aliases.append(new)
+    OP_REGISTRY.register(new, opdef)
+
+
+def get_op(name: str) -> OpDef:
+    return OP_REGISTRY[name]
+
+
+def list_ops() -> List[str]:
+    """Reference: MXListAllOpNames."""
+    return OP_REGISTRY.list_names()
+
+
+# ---------------------------------------------------------------------------
+# Imperative dispatch (reference: MXImperativeInvokeEx -> Imperative::Invoke
+# -> Engine::PushAsync; SURVEY.md 3.1).  XLA dispatch is already async; the
+# explicit engine push is replaced by the call itself.
+# ---------------------------------------------------------------------------
+
+def invoke(opdef: OpDef, inputs, kwargs: Dict[str, Any], out=None):
+    """Run an op eagerly over NDArray inputs; returns NDArray(s).
+
+    Recording mirrors Imperative::RecordOp: a TapeNode holding the pure fn
+    and input links is attached to every differentiable output.
+    """
+    from ..ndarray import NDArray
+    from .. import autograd
+    from ..engine import engine, is_naive
+
+    raw = []
+    for a in inputs:
+        if isinstance(a, NDArray):
+            a._var.check()          # async error propagation: raise pending
+            raw.append(a._data)
+        else:
+            raw.append(a)
+
+    if kwargs:
+        opdef.validate_kwargs(kwargs)
+        fn = functools.partial(opdef.fn, **kwargs)
+    else:
+        fn = opdef.fn
+
+    try:
+        result = fn(*raw)
+    except Exception as e:
+        raise MXNetError(f"operator {opdef.name} failed: {e}") from e
+
+    nout = opdef.n_outputs(kwargs)
+    outs_raw = (result,) if nout == 1 and not isinstance(result, tuple) \
+        else tuple(result)
+
+    ctx = None
+    for a in inputs:
+        if isinstance(a, NDArray):
+            ctx = a.context
+            break
+
+    # Record every differentiable op while the record() scope is active
+    # (reference: Imperative::RecordOp runs unconditionally when recording);
+    # backward prunes paths that reach no marked variable.
+    record = (autograd.is_recording() and opdef.differentiable
+              and any(isinstance(a, NDArray) for a in inputs))
+
+    outs = [NDArray(o, ctx=ctx) for o in outs_raw]
+
+    if record:
+        nd_inputs = [a for a in inputs if isinstance(a, NDArray)]
+        # fn must close over non-NDArray positional inputs as constants
+        if len(nd_inputs) != len(inputs):
+            idxs = [i for i, a in enumerate(inputs) if isinstance(a, NDArray)]
+            consts = list(raw)
+            base_fn = fn
+
+            def fn(*arrs, _idxs=idxs, _consts=consts, _f=base_fn):
+                buf = list(_consts)
+                for i, a in zip(_idxs, arrs):
+                    buf[i] = a
+                return _f(*buf)
+
+        entries = []
+        for a in nd_inputs:
+            prod = a._autograd_node
+            entries.append((None, 0, a) if prod is None
+                           else (prod[0], prod[1], a))
+        node = autograd.TapeNode(fn=fn, input_entries=entries,
+                                 n_outputs=len(outs), name=opdef.name)
+        for i, o in enumerate(outs):
+            o._autograd_node = (node, i)
+
+    if is_naive():
+        for o in outs:
+            o.wait_to_read()
+
+    eng = engine()
+    for o in outs:
+        eng.track(o)
+
+    if out is not None:
+        out_list = [out] if isinstance(out, NDArray) else list(out)
+        for dst, src in zip(out_list, outs):
+            dst._set_data(src._data)
+            dst._autograd_node = src._autograd_node
+        return out
+
+    return outs[0] if nout == 1 else outs
+
+
+def make_frontend(opdef: OpDef) -> Callable:
+    """Generate the user-facing function for an op (reference:
+    _make_ndarray_function in python/mxnet/ndarray/register.py)."""
+
+    def frontend(*args, out=None, **kwargs):
+        from ..ndarray import NDArray
+        from ..symbol import Symbol
+        if args and isinstance(args[0], Symbol) or (
+                args and isinstance(args[0], (list, tuple)) and args[0]
+                and isinstance(args[0][0], Symbol)):
+            from ..symbol.symbol import invoke_symbolic
+            return invoke_symbolic(opdef, args, kwargs)
+        if opdef.num_inputs is None and args and isinstance(args[0], (list, tuple)):
+            args = tuple(args[0]) + tuple(args[1:])
+        return invoke(opdef, args, kwargs, out=out)
+
+    params_doc = "\n".join(
+        f"    {k} : default={p.default!r}" for k, p in opdef.params.items())
+    frontend.__name__ = opdef.name
+    frontend.__qualname__ = opdef.name
+    frontend.__doc__ = (f"{opdef.doc}\n\nParameters\n----------\n"
+                        f"{params_doc}\n    out : NDArray, optional\n")
+    return frontend

@@ -1,0 +1,722 @@
+"""Tensor operators: elemwise, broadcast, scalar, reduce, shape, indexing.
+
+Reference: ``src/operator/tensor/`` (elemwise_unary_op, elemwise_binary_op,
+broadcast_reduce_op, matrix_op, indexing_op, ordering_op, init_op —
+SURVEY.md 2.1 "Operator library").  Each op here is a pure JAX function;
+XLA fuses elementwise chains into matmul epilogues automatically, which is
+why there is no hand-written kernel per op (the mshadow expression-template
+role is played by the XLA fusion pass).
+
+Naming follows the reference op names so generated frontends are
+drop-in (`broadcast_add`, `_plus_scalar`, `slice_axis`, ...).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias
+
+# ---------------------------------------------------------------------------
+# Elemwise unary (reference: src/operator/tensor/elemwise_unary_op_basic.cc)
+# ---------------------------------------------------------------------------
+
+_UNARY = {
+    "abs": jnp.abs, "sign": jnp.sign, "ceil": jnp.ceil, "floor": jnp.floor,
+    "rint": jnp.rint, "round": jnp.round, "trunc": jnp.trunc,
+    "exp": jnp.exp, "log": jnp.log, "log2": jnp.log2, "log10": jnp.log10,
+    "log1p": jnp.log1p, "expm1": jnp.expm1, "sqrt": jnp.sqrt,
+    "cbrt": jnp.cbrt, "square": jnp.square,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "arcsin": jnp.arcsin, "arccos": jnp.arccos, "arctan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh, "arccosh": jnp.arccosh, "arctanh": jnp.arctanh,
+    "degrees": jnp.degrees, "radians": jnp.radians,
+    "erf": jax.scipy.special.erf, "erfinv": jax.scipy.special.erfinv,
+    "gammaln": jax.scipy.special.gammaln,
+    "negative": jnp.negative,
+}
+
+for _name, _fn in _UNARY.items():
+    register(_name)(
+        (lambda f: (lambda data: f(data)))(_fn))
+
+register("reciprocal")(lambda data: 1.0 / data)
+register("rsqrt")(lambda data: lax.rsqrt(data))
+register("rcbrt")(lambda data: 1.0 / jnp.cbrt(data))
+register("gamma")(lambda data: jnp.exp(jax.scipy.special.gammaln(data)))
+register("logical_not", differentiable=False)(
+    lambda data: jnp.logical_not(data).astype(data.dtype))
+register("relu")(lambda data: jnp.maximum(data, 0))
+register("sigmoid")(lambda data: jax.nn.sigmoid(data))
+register("softsign")(lambda data: data / (1 + jnp.abs(data)))
+register("erfc")(lambda data: 1.0 - jax.scipy.special.erf(data))
+
+
+@register("clip")
+def clip(data, *, a_min: float = None, a_max: float = None):
+    """Clip values to [a_min, a_max] (reference: tensor/matrix_op.cc Clip)."""
+    return jnp.clip(data, a_min, a_max)
+
+
+@register("cast", aliases=["Cast"])
+def cast(data, *, dtype: str = "float32"):
+    return data.astype(jnp.dtype(dtype))
+
+
+@register("zeros_like", differentiable=False)
+def zeros_like(data):
+    return jnp.zeros_like(data)
+
+
+@register("ones_like", differentiable=False)
+def ones_like(data):
+    return jnp.ones_like(data)
+
+
+@register("full_like", differentiable=False)
+def full_like(data, *, fill_value: float = 0.0):
+    return jnp.full_like(data, fill_value)
+
+
+@register("shape_array", differentiable=False)
+def shape_array(data):
+    return jnp.asarray(data.shape, dtype=jnp.int64)
+
+
+@register("size_array", differentiable=False)
+def size_array(data):
+    return jnp.asarray([data.size], dtype=jnp.int64)
+
+
+@register("stop_gradient", aliases=["BlockGrad"])
+def stop_gradient(data):
+    return lax.stop_gradient(data)
+
+
+@register("identity", aliases=["_copy"])
+def identity(data):
+    return data
+
+
+@register("make_loss", aliases=["MakeLoss"])
+def make_loss(data, *, grad_scale: float = 1.0, valid_thresh: float = 0.0,
+              normalization: str = "null"):
+    return data
+
+
+# ---------------------------------------------------------------------------
+# Elemwise binary + broadcast (reference: elemwise_binary_broadcast_op_*.cc).
+# In the reference elemwise_* require equal shapes and broadcast_* broadcast;
+# XLA broadcasting covers both, but both names are kept for API parity.
+# ---------------------------------------------------------------------------
+
+_BINARY = {
+    "add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+    "div": jnp.divide, "mod": jnp.mod, "power": jnp.power,
+    "maximum": jnp.maximum, "minimum": jnp.minimum,
+    "hypot": jnp.hypot, "arctan2": jnp.arctan2,
+}
+
+for _name, _fn in _BINARY.items():
+    register(f"broadcast_{_name}", num_inputs=2)(
+        (lambda f: (lambda lhs, rhs: f(lhs, rhs)))(_fn))
+
+alias("broadcast_add", "broadcast_plus")
+alias("broadcast_sub", "broadcast_minus")
+alias("broadcast_power", "_power")
+
+for _name in ("add", "sub", "mul", "div"):
+    register(f"elemwise_{_name}", num_inputs=2,
+             aliases=[f"_{_name}"] if _name != "sub" else ["_sub", "_minus"])(
+        (lambda f: (lambda lhs, rhs: f(lhs, rhs)))(_BINARY[_name]))
+
+_CMP = {
+    "equal": jnp.equal, "not_equal": jnp.not_equal,
+    "greater": jnp.greater, "greater_equal": jnp.greater_equal,
+    "lesser": jnp.less, "lesser_equal": jnp.less_equal,
+}
+for _name, _fn in _CMP.items():
+    register(f"broadcast_{_name}", num_inputs=2, differentiable=False)(
+        (lambda f: (lambda lhs, rhs: f(lhs, rhs).astype(lhs.dtype)))(_fn))
+
+for _name, _fn in (("logical_and", jnp.logical_and),
+                   ("logical_or", jnp.logical_or),
+                   ("logical_xor", jnp.logical_xor)):
+    register(f"broadcast_{_name}", num_inputs=2, differentiable=False)(
+        (lambda f: (lambda lhs, rhs: f(lhs, rhs).astype(lhs.dtype)))(_fn))
+
+
+# Scalar ops (reference: elemwise_binary_scalar_op_*.cc)
+@register("_plus_scalar")
+def _plus_scalar(data, *, scalar: float = 0.0):
+    return data + scalar
+
+
+@register("_minus_scalar")
+def _minus_scalar(data, *, scalar: float = 0.0):
+    return data - scalar
+
+
+@register("_rminus_scalar")
+def _rminus_scalar(data, *, scalar: float = 0.0):
+    return scalar - data
+
+
+@register("_mul_scalar")
+def _mul_scalar(data, *, scalar: float = 1.0):
+    return data * scalar
+
+
+@register("_div_scalar")
+def _div_scalar(data, *, scalar: float = 1.0):
+    return data / scalar
+
+
+@register("_rdiv_scalar")
+def _rdiv_scalar(data, *, scalar: float = 1.0):
+    return scalar / data
+
+
+@register("_mod_scalar")
+def _mod_scalar(data, *, scalar: float = 1.0):
+    return jnp.mod(data, scalar)
+
+
+@register("_rmod_scalar")
+def _rmod_scalar(data, *, scalar: float = 1.0):
+    return jnp.mod(scalar, data)
+
+
+@register("_power_scalar")
+def _power_scalar(data, *, scalar: float = 1.0):
+    return jnp.power(data, scalar)
+
+
+@register("_rpower_scalar")
+def _rpower_scalar(data, *, scalar: float = 1.0):
+    return jnp.power(scalar, data)
+
+
+@register("_maximum_scalar")
+def _maximum_scalar(data, *, scalar: float = 0.0):
+    return jnp.maximum(data, scalar)
+
+
+@register("_minimum_scalar")
+def _minimum_scalar(data, *, scalar: float = 0.0):
+    return jnp.minimum(data, scalar)
+
+
+@register("_hypot_scalar")
+def _hypot_scalar(data, *, scalar: float = 0.0):
+    return jnp.hypot(data, scalar)
+
+
+for _name, _fn in _CMP.items():
+    register(f"_{_name}_scalar", differentiable=False)(
+        (lambda f: (lambda data, *, scalar=0.0:
+                    f(data, scalar).astype(data.dtype)))(_fn))
+register("_greater_scalar_rev", differentiable=False)(
+    lambda data, *, scalar=0.0: jnp.greater(scalar, data).astype(data.dtype))
+
+
+# ---------------------------------------------------------------------------
+# Reductions (reference: broadcast_reduce_op_value.cc)
+# ---------------------------------------------------------------------------
+
+def _norm_axis(axis):
+    if axis is None or axis == ():
+        return None
+    if isinstance(axis, int):
+        return (axis,)
+    return tuple(axis)
+
+
+def _reduce(fn, data, axis, keepdims, exclude=False):
+    axis = _norm_axis(axis)
+    if exclude and axis is not None:
+        axis = tuple(i for i in range(data.ndim) if i not in
+                     tuple(a % data.ndim for a in axis))
+    return fn(data, axis=axis, keepdims=keepdims)
+
+
+@register("sum", aliases=["sum_axis"])
+def sum_op(data, *, axis=None, keepdims: bool = False, exclude: bool = False):
+    """Sum along axes (reference: tensor/broadcast_reduce_op_value.cc)."""
+    return _reduce(jnp.sum, data, axis, keepdims, exclude)
+
+
+@register("mean")
+def mean(data, *, axis=None, keepdims: bool = False, exclude: bool = False):
+    return _reduce(jnp.mean, data, axis, keepdims, exclude)
+
+
+@register("prod")
+def prod(data, *, axis=None, keepdims: bool = False, exclude: bool = False):
+    return _reduce(jnp.prod, data, axis, keepdims, exclude)
+
+
+@register("nansum")
+def nansum(data, *, axis=None, keepdims: bool = False, exclude: bool = False):
+    return _reduce(jnp.nansum, data, axis, keepdims, exclude)
+
+
+@register("nanprod")
+def nanprod(data, *, axis=None, keepdims: bool = False, exclude: bool = False):
+    return _reduce(jnp.nanprod, data, axis, keepdims, exclude)
+
+
+@register("max", aliases=["max_axis"])
+def max_op(data, *, axis=None, keepdims: bool = False, exclude: bool = False):
+    return _reduce(jnp.max, data, axis, keepdims, exclude)
+
+
+@register("min", aliases=["min_axis"])
+def min_op(data, *, axis=None, keepdims: bool = False, exclude: bool = False):
+    return _reduce(jnp.min, data, axis, keepdims, exclude)
+
+
+@register("norm")
+def norm(data, *, ord: int = 2, axis=None, keepdims: bool = False):
+    axis = _norm_axis(axis)
+    if ord == 1:
+        return jnp.sum(jnp.abs(data), axis=axis, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(data), axis=axis, keepdims=keepdims))
+
+
+@register("argmax", differentiable=False)
+def argmax(data, *, axis=None, keepdims: bool = False):
+    out = jnp.argmax(data, axis=axis, keepdims=keepdims)
+    return out.astype(jnp.float32)
+
+
+@register("argmin", differentiable=False)
+def argmin(data, *, axis=None, keepdims: bool = False):
+    return jnp.argmin(data, axis=axis, keepdims=keepdims).astype(jnp.float32)
+
+
+@register("argmax_channel", differentiable=False)
+def argmax_channel(data):
+    return jnp.argmax(data, axis=-1).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Ordering (reference: tensor/ordering_op.cc)
+# ---------------------------------------------------------------------------
+
+@register("sort")
+def sort(data, *, axis: int = -1, is_ascend: bool = True):
+    out = jnp.sort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+@register("argsort", differentiable=False)
+def argsort(data, *, axis: int = -1, is_ascend: bool = True, dtype="float32"):
+    idx = jnp.argsort(data, axis=axis)
+    if not is_ascend:
+        idx = jnp.flip(idx, axis=axis)
+    return idx.astype(jnp.dtype(dtype))
+
+
+def _topk_nout(kwargs):
+    return 2 if kwargs.get("ret_typ", "indices") == "both" else 1
+
+
+@register("topk", differentiable=False, num_outputs=_topk_nout)
+def topk(data, *, axis: int = -1, k: int = 1, ret_typ: str = "indices",
+         is_ascend: bool = False, dtype="float32"):
+    """Top-k (reference: ordering_op.cc TopK)."""
+    src = -data if is_ascend else data
+    moved = jnp.moveaxis(src, axis, -1)
+    vals, idx = lax.top_k(moved, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis).astype(jnp.dtype(dtype))
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return vals, idx
+    return idx
+
+
+# ---------------------------------------------------------------------------
+# Matrix ops (reference: tensor/matrix_op.cc, dot-inl.h)
+# ---------------------------------------------------------------------------
+
+@register("dot", num_inputs=2)
+def dot(lhs, rhs, *, transpose_a: bool = False, transpose_b: bool = False):
+    """Generalized dot: contracts last axis of lhs with first of rhs
+    (reference: src/operator/tensor/dot-inl.h).  Lowers to the MXU."""
+    if transpose_a:
+        lhs = jnp.moveaxis(lhs, 0, -1) if lhs.ndim > 1 else lhs
+    if transpose_b:
+        rhs = jnp.moveaxis(rhs, -1, 0) if rhs.ndim > 1 else rhs
+    if lhs.ndim == 1 and rhs.ndim == 1:
+        return jnp.dot(lhs, rhs)
+    return jnp.tensordot(lhs, rhs, axes=([lhs.ndim - 1], [0]))
+
+
+@register("batch_dot", num_inputs=2)
+def batch_dot(lhs, rhs, *, transpose_a: bool = False,
+              transpose_b: bool = False):
+    """Batched matmul over leading batch dims (reference: dot-inl.h
+    BatchDot); maps directly onto the MXU as a batched GEMM."""
+    if transpose_a:
+        lhs = jnp.swapaxes(lhs, -1, -2)
+    if transpose_b:
+        rhs = jnp.swapaxes(rhs, -1, -2)
+    return jnp.matmul(lhs, rhs)
+
+
+@register("khatri_rao", num_inputs=None)
+def khatri_rao(*mats):
+    out = mats[0]
+    for m in mats[1:]:
+        out = (out[:, None, :] * m[None, :, :]).reshape(-1, out.shape[-1])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Shape manipulation (reference: tensor/matrix_op.cc)
+# ---------------------------------------------------------------------------
+
+@register("reshape", aliases=["Reshape"])
+def reshape(data, *, shape=(), reverse: bool = False):
+    """Reshape with MXNet's special codes 0 (keep), -1 (infer), -2 (copy
+    rest), -3 (merge two), -4 (split) — reference: matrix_op.cc Reshape."""
+    shape = tuple(shape)
+    if not any(s in (0, -2, -3, -4) for s in shape):
+        return jnp.reshape(data, shape)
+    src = list(data.shape)[::-1] if reverse else list(data.shape)
+    out = []
+    i = 0
+    it = iter(range(len(shape)))
+    shape_l = list(shape)
+    j = 0
+    while j < len(shape_l):
+        s = shape_l[j]
+        if s == 0:
+            out.append(src[i]); i += 1
+        elif s == -1:
+            out.append(-1); i += 1
+        elif s == -2:
+            out.extend(src[i:]); i = len(src)
+        elif s == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif s == -4:
+            a, b = shape_l[j + 1], shape_l[j + 2]
+            if a == -1:
+                a = src[i] // b
+            if b == -1:
+                b = src[i] // a
+            out.extend([a, b]); i += 1; j += 2
+        else:
+            out.append(s); i += 1
+        j += 1
+    if reverse:
+        out = out[::-1]
+    return jnp.reshape(data, tuple(out))
+
+
+@register("transpose")
+def transpose(data, *, axes=()):
+    axes = tuple(axes) if axes else None
+    return jnp.transpose(data, axes)
+
+
+@register("expand_dims")
+def expand_dims(data, *, axis: int = 0):
+    return jnp.expand_dims(data, axis)
+
+
+@register("squeeze")
+def squeeze(data, *, axis=None):
+    return jnp.squeeze(data, axis=_norm_axis(axis))
+
+
+@register("flatten", aliases=["Flatten"])
+def flatten(data):
+    """Collapse all but the first axis (reference: matrix_op.cc Flatten)."""
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+@register("flip", aliases=["reverse"])
+def flip(data, *, axis=0):
+    return jnp.flip(data, axis=_norm_axis(axis))
+
+
+@register("repeat")
+def repeat(data, *, repeats: int = 1, axis=None):
+    return jnp.repeat(data, repeats, axis=axis)
+
+
+@register("tile")
+def tile(data, *, reps=()):
+    return jnp.tile(data, tuple(reps))
+
+
+@register("pad", aliases=["Pad"])
+def pad(data, *, mode: str = "constant", pad_width=(), constant_value: float = 0.0):
+    """N-d pad (reference: src/operator/pad.cc). pad_width is the flat
+    (before, after) per-axis list like the reference."""
+    pw = tuple(pad_width)
+    pairs = [(pw[2 * i], pw[2 * i + 1]) for i in range(len(pw) // 2)]
+    jmode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[mode]
+    if jmode == "constant":
+        return jnp.pad(data, pairs, mode=jmode, constant_values=constant_value)
+    return jnp.pad(data, pairs, mode=jmode)
+
+
+@register("stack", num_inputs=None)
+def stack(*data, axis: int = 0):
+    return jnp.stack(data, axis=axis)
+
+
+@register("concat", num_inputs=None, aliases=["Concat"])
+def concat(*data, dim: int = 1, num_args: int = 0):
+    """Concatenate along dim (reference: src/operator/concat.cc; note the
+    reference's default dim=1, kept here)."""
+    return jnp.concatenate(data, axis=dim)
+
+
+def _split_nout(kwargs):
+    return int(kwargs.get("num_outputs", 1))
+
+
+@register("split", num_outputs=_split_nout, aliases=["SliceChannel"])
+def split(data, *, num_outputs: int = 1, axis: int = 1,
+          squeeze_axis: bool = False):
+    parts = jnp.split(data, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts) if num_outputs > 1 else parts[0]
+
+
+@register("broadcast_to")
+def broadcast_to(data, *, shape=()):
+    tgt = tuple(s if s != 0 else d for s, d in zip(shape, data.shape))
+    return jnp.broadcast_to(data, tgt)
+
+
+@register("broadcast_like", num_inputs=2)
+def broadcast_like(lhs, rhs, *, lhs_axes=None, rhs_axes=None):
+    return jnp.broadcast_to(lhs, rhs.shape)
+
+
+@register("broadcast_axis", aliases=["broadcast_axes"])
+def broadcast_axis(data, *, axis=(), size=()):
+    axis = (axis,) if isinstance(axis, int) else tuple(axis)
+    size = (size,) if isinstance(size, int) else tuple(size)
+    tgt = list(data.shape)
+    for a, s in zip(axis, size):
+        tgt[a] = s
+    return jnp.broadcast_to(data, tuple(tgt))
+
+
+@register("swapaxes", aliases=["SwapAxis"])
+def swapaxes(data, *, dim1: int = 0, dim2: int = 0):
+    return jnp.swapaxes(data, dim1, dim2)
+
+
+@register("depth_to_space")
+def depth_to_space(data, *, block_size: int = 1):
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, b, b, c // (b * b), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+@register("space_to_depth")
+def space_to_depth(data, *, block_size: int = 1):
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+@register("diag")
+def diag(data, *, k: int = 0, axis1: int = 0, axis2: int = 1):
+    if data.ndim == 1:
+        return jnp.diag(data, k)
+    return jnp.diagonal(data, offset=k, axis1=axis1, axis2=axis2)
+
+
+# ---------------------------------------------------------------------------
+# Slicing / indexing (reference: tensor/matrix_op.cc + indexing_op.cc)
+# ---------------------------------------------------------------------------
+
+@register("slice", aliases=["crop"])
+def slice_op(data, *, begin=(), end=(), step=()):
+    step = tuple(step) if step else (None,) * len(begin)
+    idx = [slice(b, e, s) for b, e, s in zip(begin, end, step)]
+    return data[tuple(idx)]
+
+
+@register("slice_axis")
+def slice_axis(data, *, axis: int = 0, begin: int = 0, end=None):
+    nd_slice = [slice(None)] * data.ndim
+    nd_slice[axis] = slice(begin, end)
+    return data[tuple(nd_slice)]
+
+
+@register("slice_like", num_inputs=2)
+def slice_like(lhs, rhs, *, axes=()):
+    axes = tuple(axes) if axes else tuple(range(lhs.ndim))
+    nd_slice = [slice(None)] * lhs.ndim
+    for a in axes:
+        nd_slice[a] = slice(0, rhs.shape[a])
+    return lhs[tuple(nd_slice)]
+
+
+@register("take", num_inputs=2)
+def take(a, indices, *, axis: int = 0, mode: str = "clip"):
+    """Gather rows (reference: indexing_op.cc Take); the Embedding backward
+    pattern.  mode='clip' clips OOB indices like the reference default."""
+    idx = indices.astype(jnp.int32)
+    if mode == "wrap":
+        idx = jnp.mod(idx, a.shape[axis])
+    else:
+        idx = jnp.clip(idx, 0, a.shape[axis] - 1)
+    return jnp.take(a, idx, axis=axis)
+
+
+@register("pick", num_inputs=2)
+def pick(data, index, *, axis: int = -1, keepdims: bool = False,
+         mode: str = "clip"):
+    idx = jnp.clip(index.astype(jnp.int32), 0, data.shape[axis] - 1)
+    picked = jnp.take_along_axis(data, jnp.expand_dims(idx, axis), axis=axis)
+    if not keepdims:
+        picked = jnp.squeeze(picked, axis=axis)
+    return picked
+
+
+@register("gather_nd", num_inputs=2)
+def gather_nd(data, indices):
+    """reference: indexing_op.cc GatherND; indices shape (M, ...)."""
+    idx = tuple(indices.astype(jnp.int32))
+    return data[idx]
+
+
+@register("scatter_nd", num_inputs=2)
+def scatter_nd(data, indices, *, shape=()):
+    idx = tuple(indices.astype(jnp.int32))
+    out = jnp.zeros(tuple(shape), dtype=data.dtype)
+    return out.at[idx].add(data)
+
+
+@register("one_hot", differentiable=False)
+def one_hot(indices, *, depth: int = 0, on_value: float = 1.0,
+            off_value: float = 0.0, dtype: str = "float32"):
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=jnp.dtype(dtype))
+    return oh * (on_value - off_value) + off_value
+
+
+@register("where", num_inputs=3)
+def where(condition, x, y):
+    return jnp.where(condition.astype(bool), x, y)
+
+
+@register("sequence_mask", num_inputs=2, aliases=["SequenceMask"])
+def sequence_mask(data, sequence_length, *, use_sequence_length: bool = True,
+                  value: float = 0.0, axis: int = 0):
+    """Mask positions past each sequence's length (reference:
+    src/operator/sequence_mask.cc; axis 0 = time-major like the reference)."""
+    maxlen = data.shape[axis]
+    steps = jnp.arange(maxlen)
+    mask = steps[:, None] < sequence_length[None, :].astype(jnp.int32)
+    extra = data.ndim - 2
+    mask = mask.reshape(mask.shape + (1,) * extra)
+    if axis == 1:
+        mask = jnp.swapaxes(mask, 0, 1)
+    return jnp.where(mask, data, value)
+
+
+@register("sequence_last", num_inputs=2, aliases=["SequenceLast"])
+def sequence_last(data, sequence_length, *, use_sequence_length: bool = True,
+                  axis: int = 0):
+    idx = (sequence_length.astype(jnp.int32) - 1)
+    if axis == 0:
+        return jnp.take_along_axis(
+            data, idx.reshape((1, -1) + (1,) * (data.ndim - 2)), axis=0)[0]
+    return jnp.take_along_axis(
+        data, idx.reshape((-1, 1) + (1,) * (data.ndim - 2)), axis=1)[:, 0]
+
+
+@register("sequence_reverse", num_inputs=2, aliases=["SequenceReverse"])
+def sequence_reverse(data, sequence_length, *,
+                     use_sequence_length: bool = True, axis: int = 0):
+    maxlen = data.shape[0]
+    steps = jnp.arange(maxlen)[:, None]
+    lens = sequence_length.astype(jnp.int32)[None, :]
+    rev_idx = jnp.where(steps < lens, lens - 1 - steps, steps)
+    rev_idx = rev_idx.reshape(rev_idx.shape + (1,) * (data.ndim - 2))
+    return jnp.take_along_axis(data, rev_idx, axis=0)
+
+
+@register("boolean_mask", num_inputs=2, aliases=["_contrib_boolean_mask"],
+          differentiable=False)
+def boolean_mask(data, index, *, axis: int = 0):
+    """Dynamic-shape op: materializes on host (reference:
+    contrib/boolean_mask.cc).  Not jittable by design; eager only."""
+    import numpy as np
+    mask = np.asarray(index).astype(bool)
+    return jnp.asarray(np.asarray(data)[mask])
+
+
+# ---------------------------------------------------------------------------
+# Init ops (reference: tensor/init_op.cc) — used by Symbol graphs
+# ---------------------------------------------------------------------------
+
+@register("_zeros", num_inputs=0, differentiable=False)
+def _zeros(*, shape=(), dtype: str = "float32", ctx: str = ""):
+    return jnp.zeros(tuple(shape), dtype=jnp.dtype(dtype))
+
+
+@register("_ones", num_inputs=0, differentiable=False)
+def _ones(*, shape=(), dtype: str = "float32", ctx: str = ""):
+    return jnp.ones(tuple(shape), dtype=jnp.dtype(dtype))
+
+
+@register("_full", num_inputs=0, differentiable=False)
+def _full(*, shape=(), value: float = 0.0, dtype: str = "float32", ctx: str = ""):
+    return jnp.full(tuple(shape), value, dtype=jnp.dtype(dtype))
+
+
+@register("_arange", num_inputs=0, differentiable=False)
+def _arange(*, start: float = 0, stop=None, step: float = 1.0, repeat: int = 1,
+            dtype: str = "float32", ctx: str = "", infer_range: bool = False):
+    out = jnp.arange(start, stop, step, dtype=jnp.dtype(dtype))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register("_linspace", num_inputs=0, differentiable=False)
+def _linspace(*, start: float = 0, stop: float = 1, num: int = 50,
+              endpoint: bool = True, dtype: str = "float32", ctx: str = ""):
+    return jnp.linspace(start, stop, num, endpoint=endpoint,
+                        dtype=jnp.dtype(dtype))
+
+
+@register("_eye", num_inputs=0, differentiable=False)
+def _eye(*, N: int = 0, M: int = 0, k: int = 0, dtype: str = "float32",
+         ctx: str = ""):
+    return jnp.eye(N, M if M else None, k, dtype=jnp.dtype(dtype))
+
+
+@register("_contrib_arange_like", differentiable=False,
+          aliases=["arange_like"])
+def arange_like(data, *, start: float = 0.0, step: float = 1.0,
+                repeat: int = 1, axis=None):
+    if axis is None:
+        n = data.size
+        return (jnp.arange(n, dtype=data.dtype) * step + start).reshape(data.shape)
+    n = data.shape[axis]
+    return jnp.arange(n, dtype=data.dtype) * step + start

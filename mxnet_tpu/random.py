@@ -1,0 +1,229 @@
+"""RNG management + random sampling ops.
+
+Reference: ``src/operator/random/`` (samplers over cuRAND/mkl resources,
+``ResourceRequest::kRandom``) and ``python/mxnet/random.py`` (``mx.random.seed``).
+
+TPU-native redesign: JAX threefry counter-based PRNG.
+
+- Eager mode: a process-global key, split per draw (``mx.random.seed`` resets
+  it) — matching the reference's stateful-sampler UX.
+- Traced mode (hybridize/CachedOp): drawing from global state would bake one
+  sample into the compiled program, so while a trace is active ``next_key()``
+  yields ``fold_in(trace_key, counter)`` where ``trace_key`` is a *traced
+  input* the CachedOp feeds a fresh key every call (see gluon/block.py).
+  This keeps op signatures reference-compatible (no explicit key argument)
+  while staying pure under jit — the TPU equivalent of the reference's
+  per-device ``kParallelRandom`` resource.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from .base import get_env
+from .ops.registry import register
+
+__all__ = ["seed", "next_key", "trace_key_scope", "uniform", "normal",
+           "randint", "randn"]
+
+
+class _RandState(threading.local):
+    def __init__(self):
+        self.key = None
+        self.trace_key = None
+        self.trace_counter = 0
+
+
+_STATE = _RandState()
+
+
+def _global_key():
+    if _STATE.key is None:
+        s = get_env("MXNET_SEED")
+        _STATE.key = jax.random.PRNGKey(int(s) if s is not None else 0)
+    return _STATE.key
+
+
+def seed(seed_state: int, ctx: str = "all"):
+    """Reference: mx.random.seed — reseed the global generator."""
+    _STATE.key = jax.random.PRNGKey(int(seed_state))
+    _STATE.trace_counter = 0
+
+
+def next_key():
+    """Next PRNG key: trace-aware (see module docstring)."""
+    if _STATE.trace_key is not None:
+        k = jax.random.fold_in(_STATE.trace_key, _STATE.trace_counter)
+        _STATE.trace_counter += 1
+        return k
+    new_key, sub = jax.random.split(_global_key())
+    _STATE.key = new_key
+    return sub
+
+
+class trace_key_scope:
+    """Installs a traced key for the duration of a trace (used by CachedOp)."""
+
+    def __init__(self, key):
+        self._key = key
+        self._saved = None
+
+    def __enter__(self):
+        self._saved = (_STATE.trace_key, _STATE.trace_counter)
+        _STATE.trace_key = self._key
+        _STATE.trace_counter = 0
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.trace_key, _STATE.trace_counter = self._saved
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Sampling ops (reference: src/operator/random/sample_op.cc).  Zero-input
+# ops with shape/dtype params, like the reference `_random_*` family.
+# ---------------------------------------------------------------------------
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+@register("_random_uniform", num_inputs=0, differentiable=False,
+          mutates_rng=True, aliases=["random_uniform"])
+def _random_uniform(*, low: float = 0.0, high: float = 1.0, shape=None,
+                    dtype: str = "float32", ctx: str = ""):
+    return jax.random.uniform(next_key(), _shape(shape),
+                              dtype=jnp.dtype(dtype), minval=low, maxval=high)
+
+
+@register("_random_normal", num_inputs=0, differentiable=False,
+          mutates_rng=True, aliases=["random_normal"])
+def _random_normal(*, loc: float = 0.0, scale: float = 1.0, shape=None,
+                   dtype: str = "float32", ctx: str = ""):
+    return loc + scale * jax.random.normal(next_key(), _shape(shape),
+                                           dtype=jnp.dtype(dtype))
+
+
+@register("_random_gamma", num_inputs=0, differentiable=False,
+          mutates_rng=True, aliases=["random_gamma"])
+def _random_gamma(*, alpha: float = 1.0, beta: float = 1.0, shape=None,
+                  dtype: str = "float32", ctx: str = ""):
+    return beta * jax.random.gamma(next_key(), alpha, _shape(shape),
+                                   dtype=jnp.dtype(dtype))
+
+
+@register("_random_exponential", num_inputs=0, differentiable=False,
+          mutates_rng=True, aliases=["random_exponential"])
+def _random_exponential(*, lam: float = 1.0, shape=None,
+                        dtype: str = "float32", ctx: str = ""):
+    return jax.random.exponential(next_key(), _shape(shape),
+                                  dtype=jnp.dtype(dtype)) / lam
+
+
+@register("_random_poisson", num_inputs=0, differentiable=False,
+          mutates_rng=True, aliases=["random_poisson"])
+def _random_poisson(*, lam: float = 1.0, shape=None, dtype: str = "float32",
+                    ctx: str = ""):
+    return jax.random.poisson(next_key(), lam, _shape(shape)).astype(
+        jnp.dtype(dtype))
+
+
+@register("_random_randint", num_inputs=0, differentiable=False,
+          mutates_rng=True, aliases=["random_randint"])
+def _random_randint(*, low: int = 0, high: int = 1, shape=None,
+                    dtype: str = "int32", ctx: str = ""):
+    return jax.random.randint(next_key(), _shape(shape), low, high,
+                              dtype=jnp.dtype(dtype))
+
+
+@register("_random_negative_binomial", num_inputs=0, differentiable=False,
+          mutates_rng=True, aliases=["random_negative_binomial"])
+def _random_negative_binomial(*, k: int = 1, p: float = 1.0, shape=None,
+                              dtype: str = "float32", ctx: str = ""):
+    lam = jax.random.gamma(next_key(), float(k), _shape(shape)) * (1 - p) / p
+    return jax.random.poisson(next_key(), lam,
+                              _shape(shape)).astype(jnp.dtype(dtype))
+
+
+@register("_sample_multinomial", differentiable=False, mutates_rng=True,
+          aliases=["sample_multinomial"])
+def _sample_multinomial(data, *, shape=None, get_prob: bool = False,
+                        dtype: str = "int32"):
+    """Categorical draw from probability rows (reference:
+    random/multisample_op.cc)."""
+    n = 1 if shape is None else int(jnp.prod(jnp.asarray(_shape(shape))))
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    out_shape = _shape(shape)
+    draws = jax.random.categorical(
+        next_key(), logits, axis=-1,
+        shape=(out_shape + data.shape[:-1]) if out_shape else data.shape[:-1])
+    if out_shape:
+        draws = jnp.moveaxis(draws, tuple(range(len(out_shape))),
+                             tuple(range(-len(out_shape), 0)))
+    return draws.astype(jnp.dtype(dtype))
+
+
+@register("_shuffle", differentiable=False, mutates_rng=True,
+          aliases=["shuffle"])
+def _shuffle(data):
+    return jax.random.permutation(next_key(), data, axis=0)
+
+
+@register("_sample_unique_zipfian", num_inputs=0, differentiable=False,
+          mutates_rng=True)
+def _sample_unique_zipfian(*, range_max: int = 1, shape=None):
+    n = _shape(shape)
+    u = jax.random.uniform(next_key(), n)
+    out = jnp.exp(u * jnp.log(float(range_max))).astype(jnp.int32) - 1
+    return jnp.clip(out, 0, range_max - 1)
+
+
+# per-element distribution-parameter samplers (sample_uniform etc.)
+@register("sample_uniform", num_inputs=2, differentiable=False,
+          mutates_rng=True)
+def sample_uniform(low, high, *, shape=None, dtype: str = "float32"):
+    s = _shape(shape)
+    u = jax.random.uniform(next_key(), low.shape + s, dtype=jnp.dtype(dtype))
+    return low.reshape(low.shape + (1,) * len(s)) + u * (
+        high - low).reshape(low.shape + (1,) * len(s))
+
+
+@register("sample_normal", num_inputs=2, differentiable=False,
+          mutates_rng=True)
+def sample_normal(mu, sigma, *, shape=None, dtype: str = "float32"):
+    s = _shape(shape)
+    z = jax.random.normal(next_key(), mu.shape + s, dtype=jnp.dtype(dtype))
+    return mu.reshape(mu.shape + (1,) * len(s)) + z * sigma.reshape(
+        sigma.shape + (1,) * len(s))
+
+
+# ---------------------------------------------------------------------------
+# python-level convenience API (mx.random / mx.nd.random)
+# ---------------------------------------------------------------------------
+
+def uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None, out=None):
+    from .ndarray import invoke_by_name
+    return invoke_by_name("_random_uniform", [], dict(
+        low=float(low), high=float(high), shape=shape, dtype=dtype), out=out)
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None, out=None):
+    from .ndarray import invoke_by_name
+    return invoke_by_name("_random_normal", [], dict(
+        loc=float(loc), scale=float(scale), shape=shape, dtype=dtype), out=out)
+
+
+def randint(low, high, shape=None, dtype="int32", ctx=None, out=None):
+    from .ndarray import invoke_by_name
+    return invoke_by_name("_random_randint", [], dict(
+        low=int(low), high=int(high), shape=shape, dtype=dtype), out=out)
+
+
+def randn(*shape, loc=0.0, scale=1.0, dtype="float32", ctx=None):
+    return normal(loc, scale, shape, dtype, ctx)

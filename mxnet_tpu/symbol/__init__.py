@@ -1,0 +1,25 @@
+"""``mx.sym`` namespace: symbolic graph building.
+
+Reference: ``python/mxnet/symbol/`` over nnvm (SURVEY.md 2.2).  Op functions
+are generated from the same registry as mx.nd (single registry serving both
+paths, like NNVM).
+"""
+from __future__ import annotations
+
+import sys
+import types
+
+from .symbol import Symbol, var, Variable, Group, load, load_json, zeros, ones
+from ..ops import registry as _reg
+from .symbol import invoke_symbolic as _invoke_symbolic
+
+op = types.ModuleType(__name__ + ".op")
+op.__doc__ = "Auto-generated symbolic operator functions."
+for _name in _reg.list_ops():
+    setattr(op, _name, _reg.make_frontend(_reg.get_op(_name)))
+sys.modules[op.__name__] = op
+
+_g = globals()
+for _name in _reg.list_ops():
+    if _name not in _g:
+        _g[_name] = getattr(op, _name)

@@ -1,0 +1,105 @@
+"""Misc utilities (reference: python/mxnet/util.py, python/mxnet/name.py,
+python/mxnet/attribute.py)."""
+from __future__ import annotations
+
+import functools
+import threading
+
+from .base import get_env, list_env_vars
+
+__all__ = ["makedirs", "use_np", "np_shape", "np_array", "getenv", "setenv",
+           "NameManager", "AttrScope"]
+
+
+def makedirs(d):
+    import os
+    os.makedirs(d, exist_ok=True)
+
+
+def getenv(name):
+    return get_env(name)
+
+
+def setenv(name, value):
+    import os
+    os.environ[name] = str(value)
+
+
+def env_info():
+    """Document all registered env knobs (reference:
+    docs faq/env_var.md — here generated from the registry)."""
+    return list_env_vars()
+
+
+# numpy-compat shims (the mx.np layer is numpy-semantics by construction on
+# JAX, so these are no-ops kept for API parity)
+def use_np(func):
+    return func
+
+
+def np_shape(active=True):
+    import contextlib
+    return contextlib.nullcontext()
+
+
+np_array = np_shape
+
+
+class NameManager:
+    """Auto-naming for layers/symbols (reference: python/mxnet/name.py)."""
+
+    _current = threading.local()
+
+    def __init__(self):
+        self._counter = {}
+
+    def get(self, name, hint):
+        if name:
+            return name
+        self._counter.setdefault(hint, 0)
+        name = f"{hint}{self._counter[hint]}"
+        self._counter[hint] += 1
+        return name
+
+    @classmethod
+    def current(cls) -> "NameManager":
+        if not hasattr(cls._current, "value"):
+            cls._current.value = NameManager()
+        return cls._current.value
+
+    def __enter__(self):
+        self._old = getattr(NameManager._current, "value", None)
+        NameManager._current.value = self
+        return self
+
+    def __exit__(self, *exc):
+        NameManager._current.value = self._old
+        return False
+
+
+class AttrScope:
+    """Attribute scoping for symbols, incl. ctx_group model-parallel
+    annotations (reference: python/mxnet/attribute.py; SURVEY.md P7).
+    On TPU, ctx_group maps to sharding annotations — see parallel/."""
+
+    _current = threading.local()
+
+    def __init__(self, **attrs):
+        self._attrs = {k: str(v) for k, v in attrs.items()}
+
+    @classmethod
+    def current_attrs(cls):
+        scope = getattr(cls._current, "value", None)
+        return dict(scope._attrs) if scope else {}
+
+    def __enter__(self):
+        self._old = getattr(AttrScope._current, "value", None)
+        merged = dict(self._old._attrs) if self._old else {}
+        merged.update(self._attrs)
+        self._merged_scope = AttrScope(**merged)
+        AttrScope._current.value = self._merged_scope
+        return self
+
+    def __exit__(self, *exc):
+        AttrScope._current.value = self._old
+        return False

@@ -1,0 +1,35 @@
+"""Test configuration: run the whole suite on a virtual 8-device CPU mesh.
+
+Mirrors the reference's "real runtime, fake scale" test philosophy
+(SURVEY.md §4: launcher-local multi-process tests): JAX host-platform
+device multiplexing stands in for a TPU pod slice, so sharding/collective
+paths execute for real without TPU hardware.
+"""
+import os
+
+# Force CPU with 8 virtual devices. The interpreter may have already
+# imported jax with an accelerator platform selected (sitecustomize), so the
+# env var alone is not enough: override via jax.config before any backend
+# initializes.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _fixed_seed():
+    """Reference pattern: tests/python/unittest/common.py with_seed()."""
+    import mxnet_tpu as mx
+    seed = int(os.environ.get("MXNET_TEST_SEED", "42"))
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    yield

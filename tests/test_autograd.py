@@ -1,0 +1,208 @@
+"""Autograd tape tests (reference: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+def test_simple_grad():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_chain_rule():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x)
+        z = (y * 2).sum()
+    z.backward()
+    assert_almost_equal(x.grad.asnumpy(), 2 * np.exp(x.asnumpy()), rtol=1e-5)
+
+
+def test_two_inputs():
+    a = nd.array([1.0, 2.0])
+    b = nd.array([3.0, 4.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = (a * b).sum()
+    c.backward()
+    assert_almost_equal(a.grad.asnumpy(), b.asnumpy())
+    assert_almost_equal(b.grad.asnumpy(), a.asnumpy())
+
+
+def test_reused_input():
+    """x used twice -> grads accumulate across uses."""
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x + x  # dy/dx = 2x + 1
+    y.backward()
+    assert_almost_equal(x.grad.asnumpy(), [5.0])
+
+
+def test_dot_grad():
+    a = nd.array(np.random.rand(3, 4).astype(np.float32))
+    b = nd.array(np.random.rand(4, 2).astype(np.float32))
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = nd.dot(a, b).sum()
+    c.backward()
+    assert_almost_equal(a.grad.asnumpy(),
+                        np.ones((3, 2)) @ b.asnumpy().T, rtol=1e-5)
+    assert_almost_equal(b.grad.asnumpy(),
+                        a.asnumpy().T @ np.ones((3, 2)), rtol=1e-5)
+
+
+def test_head_gradient():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(nd.array([10.0, 20.0]))
+    assert_almost_equal(x.grad.asnumpy(), [30.0, 60.0])
+
+
+def test_pause_scope():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with autograd.pause():
+            z = x * 100  # not recorded
+        w = y + z.detach()
+    w.backward()
+    assert_almost_equal(x.grad.asnumpy(), [2.0])
+    assert autograd.is_recording() is False
+
+
+def test_train_predict_mode():
+    assert not autograd.is_training()
+    with autograd.record(train_mode=True):
+        assert autograd.is_training()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    with autograd.record(train_mode=False):
+        assert not autograd.is_training()
+
+
+def test_grad_req_add():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(2):
+        with autograd.record():
+            y = (x * x).sum()
+        y.backward()
+    assert_almost_equal(x.grad.asnumpy(), 4 * x.asnumpy())
+    x.zero_grad()
+    assert_almost_equal(x.grad.asnumpy(), [0, 0])
+
+
+def test_autograd_grad_function():
+    x = nd.array([2.0, 3.0])
+    with autograd.record():
+        y = (x * x).sum()
+    # x has no attached grad; use autograd.grad
+    gx = autograd.grad(y, [x], create_graph=False)[0]
+    assert_almost_equal(gx.asnumpy(), 2 * x.asnumpy())
+
+
+def test_detach_cuts_graph():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        z = y.detach() * 3
+        w = y + z
+    w.backward()
+    assert_almost_equal(x.grad.asnumpy(), [2.0])
+
+
+def test_multi_output_op_grad():
+    x = nd.array(np.random.rand(4, 6).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        parts = nd.split(x, num_outputs=2, axis=1)
+        loss = (parts[0] * 2).sum() + (parts[1] * 3).sum()
+    loss.backward()
+    expected = np.concatenate([2 * np.ones((4, 3)), 3 * np.ones((4, 3))],
+                              axis=1)
+    assert_almost_equal(x.grad.asnumpy(), expected)
+
+
+def test_nondifferentiable_cuts_tape():
+    x = nd.array([1.0, 5.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        idx = nd.argmax(x)          # not differentiable
+        y = (x * 2).sum() + idx     # idx contributes no grad
+    y.backward()
+    assert_almost_equal(x.grad.asnumpy(), [2.0, 2.0, 2.0])
+
+
+def test_softmax_output_loss_grad():
+    data = nd.array(np.random.rand(4, 10).astype(np.float32))
+    label = nd.array([1, 2, 3, 4])
+    data.attach_grad()
+    with autograd.record():
+        out = nd.SoftmaxOutput(data, label)
+    out.backward()
+    p = np.exp(data.asnumpy()) / np.exp(data.asnumpy()).sum(1, keepdims=True)
+    oh = np.eye(10)[label.asnumpy().astype(int)]
+    assert_almost_equal(data.grad.asnumpy(), p - oh, rtol=1e-4, atol=1e-5)
+
+
+def test_custom_function():
+    class MyClip(autograd.Function):
+        def forward(self, x):
+            self.save_for_backward(x)
+            return nd.clip(x, a_min=-1.0, a_max=1.0)
+
+        def backward(self, dy):
+            x, = self.saved_tensors
+            mask = (x.asnumpy() > -1) & (x.asnumpy() < 1)
+            return dy * nd.array(mask.astype(np.float32))
+
+    f = MyClip()
+    x = nd.array([-2.0, 0.5, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = f(x)
+        loss = y.sum()
+    loss.backward()
+    assert_almost_equal(x.grad.asnumpy(), [0.0, 1.0, 0.0])
+
+
+def test_numeric_gradient_harness():
+    check_numeric_gradient(lambda x: nd.tanh(x),
+                           [np.random.rand(3, 3) * 0.5])
+    check_numeric_gradient(lambda a, b: nd.dot(a, b),
+                           [np.random.rand(2, 3), np.random.rand(3, 2)])
+    check_numeric_gradient(
+        lambda x: nd.Activation(x, act_type="sigmoid"),
+        [np.random.rand(4, 4)])
+
+
+def test_rnn_op_grad_flows():
+    T, N, I, H = 3, 2, 4, 5
+    x = nd.array(np.random.rand(T, N, I).astype(np.float32) * 0.1)
+    nparams = 4 * H * (I + H) + 8 * H
+    params = nd.array(np.random.rand(nparams).astype(np.float32) * 0.1)
+    h0 = nd.zeros((1, N, H))
+    c0 = nd.zeros((1, N, H))
+    params.attach_grad()
+    with autograd.record():
+        out = nd.RNN(x, params, h0, c0, state_size=H, num_layers=1,
+                     mode="lstm")
+        loss = out.sum()
+    loss.backward()
+    g = params.grad.asnumpy()
+    assert np.abs(g).sum() > 0

@@ -1,0 +1,221 @@
+"""NDArray basics (reference: tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_creation():
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.dtype == np.float32
+    assert np.allclose(a.asnumpy(), [[1, 2], [3, 4]])
+
+    z = nd.zeros((3, 4))
+    assert z.shape == (3, 4)
+    assert z.asnumpy().sum() == 0
+
+    o = nd.ones((2,), dtype="int32")
+    assert o.dtype == np.int32
+
+    f = nd.full((2, 2), 7.0)
+    assert (f.asnumpy() == 7).all()
+
+    r = nd.arange(0, 10, 2)
+    assert np.allclose(r.asnumpy(), [0, 2, 4, 6, 8])
+
+
+def test_arithmetic():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([4.0, 5.0, 6.0])
+    assert np.allclose((a + b).asnumpy(), [5, 7, 9])
+    assert np.allclose((a - b).asnumpy(), [-3, -3, -3])
+    assert np.allclose((a * b).asnumpy(), [4, 10, 18])
+    assert np.allclose((b / a).asnumpy(), [4, 2.5, 2])
+    assert np.allclose((a + 1).asnumpy(), [2, 3, 4])
+    assert np.allclose((1 + a).asnumpy(), [2, 3, 4])
+    assert np.allclose((10 - a).asnumpy(), [9, 8, 7])
+    assert np.allclose((a ** 2).asnumpy(), [1, 4, 9])
+    assert np.allclose((2 / a).asnumpy(), [2, 1, 2 / 3])
+    assert np.allclose((-a).asnumpy(), [-1, -2, -3])
+
+
+def test_inplace_arithmetic():
+    a = nd.array([1.0, 2.0])
+    a += 1
+    assert np.allclose(a.asnumpy(), [2, 3])
+    a *= 2
+    assert np.allclose(a.asnumpy(), [4, 6])
+
+
+def test_comparison():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([2.0, 2.0, 2.0])
+    assert np.allclose((a > b).asnumpy(), [0, 0, 1])
+    assert np.allclose((a >= b).asnumpy(), [0, 1, 1])
+    assert np.allclose((a == b).asnumpy(), [0, 1, 0])
+    assert np.allclose((a < 2).asnumpy(), [1, 0, 0])
+
+
+def test_indexing():
+    a = nd.array(np.arange(24).reshape(2, 3, 4))
+    assert a[0].shape == (3, 4)
+    assert a[0, 1, 2].asscalar() == 6
+    assert a[:, 1].shape == (2, 4)
+    assert a[0, :, 1:3].shape == (3, 2)
+    a[0, 0, 0] = 100
+    assert a[0, 0, 0].asscalar() == 100
+    # boolean/fancy
+    idx = nd.array([0, 1], dtype="int32")
+    assert a[idx].shape == (2, 3, 4)
+
+
+def test_shape_methods():
+    a = nd.array(np.arange(24).reshape(2, 3, 4))
+    assert a.reshape(6, 4).shape == (6, 4)
+    assert a.reshape((-1,)).shape == (24,)
+    assert a.reshape(0, -1).shape == (2, 12)
+    assert a.transpose().shape == (4, 3, 2)
+    assert a.transpose((1, 0, 2)).shape == (3, 2, 4)
+    assert a.flatten().shape == (2, 12)
+    assert a.expand_dims(0).shape == (1, 2, 3, 4)
+    assert a.expand_dims(0).squeeze(0).shape == (2, 3, 4)
+    assert a.T.shape == (4, 3, 2)
+
+
+def test_reductions():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    assert a.sum().asscalar() == 10
+    assert np.allclose(a.sum(axis=0).asnumpy(), [4, 6])
+    assert np.allclose(a.mean(axis=1).asnumpy(), [1.5, 3.5])
+    assert a.max().asscalar() == 4
+    assert a.min().asscalar() == 1
+    assert np.allclose(a.argmax(axis=1).asnumpy(), [1, 1])
+    assert abs(a.norm().asscalar() - np.sqrt(30)) < 1e-5
+
+
+def test_dot():
+    a = nd.array(np.random.rand(3, 4))
+    b = nd.array(np.random.rand(4, 5))
+    c = nd.dot(a, b)
+    assert c.shape == (3, 5)
+    assert np.allclose(c.asnumpy(), a.asnumpy() @ b.asnumpy(), atol=1e-5)
+    # transpose flags
+    c2 = nd.dot(a, b.T, transpose_b=True)
+    assert np.allclose(c2.asnumpy(), c.asnumpy(), atol=1e-5)
+
+
+def test_batch_dot():
+    a = nd.array(np.random.rand(2, 3, 4))
+    b = nd.array(np.random.rand(2, 4, 5))
+    c = nd.batch_dot(a, b)
+    assert c.shape == (2, 3, 5)
+    assert np.allclose(c.asnumpy(), a.asnumpy() @ b.asnumpy(), atol=1e-5)
+
+
+def test_concat_split_stack():
+    a = nd.ones((2, 3))
+    b = nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    c2 = nd.concat(a, b, dim=1)
+    assert c2.shape == (2, 6)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+    parts = nd.split(c, num_outputs=2, axis=0)
+    assert len(parts) == 2 and parts[0].shape == (2, 3)
+
+
+def test_broadcast_ops():
+    a = nd.array([[1.0], [2.0]])
+    b = nd.array([[10.0, 20.0]])
+    c = nd.broadcast_add(a, b)
+    assert c.shape == (2, 2)
+    assert np.allclose(c.asnumpy(), [[11, 21], [12, 22]])
+    d = nd.broadcast_to(a, shape=(2, 3))
+    assert d.shape == (2, 3)
+
+
+def test_take_pick_onehot():
+    w = nd.array(np.arange(12).reshape(4, 3))
+    idx = nd.array([0, 2], dtype="int32")
+    t = nd.take(w, idx)
+    assert t.shape == (2, 3)
+    assert np.allclose(t.asnumpy(), [[0, 1, 2], [6, 7, 8]])
+    data = nd.array([[0.1, 0.9], [0.8, 0.2]])
+    p = nd.pick(data, nd.array([1, 0]))
+    assert np.allclose(p.asnumpy(), [0.9, 0.8])
+    oh = nd.one_hot(nd.array([0, 2]), depth=3)
+    assert np.allclose(oh.asnumpy(), [[1, 0, 0], [0, 0, 1]])
+
+
+def test_topk_sort():
+    a = nd.array([3.0, 1.0, 2.0])
+    v = nd.topk(a, k=2, ret_typ="value")
+    assert np.allclose(v.asnumpy(), [3, 2])
+    s = nd.sort(a)
+    assert np.allclose(s.asnumpy(), [1, 2, 3])
+    i = nd.argsort(a)
+    assert np.allclose(i.asnumpy(), [1, 2, 0])
+
+
+def test_astype_cast():
+    a = nd.array([1.5, 2.5])
+    b = a.astype("int32")
+    assert b.dtype == np.int32
+    c = nd.cast(a, dtype="float16")
+    assert c.dtype == np.float16
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "arrays.npz")
+    data = {"w": nd.array([1.0, 2.0]), "b": nd.ones((2, 2))}
+    nd.save(fname, data)
+    loaded = nd.load(fname)
+    assert set(loaded) == {"w", "b"}
+    assert np.allclose(loaded["w"].asnumpy(), [1, 2])
+
+    nd.save(fname, [nd.array([3.0])])
+    lst = nd.load(fname)
+    assert isinstance(lst, list) and np.allclose(lst[0].asnumpy(), [3])
+
+
+def test_context_placement():
+    a = nd.ones((2, 2), ctx=mx.cpu(0))
+    assert a.context.device_type in ("cpu",)
+    b = a.as_in_context(mx.cpu(0))
+    assert b is a
+    c = a.copyto(mx.cpu(0))
+    assert c is not a
+
+
+def test_waitall_and_wait_to_read():
+    a = nd.random.uniform(shape=(100, 100))
+    b = nd.dot(a, a)
+    b.wait_to_read()
+    mx.waitall()
+
+
+def test_numpy_interop():
+    a = nd.array([1.0, 2.0])
+    arr = np.asarray(a)
+    assert isinstance(arr, np.ndarray)
+    assert float(a.sum()) == 3.0
+    assert a.tolist() == [1.0, 2.0]
+
+
+def test_random_ops():
+    mx.random.seed(0)
+    u = nd.random.uniform(0, 1, shape=(1000,))
+    assert 0.4 < u.asnumpy().mean() < 0.6
+    n = nd.random.normal(0, 1, shape=(1000,))
+    assert abs(n.asnumpy().mean()) < 0.2
+    r = nd.random.randint(0, 10, shape=(100,))
+    assert r.asnumpy().min() >= 0 and r.asnumpy().max() < 10
+    # seed determinism
+    mx.random.seed(7)
+    x1 = nd.random.uniform(shape=(5,)).asnumpy()
+    mx.random.seed(7)
+    x2 = nd.random.uniform(shape=(5,)).asnumpy()
+    assert np.allclose(x1, x2)
