@@ -47,13 +47,18 @@ class Context:
     def device_type(self) -> str:
         return self.devtype2str[self.device_typeid]
 
+    @property
+    def _canonical_typeid(self):
+        # gpu is an alias for the i-th accelerator == tpu (module docstring)
+        return 6 if self.device_typeid == 2 else self.device_typeid
+
     def __eq__(self, other):
         return (isinstance(other, Context)
-                and self.device_typeid == other.device_typeid
+                and self._canonical_typeid == other._canonical_typeid
                 and self.device_id == other.device_id)
 
     def __hash__(self):
-        return hash((self.device_typeid, self.device_id))
+        return hash((self._canonical_typeid, self.device_id))
 
     def __repr__(self):
         return f"{self.device_type}({self.device_id})"
@@ -158,4 +163,6 @@ def current_context() -> Context:
 
 def default_context() -> Context:
     """Accelerator if present else cpu (the bench path wants the chip)."""
+    if _accel_devices():
+        return Context("tpu", 0)
     return Context("cpu", 0)

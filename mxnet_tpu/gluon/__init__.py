@@ -1,0 +1,19 @@
+"""Gluon: the imperative/hybrid NN API (reference: python/mxnet/gluon/)."""
+from . import parameter
+from .parameter import Parameter, Constant, ParameterDict, \
+    DeferredInitializationError
+from . import block
+from .block import Block, HybridBlock, SymbolBlock
+from . import nn
+from . import loss
+from . import trainer
+from .trainer import Trainer
+from . import utils
+from . import data
+from . import rnn
+from . import contrib
+from . import model_zoo
+
+__all__ = ["Parameter", "Constant", "ParameterDict", "Block", "HybridBlock",
+           "SymbolBlock", "Trainer", "nn", "rnn", "loss", "data", "utils",
+           "contrib", "model_zoo"]

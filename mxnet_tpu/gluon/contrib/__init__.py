@@ -1,0 +1,4 @@
+"""Gluon contrib (reference: python/mxnet/gluon/contrib/)."""
+from . import estimator
+
+__all__ = ["estimator"]

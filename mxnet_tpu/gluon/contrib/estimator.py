@@ -1,0 +1,53 @@
+"""Minimal Estimator: fit/evaluate convenience loop
+(reference: python/mxnet/gluon/contrib/estimator/estimator.py)."""
+from __future__ import annotations
+
+from ... import autograd
+from ...base import MXNetError
+from ..trainer import Trainer
+
+__all__ = ["Estimator"]
+
+
+class Estimator:
+    def __init__(self, net, loss, train_metrics=None, trainer=None,
+                 context=None):
+        self.net = net
+        self.loss = loss
+        self.train_metrics = train_metrics or []
+        self.trainer = trainer
+        self.context = context
+
+    def fit(self, train_data, val_data=None, epochs=1):
+        if self.trainer is None:
+            raise MXNetError("Estimator needs a Trainer")
+        history = []
+        for epoch in range(epochs):
+            for m in self.train_metrics:
+                m.reset()
+            n = 0
+            for batch in train_data:
+                data, label = batch[0], batch[1]
+                with autograd.record():
+                    out = self.net(data)
+                    loss = self.loss(out, label)
+                loss.backward()
+                bs = data.shape[0]
+                self.trainer.step(bs)
+                n += bs
+                for m in self.train_metrics:
+                    m.update(label, out)
+            history.append({m.name: m.get()[1]
+                            for m in self.train_metrics})
+        return history
+
+    def evaluate(self, val_data, metrics=None):
+        metrics = metrics or self.train_metrics
+        for m in metrics:
+            m.reset()
+        for batch in val_data:
+            data, label = batch[0], batch[1]
+            out = self.net(data)
+            for m in metrics:
+                m.update(label, out)
+        return {m.name: m.get()[1] for m in metrics}

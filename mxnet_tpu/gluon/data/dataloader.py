@@ -1,0 +1,95 @@
+"""DataLoader (reference: python/mxnet/gluon/data/dataloader.py).
+
+TPU-native notes: batches are assembled host-side with NumPy (cheap) and
+materialised as a single NDArray per field — one host→device transfer per
+batch.  Worker parallelism uses a thread pool rather than the reference's
+fork-based multiprocessing: the heavy work (decode/augment) is NumPy
+releasing the GIL, and threads avoid re-importing jax per worker.
+"""
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ...base import MXNetError
+from ... import ndarray as nd
+from ...ndarray import NDArray
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference: default_batchify_fn)."""
+    if isinstance(data[0], NDArray):
+        return nd.stack_arrays(data, axis=0)
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(list(i)) for i in data]
+    arr = np.asarray(data)
+    return nd.array(arr, dtype=arr.dtype if arr.dtype != np.float64
+                    else np.float32)
+
+
+class DataLoader:
+    """Mini-batch iterator over a Dataset (reference: gluon.data.DataLoader)."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None,
+                 thread_pool=False):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise MXNetError(
+                    "batch_size is required when batch_sampler is None")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle \
+                    else SequentialSampler(len(dataset))
+            elif shuffle:
+                raise MXNetError("shuffle must be False with custom sampler")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif (batch_size is not None or shuffle or sampler is not None
+              or last_batch is not None):
+            raise MXNetError(
+                "batch_size/shuffle/sampler/last_batch are exclusive with "
+                "batch_sampler")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * self._num_workers)
+        self._pool = ThreadPoolExecutor(self._num_workers) \
+            if self._num_workers > 0 else None
+
+    def _make_batch(self, indices):
+        return self._batchify_fn([self._dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self._pool is None:
+            for indices in self._batch_sampler:
+                yield self._make_batch(indices)
+            return
+        # pipelined prefetch through the thread pool
+        import collections
+        queue = collections.deque()
+        it = iter(self._batch_sampler)
+
+        def fill():
+            while len(queue) < self._prefetch + 1:
+                try:
+                    indices = next(it)
+                except StopIteration:
+                    return
+                queue.append(self._pool.submit(self._make_batch, indices))
+
+        fill()
+        while queue:
+            fut = queue.popleft()
+            fill()
+            yield fut.result()
+
+    def __len__(self):
+        return len(self._batch_sampler)

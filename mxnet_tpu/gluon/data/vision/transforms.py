@@ -1,0 +1,217 @@
+"""Vision transforms (reference: gluon/data/vision/transforms.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ....base import MXNetError
+from .... import ndarray as nd
+from ....ndarray import NDArray
+from ...block import Block, HybridBlock
+from ...nn.basic_layers import Sequential, HybridSequential
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize",
+           "CenterCrop", "RandomResizedCrop", "RandomFlipLeftRight",
+           "RandomFlipTopBottom", "RandomCrop", "RandomBrightness",
+           "RandomContrast", "RandomSaturation", "RandomLighting"]
+
+
+class Compose(Sequential):
+    """Sequentially composed transforms (reference: transforms.Compose)."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return x.astype(self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] → CHW float32 [0,1] (reference: ToTensor)."""
+
+    def hybrid_forward(self, F, x):
+        if x.ndim == 3:
+            return x.transpose((2, 0, 1)).astype("float32") / 255.0
+        return x.transpose((0, 3, 1, 2)).astype("float32") / 255.0
+
+
+class Normalize(HybridBlock):
+    """(x - mean) / std channelwise on CHW (reference: Normalize)."""
+
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = mean
+        self._std = std
+
+    def hybrid_forward(self, F, x):
+        mean = nd.array(np.asarray(self._mean, dtype=np.float32)
+                        .reshape(-1, 1, 1))
+        std = nd.array(np.asarray(self._std, dtype=np.float32)
+                       .reshape(-1, 1, 1))
+        return (x - mean) / std
+
+
+def _resize_hwc(x, size, interp=1):
+    import jax.image
+    if isinstance(size, int):
+        size = (size, size)
+    w, h = size  # reference convention: size is (width, height)
+    method = "nearest" if interp == 0 else "linear"
+    out = jax.image.resize(x._data.astype("float32"),
+                           (h, w, x.shape[2]), method=method)
+    return NDArray(out.astype(x._data.dtype))
+
+
+class Resize(Block):
+    """Resize HWC image (reference: transforms.Resize)."""
+
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size
+        self._keep = keep_ratio
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        if self._keep and isinstance(self._size, int):
+            h, w = x.shape[0], x.shape[1]
+            if w < h:
+                size = (self._size, int(h * self._size / w))
+            else:
+                size = (int(w * self._size / h), self._size)
+        else:
+            size = self._size
+        return _resize_hwc(x, size, self._interpolation)
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        w, h = self._size
+        H, W = x.shape[0], x.shape[1]
+        if H < h or W < w:
+            return _resize_hwc(x, self._size, self._interpolation)
+        y0, x0 = (H - h) // 2, (W - w) // 2
+        return x[y0:y0 + h, x0:x0 + w, :]
+
+
+class RandomCrop(Block):
+    def __init__(self, size, pad=None, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._pad = pad
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        w, h = self._size
+        if self._pad:
+            p = self._pad
+            x = nd.array(np.pad(x.asnumpy(),
+                                ((p, p), (p, p), (0, 0)), mode="constant"),
+                         dtype=str(x.dtype))
+        H, W = x.shape[0], x.shape[1]
+        if H < h or W < w:
+            return _resize_hwc(x, self._size, self._interpolation)
+        y0 = np.random.randint(0, H - h + 1)
+        x0 = np.random.randint(0, W - w + 1)
+        return x[y0:y0 + h, x0:x0 + w, :]
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._scale = scale
+        self._ratio = ratio
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        H, W = x.shape[0], x.shape[1]
+        area = H * W
+        for _ in range(10):
+            target_area = np.random.uniform(*self._scale) * area
+            log_ratio = (np.log(self._ratio[0]), np.log(self._ratio[1]))
+            ar = np.exp(np.random.uniform(*log_ratio))
+            w = int(round(np.sqrt(target_area * ar)))
+            h = int(round(np.sqrt(target_area / ar)))
+            if w <= W and h <= H:
+                y0 = np.random.randint(0, H - h + 1)
+                x0 = np.random.randint(0, W - w + 1)
+                crop = x[y0:y0 + h, x0:x0 + w, :]
+                return _resize_hwc(crop, self._size, self._interpolation)
+        return _resize_hwc(x, self._size, self._interpolation)
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        if np.random.rand() < 0.5:
+            return x.flip(axis=1)
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        if np.random.rand() < 0.5:
+            return x.flip(axis=0)
+        return x
+
+
+class _RandomColorJitterBase(Block):
+    def __init__(self, jitter):
+        super().__init__()
+        self._jitter = jitter
+
+    def _alpha(self):
+        return 1.0 + np.random.uniform(-self._jitter, self._jitter)
+
+
+class RandomBrightness(_RandomColorJitterBase):
+    def forward(self, x):
+        return (x.astype("float32") * self._alpha()).clip(0, 255) \
+            .astype(str(x.dtype))
+
+
+class RandomContrast(_RandomColorJitterBase):
+    def forward(self, x):
+        xf = x.astype("float32")
+        mean = xf.mean()
+        out = xf * self._alpha() + mean * (1 - self._alpha())
+        return out.clip(0, 255).astype(str(x.dtype))
+
+
+class RandomSaturation(_RandomColorJitterBase):
+    def forward(self, x):
+        xf = x.astype("float32")
+        gray = xf.mean(axis=2, keepdims=True)
+        a = self._alpha()
+        return (xf * a + gray * (1 - a)).clip(0, 255).astype(str(x.dtype))
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA noise (reference: RandomLighting)."""
+
+    _EIGVAL = np.array([55.46, 4.794, 1.148], dtype=np.float32)
+    _EIGVEC = np.array([[-0.5675, 0.7192, 0.4009],
+                        [-0.5808, -0.0045, -0.8140],
+                        [-0.5836, -0.6948, 0.4203]], dtype=np.float32)
+
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        a = np.random.normal(0, self._alpha, size=(3,)).astype(np.float32)
+        rgb = (self._EIGVEC * a * self._EIGVAL).sum(axis=1)
+        return (x.astype("float32") + nd.array(rgb)).clip(0, 255) \
+            .astype(str(x.dtype))

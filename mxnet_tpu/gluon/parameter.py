@@ -1,0 +1,414 @@
+"""Parameter / ParameterDict (reference: python/mxnet/gluon/parameter.py).
+
+TPU-native notes: a Parameter keeps one NDArray per context (the reference's
+multi-device copies, SURVEY.md §2.4 P1).  Under the sharded/pjit training
+path (mxnet_tpu.parallel) the single copy is a globally-sharded jax.Array
+over the device Mesh instead — same object, different placement; nothing in
+this class assumes replication.
+"""
+from __future__ import annotations
+
+import contextvars
+from collections import OrderedDict
+from typing import List, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from .. import initializer as init_mod
+from .. import ndarray as nd
+from ..ndarray import NDArray
+
+__all__ = ["Parameter", "Constant", "ParameterDict",
+           "DeferredInitializationError"]
+
+
+class DeferredInitializationError(MXNetError):
+    """Raised when a parameter's value is requested before its shape is
+    known (reference: deferred initialization in gluon/parameter.py)."""
+
+
+# While a CachedOp trace is active, parameter reads resolve to the traced
+# placeholder values so the compiled program takes params as real inputs
+# (otherwise concrete values would be baked in as constants and gradients
+# would not flow).  Set by gluon.block.CachedOp.
+_PARAM_OVERRIDE: contextvars.ContextVar = contextvars.ContextVar(
+    "mx_param_override", default=None)
+
+
+def _shape_is_known(shape) -> bool:
+    if shape is None:
+        return False
+    return all(s is not None and s > 0 for s in shape)
+
+
+class Parameter:
+    """A weight/bias/state tensor of a Block.
+
+    Supports deferred initialization: unknown dims are 0 until the first
+    forward infers them (reference: Parameter._deferred_init).
+    """
+
+    def __init__(self, name, grad_req="write", shape=None, dtype="float32",
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self.name = name
+        self._grad_req = grad_req if differentiable else "null"
+        if isinstance(shape, int):
+            shape = (shape,)
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._differentiable = differentiable
+        # per-context storage, keyed by Context
+        self._data: "OrderedDict[Context, NDArray]" = OrderedDict()
+        self._grad: "OrderedDict[Context, NDArray]" = OrderedDict()
+        self._deferred_init = None   # (init, ctx_list, default_init)
+        self._var = None
+
+    # ------------------------------------------------------------- properties
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        if req not in ("write", "add", "null"):
+            raise MXNetError(f"invalid grad_req {req!r}")
+        if not self._differentiable:
+            req = "null"
+        if self._grad_req == req:
+            return
+        self._grad_req = req
+        if req == "null":
+            self._grad = OrderedDict()
+            for arr in self._data.values():
+                arr._grad = None
+                arr._grad_req = "null"
+        elif self._data:
+            self._init_grad()
+
+    def __repr__(self):
+        return f"Parameter {self.name} (shape={self.shape}, " \
+               f"dtype={self.dtype})"
+
+    # ---------------------------------------------------------------- init
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        """Materialise the parameter on ``ctx`` (list ok).
+
+        If the shape is not fully known yet, initialization is deferred
+        until the first forward pass infers it.
+        """
+        if default_init is None:
+            default_init = init_mod.Uniform()
+        if self._data and not force_reinit:
+            return
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if not _shape_is_known(self.shape):
+            if self.allow_deferred_init:
+                self._deferred_init = (init, list(ctx), default_init)
+                return
+            raise MXNetError(
+                f"cannot initialize Parameter {self.name!r}: shape "
+                f"{self.shape} unknown and allow_deferred_init=False")
+        self._finish_init(init, list(ctx), default_init)
+
+    def _finish_init(self, initializer, ctx_list, default_init):
+        initializer = initializer or self.init or default_init
+        initializer = init_mod.create(initializer)
+        from .. import autograd
+        with autograd.pause():
+            data = nd.zeros(self.shape, dtype=self.dtype, ctx=ctx_list[0])
+            initializer(init_mod.InitDesc(self.name), data)
+            self._data = OrderedDict()
+            for c in ctx_list:
+                self._data[c] = data if c == ctx_list[0] \
+                    else data.as_in_context(c)
+        self._deferred_init = None
+        if self._grad_req != "null":
+            self._init_grad()
+
+    def _init_grad(self):
+        from .. import autograd
+        with autograd.pause():
+            self._grad = OrderedDict()
+            for c, arr in self._data.items():
+                arr.attach_grad(self._grad_req)
+                self._grad[c] = arr.grad
+
+    def _finish_deferred_init(self):
+        """Called by the Block once shape inference has filled self.shape."""
+        if self._deferred_init is None:
+            return
+        if not _shape_is_known(self.shape):
+            raise DeferredInitializationError(
+                f"Parameter {self.name!r} shape still unknown: {self.shape}")
+        initializer, ctx_list, default_init = self._deferred_init
+        self._finish_init(initializer, ctx_list, default_init)
+
+    # ---------------------------------------------------------------- access
+    def _check_initialized(self, ctx=None):
+        if self._data:
+            if ctx is not None and ctx not in self._data:
+                raise MXNetError(
+                    f"Parameter {self.name!r} not initialized on {ctx}; "
+                    f"it lives on {list(self._data)}")
+            return
+        if self._deferred_init is not None:
+            raise DeferredInitializationError(
+                f"Parameter {self.name!r} has deferred initialization "
+                f"pending shape inference")
+        raise MXNetError(
+            f"Parameter {self.name!r} has not been initialized. Call "
+            f".initialize() first")
+
+    def data(self, ctx=None) -> NDArray:
+        override = _PARAM_OVERRIDE.get()
+        if override is not None and self in override:
+            return override[self]
+        self._check_initialized(ctx)
+        if ctx is None:
+            return next(iter(self._data.values()))
+        return self._data[ctx]
+
+    def list_data(self) -> List[NDArray]:
+        self._check_initialized()
+        return list(self._data.values())
+
+    def grad(self, ctx=None) -> NDArray:
+        if self._grad_req == "null":
+            raise MXNetError(f"Parameter {self.name!r} has grad_req='null'")
+        self._check_initialized(ctx)
+        if ctx is None:
+            return next(iter(self._grad.values()))
+        return self._grad[ctx]
+
+    def list_grad(self) -> List[NDArray]:
+        self._check_initialized()
+        return list(self._grad.values())
+
+    def list_ctx(self) -> List[Context]:
+        if not self._data:
+            if self._deferred_init is not None:
+                return list(self._deferred_init[1])
+            raise MXNetError(f"Parameter {self.name!r} not initialized")
+        return list(self._data)
+
+    def set_data(self, data):
+        """Set value on every context (reference: Parameter.set_data)."""
+        if self.shape is None or not _shape_is_known(self.shape):
+            self.shape = tuple(data.shape)
+        if self._deferred_init is not None:
+            self._finish_deferred_init()
+        self._check_initialized()
+        if not isinstance(data, NDArray):
+            data = nd.array(data, dtype=self.dtype)
+        if tuple(data.shape) != tuple(self.shape):
+            raise MXNetError(
+                f"set_data: shape mismatch for {self.name}: "
+                f"{tuple(data.shape)} vs {self.shape}")
+        for c, arr in self._data.items():
+            arr._set_data(data.as_in_context(c)._data.astype(arr._data.dtype))
+
+    def zero_grad(self):
+        if self._grad_req == "null":
+            return
+        for g in self._grad.values():
+            import jax.numpy as jnp
+            g._set_data(jnp.zeros_like(g._data))
+
+    def reset_ctx(self, ctx):
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if self._data:
+            cur = self.data()
+            self._data = OrderedDict(
+                (c, cur.as_in_context(c)) for c in ctx)
+            if self._grad_req != "null":
+                self._init_grad()
+        elif self._deferred_init is not None:
+            i, _, d = self._deferred_init
+            self._deferred_init = (i, list(ctx), d)
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if not self._data:
+            return
+        from .. import autograd
+        with autograd.pause():
+            new = OrderedDict(
+                (c, a.astype(dtype)) for c, a in self._data.items())
+            self._data = new
+            if self._grad_req != "null":
+                self._init_grad()
+
+    def var(self):
+        """Symbol variable for this parameter (reference: Parameter.var)."""
+        if self._var is None:
+            from ..symbol import Symbol
+            self._var = Symbol.var(self.name, shape=self.shape,
+                                   dtype=self.dtype)
+        return self._var
+
+    # npz-friendly export used by save_parameters
+    def _reduce(self) -> NDArray:
+        return self.data()
+
+
+class Constant(Parameter):
+    """Non-differentiable constant parameter (reference: gluon.Constant)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, NDArray):
+            value = nd.array(value)
+        self.value = value
+
+        class _CInit(init_mod.Initializer):
+            def _init_weight(self, _name, arr):
+                value.copyto(arr)
+
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype, init=_CInit(),
+                         differentiable=False)
+
+
+class ParameterDict:
+    """Ordered dict of Parameters with a shared prefix
+    (reference: gluon/parameter.py ParameterDict)."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params: "OrderedDict[str, Parameter]" = OrderedDict()
+        self._shared = shared
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def __contains__(self, name):
+        return name in self._params
+
+    def __getitem__(self, name) -> Parameter:
+        return self._params[name]
+
+    def __repr__(self):
+        body = "\n".join(f"  {v}" for v in self._params.values())
+        return f"ParameterDict {self._prefix!r} (\n{body}\n)"
+
+    def get(self, name, **kwargs) -> Parameter:
+        """Get-or-create by suffix name (prefix is prepended)."""
+        full = self._prefix + name
+        param = self._get_impl(full)
+        if param is None:
+            param = Parameter(full, **kwargs)
+            self._params[full] = param
+        else:
+            # reconcile attrs (reference behavior: inherit unknown shape)
+            shape = kwargs.get("shape")
+            if shape is not None and param.shape is not None:
+                if _shape_is_known(param.shape):
+                    pass
+                else:
+                    param.shape = tuple(shape)
+        return param
+
+    def get_constant(self, name, value=None) -> Constant:
+        full = self._prefix + name
+        param = self._get_impl(full)
+        if param is None:
+            if value is None:
+                raise MXNetError(f"no constant {full!r} and no value given")
+            param = Constant(full, value)
+            self._params[full] = param
+        return param
+
+    def _get_impl(self, full_name):
+        if full_name in self._params:
+            return self._params[full_name]
+        if self._shared is not None and full_name in self._shared:
+            self._params[full_name] = self._shared[full_name]
+            return self._params[full_name]
+        return None
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise MXNetError(f"duplicate parameter name {k!r}")
+            self._params[k] = v
+
+    # --------------------------------------------------------------- bulk ops
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        if init is None:
+            init = init_mod.Uniform()
+        for p in self._params.values():
+            p.initialize(None, ctx, default_init=init,
+                         force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for p in self._params.values():
+            p.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for p in self._params.values():
+            p.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for p in self._params.values():
+            setattr(p, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        arrays = {}
+        for name, p in self._params.items():
+            if strip_prefix and name.startswith(strip_prefix):
+                name = name[len(strip_prefix):]
+            arrays[name] = p._reduce()
+        nd.save(filename, arrays)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        loaded = nd.load(filename)
+        loaded = {restore_prefix + k: v for k, v in loaded.items()}
+        if not allow_missing:
+            for name in self._params:
+                if name not in loaded:
+                    raise MXNetError(
+                        f"Parameter {name!r} missing in file {filename!r}")
+        for name, value in loaded.items():
+            if name not in self._params:
+                if ignore_extra:
+                    continue
+                raise MXNetError(
+                    f"Parameter {name!r} in file is not in this dict "
+                    f"(use ignore_extra=True to skip)")
+            p = self._params[name]
+            if p.shape is None or not _shape_is_known(p.shape):
+                p.shape = tuple(value.shape)
+            if not p._data and p._deferred_init is None:
+                p.initialize(ctx=ctx or [current_context()])
+            elif p._deferred_init is not None:
+                p._finish_deferred_init()
+            p.set_data(value)

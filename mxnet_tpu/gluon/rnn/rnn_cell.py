@@ -1,0 +1,441 @@
+"""Recurrent cells (reference: python/mxnet/gluon/rnn/rnn_cell.py).
+
+Cells give step-level control (the reference's unroll API); the fused
+layers in rnn_layer.py are the fast path.  ``unroll`` builds a static
+python loop — under hybridize the whole unrolled graph compiles to one XLA
+program (sequence length is part of the compile signature, the bucketing
+model of SURVEY.md §2.4 P8).
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ... import ndarray as nd
+from ...ndarray import NDArray
+from ..block import HybridBlock
+
+__all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
+           "GRUCell", "SequentialRNNCell", "DropoutCell", "ModifierCell",
+           "ZoneoutCell", "ResidualCell", "BidirectionalCell"]
+
+
+def _format_sequence(length, inputs, layout, merge):
+    """Split/merge TNC|NTC sequences (reference: rnn_cell._format_sequence)."""
+    t_axis = layout.index("T")
+    batch_axis = layout.index("N")
+    if isinstance(inputs, NDArray):
+        if length is None:
+            length = inputs.shape[t_axis]
+        seq = [inputs.slice_axis(axis=t_axis, begin=i, end=i + 1)
+               .squeeze(axis=t_axis) for i in range(length)]
+    else:
+        seq = list(inputs)
+    if merge:
+        stacked = nd.stack_arrays(seq, axis=t_axis)
+        return stacked, t_axis, batch_axis, len(seq)
+    return seq, t_axis, batch_axis, len(seq)
+
+
+class RecurrentCell(HybridBlock):
+    """Base recurrent cell (reference: RecurrentCell)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+        for cell in self._children.values():
+            if isinstance(cell, RecurrentCell):
+                cell.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=nd.zeros, **kwargs):
+        if self._modified:
+            raise MXNetError("cannot call begin_state on a modified cell "
+                             "(e.g. Zoneout); call on the base cell")
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            states.append(func(shape=info["shape"], **kwargs))
+        return states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Unroll for ``length`` steps (reference: RecurrentCell.unroll)."""
+        self.reset()
+        seq, t_axis, b_axis, length = _format_sequence(
+            length, inputs, layout, False)
+        if begin_state is None:
+            batch = seq[0].shape[b_axis if b_axis < seq[0].ndim else 0]
+            begin_state = self.begin_state(seq[0].shape[0],
+                                           ctx=seq[0].context)
+        states = begin_state
+        outputs = []
+        for i in range(length):
+            out, states = self(seq[i], states)
+            outputs.append(out)
+        if valid_length is not None:
+            stacked = nd.stack_arrays(outputs, axis=layout.index("T"))
+            mask = nd.op.sequence_mask(
+                stacked.swapaxes(0, 1) if layout == "NTC" else stacked,
+                valid_length, use_sequence_length=True, axis=0)
+            stacked = mask.swapaxes(0, 1) if layout == "NTC" else mask
+            if merge_outputs is False:
+                outputs, _, _, _ = _format_sequence(length, stacked,
+                                                    layout, False)
+            else:
+                return stacked, states
+        if merge_outputs is None or merge_outputs:
+            merged, _, _, _ = _format_sequence(length, outputs, layout, True)
+            return merged, states
+        return outputs, states
+
+    def __call__(self, inputs, states, **kwargs):
+        self._counter += 1
+        if isinstance(states, NDArray):
+            states = [states]
+        return super().__call__(inputs, *states, **kwargs)
+
+
+class HybridRecurrentCell(RecurrentCell):
+    pass
+
+
+class RNNCell(HybridRecurrentCell):
+    """Elman RNN cell (reference: RNNCell)."""
+
+    def __init__(self, hidden_size, activation="tanh", input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._activation = activation
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(hidden_size, hidden_size),
+                init=h2h_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(hidden_size,),
+                init=i2h_bias_initializer, allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(hidden_size,),
+                init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "rnn"
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, x, h, i2h_weight, h2h_weight, i2h_bias,
+                       h2h_bias):
+        i2h = F.FullyConnected(x, i2h_weight, i2h_bias,
+                               num_hidden=self._hidden_size)
+        h2h = F.FullyConnected(h, h2h_weight, h2h_bias,
+                               num_hidden=self._hidden_size)
+        out = F.Activation(i2h + h2h, act_type=self._activation)
+        return out, [out]
+
+
+class LSTMCell(HybridRecurrentCell):
+    """LSTM cell, gate order i,f,g,o (reference: LSTMCell)."""
+
+    def __init__(self, hidden_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(4 * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(4 * hidden_size, hidden_size),
+                init=h2h_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(4 * hidden_size,),
+                init=i2h_bias_initializer, allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(4 * hidden_size,),
+                init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "lstm"
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (4 * self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, x, h, c, i2h_weight, h2h_weight, i2h_bias,
+                       h2h_bias):
+        H = self._hidden_size
+        i2h = F.FullyConnected(x, i2h_weight, i2h_bias, num_hidden=4 * H)
+        h2h = F.FullyConnected(h, h2h_weight, h2h_bias, num_hidden=4 * H)
+        gates = i2h + h2h
+        i, f, g, o = F.split(gates, num_outputs=4, axis=-1)
+        i, f, o = F.sigmoid(i), F.sigmoid(f), F.sigmoid(o)
+        g = F.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * F.tanh(c_new)
+        return h_new, [h_new, c_new]
+
+
+class GRUCell(HybridRecurrentCell):
+    """GRU cell, gate order r,z,n (reference: GRUCell)."""
+
+    def __init__(self, hidden_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(3 * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(3 * hidden_size, hidden_size),
+                init=h2h_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(3 * hidden_size,),
+                init=i2h_bias_initializer, allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(3 * hidden_size,),
+                init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "gru"
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (3 * self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, x, h, i2h_weight, h2h_weight, i2h_bias,
+                       h2h_bias):
+        H = self._hidden_size
+        i2h = F.FullyConnected(x, i2h_weight, i2h_bias, num_hidden=3 * H)
+        h2h = F.FullyConnected(h, h2h_weight, h2h_bias, num_hidden=3 * H)
+        ir, iz, inn = F.split(i2h, num_outputs=3, axis=-1)
+        hr, hz, hn = F.split(h2h, num_outputs=3, axis=-1)
+        r = F.sigmoid(ir + hr)
+        z = F.sigmoid(iz + hz)
+        n = F.tanh(inn + r * hn)
+        h_new = (1 - z) * n + z * h
+        return h_new, [h_new]
+
+
+class SequentialRNNCell(RecurrentCell):
+    """Stack cells (reference: SequentialRNNCell)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        infos = []
+        for cell in self._children.values():
+            infos.extend(cell.state_info(batch_size))
+        return infos
+
+    def begin_state(self, batch_size=0, func=nd.zeros, **kwargs):
+        states = []
+        for cell in self._children.values():
+            states.extend(cell.begin_state(batch_size, func, **kwargs))
+        return states
+
+    def __call__(self, inputs, states, **kwargs):
+        self._counter += 1
+        if isinstance(states, NDArray):
+            states = [states]
+        next_states = []
+        p = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            state = states[p:p + n]
+            p += n
+            inputs, state = cell(inputs, state)
+            next_states.extend(state)
+        return inputs, next_states
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+    def forward(self, *args, **kwargs):
+        raise MXNetError("SequentialRNNCell is called step-wise, not via "
+                         "forward")
+
+
+class DropoutCell(HybridRecurrentCell):
+    def __init__(self, rate, axes=(), **kwargs):
+        super().__init__(**kwargs)
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def hybrid_forward(self, F, x):
+        from ... import autograd
+        if self._rate > 0 and autograd.is_training():
+            x = F.Dropout(x, p=self._rate, axes=self._axes)
+        return x, []
+
+    def __call__(self, inputs, states, **kwargs):
+        self._counter += 1
+        out = HybridBlock.__call__(self, inputs)
+        return out[0], states
+
+
+class ModifierCell(HybridRecurrentCell):
+    """Base for cells wrapping another cell (reference: ModifierCell)."""
+
+    def __init__(self, base_cell):
+        super().__init__(prefix=base_cell.prefix + self._alias() + "_")
+        base_cell._modified = True
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, func=nd.zeros, **kwargs):
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(batch_size, func, **kwargs)
+        self.base_cell._modified = True
+        return begin
+
+
+class ZoneoutCell(ModifierCell):
+    """Zoneout regularization (reference: ZoneoutCell)."""
+
+    def __init__(self, base_cell, zoneout_outputs=0., zoneout_states=0.):
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def _alias(self):
+        return "zoneout"
+
+    def reset(self):
+        super().reset()
+        self._prev_output = None
+
+    def __call__(self, inputs, states, **kwargs):
+        from ... import autograd
+        self._counter += 1
+        next_output, next_states = self.base_cell(inputs, states)
+        if not autograd.is_training():
+            return next_output, next_states
+        import numpy as np
+
+        def mask(p, like):
+            keep = nd.array(
+                (np.random.rand(*like.shape) >= p).astype("float32"))
+            return keep
+        prev = self._prev_output
+        if prev is None:
+            prev = nd.zeros(next_output.shape)
+        if self.zoneout_outputs > 0.:
+            m = mask(self.zoneout_outputs, next_output)
+            output = m * next_output + (1 - m) * prev
+        else:
+            output = next_output
+        if self.zoneout_states > 0.:
+            new_states = []
+            for new_s, old_s in zip(next_states, states):
+                m = mask(self.zoneout_states, new_s)
+                new_states.append(m * new_s + (1 - m) * old_s)
+        else:
+            new_states = next_states
+        self._prev_output = output
+        return output, new_states
+
+
+class ResidualCell(ModifierCell):
+    """Adds the input to the cell output (reference: ResidualCell)."""
+
+    def _alias(self):
+        return "residual"
+
+    def __call__(self, inputs, states, **kwargs):
+        self._counter += 1
+        output, states = self.base_cell(inputs, states)
+        return output + inputs, states
+
+
+class BidirectionalCell(HybridRecurrentCell):
+    """Run two cells over the sequence in both directions
+    (reference: BidirectionalCell). Only usable via unroll()."""
+
+    def __init__(self, l_cell, r_cell, output_prefix="bi_"):
+        super().__init__(prefix="", params=None)
+        self.register_child(l_cell, "l_cell")
+        self.register_child(r_cell, "r_cell")
+        self._output_prefix = output_prefix
+
+    def __call__(self, inputs, states):
+        raise MXNetError("BidirectionalCell cannot be stepped; use unroll()")
+
+    def state_info(self, batch_size=0):
+        l, r = self._children["l_cell"], self._children["r_cell"]
+        return l.state_info(batch_size) + r.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, func=nd.zeros, **kwargs):
+        l, r = self._children["l_cell"], self._children["r_cell"]
+        return l.begin_state(batch_size, func, **kwargs) + \
+            r.begin_state(batch_size, func, **kwargs)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        self.reset()
+        seq, t_axis, b_axis, length = _format_sequence(length, inputs,
+                                                       layout, False)
+        l_cell = self._children["l_cell"]
+        r_cell = self._children["r_cell"]
+        if begin_state is None:
+            begin_state = self.begin_state(seq[0].shape[0],
+                                           ctx=seq[0].context)
+        nl = len(l_cell.state_info())
+        l_out, l_states = l_cell.unroll(
+            length, seq, begin_state[:nl], layout="TNC"
+            if layout == "TNC" else "NTC", merge_outputs=False,
+            valid_length=valid_length)
+        r_out, r_states = r_cell.unroll(
+            length, list(reversed(seq)), begin_state[nl:],
+            layout="TNC" if layout == "TNC" else "NTC",
+            merge_outputs=False, valid_length=valid_length)
+        outputs = [nd.op.concat(lo, ro, dim=-1)
+                   for lo, ro in zip(l_out, reversed(r_out))]
+        if merge_outputs is None or merge_outputs:
+            merged, _, _, _ = _format_sequence(length, outputs, layout, True)
+            return merged, l_states + r_states
+        return outputs, l_states + r_states

@@ -1,0 +1,169 @@
+"""Fused recurrent layers (reference: python/mxnet/gluon/rnn/rnn_layer.py).
+
+Parameters are kept per-layer/direction (reference naming: ``l0_i2h_weight``,
+``r0_h2h_bias``...) and packed into the flat cudnn-layout vector the fused
+``RNN`` op consumes (ops/nn.py; reference src/operator/rnn.cc) at forward
+time — the concat is free under XLA fusion.
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ... import ndarray as nd
+from ...ndarray import NDArray
+from ..block import HybridBlock
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, mode,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(**kwargs)
+        if layout not in ("TNC", "NTC"):
+            raise MXNetError(f"bad layout {layout!r}")
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._mode = mode
+        self._gates = _GATES[mode]
+        ng, ni, nh = self._gates, input_size, hidden_size
+        with self.name_scope():
+            for i in range(num_layers):
+                for d in (["l", "r"] if bidirectional else ["l"]):
+                    # attribute assignment registers in _reg_params so the
+                    # params reach hybrid_forward / the CachedOp trace
+                    setattr(self, f"{d}{i}_i2h_weight", self.params.get(
+                        f"{d}{i}_i2h_weight", shape=(ng * nh, ni),
+                        init=i2h_weight_initializer,
+                        allow_deferred_init=True))
+                    setattr(self, f"{d}{i}_h2h_weight", self.params.get(
+                        f"{d}{i}_h2h_weight", shape=(ng * nh, nh),
+                        init=h2h_weight_initializer,
+                        allow_deferred_init=True))
+                    setattr(self, f"{d}{i}_i2h_bias", self.params.get(
+                        f"{d}{i}_i2h_bias", shape=(ng * nh,),
+                        init=i2h_bias_initializer,
+                        allow_deferred_init=True))
+                    setattr(self, f"{d}{i}_h2h_bias", self.params.get(
+                        f"{d}{i}_h2h_bias", shape=(ng * nh,),
+                        init=h2h_bias_initializer,
+                        allow_deferred_init=True))
+                ni = nh * self._dir
+
+    def _param_names(self):
+        dirs = ["l", "r"] if self._dir == 2 else ["l"]
+        weights, biases = [], []
+        for i in range(self._num_layers):
+            for d in dirs:
+                weights.append(f"{d}{i}_i2h_weight")
+                weights.append(f"{d}{i}_h2h_weight")
+                biases.append(f"{d}{i}_i2h_bias")
+                biases.append(f"{d}{i}_h2h_bias")
+        return weights + biases
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=nd.zeros, **kwargs):
+        """Initial hidden state(s) (reference: _RNNLayer.begin_state)."""
+        states = []
+        for info in self.state_info(batch_size):
+            states.append(func(shape=info["shape"], **kwargs))
+        return states
+
+    def infer_shape(self, x, *args):
+        ni = x.shape[2] if self._layout == "TNC" else x.shape[2]
+        nh, ng = self._hidden_size, self._gates
+        dirs = ["l", "r"] if self._dir == 2 else ["l"]
+        cur = ni
+        for i in range(self._num_layers):
+            for d in dirs:
+                self.params[self.prefix + f"{d}{i}_i2h_weight"].shape = \
+                    (ng * nh, cur)
+            cur = nh * self._dir
+        self._input_size = ni
+
+    def __call__(self, inputs, states=None, **kwargs):
+        if states is None:
+            skip_states = True
+            batch = inputs.shape[self._layout.index("N")]
+            states = self.begin_state(batch, ctx=inputs.context)
+        else:
+            skip_states = False
+            if isinstance(states, NDArray):
+                states = [states]
+        out = super().__call__(inputs, *states, **kwargs)
+        if skip_states:
+            return out[0]
+        return out[0], list(out[1:])
+
+    def hybrid_forward(self, F, x, *states, **params):
+        if self._layout == "NTC":
+            x = x.swapaxes(0, 1)
+        names = self._param_names()
+        flat = F.concat(*[params[n].reshape((-1,)) for n in names], dim=0)
+        rnn_args = [x, flat, states[0]]
+        if self._mode == "lstm":
+            rnn_args.append(states[1])
+        outs = F.RNN(*rnn_args, state_size=self._hidden_size,
+                     num_layers=self._num_layers, mode=self._mode,
+                     bidirectional=self._dir == 2, p=self._dropout,
+                     state_outputs=True)
+        out = outs[0]
+        if self._layout == "NTC":
+            out = out.swapaxes(0, 1)
+        return (out,) + tuple(outs[1:])
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._hidden_size}, " \
+               f"layers={self._num_layers}, bidirectional={self._dir == 2})"
+
+
+class RNN(_RNNLayer):
+    """Vanilla multi-layer RNN (reference: rnn_layer.RNN)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False, input_size=0,
+                 **kwargs):
+        mode = "rnn_relu" if activation == "relu" else "rnn_tanh"
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, mode, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    """Multi-layer (bi)LSTM (reference: rnn_layer.LSTM)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, "lstm", **kwargs)
+
+    def state_info(self, batch_size=0):
+        shape = (self._num_layers * self._dir, batch_size, self._hidden_size)
+        return [{"shape": shape, "__layout__": "LNC"},
+                {"shape": shape, "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    """Multi-layer (bi)GRU (reference: rnn_layer.GRU)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, "gru", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]

@@ -1,0 +1,126 @@
+"""Trainer: optimizer + kvstore orchestration
+(reference: python/mxnet/gluon/trainer.py; SURVEY.md §3.4).
+
+Gradient flow per step: backward fills per-ctx grads → `_allreduce_grads`
+sums them across devices through the kvstore (on TPU: XLA collectives) →
+the optimizer updates each ctx copy.  With a single device (or with
+sharded params under the parallel/pjit path) the reduce is a no-op.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from .. import optimizer as opt
+from ..ndarray import NDArray
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore=None,
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise MXNetError("params must be a dict or list of Parameters")
+        self._params = []
+        self._param2idx = {}
+        for i, p in enumerate(params):
+            if not isinstance(p, Parameter):
+                raise MXNetError(f"invalid parameter {p!r}")
+            self._params.append(p)
+            self._param2idx[p.name] = i
+        self._scale = 1.0
+        optimizer_params = optimizer_params or {}
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore = None
+        self._kv_initialized = False
+        self._kvstore_arg = kvstore
+        self._update_on_kvstore = update_on_kvstore
+        self._updaters = None
+        self._states_to_init = True
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            if optimizer_params:
+                raise MXNetError(
+                    "optimizer_params must be empty when optimizer is an "
+                    "Optimizer instance")
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+        self._updater = opt.get_updater(self._optimizer)
+
+    def _init_kvstore(self):
+        arg = self._kvstore_arg
+        if arg is None or (isinstance(arg, str) and arg == "local"
+                           and len(self._params[0].list_ctx()) <= 1):
+            # single-device: no kvstore needed
+            self._kvstore = None
+        else:
+            from .. import kvstore as kvs
+            self._kvstore = kvs.create(arg) if isinstance(arg, str) else arg
+            for i, p in enumerate(self._params):
+                if p.grad_req != "null":
+                    self._kvstore.init(str(i), p.data())
+        self._kv_initialized = True
+
+    # ---------------------------------------------------------------- props
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    # ---------------------------------------------------------------- steps
+    def step(self, batch_size, ignore_stale_grad=False):
+        """allreduce grads → rescale 1/batch_size → optimizer update
+        (reference: Trainer.step)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, p in enumerate(self._params):
+            if p.grad_req != "null":
+                grads = p.list_grad()
+                self._kvstore.pushpull(str(i), grads, out=grads)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
+                continue
+            for w, g in zip(p.list_data(), p.list_grad()):
+                self._updater(i, g, w)
+
+    # ---------------------------------------------------------- persistence
+    def save_states(self, fname):
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer=False))
+
+    def load_states(self, fname):
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())

@@ -1,0 +1,80 @@
+"""Gluon utilities (reference: python/mxnet/gluon/utils.py)."""
+from __future__ import annotations
+
+import hashlib
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from ..ndarray import NDArray
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split along batch axis into num_slice chunks
+    (reference: utils.split_data)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise MXNetError(
+            f"cannot evenly split batch of {size} into {num_slice} slices "
+            f"(set even_split=False to allow uneven)")
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(data.slice_axis(axis=batch_axis, begin=begin, end=end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split batch and place one slice per context
+    (reference: utils.split_and_load — the P1 data-parallel primitive)."""
+    if not isinstance(data, NDArray):
+        data = nd.array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(c) for s, c in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so the global L2 norm <= max_norm
+    (reference: utils.clip_global_norm)."""
+    if not arrays:
+        raise MXNetError("clip_global_norm: empty array list")
+    total = 0.0
+    for a in arrays:
+        n = a.norm().asscalar()
+        total += float(n) ** 2
+    total = total ** 0.5
+    if check_isfinite and not (total < float("inf")):
+        import warnings
+        warnings.warn("nan or inf found in gradients during "
+                      "clip_global_norm")
+    scale = max_norm / (total + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a._set_data((a * scale)._data)
+    return total
+
+
+def check_sha1(filename, sha1_hash):
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None,
+             retries=5, verify_ssl=True):
+    """Reference: utils.download.  This build runs without network egress;
+    the function exists for API parity and raises a clear error."""
+    raise MXNetError(
+        "download() is unavailable: this environment has no network "
+        "access. Place files locally and pass the path instead.")

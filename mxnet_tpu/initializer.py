@@ -89,6 +89,12 @@ class One(Initializer):
         self._init_one(arr)
 
 
+# string aliases used throughout gluon layer defaults (reference accepts
+# both "zeros" and "zero")
+_REG.register("zeros", Zero, override=True)
+_REG.register("ones", One, override=True)
+
+
 @register
 class Constant(Initializer):
     def __init__(self, value=0.0):

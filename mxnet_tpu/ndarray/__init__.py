@@ -137,12 +137,15 @@ ElementWiseSum = add_n
 def save(fname, data):
     if isinstance(data, NDArray):
         data = [data]
+    # pass a file object so numpy does not append ".npz" to the name
     if isinstance(data, dict):
         arrays = {k: v.asnumpy() for k, v in data.items()}
-        _np.savez(fname, __mx_format__="dict", **arrays)
+        with open(fname, "wb") as f:
+            _np.savez(f, __mx_format__="dict", **arrays)
     elif isinstance(data, (list, tuple)):
         arrays = {f"__arr_{i}": v.asnumpy() for i, v in enumerate(data)}
-        _np.savez(fname, __mx_format__="list", **arrays)
+        with open(fname, "wb") as f:
+            _np.savez(f, __mx_format__="list", **arrays)
     else:
         raise MXNetError("save: data must be NDArray, list or dict")
 

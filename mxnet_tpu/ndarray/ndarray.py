@@ -59,7 +59,7 @@ class NDArray:
             data = jnp.asarray(data, dtype=dtype)
         elif dtype is not None and data.dtype != dtype:
             data = data.astype(dtype)
-        if ctx is not None:
+        if ctx is not None and not isinstance(data, jax.core.Tracer):
             dev = ctx.jax_device()
             if data.device != dev:
                 data = jax.device_put(data, dev)
@@ -109,6 +109,9 @@ class NDArray:
     def context(self) -> Context:
         if self._ctx is not None:
             return self._ctx
+        if isinstance(self._data, jax.core.Tracer):
+            # inside a trace there is no physical placement yet
+            return current_context()
         dev = self._data.device
         plat = getattr(dev, "platform", "cpu")
         if plat == "cpu":

@@ -625,3 +625,75 @@ def GridGenerator(data, *, transform_type: str = "affine", target_shape=()):
     theta = data.reshape(-1, 2, 3)
     out = jnp.einsum("nij,jk->nik", theta, base)
     return out.reshape(-1, 2, h, w)
+
+
+# ---------------------------------------------------------------------------
+# CTC loss (reference: src/operator/nn/ctc_loss.cc / mx.nd.CTCLoss).
+# Log-domain forward algorithm via lax.scan (TPU-friendly: static shapes,
+# no data-dependent python control flow); vmapped over the batch.
+# Convention (blank_label='first'): channel 0 is blank, labels are 1..C-1,
+# label padding value is 0.
+# ---------------------------------------------------------------------------
+
+def _ctc_forward_single(logprobs, label, t_len, l_len):
+    """logprobs (T, C) log-softmax; label (L,) ints; returns -log p(label)."""
+    T, C = logprobs.shape
+    L = label.shape[0]
+    S = 2 * L + 1
+    neg_inf = jnp.float32(-1e30)
+    # extended label sequence: blank, l1, blank, l2, ..., blank
+    z = jnp.zeros((S,), dtype=label.dtype)
+    z = z.at[1::2].set(label)
+    s_idx = jnp.arange(S)
+    # transitions: from s, s-1 always; from s-2 iff z[s] != z[s-2] and odd s
+    z_prev2 = jnp.concatenate([jnp.zeros((2,), z.dtype), z[:-2]])
+    can_skip = (s_idx % 2 == 1) & (z != z_prev2)
+
+    alpha0 = jnp.full((S,), neg_inf)
+    alpha0 = alpha0.at[0].set(logprobs[0, 0])
+    alpha0 = alpha0.at[1].set(
+        jnp.where(l_len > 0, logprobs[0, z[1]], neg_inf))
+
+    def step(alpha, t):
+        a_prev1 = jnp.concatenate([jnp.array([neg_inf]), alpha[:-1]])
+        a_prev2 = jnp.concatenate([jnp.full((2,), neg_inf), alpha[:-2]])
+        a_prev2 = jnp.where(can_skip, a_prev2, neg_inf)
+        stacked = jnp.stack([alpha, a_prev1, a_prev2])
+        merged = jax.scipy.special.logsumexp(stacked, axis=0)
+        new_alpha = merged + logprobs[t, z]
+        # freeze the recursion past this sample's length
+        new_alpha = jnp.where(t < t_len, new_alpha, alpha)
+        return new_alpha, None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+    end1 = alpha[2 * l_len]        # final blank
+    end2 = jnp.where(l_len > 0, alpha[2 * l_len - 1], neg_inf)
+    logp = jnp.logaddexp(end1, end2)
+    return -logp
+
+
+@register("CTCLoss", num_inputs=4, aliases=["ctc_loss", "_contrib_CTCLoss",
+                                            "_contrib_ctc_loss"])
+def CTCLoss(data, label, data_lengths=None, label_lengths=None, *,
+            use_data_lengths: bool = False, use_label_lengths: bool = False,
+            blank_label: str = "first"):
+    """data (T, N, C) unnormalized activations; label (N, L)."""
+    T, N, C = data.shape
+    logprobs = jax.nn.log_softmax(data, axis=-1)  # (T, N, C)
+    label = label.astype(jnp.int32)
+    if blank_label == "last":
+        # rotate so blank becomes channel 0 (internal convention)
+        logprobs = jnp.concatenate(
+            [logprobs[..., -1:], logprobs[..., :-1]], axis=-1)
+        label = label + 1
+    if data_lengths is None or not use_data_lengths:
+        t_lens = jnp.full((N,), T, dtype=jnp.int32)
+    else:
+        t_lens = data_lengths.astype(jnp.int32)
+    if label_lengths is None or not use_label_lengths:
+        l_lens = jnp.sum(label > 0, axis=1).astype(jnp.int32)
+    else:
+        l_lens = label_lengths.astype(jnp.int32)
+    per_n = jax.vmap(_ctc_forward_single, in_axes=(1, 0, 0, 0))(
+        logprobs, label, t_lens, l_lens)
+    return per_n.astype(data.dtype)

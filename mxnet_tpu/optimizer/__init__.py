@@ -1,0 +1,14 @@
+"""Optimizers (reference: python/mxnet/optimizer/).
+
+Each optimizer delegates its math to the fused update ops in
+``ops/optimizer_ops.py`` (reference: src/operator/optimizer_op.cc) so the
+update is one XLA program per parameter; under the pjit training path the
+same pure functions fuse straight into the compiled step.
+"""
+from .optimizer import (Optimizer, SGD, NAG, Adam, AdamW, LAMB, RMSProp,
+                        AdaGrad, AdaDelta, Ftrl, Signum, SignSGD, LARS,
+                        Updater, create, register, get_updater, Test)
+
+__all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "LAMB", "RMSProp",
+           "AdaGrad", "AdaDelta", "Ftrl", "Signum", "SignSGD", "LARS",
+           "Updater", "create", "register", "get_updater", "Test"]

@@ -1,0 +1,220 @@
+"""Gluon Block/HybridBlock/Parameter tests.
+
+Modeled on the reference suite tests/python/unittest/test_gluon.py
+(hybridize-vs-imperative equivalence, deferred init, save/load round trips
+— SURVEY.md §4).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+
+def _mlp():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation="relu"))
+        net.add(nn.Dense(8))
+    return net
+
+
+def test_parameter_basic():
+    p = gluon.Parameter("weight", shape=(4, 3))
+    p.initialize(ctx=mx.cpu(0))
+    assert p.data().shape == (4, 3)
+    assert p.grad().shape == (4, 3)
+    assert p.list_ctx() == [mx.cpu(0)]
+    p.set_data(nd.ones((4, 3)))
+    assert p.data().asnumpy().sum() == 12
+
+
+def test_parameter_deferred_init():
+    net = _mlp()
+    net.initialize()
+    # shape unknown until first forward
+    with pytest.raises(Exception):
+        net[0].weight.data()
+    x = nd.ones((2, 5))
+    net(x)
+    assert net[0].weight.shape == (32, 5)
+
+
+def test_parameter_sharing():
+    d1 = nn.Dense(8, in_units=8)
+    d2 = nn.Dense(8, in_units=8, params=d1.collect_params())
+    d1.initialize()
+    x = nd.random.uniform(shape=(4, 8))
+    assert np.allclose(d1(x).asnumpy(), d2(x).asnumpy())
+
+
+def test_hybrid_vs_imperative():
+    net = _mlp()
+    net.initialize()
+    x = nd.random.uniform(shape=(4, 10))
+    y_imp = net(x).asnumpy()
+    net.hybridize()
+    y_hyb = net(x).asnumpy()
+    assert np.allclose(y_imp, y_hyb, atol=1e-5)
+
+
+def test_hybrid_gradients_match():
+    x_np = np.random.randn(4, 10).astype(np.float32)
+
+    def run(hybridize):
+        mx.random.seed(7)
+        np.random.seed(7)
+        net = _mlp()
+        net.initialize()
+        if hybridize:
+            net.hybridize()
+        x = nd.array(x_np)
+        x.attach_grad()
+        with autograd.record():
+            y = net(x)
+            loss = (y * y).sum()
+        loss.backward()
+        grads = {name[len(net.prefix):]: p.grad().asnumpy()
+                 for name, p in net.collect_params().items()}
+        return x.grad.asnumpy(), grads
+
+    xg_i, g_i = run(False)
+    xg_h, g_h = run(True)
+    assert np.allclose(xg_i, xg_h, atol=1e-4), np.abs(xg_i - xg_h).max()
+    for name in g_i:
+        assert np.allclose(g_i[name], g_h[name], atol=1e-4), name
+
+
+def test_cached_op_reuse():
+    from mxnet_tpu.gluon.block import nb_cached_programs
+    net = _mlp()
+    net.initialize()
+    net.hybridize()
+    x = nd.ones((2, 6))
+    before = nb_cached_programs()
+    net(x)
+    net(x)
+    net(x)
+    after_same = nb_cached_programs()
+    assert after_same == before + 1  # one signature -> one compile
+    net(nd.ones((4, 6)))  # new batch size -> new program
+    assert nb_cached_programs() == after_same + 1
+
+
+def test_conv_pool_shapes():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(8, 3, padding=1), nn.MaxPool2D(2, 2),
+                nn.Conv2D(16, 3, padding=1), nn.GlobalAvgPool2D(),
+                nn.Flatten(), nn.Dense(10))
+    net.initialize()
+    out = net(nd.ones((2, 3, 16, 16)))
+    assert out.shape == (2, 10)
+
+
+def test_conv_transpose_shape():
+    net = nn.Conv2DTranspose(4, 3, strides=2, padding=1, output_padding=1,
+                             in_channels=8)
+    net.initialize()
+    out = net(nd.ones((2, 8, 7, 7)))
+    assert out.shape == (2, 4, 14, 14)
+
+
+def test_batchnorm_train_vs_eval():
+    bn = nn.BatchNorm(in_channels=4)
+    bn.initialize()
+    x = nd.random.uniform(shape=(8, 4, 3, 3))
+    with autograd.record():
+        y_train = bn(x)
+    y_eval = bn(x)
+    # training output is normalized by batch stats: near zero mean
+    m = y_train.asnumpy().mean(axis=(0, 2, 3))
+    assert np.abs(m).max() < 1e-4
+    # eval uses running stats (just updated once): different output
+    assert not np.allclose(y_train.asnumpy(), y_eval.asnumpy())
+
+
+def test_embedding_layer():
+    emb = nn.Embedding(10, 6)
+    emb.initialize()
+    idx = nd.array(np.array([[1, 2], [3, 4]]), dtype="int32")
+    out = emb(idx)
+    assert out.shape == (2, 2, 6)
+
+
+def test_layernorm_groupnorm():
+    ln = nn.LayerNorm(in_channels=6)
+    ln.initialize()
+    y = ln(nd.random.uniform(shape=(3, 6)))
+    m = y.asnumpy().mean(axis=-1)
+    assert np.abs(m).max() < 1e-4
+    gn = nn.GroupNorm(num_groups=2, in_channels=4)
+    gn.initialize()
+    z = gn(nd.random.uniform(shape=(2, 4, 5, 5)))
+    assert z.shape == (2, 4, 5, 5)
+
+
+def test_save_load_parameters(tmp_path):
+    net = _mlp()
+    net.initialize()
+    x = nd.random.uniform(shape=(2, 12))
+    y0 = net(x).asnumpy()
+    fname = str(tmp_path / "mlp.params")
+    net.save_parameters(fname)
+    net2 = _mlp()
+    net2.load_parameters(fname)
+    assert np.allclose(y0, net2(x).asnumpy(), atol=1e-6)
+
+
+def test_sequential_getitem_len():
+    net = _mlp()
+    assert len(net) == 2
+    assert isinstance(net[0], nn.Dense)
+    assert isinstance(net[0:1], nn.HybridSequential)
+
+
+def test_activations():
+    x = nd.array(np.linspace(-3, 3, 13, dtype=np.float32))
+    for blk, ref in [
+        (nn.Activation("relu"), lambda v: np.maximum(v, 0)),
+        (nn.LeakyReLU(0.1), lambda v: np.where(v > 0, v, 0.1 * v)),
+        (nn.ELU(1.0), lambda v: np.where(v > 0, v, np.expm1(v))),
+        (nn.Swish(), lambda v: v / (1 + np.exp(-v))),
+    ]:
+        out = blk(x).asnumpy()
+        assert np.allclose(out, ref(x.asnumpy()), atol=1e-5), type(blk)
+
+
+def test_custom_hybrid_block():
+    class Net(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.fc = nn.Dense(6, in_units=4)
+                self.scale = self.params.get("scale", shape=(1,),
+                                             init="ones")
+
+        def hybrid_forward(self, F, x, scale):
+            return self.fc(x) * scale
+
+    net = Net()
+    net.initialize()
+    x = nd.ones((2, 4))
+    y1 = net(x).asnumpy()
+    net.hybridize()
+    y2 = net(x).asnumpy()
+    assert np.allclose(y1, y2, atol=1e-6)
+    # grads flow to child + own param under hybrid
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    assert float(np.abs(net.scale.grad().asnumpy()).sum()) > 0
+    assert float(np.abs(net.fc.weight.grad().asnumpy()).sum()) > 0
+
+
+def test_block_summary_runs(capsys):
+    net = _mlp()
+    net.initialize()
+    net.summary(nd.ones((1, 5)))
+    assert "Total params" in capsys.readouterr().out

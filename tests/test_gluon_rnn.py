@@ -1,0 +1,107 @@
+"""RNN layer/cell tests (reference: tests/python/unittest/test_gluon_rnn.py)."""
+import numpy as np
+import pytest
+
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import rnn
+
+
+@pytest.mark.parametrize("layer_cls,nstates", [
+    (rnn.RNN, 1), (rnn.GRU, 1), (rnn.LSTM, 2)])
+def test_rnn_layer_forward_shapes(layer_cls, nstates):
+    layer = layer_cls(16, num_layers=2)
+    layer.initialize()
+    x = nd.random.uniform(shape=(5, 3, 8))  # TNC
+    out = layer(x)
+    assert out.shape == (5, 3, 16)
+    states = layer.begin_state(3)
+    out, new_states = layer(x, states)
+    assert out.shape == (5, 3, 16)
+    assert len(new_states) == nstates
+    assert new_states[0].shape == (2, 3, 16)
+
+
+def test_bidirectional_lstm_shape():
+    layer = rnn.LSTM(10, num_layers=1, bidirectional=True)
+    layer.initialize()
+    x = nd.random.uniform(shape=(4, 2, 6))
+    out = layer(x)
+    assert out.shape == (4, 2, 20)
+
+
+def test_rnn_layer_ntc_layout():
+    layer = rnn.GRU(12, layout="NTC")
+    layer.initialize()
+    x = nd.random.uniform(shape=(2, 7, 5))
+    assert layer(x).shape == (2, 7, 12)
+
+
+def test_rnn_grad_flows():
+    layer = rnn.LSTM(8)
+    layer.initialize()
+    x = nd.random.uniform(shape=(3, 2, 4))
+    x.attach_grad()
+    with autograd.record():
+        out = layer(x)
+        loss = (out * out).sum()
+    loss.backward()
+    assert np.abs(x.grad.asnumpy()).sum() > 0
+    for _, p in layer.collect_params().items():
+        assert np.abs(p.grad().asnumpy()).sum() > 0, p
+
+
+def test_lstm_cell_unroll_matches_fused():
+    """Cell unroll and fused layer compute the same function when weights
+    are shared (the reference's core consistency check)."""
+    H, I, T, N = 6, 4, 5, 2
+    fused = rnn.LSTM(H, input_size=I)
+    fused.initialize()
+    cell = rnn.LSTMCell(H, input_size=I)
+    cell.initialize()
+    # copy weights: fused l0_* <-> cell *
+    pf = {k.split("lstm")[-1].split("_", 1)[1]: v
+          for k, v in fused.collect_params().items()}
+    pc = {k.split("lstmcell")[-1].split("_", 1)[1]: v
+          for k, v in cell.collect_params().items()}
+    for name in ["i2h_weight", "h2h_weight", "i2h_bias", "h2h_bias"]:
+        pc[name].set_data(pf["l0_" + name].data())
+    x = nd.random.uniform(shape=(T, N, I))
+    out_fused = fused(x).asnumpy()
+    outs, _ = cell.unroll(T, x, layout="TNC", merge_outputs=True)
+    assert np.allclose(out_fused, outs.asnumpy(), atol=1e-5)
+
+
+def test_sequential_rnn_cell():
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(8, input_size=4))
+    stack.add(rnn.GRUCell(6, input_size=8))
+    stack.initialize()
+    x = nd.random.uniform(shape=(2, 4))
+    states = stack.begin_state(2)
+    out, new_states = stack(x, states)
+    assert out.shape == (2, 6)
+    assert len(new_states) == 3  # lstm h,c + gru h
+
+
+def test_residual_cell():
+    base = rnn.GRUCell(4, input_size=4)
+    cell = rnn.ResidualCell(base)
+    cell.initialize()
+    x = nd.random.uniform(shape=(2, 4))
+    states = cell.begin_state(2)
+    out, _ = cell(x, states)
+    base_out, _ = base(x, states)
+    assert np.allclose(out.asnumpy(),
+                       base_out.asnumpy() + x.asnumpy(), atol=1e-6)
+
+
+def test_cell_unroll_valid_length():
+    cell = rnn.RNNCell(5, input_size=3)
+    cell.initialize()
+    x = nd.random.uniform(shape=(2, 6, 3))  # NTC
+    valid = nd.array(np.array([3, 5], dtype=np.float32))
+    out, _ = cell.unroll(6, x, layout="NTC", merge_outputs=True,
+                         valid_length=valid)
+    o = out.asnumpy()
+    assert np.abs(o[0, 3:]).sum() == 0  # masked past valid_length
+    assert np.abs(o[1, :5]).sum() > 0

@@ -1,0 +1,131 @@
+"""Trainer/optimizer integration tests (reference:
+tests/python/unittest/test_gluon_trainer.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+
+def _net():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu", in_units=8))
+        net.add(nn.Dense(4, in_units=16))
+    return net
+
+
+def _step(net, trainer, x, y):
+    loss_fn = gluon.loss.L2Loss()
+    with autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    trainer.step(x.shape[0])
+    return float(loss.mean().asscalar())
+
+
+@pytest.mark.parametrize("opt,kw", [
+    ("sgd", {"learning_rate": 0.1}),
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("adam", {"learning_rate": 1e-2}),
+    ("adamw", {"learning_rate": 1e-2}),
+    ("nag", {"learning_rate": 0.05, "momentum": 0.9}),
+    ("rmsprop", {"learning_rate": 1e-2}),
+    ("lamb", {"learning_rate": 1e-2}),
+])
+def test_trainer_decreases_loss(opt, kw):
+    net = _net()
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), opt, kw)
+    x = nd.random.uniform(shape=(16, 8))
+    y = nd.random.uniform(shape=(16, 4))
+    first = _step(net, trainer, x, y)
+    for _ in range(10):
+        last = _step(net, trainer, x, y)
+    assert last < first, f"{opt}: {first} -> {last}"
+
+
+def test_trainer_lr_scheduler():
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+    net = _net()
+    net.initialize()
+    sched = FactorScheduler(step=2, factor=0.5, base_lr=0.1)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "lr_scheduler": sched})
+    x = nd.random.uniform(shape=(4, 8))
+    y = nd.random.uniform(shape=(4, 4))
+    for _ in range(6):
+        _step(net, trainer, x, y)
+    assert trainer.learning_rate < 0.1
+
+
+def test_trainer_save_load_states(tmp_path):
+    net = _net()
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    x = nd.random.uniform(shape=(4, 8))
+    y = nd.random.uniform(shape=(4, 4))
+    _step(net, trainer, x, y)
+    fname = str(tmp_path / "trainer.states")
+    trainer.save_states(fname)
+    trainer2 = gluon.Trainer(net.collect_params(), "sgd",
+                             {"learning_rate": 0.1, "momentum": 0.9})
+    trainer2.load_states(fname)
+    # momentum state carried over
+    s1 = trainer._updater.states
+    s2 = trainer2._updater.states
+    for k in s1:
+        if s1[k] is None:
+            continue
+        a = s1[k] if not isinstance(s1[k], tuple) else s1[k][0]
+        b = s2[k] if not isinstance(s2[k], tuple) else s2[k][0]
+        assert np.allclose(a.asnumpy(), b.asnumpy())
+
+
+def test_zero_grad():
+    net = _net()
+    net.initialize()
+    x = nd.random.uniform(shape=(4, 8))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    params = net.collect_params()
+    params.zero_grad()
+    for _, p in params.items():
+        assert np.abs(p.grad().asnumpy()).sum() == 0
+
+
+def test_gradient_accumulation():
+    net = _net()
+    net.initialize()
+    for _, p in net.collect_params().items():
+        p.grad_req = "add"
+    x = nd.random.uniform(shape=(4, 8))
+    with autograd.record():
+        net(x).sum().backward()
+    g1 = net[0].weight.grad().asnumpy().copy()
+    with autograd.record():
+        net(x).sum().backward()
+    g2 = net[0].weight.grad().asnumpy()
+    assert np.allclose(g2, 2 * g1, rtol=1e-4, atol=1e-5)
+
+
+def test_clip_global_norm():
+    arrays = [nd.ones((2, 2)) * 3, nd.ones((3,)) * 4]
+    total = gluon.utils.clip_global_norm(arrays, 1.0)
+    new_total = sum(float(a.norm().asscalar()) ** 2
+                    for a in arrays) ** 0.5
+    assert new_total < 1.01
+    assert total > 1.0
+
+
+def test_split_and_load():
+    data = nd.arange(12).reshape((6, 2))
+    ctxs = [mx.cpu(0), mx.cpu(0)]
+    parts = gluon.utils.split_and_load(data, ctxs)
+    assert len(parts) == 2
+    assert parts[0].shape == (3, 2)
+    got = np.concatenate([p.asnumpy() for p in parts])
+    assert np.allclose(got, data.asnumpy())
