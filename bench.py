@@ -1,0 +1,116 @@
+"""Headline benchmark: BERT-large pretraining-style training step.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Metric is model FLOPs utilization (MFU) of a BERT-large (bert_24_1024_16)
+masked-LM training step at seq 128 on the available accelerator —
+the BASELINE.json north-star metric (target >= 35% MFU).
+
+Env knobs: BENCH_BATCH (default 32 on TPU / 8 on CPU), BENCH_SEQLEN (128),
+BENCH_STEPS (8), BENCH_PEAK_TFLOPS (per-chip peak for MFU; default 459
+bf16 for v5p when a TPU is present, else a nominal CPU figure).
+"""
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, models, parallel
+
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+
+    on_tpu = any(d.platform != "cpu" for d in jax.devices())
+    B = int(os.environ.get("BENCH_BATCH", 32 if on_tpu else 4))
+    L = int(os.environ.get("BENCH_SEQLEN", 128))
+    steps = int(os.environ.get("BENCH_STEPS", 8))
+    # per-chip bf16 peak for MFU: v5p 459 TF, v5e ("v5 lite") 197 TF
+    kind = jax.devices()[0].device_kind.lower() if on_tpu else ""
+    default_peak = 197.0 if "lite" in kind or "v5e" in kind else \
+        (459.0 if on_tpu else 0.15)
+    peak_tflops = float(os.environ.get("BENCH_PEAK_TFLOPS", default_peak))
+
+    if on_tpu:
+        cfg = dict(model_name="bert_24_1024_16", vocab_size=30522,
+                   max_length=max(L, 128))
+    else:
+        # CI/CPU fallback: tiny config so the harness still runs end-to-end
+        cfg = dict(model_name="bert_12_768_12", vocab_size=1024, units=128,
+                   hidden_size=512, num_layers=2, num_heads=8,
+                   max_length=max(L, 128))
+
+    model = models.get_bert_model(dropout=0.0, **cfg)
+    model.initialize()
+    head = models.BERTForPretrain(model, vocab_size=cfg["vocab_size"])
+    head.initialize()
+
+    n_mask = max(1, int(0.15 * L))
+    inputs = nd.array(rng.randint(0, cfg["vocab_size"], (B, L)),
+                      dtype="int32")
+    token_types = nd.zeros((B, L), dtype="int32")
+    valid_length = nd.array(np.full((B,), L, np.float32))
+    masked_pos = nd.array(rng.randint(0, L, (B, n_mask)), dtype="int32")
+    mlm_labels = rng.randint(0, cfg["vocab_size"], (B, n_mask)) \
+        .astype(np.int32)
+    nsp_labels = rng.randint(0, 2, (B,)).astype(np.int32)
+
+    def loss_fn(outputs, mlm_y, nsp_y):
+        mlm_scores, nsp_scores = outputs
+        mlm_logp = jax.nn.log_softmax(mlm_scores.astype(jnp.float32), -1)
+        mlm_loss = -jnp.take_along_axis(
+            mlm_logp, mlm_y[..., None], axis=-1).mean()
+        nsp_logp = jax.nn.log_softmax(nsp_scores.astype(jnp.float32), -1)
+        nsp_loss = -jnp.take_along_axis(
+            nsp_logp, nsp_y[:, None], axis=-1).mean()
+        return mlm_loss + nsp_loss
+
+    mesh = parallel.make_mesh(dp=1, tp=1, sp=1,
+                              devices=jax.devices()[:1])
+    trainer = parallel.ShardedTrainer(
+        head, loss_fn, mesh, optimizer="adamw",
+        optimizer_params={"learning_rate": 1e-4},
+        example_inputs=(inputs, token_types, valid_length, masked_pos),
+        n_labels=2, dtype=jnp.bfloat16 if on_tpu else None)
+
+    batch = (inputs, token_types, valid_length, masked_pos,
+             nd.array(mlm_labels, dtype="int32"),
+             nd.array(nsp_labels, dtype="int32"))
+
+    # warmup: first few calls hit distinct jit signatures (fresh arrays →
+    # uncommitted shardings, donation transitions) and compile.
+    # NOTE: synchronize via device_get — block_until_ready is a no-op on
+    # some remote-dispatch backends (axon tunnel).
+    for _ in range(3):
+        loss = trainer.step(*batch)
+        jax.device_get(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = trainer.step(*batch)
+    jax.device_get(loss)
+    dt = (time.perf_counter() - t0) / steps
+
+    n_params = sum(int(np.prod(a.shape)) for a in trainer.params.values())
+    flops_per_step = 6.0 * n_params * B * L      # fwd+bwd transformer rule
+    mfu = flops_per_step / dt / (peak_tflops * 1e12)
+    samples_per_sec = B / dt
+
+    baseline_mfu = 0.35                          # BASELINE.json north star
+    print(json.dumps({
+        "metric": "bert_large_pretrain_mfu" if on_tpu
+                  else "bert_tiny_pretrain_mfu_cpu",
+        "value": round(mfu, 4),
+        "unit": "mfu_fraction",
+        "vs_baseline": round(mfu / baseline_mfu, 4),
+        "samples_per_sec": round(samples_per_sec, 2),
+        "batch": B, "seqlen": L, "params": n_params,
+        "loss": float(jax.device_get(loss)),
+    }))
+
+
+if __name__ == "__main__":
+    main()

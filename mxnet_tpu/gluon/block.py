@@ -334,6 +334,10 @@ class CachedOp:
         from .. import autograd
         from ..ops.registry import OpDef, invoke
 
+        # probe params before anything else (deferred init must surface
+        # before signatures or RNG are touched)
+        for _n, p in param_list:
+            p.data(ctx)
         sig = (tuple((tuple(x.shape), str(x._data.dtype)) for x in inputs),
                tuple((tuple(p.shape), str(p.dtype)) for _n, p in param_list),
                autograd.is_training())
@@ -342,8 +346,15 @@ class CachedOp:
             entry = self._build(inputs, param_list, sig, ctx)
         jitted, meta = entry
 
+        from .. import random as mxrand
+        # fetch params FIRST: DeferredInitializationError must propagate
+        # before any RNG is consumed (keeps the eager/hybrid param-init
+        # streams identical)
         param_arrays = [p.data(ctx) for _n, p in param_list]
-        all_in = list(inputs) + param_arrays
+        # fresh PRNG key each call: random ops inside the trace draw from
+        # fold_in(key, counter) so dropout masks differ across steps
+        key = NDArray(mxrand.next_key())
+        all_in = [key] + list(inputs) + param_arrays
         n_out = meta["n_flat_out"] + len(meta["aux_params"])
         fn = jitted if n_out > 1 else meta["unwrap1"]
         opdef = OpDef(f"cached_op_{self._block.name}", fn,
@@ -368,7 +379,9 @@ class CachedOp:
         training = autograd.is_training()
         meta = {"aux_params": [], "n_flat_out": None, "tree": None}
 
-        def pure(*arrays):
+        from .. import random as mxrand
+
+        def pure(key, *arrays):
             xs = [NDArray(a) for a in arrays[:n_in]]
             override = {p: NDArray(a)
                         for p, a in zip(params, arrays[n_in:])}
@@ -376,8 +389,9 @@ class CachedOp:
             tok_p = _PARAM_OVERRIDE.set(override)
             tok_a = _AUX_CAPTURE.set(OrderedDict())
             try:
-                with autograd.pause(train_mode=training):
-                    out = block.forward(*xs)
+                with mxrand.trace_key_scope(key):
+                    with autograd.pause(train_mode=training):
+                        out = block.forward(*xs)
                 cap = _AUX_CAPTURE.get()
             finally:
                 _AUX_CAPTURE.reset(tok_a)
@@ -390,8 +404,11 @@ class CachedOp:
             return tuple(x._data for x in flat) + tuple(cap.values())
 
         # Trace eagerly once via eval_shape so meta is filled determinately
-        # before the jitted callable is used (jit traces lazily).
-        jax.eval_shape(pure, *[x._data for x in inputs],
+        # before the jitted callable is used (jit traces lazily).  The key
+        # here is a constant dummy (eval_shape executes nothing): the
+        # global RNG stream must not advance during meta-tracing.
+        jax.eval_shape(pure, jax.random.PRNGKey(0),
+                       *[x._data for x in inputs],
                        *[p.data(ctx)._data for p in params])
         jitted = jax.jit(pure)
         meta["unwrap1"] = lambda *arrays: jitted(*arrays)[0]

@@ -130,11 +130,14 @@ class CIFAR10(_DownloadedDataset):
         rec = raw.reshape(-1, 3072 + self._label_bytes())
         data = rec[:, self._label_bytes():].reshape(-1, 3, 32, 32) \
             .transpose(0, 2, 3, 1)
-        label = rec[:, self._label_bytes() - 1].astype(np.int32)
+        label = rec[:, self._label_index()].astype(np.int32)
         return data, label
 
     def _label_bytes(self):
         return 1
+
+    def _label_index(self):
+        return 0
 
     def _get_data(self):
         files = self._TRAIN_FILES if self._train else self._TEST_FILES
@@ -166,6 +169,10 @@ class CIFAR100(CIFAR10):
 
     def _label_bytes(self):
         return 2
+
+    def _label_index(self):
+        # CIFAR-100 record: <coarse><fine><3072 px>
+        return 1 if self._fine else 0
 
 
 class ImageFolderDataset(Dataset):

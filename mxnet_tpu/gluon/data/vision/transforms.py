@@ -47,15 +47,13 @@ class Normalize(HybridBlock):
 
     def __init__(self, mean=0.0, std=1.0):
         super().__init__()
-        self._mean = mean
-        self._std = std
+        self._mean = nd.array(np.asarray(mean, dtype=np.float32)
+                              .reshape(-1, 1, 1))
+        self._std = nd.array(np.asarray(std, dtype=np.float32)
+                             .reshape(-1, 1, 1))
 
     def hybrid_forward(self, F, x):
-        mean = nd.array(np.asarray(self._mean, dtype=np.float32)
-                        .reshape(-1, 1, 1))
-        std = nd.array(np.asarray(self._std, dtype=np.float32)
-                       .reshape(-1, 1, 1))
-        return (x - mean) / std
+        return (x - self._mean) / self._std
 
 
 def _resize_hwc(x, size, interp=1):
@@ -186,8 +184,8 @@ class RandomContrast(_RandomColorJitterBase):
     def forward(self, x):
         xf = x.astype("float32")
         mean = xf.mean()
-        out = xf * self._alpha() + mean * (1 - self._alpha())
-        return out.clip(0, 255).astype(str(x.dtype))
+        a = self._alpha()
+        return (xf * a + mean * (1 - a)).clip(0, 255).astype(str(x.dtype))
 
 
 class RandomSaturation(_RandomColorJitterBase):

@@ -424,17 +424,29 @@ class BidirectionalCell(HybridRecurrentCell):
         if begin_state is None:
             begin_state = self.begin_state(seq[0].shape[0],
                                            ctx=seq[0].context)
+        def _rev(frames):
+            """Per-sample reversal: with valid_length, each sample is
+            reversed only within its valid region (reference:
+            SequenceReverse with sequence_length) so the backward cell
+            never consumes padding before real data."""
+            if valid_length is None:
+                return list(reversed(frames))
+            stacked = nd.stack_arrays(frames, axis=0)   # (T, N, ...)
+            rev = nd.op.sequence_reverse(stacked, valid_length,
+                                         use_sequence_length=True)
+            return [rev[i] for i in range(len(frames))]
+
         nl = len(l_cell.state_info())
         l_out, l_states = l_cell.unroll(
             length, seq, begin_state[:nl], layout="TNC"
             if layout == "TNC" else "NTC", merge_outputs=False,
             valid_length=valid_length)
         r_out, r_states = r_cell.unroll(
-            length, list(reversed(seq)), begin_state[nl:],
+            length, _rev(seq), begin_state[nl:],
             layout="TNC" if layout == "TNC" else "NTC",
             merge_outputs=False, valid_length=valid_length)
         outputs = [nd.op.concat(lo, ro, dim=-1)
-                   for lo, ro in zip(l_out, reversed(r_out))]
+                   for lo, ro in zip(l_out, _rev(r_out))]
         if merge_outputs is None or merge_outputs:
             merged, _, _, _ = _format_sequence(length, outputs, layout, True)
             return merged, l_states + r_states

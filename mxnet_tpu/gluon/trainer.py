@@ -52,7 +52,10 @@ class Trainer:
         else:
             self._optimizer = opt.create(optimizer, param_dict=param_dict,
                                          **optimizer_params)
+        # one Updater (state set) per device copy: sharing one state across
+        # devices would double-step momentum/Adam statistics
         self._updater = opt.get_updater(self._optimizer)
+        self._dev_updaters = {0: self._updater}
 
     def _init_kvstore(self):
         arg = self._kvstore_arg
@@ -110,11 +113,19 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
+        import copy
         for i, p in enumerate(self._params):
             if p.grad_req == "null":
                 continue
-            for w, g in zip(p.list_data(), p.list_grad()):
-                self._updater(i, g, w)
+            for j, (w, g) in enumerate(zip(p.list_data(), p.list_grad())):
+                if j not in self._dev_updaters:
+                    o2 = copy.copy(self._optimizer)
+                    # shallow copy shares the count dict: detach it, else
+                    # per-device updates still double-advance t
+                    o2._index_update_count = dict(
+                        self._optimizer._index_update_count)
+                    self._dev_updaters[j] = opt.get_updater(o2)
+                self._dev_updaters[j](i, g, w)
 
     # ---------------------------------------------------------- persistence
     def save_states(self, fname):

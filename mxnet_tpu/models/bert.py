@@ -1,0 +1,226 @@
+"""BERT — the flagship model family (GluonNLP scripts/bert parity).
+
+Reference chain: the fused attention kernels live in the core
+(``src/operator/contrib/transformer.cc``); the model lived in GluonNLP
+(``bert_12_768_12`` / ``bert_24_1024_16``).  This in-tree build supplies
+BERTModel + pretrain (MLM/NSP) and SQuAD heads as HybridBlocks; under
+``hybridize()`` or the pjit path (mxnet_tpu.parallel) the whole encoder
+compiles to one XLA program with attention on batched MXU GEMMs.
+
+Internal layout is (L, B, C) time-major — the interleaved attention
+kernels' contract — with (B, L) int token inputs at the API boundary,
+matching the GluonNLP call signature ``model(inputs, token_types,
+valid_length)``.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from .transformer_blocks import TransformerEncoderCell
+
+__all__ = ["BERTEncoder", "BERTModel", "BERTForPretrain", "BERTForQA",
+           "BERTClassifier", "bert_12_768_12", "bert_24_1024_16",
+           "get_bert_model"]
+
+NEG_INF = -1e9
+
+
+class BERTEncoder(HybridBlock):
+    """Stack of transformer encoder cells (gelu, post-norm)."""
+
+    def __init__(self, units=768, hidden_size=3072, num_layers=12,
+                 num_heads=12, dropout=0.1, max_length=512,
+                 layer_norm_eps=1e-12, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._num_heads = num_heads
+        self._max_length = max_length
+        with self.name_scope():
+            self.position_weight = self.params.get(
+                "position_weight", shape=(max_length, units),
+                init="normal")
+            self.layer_norm = nn.LayerNorm(in_channels=units,
+                                           epsilon=layer_norm_eps)
+            self.dropout_layer = nn.Dropout(dropout)
+            self.transformer_cells = nn.HybridSequential()
+            for _ in range(num_layers):
+                self.transformer_cells.add(TransformerEncoderCell(
+                    units, hidden_size, num_heads, dropout,
+                    activation="gelu", layer_norm_eps=layer_norm_eps))
+
+    def hybrid_forward(self, F, x, mask=None, position_weight=None):
+        # x: (L, B, C)
+        L = x.shape[0]
+        pos = position_weight.slice_axis(axis=0, begin=0, end=L)
+        x = x + pos.expand_dims(1)
+        x = self.dropout_layer(self.layer_norm(x))
+        for cell in self.transformer_cells:
+            x = cell(x, mask)
+        return x
+
+
+class BERTModel(HybridBlock):
+    """Embeddings + encoder + pooler (GluonNLP BERTModel parity).
+
+    Call: ``model(inputs, token_types, valid_length)`` with (B, L) int32.
+    Returns (sequence_output (B, L, C), pooled_output (B, C)).
+    """
+
+    def __init__(self, units=768, hidden_size=3072, num_layers=12,
+                 num_heads=12, vocab_size=30522, token_type_vocab_size=2,
+                 max_length=512, dropout=0.1, layer_norm_eps=1e-12,
+                 use_pooler=True, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._num_heads = num_heads
+        self._use_pooler = use_pooler
+        with self.name_scope():
+            self.word_embed = nn.Embedding(vocab_size, units,
+                                           weight_initializer="normal")
+            self.token_type_embed = nn.Embedding(token_type_vocab_size,
+                                                 units,
+                                                 weight_initializer="normal")
+            self.encoder = BERTEncoder(units, hidden_size, num_layers,
+                                       num_heads, dropout, max_length,
+                                       layer_norm_eps)
+            if use_pooler:
+                self.pooler = nn.Dense(units, in_units=units,
+                                       activation="tanh", flatten=False)
+
+    def _make_mask(self, F, valid_length, L):
+        # additive mask (B*H, L, L): 0 where key < valid_length else -inf
+        steps = nd.arange(L, ctx=valid_length.context)          # (L,)
+        keys_ok = F.broadcast_lesser(
+            steps.reshape((1, L)),
+            valid_length.reshape((-1, 1)).astype("float32"))    # (B, L)
+        mask = (1.0 - keys_ok) * NEG_INF                        # (B, L)
+        mask = mask.reshape((-1, 1, 1, L))                      # (B,1,1,L)
+        mask = mask.broadcast_to((mask.shape[0], self._num_heads, L, L))
+        return mask.reshape((-1, L, L))                         # (B*H,L,L)
+
+    def hybrid_forward(self, F, inputs, token_types=None, valid_length=None):
+        B, L = inputs.shape
+        emb = self.word_embed(inputs)
+        if token_types is not None:
+            emb = emb + self.token_type_embed(token_types)
+        x = emb.swapaxes(0, 1)                                  # (L, B, C)
+        mask = None
+        if valid_length is not None:
+            mask = self._make_mask(F, valid_length, L)
+        out = self.encoder(x, mask)                             # (L, B, C)
+        seq = out.swapaxes(0, 1)                                # (B, L, C)
+        if not self._use_pooler:
+            return seq
+        pooled = self.pooler(seq.slice_axis(axis=1, begin=0, end=1)
+                             .squeeze(axis=1))
+        return seq, pooled
+
+
+class BERTForPretrain(HybridBlock):
+    """MLM + NSP heads over BERTModel (GluonNLP BERTForPretrain)."""
+
+    def __init__(self, bert: BERTModel, vocab_size=None, **kwargs):
+        super().__init__(**kwargs)
+        units = bert._units
+        self._vocab_size = vocab_size or \
+            bert.word_embed._input_dim
+        with self.name_scope():
+            self.bert = bert
+            self.mlm_dense = nn.Dense(units, in_units=units,
+                                      flatten=False)
+            self.mlm_norm = nn.LayerNorm(in_channels=units, epsilon=1e-12)
+            self.mlm_decoder = nn.Dense(self._vocab_size, in_units=units,
+                                        flatten=False)
+            self.nsp_classifier = nn.Dense(2, in_units=units)
+
+    def hybrid_forward(self, F, inputs, token_types, valid_length,
+                       masked_positions):
+        seq, pooled = self.bert(inputs, token_types, valid_length)
+        # gather the masked positions: (B, M, C)
+        gathered = _gather_positions(F, seq, masked_positions)
+        h = self.mlm_dense(gathered)
+        h = F._contrib_gelu_erf(h)
+        h = self.mlm_norm(h)
+        mlm_scores = self.mlm_decoder(h)          # (B, M, V)
+        nsp_scores = self.nsp_classifier(pooled)  # (B, 2)
+        return mlm_scores, nsp_scores
+
+
+def _gather_positions(F, seq, positions):
+    """seq (B, L, C), positions (B, M) -> (B, M, C)."""
+    B, L, C = seq.shape
+    M = positions.shape[1]
+    flat = seq.reshape((B * L, C))
+    offset = nd.arange(B, ctx=seq.context).reshape((B, 1)) * L
+    idx = (positions.astype("float32") + offset).reshape((-1,))
+    out = F.take(flat, idx.astype("int32"), axis=0)
+    return out.reshape((B, M, C))
+
+
+class BERTClassifier(HybridBlock):
+    """Sentence-pair classification head (GluonNLP BERTClassifier)."""
+
+    def __init__(self, bert: BERTModel, num_classes=2, dropout=0.1,
+                 **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.bert = bert
+            self.classifier = nn.HybridSequential()
+            self.classifier.add(nn.Dropout(dropout))
+            self.classifier.add(nn.Dense(num_classes,
+                                         in_units=bert._units))
+
+    def hybrid_forward(self, F, inputs, token_types, valid_length=None):
+        _, pooled = self.bert(inputs, token_types, valid_length)
+        return self.classifier(pooled)
+
+
+class BERTForQA(HybridBlock):
+    """SQuAD span head (GluonNLP BertForQA): (B, L, 2) start/end logits."""
+
+    def __init__(self, bert: BERTModel, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.bert = bert
+            self.span_classifier = nn.Dense(2, in_units=bert._units,
+                                            flatten=False)
+
+    def hybrid_forward(self, F, inputs, token_types, valid_length=None):
+        seq, _ = self.bert(inputs, token_types, valid_length)
+        scores = self.span_classifier(seq)        # (B, L, 2)
+        return scores
+
+
+_BERT_CONFIGS = {
+    "bert_12_768_12": dict(units=768, hidden_size=3072, num_layers=12,
+                           num_heads=12),
+    "bert_24_1024_16": dict(units=1024, hidden_size=4096, num_layers=24,
+                            num_heads=16),
+}
+
+
+def get_bert_model(model_name="bert_12_768_12", vocab_size=30522,
+                   dropout=0.1, max_length=512, use_pooler=True, **kwargs):
+    if model_name not in _BERT_CONFIGS:
+        raise MXNetError(f"unknown bert config {model_name!r}; "
+                         f"known: {sorted(_BERT_CONFIGS)}")
+    cfg = dict(_BERT_CONFIGS[model_name])
+    cfg.update(kwargs)
+    return BERTModel(vocab_size=vocab_size, dropout=dropout,
+                     max_length=max_length, use_pooler=use_pooler, **cfg)
+
+
+def bert_12_768_12(**kwargs):
+    """BERT-base (GluonNLP name)."""
+    return get_bert_model("bert_12_768_12", **kwargs)
+
+
+def bert_24_1024_16(**kwargs):
+    """BERT-large (GluonNLP name) — the north-star pretrain config."""
+    return get_bert_model("bert_24_1024_16", **kwargs)

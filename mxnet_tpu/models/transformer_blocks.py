@@ -1,0 +1,178 @@
+"""Shared transformer building blocks (GluonNLP-parity layers).
+
+The reference core ships only the fused attention matmul kernels
+(``src/operator/contrib/transformer.cc``); the model-level blocks lived in
+GluonNLP.  Here both live in-tree: these HybridBlocks call the same
+``_contrib_interleaved_matmul_*`` ops, so the attention math hits batched
+MXU GEMMs, and under ``hybridize()``/pjit the whole cell fuses into one
+XLA program.  For long sequences the same API can route to the Pallas
+flash-attention kernel (ops/pallas_kernels.py) via ``use_flash``.
+"""
+from __future__ import annotations
+
+import math
+
+from ..base import MXNetError
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+
+__all__ = ["PositionwiseFFN", "MultiHeadSelfAttention",
+           "MultiHeadAttention", "TransformerEncoderCell",
+           "TransformerDecoderCell"]
+
+
+class PositionwiseFFN(HybridBlock):
+    """FFN(x) = W2 act(W1 x) with residual+LN (GluonNLP BERT layout)."""
+
+    def __init__(self, units, hidden_size, dropout=0.0, activation="gelu",
+                 layer_norm_eps=1e-5, pre_norm=False, **kwargs):
+        super().__init__(**kwargs)
+        self._pre_norm = pre_norm
+        with self.name_scope():
+            self.ffn_1 = nn.Dense(hidden_size, in_units=units,
+                                  flatten=False, prefix="ffn_1_")
+            self.ffn_2 = nn.Dense(units, in_units=hidden_size,
+                                  flatten=False, prefix="ffn_2_")
+            self.layer_norm = nn.LayerNorm(in_channels=units,
+                                           epsilon=layer_norm_eps)
+            self.dropout_layer = nn.Dropout(dropout)
+        self._activation = activation
+
+    def _act(self, F, x):
+        if self._activation == "gelu":
+            return F._contrib_gelu_erf(x)
+        if self._activation == "gelu_tanh":
+            return F._contrib_gelu_tanh(x)
+        return F.Activation(x, act_type=self._activation)
+
+    def hybrid_forward(self, F, x):
+        residual = x
+        if self._pre_norm:
+            x = self.layer_norm(x)
+        out = self.ffn_1(x)
+        out = self._act(F, out)
+        out = self.ffn_2(out)
+        out = self.dropout_layer(out)
+        out = out + residual
+        if not self._pre_norm:
+            out = self.layer_norm(out)
+        return out
+
+
+class MultiHeadSelfAttention(HybridBlock):
+    """Self-attention over (L, B, C) via the interleaved qkv kernels
+    (reference op: _contrib_interleaved_matmul_selfatt_qk/valatt)."""
+
+    def __init__(self, units, num_heads, dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        if units % num_heads:
+            raise MXNetError(f"units {units} not divisible by heads "
+                             f"{num_heads}")
+        self._units = units
+        self._heads = num_heads
+        with self.name_scope():
+            self.qkv = nn.Dense(3 * units, in_units=units, flatten=False,
+                                prefix="qkv_")
+            self.out_proj = nn.Dense(units, in_units=units, flatten=False,
+                                     prefix="out_proj_")
+            self.dropout_layer = nn.Dropout(dropout)
+
+    def hybrid_forward(self, F, x, mask=None):
+        # x: (L, B, C). qkv: (L, B, 3C) interleaved per head [q|k|v]
+        qkv = self.qkv(x)
+        scores = F._contrib_interleaved_matmul_selfatt_qk(
+            qkv, heads=self._heads)            # (B*H, L, L)
+        if mask is not None:
+            scores = scores + mask
+        att = F.softmax(scores, axis=-1)
+        att = self.dropout_layer(att)
+        out = F._contrib_interleaved_matmul_selfatt_valatt(
+            qkv, att, heads=self._heads)       # (L, B, C)
+        return self.out_proj(out)
+
+
+class MultiHeadAttention(HybridBlock):
+    """Cross-attention: q from decoder (L_q,B,C), kv from memory
+    (L_kv,B,C) via the encdec interleaved kernels."""
+
+    def __init__(self, units, num_heads, dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._heads = num_heads
+        with self.name_scope():
+            self.q_proj = nn.Dense(units, in_units=units, flatten=False,
+                                   prefix="q_proj_")
+            self.kv_proj = nn.Dense(2 * units, in_units=units,
+                                    flatten=False, prefix="kv_proj_")
+            self.out_proj = nn.Dense(units, in_units=units, flatten=False,
+                                     prefix="out_proj_")
+            self.dropout_layer = nn.Dropout(dropout)
+
+    def hybrid_forward(self, F, x, mem, mask=None):
+        q = self.q_proj(x)
+        kv = self.kv_proj(mem)
+        scores = F._contrib_interleaved_matmul_encdec_qk(
+            q, kv, heads=self._heads)          # (B*H, L_q, L_kv)
+        if mask is not None:
+            scores = scores + mask
+        att = F.softmax(scores, axis=-1)
+        att = self.dropout_layer(att)
+        out = F._contrib_interleaved_matmul_encdec_valatt(
+            kv, att, heads=self._heads)
+        return self.out_proj(out)
+
+
+class TransformerEncoderCell(HybridBlock):
+    """Post-norm transformer encoder layer (BERT layout)."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 activation="gelu", layer_norm_eps=1e-5, pre_norm=False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._pre_norm = pre_norm
+        with self.name_scope():
+            self.attention = MultiHeadSelfAttention(units, num_heads,
+                                                    dropout)
+            self.attn_norm = nn.LayerNorm(in_channels=units,
+                                          epsilon=layer_norm_eps)
+            self.dropout_layer = nn.Dropout(dropout)
+            self.ffn = PositionwiseFFN(units, hidden_size, dropout,
+                                       activation, layer_norm_eps,
+                                       pre_norm)
+
+    def hybrid_forward(self, F, x, mask=None):
+        residual = x
+        h = self.attn_norm(x) if self._pre_norm else x
+        h = self.attention(h, mask)
+        h = self.dropout_layer(h)
+        h = h + residual
+        if not self._pre_norm:
+            h = self.attn_norm(h)
+        return self.ffn(h)
+
+
+class TransformerDecoderCell(HybridBlock):
+    """Decoder layer: masked self-att, cross-att, FFN."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 activation="relu", layer_norm_eps=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.self_attention = MultiHeadSelfAttention(units, num_heads,
+                                                         dropout)
+            self.self_norm = nn.LayerNorm(in_channels=units,
+                                          epsilon=layer_norm_eps)
+            self.cross_attention = MultiHeadAttention(units, num_heads,
+                                                      dropout)
+            self.cross_norm = nn.LayerNorm(in_channels=units,
+                                           epsilon=layer_norm_eps)
+            self.dropout_layer = nn.Dropout(dropout)
+            self.ffn = PositionwiseFFN(units, hidden_size, dropout,
+                                       activation, layer_norm_eps)
+
+    def hybrid_forward(self, F, x, mem, self_mask=None, mem_mask=None):
+        h = self.self_attention(x, self_mask)
+        h = self.self_norm(x + self.dropout_layer(h))
+        c = self.cross_attention(h, mem, mem_mask)
+        c = self.cross_norm(h + self.dropout_layer(c))
+        return self.ffn(c)

@@ -116,8 +116,23 @@ class Optimizer:
     def update(self, index, weight, grad, state):
         raise NotImplementedError
 
+    def _is_mp_state(self, weight, state):
+        return (self.multi_precision
+                and str(weight.dtype) in ("float16", "bfloat16")
+                and isinstance(state, tuple) and len(state) == 2
+                and isinstance(state[0], NDArray)
+                and state[0].shape == weight.shape)
+
     def update_multi_precision(self, index, weight, grad, state):
-        self.update(index, weight, grad, state)
+        """Generic fp16/bf16 path: update the fp32 master copy with the
+        inner state, then cast back (reference: update_multi_precision).
+        Optimizers with fused mp ops (SGD) override this."""
+        if self._is_mp_state(weight, state):
+            w32, base_state = state
+            self.update(index, w32, grad.astype("float32"), base_state)
+            weight._set_data(w32._data.astype(weight._data.dtype))
+        else:
+            self.update(index, weight, grad, state)
 
     # --------------------------------------------------------- serialization
     def __getstate__(self):

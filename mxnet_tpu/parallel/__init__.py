@@ -1,0 +1,28 @@
+"""Parallelism over TPU meshes (SURVEY.md §2.4: P1-P8 + new TP/SP).
+
+The reference scaled via kvstore tiers (local reduce / NCCL / ps-lite —
+SURVEY.md §5.8); the TPU-native design scales via ONE mechanism: shard
+annotations over a ``jax.sharding.Mesh`` compiled by GSPMD, with XLA
+inserting the ICI/DCN collectives.  This package supplies:
+
+- mesh construction (``make_mesh``) with named axes dp/tp/sp;
+- ``functionalize``: trace a Gluon Block into a pure fn of
+  (params, inputs) — the bridge from the imperative API to pjit;
+- sharding rules (regex -> PartitionSpec) with Megatron-style defaults
+  for the in-tree transformer blocks;
+- pure pytree optimizers (sgd/adamw/lamb) for inside compiled steps;
+- ``ShardedTrainer``: one compiled train step = fwd + bwd + update with
+  dp/tp shardings (replaces Trainer+kvstore at pod scale);
+- ring attention (context parallelism over the ICI ring via ppermute).
+"""
+from .mesh import make_mesh, mesh_axis_size
+from .functional import functionalize
+from .sharding import ShardingRules, MEGATRON_RULES, partition_params
+from .optim import sgd_init, sgd_update, adamw_init, adamw_update
+from .trainer import ShardedTrainer
+from .ring_attention import ring_attention, ring_self_attention
+
+__all__ = ["make_mesh", "mesh_axis_size", "functionalize",
+           "ShardingRules", "MEGATRON_RULES", "partition_params",
+           "sgd_init", "sgd_update", "adamw_init", "adamw_update",
+           "ShardedTrainer", "ring_attention", "ring_self_attention"]

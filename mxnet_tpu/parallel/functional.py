@@ -1,0 +1,66 @@
+"""Bridge from the imperative Gluon API to pure functions for pjit.
+
+``functionalize(block, *example_inputs)`` returns ``(apply_fn, params)``:
+``apply_fn(params_dict, *input_arrays) -> (outputs, aux_updates)`` is a
+pure traced re-execution of the block's forward (same mechanism as the
+CachedOp, gluon/block.py), so the identical model object drives both the
+eager path and pod-scale pjit training.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+from .. import autograd
+from ..ndarray import NDArray
+from ..gluon.block import _AUX_CAPTURE, _TRACING, _flatten
+from ..gluon.parameter import _PARAM_OVERRIDE
+
+__all__ = ["functionalize"]
+
+
+def functionalize(block, *example_inputs, train_mode=True):
+    """Returns (apply_fn, init_params).
+
+    apply_fn(params: dict[str, Array], *inputs: Array)
+        -> (tuple_of_outputs, dict_of_aux_updates)
+    init_params: dict[str, jax.Array] snapshot of current values.
+    """
+    # resolve deferred shapes with one imperative pass — only when needed
+    # (the pass runs op-by-op; for fully-specified models skip it)
+    needs_pass = any(p._deferred_init is not None or not p._data
+                     for p in block.collect_params().values())
+    if needs_pass:
+        nd_inputs = [x if isinstance(x, NDArray) else NDArray(x)
+                     for x in example_inputs]
+        was_active = getattr(block, "_active", False)
+        if hasattr(block, "hybridize"):
+            block.hybridize(False)
+        with autograd.pause(train_mode=train_mode):
+            block(*nd_inputs)
+        if hasattr(block, "hybridize") and was_active:
+            block.hybridize(True)
+
+    params = OrderedDict(block.collect_params().items())
+    names = list(params)
+
+    def apply_fn(param_arrays, *input_arrays):
+        xs = [NDArray(a) for a in input_arrays]
+        override = {params[n]: NDArray(param_arrays[n]) for n in names}
+        tok_t = _TRACING.set(True)
+        tok_p = _PARAM_OVERRIDE.set(override)
+        tok_a = _AUX_CAPTURE.set(OrderedDict())
+        try:
+            with autograd.pause(train_mode=train_mode):
+                out = block.forward(*xs)
+            cap = _AUX_CAPTURE.get()
+        finally:
+            _AUX_CAPTURE.reset(tok_a)
+            _PARAM_OVERRIDE.reset(tok_p)
+            _TRACING.reset(tok_t)
+        flat, tree = _flatten(out)
+        aux = {p.name: v for p, v in cap.items()}
+        outs = tuple(x._data for x in flat)
+        return (outs[0] if tree is None else outs), aux
+
+    init_params = {n: params[n].data()._data for n in names}
+    return apply_fn, init_params

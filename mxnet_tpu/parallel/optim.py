@@ -1,0 +1,82 @@
+"""Pure pytree optimizers for compiled train steps.
+
+Same math as the fused ops (ops/optimizer_ops.py) but over whole param
+pytrees, so the entire update fuses into the pjit step program and XLA
+donates the buffers (the in-place behavior of the reference's fused
+optimizer ops at the memory level).
+
+NOTE: update fns use one tree_map per returned tree — a single tree_map
+whose fn returns a tuple would NEST the tuple into the pytree (tree_map
+treats tuples as subtrees, not leaves).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["sgd_init", "sgd_update", "adamw_init", "adamw_update",
+           "lamb_init", "lamb_update"]
+
+_tree_map = jax.tree_util.tree_map
+
+
+# ------------------------------------------------------------------- SGD
+def sgd_init(params):
+    return {"mom": _tree_map(jnp.zeros_like, params)}
+
+
+def sgd_update(params, grads, state, lr=0.01, momentum=0.9, wd=0.0):
+    new_m = _tree_map(
+        lambda w, g, m: momentum * m - lr * (g + wd * w),
+        params, grads, state["mom"])
+    new_p = _tree_map(lambda w, m: w + m, params, new_m)
+    return new_p, {"mom": new_m}
+
+
+# ----------------------------------------------------------------- AdamW
+def adamw_init(params):
+    return {"mean": _tree_map(jnp.zeros_like, params),
+            "var": _tree_map(jnp.zeros_like, params),
+            "step": jnp.zeros((), jnp.int32)}
+
+
+def adamw_update(params, grads, state, lr=1e-3, beta1=0.9, beta2=0.999,
+                 eps=1e-8, wd=0.01):
+    step = state["step"] + 1
+    c1 = 1.0 - beta1 ** step.astype(jnp.float32)
+    c2 = 1.0 - beta2 ** step.astype(jnp.float32)
+    new_m = _tree_map(lambda g, m: beta1 * m + (1 - beta1) * g,
+                      grads, state["mean"])
+    new_v = _tree_map(lambda g, v: beta2 * v + (1 - beta2) * jnp.square(g),
+                      grads, state["var"])
+    new_p = _tree_map(
+        lambda w, m, v: w - lr * ((m / c1) / (jnp.sqrt(v / c2) + eps)
+                                  + wd * w),
+        params, new_m, new_v)
+    return new_p, {"mean": new_m, "var": new_v, "step": step}
+
+
+# ------------------------------------------------------------------ LAMB
+def lamb_init(params):
+    return adamw_init(params)
+
+
+def lamb_update(params, grads, state, lr=1e-3, beta1=0.9, beta2=0.999,
+                eps=1e-6, wd=0.01):
+    step = state["step"] + 1
+    c1 = 1.0 - beta1 ** step.astype(jnp.float32)
+    c2 = 1.0 - beta2 ** step.astype(jnp.float32)
+    new_m = _tree_map(lambda g, m: beta1 * m + (1 - beta1) * g,
+                      grads, state["mean"])
+    new_v = _tree_map(lambda g, v: beta2 * v + (1 - beta2) * jnp.square(g),
+                      grads, state["var"])
+
+    def upd(w, m, v):
+        u = (m / c1) / (jnp.sqrt(v / c2) + eps) + wd * w
+        r1 = jnp.linalg.norm(w.reshape(-1))
+        r2 = jnp.linalg.norm(u.reshape(-1))
+        ratio = jnp.where((r1 > 0) & (r2 > 0), r1 / r2, 1.0)
+        return w - lr * ratio * u
+
+    new_p = _tree_map(upd, params, new_m, new_v)
+    return new_p, {"mean": new_m, "var": new_v, "step": step}

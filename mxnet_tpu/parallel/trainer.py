@@ -1,0 +1,119 @@
+"""ShardedTrainer: ONE compiled train step over a device mesh.
+
+Replaces Trainer+kvstore at pod scale (SURVEY.md §3.4 TPU mapping): the
+entire fwd+bwd+optimizer+allreduce is a single pjit program; XLA lowers
+the gradient reductions to ICI/DCN collectives from the shardings alone.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+from . import optim as _optim
+from .functional import functionalize
+from .sharding import MEGATRON_RULES, partition_params
+
+__all__ = ["ShardedTrainer"]
+
+def _sgd_shardings(ps, repl):
+    return {"mom": dict(ps)}
+
+
+def _adam_shardings(ps, repl):
+    return {"mean": dict(ps), "var": dict(ps), "step": repl}
+
+
+_OPTIMS = {
+    "sgd": (_optim.sgd_init, _optim.sgd_update, _sgd_shardings),
+    "adamw": (_optim.adamw_init, _optim.adamw_update, _adam_shardings),
+    "lamb": (_optim.lamb_init, _optim.lamb_update, _adam_shardings),
+}
+
+
+class ShardedTrainer:
+    """Compile a data+tensor-parallel training step for a Gluon block.
+
+    loss_fn(outputs, *labels) -> scalar, written in jnp over raw arrays.
+    Batch dims of inputs/labels are sharded over "dp"; params follow
+    ``rules`` (default Megatron TP).  Donation gives in-place updates.
+    """
+
+    def __init__(self, block, loss_fn, mesh: Mesh, optimizer="adamw",
+                 optimizer_params=None, rules=MEGATRON_RULES,
+                 example_inputs=(), n_labels=1, dtype=None):
+        if optimizer not in _OPTIMS:
+            raise MXNetError(f"unknown optimizer {optimizer!r}; "
+                             f"known: {sorted(_OPTIMS)}")
+        self.mesh = mesh
+        self.block = block
+        opt_init, opt_update, opt_shard = _OPTIMS[optimizer]
+        opt_kw = dict(optimizer_params or {})
+        if "learning_rate" in opt_kw:
+            opt_kw["lr"] = opt_kw.pop("learning_rate")
+
+        apply_fn, params = functionalize(block, *example_inputs,
+                                         train_mode=True)
+        if dtype is not None:
+            params = {n: a.astype(dtype) if jnp.issubdtype(
+                a.dtype, jnp.floating) else a for n, a in params.items()}
+        self.params, self.param_shardings = partition_params(
+            params, mesh, rules)
+        self.opt_state = opt_init(self.params)
+        self._n_inputs = len(example_inputs)
+
+        batch_spec = NamedSharding(mesh, P("dp"))
+        repl = NamedSharding(mesh, P())
+        # pin optimizer-state shardings: without this the first step's
+        # outputs carry compiler-chosen shardings, every subsequent call
+        # misses the jit cache and RECOMPILES the whole step
+        opt_shardings = opt_shard(self.param_shardings, repl)
+        self.opt_state = jax.tree_util.tree_map(
+            lambda a, s: jax.device_put(a, s), self.opt_state,
+            opt_shardings)
+
+        def train_step(params, opt_state, *batch):
+            inputs = batch[:self._n_inputs]
+            labels = batch[self._n_inputs:]
+
+            def loss_of(p):
+                out, aux = apply_fn(p, *inputs)
+                return loss_fn(out, *labels), aux
+
+            (loss, aux), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(params)
+            new_params, new_state = opt_update(params, grads, opt_state,
+                                               **opt_kw)
+            return new_params, new_state, loss
+
+        self._step = jax.jit(
+            train_step,
+            donate_argnums=(0, 1),
+            out_shardings=(self.param_shardings, opt_shardings, repl))
+        self._batch_spec = batch_spec
+
+    def shard_batch(self, *arrays):
+        """Place host arrays batch-sharded over dp."""
+        out = []
+        for a in arrays:
+            spec = P(*(["dp"] + [None] * (a.ndim - 1)))
+            out.append(jax.device_put(a, NamedSharding(self.mesh, spec)))
+        return tuple(out)
+
+    def step(self, *batch):
+        """One compiled step; returns the (replicated) scalar loss."""
+        batch = self.shard_batch(*[getattr(b, "_data", b) for b in batch])
+        self.params, self.opt_state, loss = self._step(
+            self.params, self.opt_state, *batch)
+        return loss
+
+    def write_back(self):
+        """Copy trained params back into the Block's Parameters."""
+        for name, p in self.block.collect_params().items():
+            if name in self.params:
+                arr = p.data()
+                arr._set_data(jax.device_put(
+                    self.params[name],
+                    arr._data.sharding if hasattr(arr._data, "sharding")
+                    else None).astype(arr._data.dtype))

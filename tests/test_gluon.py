@@ -218,3 +218,49 @@ def test_block_summary_runs(capsys):
     net.initialize()
     net.summary(nd.ones((1, 5)))
     assert "Total params" in capsys.readouterr().out
+
+
+def test_hybrid_dropout_varies_across_calls():
+    """CachedOp must feed a fresh PRNG key per call (review finding:
+    baked-constant keys repeat the same dropout mask every step)."""
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dropout(0.5))
+    net.initialize()
+    net.hybridize()
+    x = nd.ones((4, 64))
+    with autograd.record():
+        m1 = net(x).asnumpy()
+    with autograd.record():
+        m2 = net(x).asnumpy()
+    assert (m1 != m2).any(), "identical dropout masks across calls"
+    # eval mode: dropout off
+    assert np.allclose(net(x).asnumpy(), 1.0)
+
+
+def test_multi_precision_adam():
+    """multi_precision with non-SGD optimizers (review finding)."""
+    import mxnet_tpu.optimizer as opt
+    w = nd.array(np.ones((4,), np.float16), dtype="float16")
+    g = nd.array(np.full((4,), 0.5, np.float16), dtype="float16")
+    o = opt.Adam(learning_rate=0.1, multi_precision=True)
+    state = o.create_state_multi_precision(0, w)
+    assert isinstance(state, tuple) and str(state[0].dtype) == "float32"
+    o.update_multi_precision(0, w, g, state)
+    assert str(w.dtype) == "float16"
+    assert (w.asnumpy() < 1.0).all()
+
+
+def test_trainer_multi_device_state_not_double_stepped():
+    """Per-device updaters (review finding: shared state double-steps)."""
+    p = gluon.Parameter("w", shape=(2,))
+    p.initialize(ctx=[mx.cpu(0)])
+    # simulate 2 device copies
+    import mxnet_tpu.context as ctx_mod
+    trainer = gluon.Trainer([p], "adam", {"learning_rate": 0.1})
+    with autograd.record():
+        loss = (p.data() * p.data()).sum()
+    loss.backward()
+    trainer.step(1)
+    t = trainer._updater.optimizer._index_update_count[0]
+    assert t == 1, t

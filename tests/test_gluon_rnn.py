@@ -105,3 +105,28 @@ def test_cell_unroll_valid_length():
     o = out.asnumpy()
     assert np.abs(o[0, 3:]).sum() == 0  # masked past valid_length
     assert np.abs(o[1, :5]).sum() > 0
+
+
+def test_bidirectional_valid_length_reversal():
+    """Backward cell must see each sample reversed within its valid region
+    (review finding: naive reversal feeds padding first)."""
+    H, I = 4, 3
+    l_cell = rnn.GRUCell(H, input_size=I)
+    r_cell = rnn.GRUCell(H, input_size=I)
+    bi = rnn.BidirectionalCell(l_cell, r_cell)
+    bi.initialize()
+    T = 6
+    np.random.seed(0)
+    x_short = np.random.randn(1, 4, I).astype(np.float32)  # 4 valid steps
+    x_pad = np.concatenate(
+        [x_short, np.zeros((1, 2, I), np.float32)], axis=1)  # pad to 6
+    # padded batch with valid_length=4
+    out_pad, _ = bi.unroll(T, nd.array(x_pad), layout="NTC",
+                           merge_outputs=True,
+                           valid_length=nd.array(np.array([4.0])))
+    # unpadded reference run
+    out_ref, _ = bi.unroll(4, nd.array(x_short), layout="NTC",
+                           merge_outputs=True)
+    a = out_pad.asnumpy()[0, :4]
+    b = out_ref.asnumpy()[0]
+    assert np.allclose(a, b, atol=1e-5), np.abs(a - b).max()

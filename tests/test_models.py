@@ -1,0 +1,136 @@
+"""Model-family tests (BERT, NMT transformer, model_zoo vision)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd, models
+
+
+def _tiny_bert(**kw):
+    cfg = dict(vocab_size=64, units=32, hidden_size=64, num_layers=2,
+               num_heads=4, max_length=32, dropout=0.0)
+    cfg.update(kw)
+    return models.get_bert_model("bert_12_768_12", **cfg)
+
+
+def test_bert_forward_shapes():
+    bert = _tiny_bert()
+    bert.initialize()
+    B, L = 2, 16
+    inp = nd.array(np.random.randint(0, 64, (B, L)), dtype="int32")
+    tt = nd.zeros((B, L), dtype="int32")
+    vl = nd.array(np.array([16, 9], dtype=np.float32))
+    seq, pooled = bert(inp, tt, vl)
+    assert seq.shape == (B, L, 32)
+    assert pooled.shape == (B, 32)
+
+
+def test_bert_valid_length_masks_attention():
+    """Tokens past valid_length must not influence earlier positions."""
+    bert = _tiny_bert()
+    bert.initialize()
+    B, L = 1, 8
+    base = np.random.randint(1, 64, (B, L)).astype(np.int32)
+    vl = nd.array(np.array([4], dtype=np.float32))
+    tt = nd.zeros((B, L), dtype="int32")
+    seq1, _ = bert(nd.array(base, dtype="int32"), tt, vl)
+    changed = base.copy()
+    changed[0, 5] = (changed[0, 5] + 7) % 64   # mutate a masked-out token
+    seq2, _ = bert(nd.array(changed, dtype="int32"), tt, vl)
+    a = seq1.asnumpy()[0, :4]
+    b = seq2.asnumpy()[0, :4]
+    assert np.allclose(a, b, atol=1e-5), np.abs(a - b).max()
+
+
+def test_bert_pretrain_heads():
+    bert = _tiny_bert()
+    bert.initialize()
+    head = models.BERTForPretrain(bert, vocab_size=64)
+    head.initialize()
+    B, L, M = 2, 16, 3
+    inp = nd.array(np.random.randint(0, 64, (B, L)), dtype="int32")
+    tt = nd.zeros((B, L), dtype="int32")
+    vl = nd.array(np.full((B,), L, np.float32))
+    mpos = nd.array(np.random.randint(0, L, (B, M)), dtype="int32")
+    with autograd.record():
+        mlm, nsp = head(inp, tt, vl, mpos)
+        loss = mlm.sum() + nsp.sum()
+    loss.backward()
+    assert mlm.shape == (B, M, 64)
+    assert nsp.shape == (B, 2)
+    g = bert.word_embed.weight.grad().asnumpy()
+    assert np.abs(g).sum() > 0
+
+
+def test_bert_qa_head():
+    bert = _tiny_bert()
+    bert.initialize()
+    qa = models.BERTForQA(bert)
+    qa.initialize()
+    inp = nd.array(np.random.randint(0, 64, (2, 16)), dtype="int32")
+    tt = nd.zeros((2, 16), dtype="int32")
+    out = qa(inp, tt, nd.array(np.full((2,), 16, np.float32)))
+    assert out.shape == (2, 16, 2)
+
+
+def _tiny_nmt():
+    return models.transformer_base(32, 40, units=16, hidden_size=32,
+                                   num_layers=2, num_heads=2,
+                                   max_length=64, dropout=0.0)
+
+
+def test_transformer_train_and_decode():
+    tr = _tiny_nmt()
+    tr.initialize()
+    src = nd.array(np.random.randint(4, 32, (2, 10)), dtype="int32")
+    tgt = nd.array(np.random.randint(4, 40, (2, 8)), dtype="int32")
+    sv = nd.array(np.array([10, 7], dtype=np.float32))
+    logits = tr(src, tgt, sv)
+    assert logits.shape == (2, 8, 40)
+    loss_fn = models.SmoothedSoftmaxCELoss(smoothing=0.1)
+    lab = nd.array(np.random.randint(0, 40, (2, 8)))
+    with autograd.record():
+        lg = tr(src, tgt, sv)
+        loss = loss_fn(lg, lab,
+                       nd.array(np.array([8, 6], dtype=np.float32)))
+    loss.backward()
+    assert np.isfinite(loss.asnumpy()).all()
+    out = tr.greedy_decode(src, sv, max_decode_len=4)
+    assert out.shape[0] == 2 and out.shape[1] <= 5
+    beam = tr.beam_search(src.slice_axis(axis=0, begin=0, end=1),
+                          sv.slice_axis(axis=0, begin=0, end=1),
+                          beam_size=2, max_decode_len=3)
+    assert beam.asnumpy()[0, 0] == 2  # starts with BOS
+
+
+def test_transformer_causal_mask():
+    """Changing a later target token must not change earlier logits."""
+    tr = _tiny_nmt()
+    tr.initialize()
+    src = nd.array(np.random.randint(4, 32, (1, 6)), dtype="int32")
+    tgt1 = np.random.randint(4, 40, (1, 6)).astype(np.int32)
+    tgt2 = tgt1.copy()
+    tgt2[0, 4] = (tgt2[0, 4] + 3) % 36 + 4
+    l1 = tr(src, nd.array(tgt1, dtype="int32")).asnumpy()
+    l2 = tr(src, nd.array(tgt2, dtype="int32")).asnumpy()
+    assert np.allclose(l1[0, :4], l2[0, :4], atol=1e-5)
+    assert not np.allclose(l1[0, 4:], l2[0, 4:], atol=1e-5)
+
+
+def test_label_smoothing_loss_value():
+    logits = np.log(np.full((1, 1, 4), 0.25, dtype=np.float32))
+    lab = nd.array(np.array([[1]], dtype=np.float32))
+    loss = models.SmoothedSoftmaxCELoss(smoothing=0.1)(
+        nd.array(logits), lab).asnumpy()
+    # uniform logits: nll == smooth == log(4)
+    assert np.allclose(loss, np.log(4), atol=1e-5)
+
+
+@pytest.mark.parametrize("name", ["resnet18_v1", "resnet18_v2",
+                                  "mobilenet_v2_1.0".replace("_v2_", "v2_"),
+                                  "squeezenet1.0"])
+def test_model_zoo_forward(name):
+    net = gluon.model_zoo.get_model(name, classes=10)
+    net.initialize()
+    out = net(nd.random.uniform(shape=(1, 3, 64, 64)))
+    assert out.shape == (1, 10)
