@@ -2,9 +2,14 @@
 (reference: python/mxnet/gluon/trainer.py; SURVEY.md §3.4).
 
 Gradient flow per step: backward fills per-ctx grads → `_allreduce_grads`
-sums them across devices through the kvstore (on TPU: XLA collectives) →
-the optimizer updates each ctx copy.  With a single device (or with
-sharded params under the parallel/pjit path) the reduce is a no-op.
+sums them across devices through the kvstore (on TPU: one fused XLA
+collective for the 'xla' tier) → the optimizer updates each ctx copy.
+With a single device the reduce is a no-op and no kvstore is created.
+
+One Optimizer instance is shared by every per-device updater; per-device
+update counts are kept separate via ``Optimizer._set_current_context`` so
+hyperparameter changes (set_learning_rate, rescale_grad) reach all device
+copies while Adam-style step counters do not double-advance.
 """
 from __future__ import annotations
 
@@ -17,19 +22,19 @@ __all__ = ["Trainer"]
 
 
 class Trainer:
-    def __init__(self, params, optimizer, optimizer_params=None, kvstore=None,
-                 compression_params=None, update_on_kvstore=None):
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None,
+                 update_on_kvstore=None):
         if isinstance(params, (dict, ParameterDict)):
             params = list(params.values())
         if not isinstance(params, (list, tuple)):
             raise MXNetError("params must be a dict or list of Parameters")
         self._params = []
-        self._param2idx = {}
-        for i, p in enumerate(params):
+        for p in params:
             if not isinstance(p, Parameter):
                 raise MXNetError(f"invalid parameter {p!r}")
             self._params.append(p)
-            self._param2idx[p.name] = i
+        self._compression_params = compression_params
         self._scale = 1.0
         optimizer_params = optimizer_params or {}
         self._init_optimizer(optimizer, optimizer_params)
@@ -37,8 +42,6 @@ class Trainer:
         self._kv_initialized = False
         self._kvstore_arg = kvstore
         self._update_on_kvstore = update_on_kvstore
-        self._updaters = None
-        self._states_to_init = True
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: p for i, p in enumerate(self._params)}
@@ -52,23 +55,48 @@ class Trainer:
         else:
             self._optimizer = opt.create(optimizer, param_dict=param_dict,
                                          **optimizer_params)
-        # one Updater (state set) per device copy: sharing one state across
-        # devices would double-step momentum/Adam statistics
+        # one Updater (state set) per device copy, all driving the SAME
+        # optimizer instance (reference: Trainer._init_optimizer)
         self._updater = opt.get_updater(self._optimizer)
         self._dev_updaters = {0: self._updater}
 
+    def _num_ctx(self):
+        for p in self._params:
+            if p.grad_req != "null":
+                return len(p.list_ctx())
+        return 1
+
     def _init_kvstore(self):
         arg = self._kvstore_arg
-        if arg is None or (isinstance(arg, str) and arg == "local"
-                           and len(self._params[0].list_ctx()) <= 1):
-            # single-device: no kvstore needed
+        multi_ctx = self._num_ctx() > 1
+        if arg is None or not multi_ctx:
+            # single-device (or explicitly disabled): grads are already the
+            # full-batch grads, no cross-device reduce exists
             self._kvstore = None
+            if self._update_on_kvstore:
+                raise MXNetError(
+                    "update_on_kvstore=True requires a kvstore")
+            self._update_on_kvstore = False
         else:
             from .. import kvstore as kvs
-            self._kvstore = kvs.create(arg) if isinstance(arg, str) else arg
+            store = kvs.create(arg) if isinstance(arg, str) else arg
+            if self._compression_params is not None:
+                store.set_gradient_compression(self._compression_params)
+            update_on_kvstore = self._update_on_kvstore
+            if update_on_kvstore is None:
+                update_on_kvstore = False
+            if update_on_kvstore and not store.is_capable(
+                    kvs.KVStoreBase.OPTIMIZER):
+                raise MXNetError(
+                    f"kvstore type {store.type!r} cannot run the optimizer "
+                    f"(update_on_kvstore)")
+            self._update_on_kvstore = update_on_kvstore
             for i, p in enumerate(self._params):
                 if p.grad_req != "null":
-                    self._kvstore.init(str(i), p.data())
+                    store.init(str(i), p.data())
+            if update_on_kvstore:
+                store.set_optimizer(self._optimizer)
+            self._kvstore = store
         self._kv_initialized = True
 
     # ---------------------------------------------------------------- props
@@ -96,42 +124,79 @@ class Trainer:
     def allreduce_grads(self):
         if not self._kv_initialized:
             self._init_kvstore()
+        if self._update_on_kvstore:
+            raise MXNetError(
+                "allreduce_grads() is meaningless with "
+                "update_on_kvstore=True")
         self._allreduce_grads()
 
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
+        keys, grads = [], []
         for i, p in enumerate(self._params):
             if p.grad_req != "null":
-                grads = p.list_grad()
-                self._kvstore.pushpull(str(i), grads, out=grads)
+                keys.append(str(i))
+                grads.append(p.list_grad())
+        if not keys:
+            return
+        if self._update_on_kvstore:
+            # optimizer runs on the store's master copy: push grads, the
+            # updated weights come back in _update via pull
+            self._kvstore.push(keys, grads)
+        else:
+            # one batched call so the 'xla' tier can bucket-fuse collectives
+            self._kvstore.pushpull(keys, grads, out=grads)
 
     def update(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:
             self._init_kvstore()
+        if self._update_on_kvstore:
+            raise MXNetError(
+                "update() cannot be called when update_on_kvstore=True; "
+                "use step()")
         self._optimizer.rescale_grad = self._scale / batch_size
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
-        import copy
+        if self._update_on_kvstore:
+            for i, p in enumerate(self._params):
+                if p.grad_req != "null":
+                    self._kvstore.pull(str(i), out=p.list_data())
+            return
         for i, p in enumerate(self._params):
             if p.grad_req == "null":
                 continue
             for j, (w, g) in enumerate(zip(p.list_data(), p.list_grad())):
                 if j not in self._dev_updaters:
-                    o2 = copy.copy(self._optimizer)
-                    # shallow copy shares the count dict: detach it, else
-                    # per-device updates still double-advance t
-                    o2._index_update_count = dict(
-                        self._optimizer._index_update_count)
-                    self._dev_updaters[j] = opt.get_updater(o2)
+                    self._dev_updaters[j] = opt.get_updater(self._optimizer)
+                self._optimizer._set_current_context(j)
                 self._dev_updaters[j](i, g, w)
+        self._optimizer._set_current_context(0)
 
     # ---------------------------------------------------------- persistence
     def save_states(self, fname):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname)
+            return
         with open(fname, "wb") as f:
             f.write(self._updater.get_states(dump_optimizer=False))
 
     def load_states(self, fname):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+            return
         with open(fname, "rb") as f:
-            self._updater.set_states(f.read())
+            payload = f.read()
+        # restore into EVERY device updater — including ones that have not
+        # been lazily created yet (fresh-Trainer resume on multi-ctx params)
+        for j in range(self._num_ctx()):
+            if j not in self._dev_updaters:
+                self._dev_updaters[j] = opt.get_updater(self._optimizer)
+        for updater in self._dev_updaters.values():
+            updater.set_states(payload)
+            updater.optimizer = self._optimizer

@@ -1,0 +1,6 @@
+"""Multi-device / multi-node communication (reference:
+python/mxnet/kvstore/; SURVEY.md §2.1 KVStore row, §5.8)."""
+from .base import KVStoreBase
+from .kvstore import KVStore, create
+
+__all__ = ["KVStoreBase", "KVStore", "create"]

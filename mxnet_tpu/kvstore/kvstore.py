@@ -1,0 +1,399 @@
+"""KVStore: multi-device gradient aggregation & weight sync.
+
+Reference surface: ``python/mxnet/kvstore/kvstore.py`` + ``src/kvstore/``
+(`KVStoreLocal`, `CommDevice`, `KVStoreNCCL`) — SURVEY.md §2.1 KVStore row,
+§2.4 P1/P2/P5/P6, §5.8.
+
+TPU-native redesign (not a translation):
+
+- ``'local'`` / ``'device'``: single-process reduce across per-context
+  copies.  The reference reduces on CPU ('local') or via GPU P2P
+  ('device'); here both are one ``jax.device_put`` + add chain differing
+  only in where the reduction lands.
+- ``'xla'``: the NCCL/dist tier replacement — push/pull/pushpull lower to
+  ONE compiled XLA collective program (``shard_map`` + ``lax.psum``) over a
+  1-d device mesh, so on real hardware the reduce rides ICI without host
+  round-trips.  Small keys are fused into buckets (reference:
+  ``MXNET_KVSTORE_BIGARRAY_BOUND`` fusion in KVStoreNCCL).
+- 2-bit gradient compression with error-feedback residual (reference:
+  ``src/kvstore/gradient_compression.cc``) applies to every tier's push.
+"""
+from __future__ import annotations
+
+import functools
+from collections import OrderedDict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError, get_env
+from ..context import cpu
+from ..ndarray import NDArray
+from .. import optimizer as opt
+from .base import KVStoreBase
+
+__all__ = ["KVStore", "create"]
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+def _normalize(key, value):
+    """-> list of (str_key, [NDArray per device]) pairs."""
+    keys = _as_list(key)
+    if len(keys) == 1 and not (isinstance(value, (list, tuple))
+                               and value and isinstance(value[0],
+                                                        (list, tuple))):
+        vals = [_as_list(value)]
+    else:
+        vals = [_as_list(v) for v in value]
+    if len(keys) != len(vals):
+        raise MXNetError(
+            f"kvstore: {len(keys)} keys but {len(vals)} value lists")
+    return [(str(k), list(v)) for k, v in zip(keys, vals)]
+
+
+class _TwoBitCompressor:
+    """2-bit sign compression with error feedback
+    (reference: gradient_compression.cc)."""
+
+    def __init__(self, threshold=0.5):
+        self.threshold = float(threshold)
+        self._residual = {}
+
+    def compress(self, key, idx, grad_data):
+        thr = self.threshold
+        res = self._residual.get((key, idx))
+        if res is None:
+            res = jnp.zeros_like(grad_data)
+        g = grad_data + res
+        q = jnp.where(g >= thr, thr, 0.0) + jnp.where(g <= -thr, -thr, 0.0)
+        q = q.astype(grad_data.dtype)
+        self._residual[(key, idx)] = g - q
+        return q
+
+
+class KVStore(KVStoreBase):
+    """Classic imperative API: init / push / pull / pushpull.
+
+    Subclasses supply ``_reduce`` (aggregate per-device copies) — everything
+    else (storage, updater, compression, broadcast) is shared.
+    """
+
+    CAPABILITIES = (KVStoreBase.OPTIMIZER,)
+
+    def __init__(self):
+        self._store: "OrderedDict[str, NDArray]" = OrderedDict()
+        self._updater = None
+        self._optimizer = None
+        self._compressor = None
+
+    # ------------------------------------------------------------ identity
+    @property
+    def type(self):
+        return self._TYPE
+
+    # ---------------------------------------------------------------- init
+    def init(self, key, value):
+        for k, vals in _normalize(key, value):
+            if k in self._store:
+                raise MXNetError(f"kvstore: key {k!r} already initialized")
+            self._store[k] = self._pin(vals[0])
+
+    def _pin(self, value: NDArray) -> NDArray:
+        """Where the master copy of a key lives ('local': host cpu).
+
+        Always a fresh NDArray wrapper: ``as_in_context`` returns ``self``
+        for a same-context value, and aliasing the caller's array would let
+        pushes overwrite live weights.
+        """
+        return value.as_in_context(cpu(0)).copy()
+
+    # ---------------------------------------------------------------- push
+    def push(self, key, value, priority=0):
+        for k, vals in _normalize(key, value):
+            self._push_one(k, vals)
+
+    def _push_one(self, k, vals):
+        if k not in self._store:
+            raise MXNetError(f"kvstore: push to uninitialized key {k!r}")
+        vals = self._maybe_compress(k, vals)
+        merged = self._reduce(k, vals)
+        stored = self._store[k]
+        if self._updater is not None:
+            self._updater(int(k) if k.isdigit() else k,
+                          merged.as_in_context(stored.context), stored)
+        else:
+            stored._set_data(merged.as_in_context(stored.context)._data
+                             .astype(stored._data.dtype))
+
+    def _maybe_compress(self, k, vals):
+        if self._compressor is None:
+            return vals
+        return [NDArray(self._compressor.compress(k, i, v._data),
+                        ctx=v.context) for i, v in enumerate(vals)]
+
+    # ---------------------------------------------------------------- pull
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        if out is None:
+            raise MXNetError("kvstore.pull requires out=")
+        for k, outs in _normalize(key, out):
+            if k not in self._store:
+                raise MXNetError(f"kvstore: pull of uninitialized key {k!r}")
+            stored = self._store[k]
+            for o in outs:
+                stored.copyto(o)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        # dense framework storage: row_ids select rows of the dense value
+        if out is None or row_ids is None:
+            raise MXNetError("row_sparse_pull requires out= and row_ids=")
+        for (k, outs), rids in zip(_normalize(key, out),
+                                   _normalize(key, row_ids)):
+            stored = self._store[k]
+            for o, r in zip(outs, rids):
+                rows = jnp.take(stored._data, r._data.astype(jnp.int32),
+                                axis=0)
+                o._set_data(jax.device_put(
+                    rows.astype(o._data.dtype),
+                    o.context.jax_device()))
+
+    # ------------------------------------------------------------ pushpull
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out=out, priority=priority)
+
+    def broadcast(self, key, value, out, priority=0):
+        self.init(key, value)
+        self.pull(key, out=out, priority=priority)
+
+    # ------------------------------------------------------------ optimizer
+    def set_optimizer(self, optimizer):
+        if not self.is_capable(KVStoreBase.OPTIMIZER):
+            raise MXNetError(
+                f"kvstore type {self.type!r} cannot run the optimizer "
+                f"(update_on_kvstore unsupported)")
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+
+    def set_gradient_compression(self, compression_params):
+        params = dict(compression_params)
+        ctype = params.pop("type", "2bit")
+        if ctype != "2bit":
+            raise MXNetError(f"unsupported compression type {ctype!r}")
+        self._compressor = _TwoBitCompressor(params.pop("threshold", 0.5))
+        if params:
+            raise MXNetError(f"unknown compression params {params}")
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    # ------------------------------------------------------------- reduce
+    def _reduce(self, k, vals) -> NDArray:
+        raise NotImplementedError
+
+
+@KVStoreBase.register
+class Local(KVStore):
+    """Reduce on host CPU (reference: KVStoreLocal / CommCPU)."""
+
+    _TYPE = "local"
+
+    def _reduce(self, k, vals):
+        dev = cpu(0).jax_device()
+        acc = jax.device_put(vals[0]._data, dev)
+        for v in vals[1:]:
+            acc = acc + jax.device_put(v._data, dev)
+        return NDArray(acc, ctx=cpu(0))
+
+
+@KVStoreBase.register
+class Device(KVStore):
+    """Reduce on the first value's device (reference: CommDevice P2P)."""
+
+    _TYPE = "device"
+
+    def _pin(self, value):
+        return value.copy()
+
+    def _reduce(self, k, vals):
+        dev = vals[0]._data.device
+        acc = vals[0]._data
+        for v in vals[1:]:
+            acc = acc + jax.device_put(v._data, dev)
+        return NDArray(acc, ctx=vals[0].context)
+
+
+@KVStoreBase.register
+class XLA(KVStore):
+    """Allreduce as one compiled XLA collective over the device mesh.
+
+    The north-star ``kvstore('xla')`` tier (SURVEY §5.8): per-device copies
+    are assembled into a sharded global array (zero copies — shards stay on
+    their devices), a cached ``jit(shard_map(psum))`` program reduces over
+    the 'dev' axis on ICI, and the replicated result is read back from
+    per-device shards.  Keys smaller than MXNET_KVSTORE_BIGARRAY_BOUND are
+    fused into one bucket per dtype (reference: NCCL small-grad fusion).
+    """
+
+    _TYPE = "xla"
+    CAPABILITIES = ()
+
+    def __init__(self):
+        super().__init__()
+        self._fn_cache = {}
+        self._mesh_cache = {}
+        self.bigarray_bound = int(get_env("MXNET_KVSTORE_BIGARRAY_BOUND",
+                                          1 << 19))
+
+    def _pin(self, value):
+        return value.copy()
+
+    # single-key reduce (used by push when called per key)
+    def _reduce(self, k, vals):
+        if len(vals) == 1:
+            return vals[0]
+        reduced = self._fused_allreduce([(k, vals)])
+        return reduced[k][0]
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Batched fused path: aggregates ALL keys in as few collective
+        launches as possible, then writes results straight into ``out``
+        shards (no master-copy round trip)."""
+        pairs = _normalize(key, value)
+        for k, _ in pairs:
+            if k not in self._store:
+                raise MXNetError(
+                    f"kvstore: push to uninitialized key {k!r}")
+        if any(len(v) == 1 for _, v in pairs) or self._updater is not None \
+                or self._compressor is not None:
+            # degenerate / compressed path: classic push+pull via store
+            return super().pushpull(key, value, out, priority)
+        reduced = self._fused_allreduce(pairs)
+        for k, _ in pairs:
+            per_dev = reduced[k]
+            self._store[k]._set_data(
+                per_dev[0]._data.astype(self._store[k]._data.dtype))
+        if out is not None:
+            for k, outs in _normalize(key, out):
+                per_dev = reduced[k]
+                for o, r in zip(outs, per_dev):
+                    o._set_data(r._data.astype(o._data.dtype))
+
+    # ------------------------------------------------------------ internals
+    def _sharding(self, devices):
+        """Cached (mesh, input sharding) per device tuple — Mesh
+        construction is host-side work that must stay off the step path."""
+        cached = self._mesh_cache.get(devices)
+        if cached is None:
+            mesh = Mesh(np.array(devices), ("dev",))
+            cached = (mesh, NamedSharding(mesh, P("dev")))
+            self._mesh_cache[devices] = cached
+        return cached
+
+    def _allreduce_fn(self, devices, size, dtype):
+        cache_key = (devices, size, dtype)
+        fn = self._fn_cache.get(cache_key)
+        if fn is None:
+            mesh, _ = self._sharding(devices)
+            body = jax.shard_map(lambda x: lax.psum(x, "dev"), mesh=mesh,
+                                 in_specs=P("dev"), out_specs=P())
+            fn = jax.jit(body,
+                         out_shardings=NamedSharding(mesh, P()))
+            self._fn_cache[cache_key] = fn
+        return fn
+
+    def _fused_allreduce(self, pairs):
+        """pairs: [(key, [NDArray per device])] -> {key: [NDArray per dev]}.
+
+        Groups keys by dtype, packs small ones into shared buckets, runs
+        one psum per bucket, and splits results back out of the replicated
+        per-device shards.
+        """
+        ndev = len(pairs[0][1])
+        devices = tuple(v._data.device for v in pairs[0][1])
+        if len(set(devices)) != ndev:
+            raise MXNetError(
+                "kvstore('xla'): per-key copies must live on distinct "
+                f"devices, got {devices}")
+        by_dtype = OrderedDict()
+        for k, vals in pairs:
+            if len(vals) != ndev:
+                raise MXNetError(
+                    f"kvstore('xla'): key {k!r} has {len(vals)} copies, "
+                    f"expected {ndev}")
+            by_dtype.setdefault(str(vals[0]._data.dtype), []).append(
+                (k, vals))
+
+        results = {}
+        for dtype, group in by_dtype.items():
+            buckets, cur, cur_elems = [], [], 0
+            for k, vals in group:
+                n = int(np.prod(vals[0].shape)) if vals[0].shape else 1
+                if n >= self.bigarray_bound:
+                    buckets.append([(k, vals, n)])
+                    continue
+                cur.append((k, vals, n))
+                cur_elems += n
+                if cur_elems >= self.bigarray_bound:
+                    buckets.append(cur)
+                    cur, cur_elems = [], 0
+            if cur:
+                buckets.append(cur)
+            for bucket in buckets:
+                total = sum(n for _, _, n in bucket)
+                shards = []
+                for d in range(ndev):
+                    flats = [vals[d]._data.reshape(-1)
+                             for _, vals, _ in bucket]
+                    shards.append(flats[0] if len(flats) == 1
+                                  else jnp.concatenate(flats))
+                _, in_sharding = self._sharding(devices)
+                mesh_arr = jax.make_array_from_single_device_arrays(
+                    (ndev * total,), in_sharding, shards)
+                out = self._allreduce_fn(devices, total, dtype)(mesh_arr)
+                per_dev_full = [s.data for s in out.addressable_shards]
+                # addressable_shards order follows device order in mesh
+                offset = 0
+                for k, vals, n in bucket:
+                    outs = []
+                    for d in range(ndev):
+                        seg = lax.dynamic_slice_in_dim(
+                            per_dev_full[d], offset, n)
+                        outs.append(NDArray(
+                            seg.reshape(vals[d].shape),
+                            ctx=vals[d].context))
+                    results[k] = outs
+                    offset += n
+        return results
+
+
+# 'nccl' scripts get the ICI tier transparently (reference: KVStoreNCCL)
+KVStoreBase.register_alias("nccl", XLA)
+
+
+def create(name="local") -> KVStore:
+    """Factory (reference: kvstore.create / KVStoreBase registry)."""
+    if not isinstance(name, str):
+        raise MXNetError("kvstore name must be a string")
+    klass = KVStoreBase.kv_registry.get(name.lower())
+    if klass is None:
+        raise MXNetError(
+            f"unknown kvstore type {name!r}; registered: "
+            f"{sorted(KVStoreBase.kv_registry)}")
+    return klass()

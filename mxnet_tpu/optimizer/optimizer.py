@@ -50,6 +50,10 @@ class Optimizer:
         self.num_update = begin_num_update
         self.begin_num_update = begin_num_update
         self._index_update_count = {}
+        # one Trainer-shared optimizer drives updaters on several device
+        # copies; per-device t counters keep Adam-style bias correction
+        # from double-advancing (reference: Optimizer._set_current_context)
+        self._all_index_update_counts = {0: self._index_update_count}
         self.idx2name = param_idx2name or {}
         self.param_dict = param_dict or {}
         self.lr_mult = {}
@@ -73,6 +77,13 @@ class Optimizer:
 
     def set_wd_mult(self, args_wd_mult):
         self.wd_mult = dict(args_wd_mult)
+
+    def _set_current_context(self, device_id):
+        """Switch to ``device_id``'s update-count table (reference:
+        Optimizer._set_current_context)."""
+        if device_id not in self._all_index_update_counts:
+            self._all_index_update_counts[device_id] = {}
+        self._index_update_count = self._all_index_update_counts[device_id]
 
     def _update_count(self, index):
         if index not in self._index_update_count:

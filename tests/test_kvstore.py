@@ -1,0 +1,292 @@
+"""KVStore tests (reference patterns: tests/python/unittest/test_kvstore.py,
+test_kvstore_custom.py; SURVEY.md §4 dist-test row)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon, autograd, kvstore
+from mxnet_tpu.base import MXNetError
+
+CTXS = [mx.cpu(0), mx.cpu(1)]
+
+
+def _rand(shape, seed=0):
+    return np.random.RandomState(seed).uniform(-1, 1, shape).astype("float32")
+
+
+@pytest.mark.parametrize("kv_type", ["local", "device", "xla"])
+def test_push_pull_sum(kv_type):
+    kv = kvstore.create(kv_type)
+    shape = (4, 5)
+    a, b = _rand(shape, 1), _rand(shape, 2)
+    kv.init("w", nd.array(np.zeros(shape, "float32")))
+    vals = [nd.array(a, ctx=CTXS[0]), nd.array(b, ctx=CTXS[1])]
+    outs = [nd.zeros(shape, ctx=c) for c in CTXS]
+    kv.pushpull("w", vals, out=outs)
+    for o in outs:
+        np.testing.assert_allclose(o.asnumpy(), a + b, rtol=1e-6)
+
+
+@pytest.mark.parametrize("kv_type", ["local", "device", "xla"])
+def test_multi_key_list_api(kv_type):
+    kv = kvstore.create(kv_type)
+    shapes = [(3,), (2, 4), (5, 1)]
+    keys = [str(i) for i in range(len(shapes))]
+    kv.init(keys, [nd.zeros(s) for s in shapes])
+    per_key = []
+    for i, s in enumerate(shapes):
+        per_key.append([nd.array(_rand(s, 10 + i), ctx=CTXS[0]),
+                        nd.array(_rand(s, 20 + i), ctx=CTXS[1])])
+    outs = [[nd.zeros(s, ctx=c) for c in CTXS] for s in shapes]
+    kv.pushpull(keys, per_key, out=outs)
+    for i, s in enumerate(shapes):
+        want = _rand(s, 10 + i) + _rand(s, 20 + i)
+        for o in outs[i]:
+            np.testing.assert_allclose(o.asnumpy(), want, rtol=1e-6)
+
+
+def test_xla_bucket_fusion_many_small_keys():
+    """Dozens of small keys + one large key: results must be exact even
+    when fused into shared buckets (NCCL small-grad fusion analogue)."""
+    kv = kvstore.create("xla")
+    kv.bigarray_bound = 64  # force several buckets
+    n_keys = 20
+    shapes = [(7,)] * (n_keys - 1) + [(130,)]
+    keys = [str(i) for i in range(n_keys)]
+    kv.init(keys, [nd.zeros(s) for s in shapes])
+    per_key, want = [], []
+    for i, s in enumerate(shapes):
+        a, b = _rand(s, i), _rand(s, 100 + i)
+        per_key.append([nd.array(a, ctx=CTXS[0]), nd.array(b, ctx=CTXS[1])])
+        want.append(a + b)
+    outs = [[nd.zeros(s, ctx=c) for c in CTXS] for s in shapes]
+    kv.pushpull(keys, per_key, out=outs)
+    for i in range(n_keys):
+        for o in outs[i]:
+            np.testing.assert_allclose(o.asnumpy(), want[i], rtol=1e-6)
+
+
+def test_xla_four_devices():
+    ctxs = [mx.cpu(i) for i in range(4)]
+    kv = kvstore.create("xla")
+    shape = (6, 3)
+    kv.init("0", nd.zeros(shape))
+    arrs = [_rand(shape, i) for i in range(4)]
+    vals = [nd.array(a, ctx=c) for a, c in zip(arrs, ctxs)]
+    outs = [nd.zeros(shape, ctx=c) for c in ctxs]
+    kv.pushpull("0", vals, out=outs)
+    for o in outs:
+        np.testing.assert_allclose(o.asnumpy(), sum(arrs), rtol=1e-5)
+
+
+def test_update_on_kvstore_optimizer():
+    """Reference invariant: store runs SGD on the master copy; pulled
+    weights reflect the update."""
+    kv = kvstore.create("local")
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5, rescale_grad=1.0))
+    w0 = _rand((4,), 3)
+    kv.init("0", nd.array(w0))
+    g = [nd.array(np.ones(4, "float32"), ctx=CTXS[0]),
+         nd.array(np.ones(4, "float32"), ctx=CTXS[1])]
+    kv.push("0", g)
+    out = [nd.zeros((4,), ctx=CTXS[0])]
+    kv.pull("0", out=out)
+    np.testing.assert_allclose(out[0].asnumpy(), w0 - 0.5 * 2.0, rtol=1e-6)
+
+
+def test_xla_rejects_optimizer():
+    kv = kvstore.create("xla")
+    with pytest.raises(MXNetError):
+        kv.set_optimizer(mx.optimizer.SGD())
+
+
+def test_gradient_compression_2bit():
+    kv = kvstore.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("0", nd.zeros((4,)))
+    # grads below threshold are quantized to 0, residual carries over
+    g = np.array([0.3, -0.3, 0.8, -0.9], "float32")
+    vals = [nd.array(g, ctx=CTXS[0]), nd.array(g, ctx=CTXS[1])]
+    outs = [nd.zeros((4,), ctx=CTXS[0])]
+    kv.pushpull("0", vals, out=outs)
+    np.testing.assert_allclose(outs[0].asnumpy(),
+                               np.array([0, 0, 1.0, -1.0], "float32"))
+    # second push: residual (0.3) + 0.3 crosses the threshold
+    kv.pushpull("0", vals, out=outs)
+    np.testing.assert_allclose(outs[0].asnumpy(),
+                               np.array([1.0, -1.0, 1.0, -1.0], "float32"))
+
+
+def test_custom_kvstore_registration():
+    """Reference: test_kvstore_custom.py — plugin registry without
+    network."""
+    from mxnet_tpu.kvstore import KVStoreBase
+
+    @KVStoreBase.register
+    class Doubling(kvstore.KVStore):
+        _TYPE = "doubling"
+        CAPABILITIES = ()
+
+        def _reduce(self, k, vals):
+            acc = vals[0]
+            for v in vals[1:]:
+                acc = acc + v.as_in_context(acc.context)
+            return acc * 2
+
+    kv = kvstore.create("doubling")
+    assert kv.type == "doubling"
+    kv.init("0", nd.zeros((2,)))
+    vals = [nd.array(np.ones(2, "float32"), ctx=c) for c in CTXS]
+    outs = [nd.zeros((2,), ctx=CTXS[0])]
+    kv.pushpull("0", vals, out=outs)
+    np.testing.assert_allclose(outs[0].asnumpy(), np.full(2, 4.0))
+
+
+def test_unknown_type_raises():
+    with pytest.raises(MXNetError):
+        kvstore.create("no_such_store")
+
+
+# --------------------------------------------------------------------------
+# P1 data parallelism through the reference user API:
+# split_and_load + per-ctx backward + Trainer.step
+# --------------------------------------------------------------------------
+def _make_net(ctxs):
+    net = gluon.nn.Dense(1, use_bias=True)
+    net.initialize(mx.initializer.Xavier(), ctx=ctxs)
+    return net
+
+
+@pytest.mark.parametrize("kv_type", ["device", "xla"])
+def test_trainer_multi_device_matches_single(kv_type):
+    """2-ctx data-parallel SGD must equal single-device full-batch SGD."""
+    X = _rand((8, 3), 7)
+    Y = (X @ np.array([[1.0], [-2.0], [0.5]], "float32")
+         + 0.1).astype("float32")
+    loss_fn = gluon.loss.L2Loss()
+
+    def run(ctxs, kv):
+        mx.random.seed(0)
+        net = _make_net(ctxs)
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1}, kvstore=kv)
+        for _ in range(5):
+            xs = gluon.utils.split_and_load(nd.array(X), ctxs)
+            ys = gluon.utils.split_and_load(nd.array(Y), ctxs)
+            with autograd.record():
+                losses = [loss_fn(net(x), y) for x, y in zip(xs, ys)]
+            for l in losses:
+                l.backward()
+            trainer.step(X.shape[0])
+        p = net.collect_params()
+        # block name counters auto-increment across nets: compare by order
+        return [v.data(ctxs[0]).asnumpy() for v in p.values()]
+
+    single = run([mx.cpu(0)], None)
+    multi = run(CTXS, kv_type)
+    assert len(single) == len(multi)
+    for s, m in zip(single, multi):
+        np.testing.assert_allclose(m, s, rtol=1e-5, atol=1e-6)
+
+
+def test_trainer_multi_device_replicas_stay_synced():
+    X = _rand((8, 3), 11)
+    Y = _rand((8, 1), 12)
+    net = _make_net(CTXS)
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-2}, kvstore="xla")
+    loss_fn = gluon.loss.L2Loss()
+    for _ in range(3):
+        xs = gluon.utils.split_and_load(nd.array(X), CTXS)
+        ys = gluon.utils.split_and_load(nd.array(Y), CTXS)
+        with autograd.record():
+            losses = [loss_fn(net(x), y) for x, y in zip(xs, ys)]
+        for l in losses:
+            l.backward()
+        trainer.step(X.shape[0])
+    for p in net.collect_params().values():
+        copies = [d.asnumpy() for d in p.list_data()]
+        np.testing.assert_allclose(copies[0], copies[1], rtol=1e-6)
+
+
+def test_trainer_set_lr_reaches_all_devices():
+    """ADVICE round-1 item: hyperparameter changes must affect every
+    device's updates, not just device 0."""
+    net = _make_net(CTXS)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore="device")
+    X, Y = _rand((4, 3), 1), _rand((4, 1), 2)
+    loss_fn = gluon.loss.L2Loss()
+
+    def one_step():
+        xs = gluon.utils.split_and_load(nd.array(X), CTXS)
+        ys = gluon.utils.split_and_load(nd.array(Y), CTXS)
+        with autograd.record():
+            losses = [loss_fn(net(x), y) for x, y in zip(xs, ys)]
+        for l in losses:
+            l.backward()
+        trainer.step(X.shape[0])
+
+    one_step()
+    trainer.set_learning_rate(0.0)  # freezes ALL replicas if shared
+    before = [d.asnumpy() for p in net.collect_params().values()
+              for d in p.list_data()]
+    one_step()
+    after = [d.asnumpy() for p in net.collect_params().values()
+             for d in p.list_data()]
+    for b, a in zip(before, after):
+        np.testing.assert_array_equal(b, a)
+
+
+def test_trainer_save_load_states_multi_device(tmp_path):
+    net = _make_net(CTXS)
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-2}, kvstore="device")
+    X, Y = _rand((4, 3), 1), _rand((4, 1), 2)
+    loss_fn = gluon.loss.L2Loss()
+    xs = gluon.utils.split_and_load(nd.array(X), CTXS)
+    ys = gluon.utils.split_and_load(nd.array(Y), CTXS)
+    with autograd.record():
+        losses = [loss_fn(net(x), y) for x, y in zip(xs, ys)]
+    for l in losses:
+        l.backward()
+    trainer.step(X.shape[0])
+    fname = str(tmp_path / "trainer.states")
+    trainer.save_states(fname)
+    trainer2 = gluon.Trainer(net.collect_params(), "adam",
+                             {"learning_rate": 1e-2}, kvstore="device")
+    trainer2.load_states(fname)
+    # states restored into every device updater — load_states on a FRESH
+    # trainer must pre-create updaters for all ctxs, not just device 0
+    assert len(trainer2._dev_updaters) == len(CTXS)
+    for updater in trainer2._dev_updaters.values():
+        assert updater.states.keys() == trainer._updater.states.keys()
+        assert updater.optimizer is trainer2._optimizer
+
+
+def test_trainer_update_on_kvstore():
+    X = _rand((8, 3), 7)
+    Y = (X @ np.array([[1.0], [-2.0], [0.5]], "float32")).astype("float32")
+    loss_fn = gluon.loss.L2Loss()
+
+    def run(update_on_kv):
+        mx.random.seed(0)
+        net = _make_net(CTXS)
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1}, kvstore="local",
+                                update_on_kvstore=update_on_kv)
+        for _ in range(3):
+            xs = gluon.utils.split_and_load(nd.array(X), CTXS)
+            ys = gluon.utils.split_and_load(nd.array(Y), CTXS)
+            with autograd.record():
+                losses = [loss_fn(net(x), y) for x, y in zip(xs, ys)]
+            for l in losses:
+                l.backward()
+            trainer.step(X.shape[0])
+        return [v.data(CTXS[0]).asnumpy()
+                for v in net.collect_params().values()]
+
+    worker_side = run(False)
+    server_side = run(True)
+    for w, s in zip(worker_side, server_side):
+        np.testing.assert_allclose(s, w, rtol=1e-5, atol=1e-6)
