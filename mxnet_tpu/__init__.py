@@ -40,9 +40,9 @@ def waitall():
 import importlib as _importlib
 
 for _mod in ("initializer", "optimizer", "metric", "gluon", "io", "kvstore",
-             "callback", "profiler", "util", "runtime", "test_utils",
-             "executor", "module", "image", "contrib", "parallel", "models",
-             "np", "npx", "lr_scheduler"):
+             "recordio", "callback", "profiler", "util", "runtime",
+             "test_utils", "executor", "module", "image", "contrib",
+             "parallel", "models", "np", "npx", "lr_scheduler"):
     try:
         globals()[_mod] = _importlib.import_module(f".{_mod}", __name__)
     except ModuleNotFoundError as _e:

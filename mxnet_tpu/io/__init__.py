@@ -1,0 +1,9 @@
+"""Data I/O subsystem (reference: python/mxnet/io/ + src/io/;
+SURVEY.md §2.1 Data iterators row, §3.5)."""
+from .io import (DataDesc, DataBatch, DataIter, ResizeIter,
+                 PrefetchingIter, NDArrayIter, CSVIter, MNISTIter,
+                 ImageRecordIter)
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "ResizeIter",
+           "PrefetchingIter", "NDArrayIter", "CSVIter", "MNISTIter",
+           "ImageRecordIter"]

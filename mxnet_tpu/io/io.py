@@ -1,0 +1,669 @@
+"""Data iterators (reference: python/mxnet/io/io.py + src/io/).
+
+TPU-native notes: the reference's C++ decode/augment threads
+(``iter_image_recordio_2.cc``, ``PrefetcherIter``) are replaced by a
+host-side NumPy/cv2 pipeline behind a background prefetch thread; batch
+assembly is one contiguous NumPy array → one host→device transfer.  Device
+work (normalization etc.) belongs in the compiled step, where XLA fuses it.
+
+Sharding for the distributed tier uses the reference's ``num_parts`` /
+``part_index`` contract: each worker iterates only its shard.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import queue as _queue
+import struct
+import threading
+from collections import namedtuple
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from ..ndarray import NDArray
+from .. import recordio
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "ResizeIter",
+           "PrefetchingIter", "NDArrayIter", "CSVIter", "MNISTIter",
+           "ImageRecordIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
+    """Shape/type descriptor (reference: io.DataDesc)."""
+
+    def __new__(cls, name, shape, dtype=np.float32, layout="NCHW"):
+        return super().__new__(cls, name, tuple(shape), dtype, layout)
+
+    @staticmethod
+    def get_batch_axis(layout):
+        return 0 if layout is None else layout.find("N")
+
+
+class DataBatch:
+    """One mini-batch (reference: io.DataBatch)."""
+
+    def __init__(self, data, label=None, pad=None, index=None,
+                 provide_data=None, provide_label=None):
+        if data is not None and not isinstance(data, (list, tuple)):
+            raise MXNetError("DataBatch.data must be a list of NDArrays")
+        if label is not None and not isinstance(label, (list, tuple)):
+            raise MXNetError("DataBatch.label must be a list of NDArrays")
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __str__(self):
+        shapes = [d.shape for d in self.data] if self.data else []
+        lshapes = [l.shape for l in self.label] if self.label else []
+        return f"DataBatch: data shapes: {shapes} label shapes: {lshapes}"
+
+
+class DataIter:
+    """Iterator base (reference: io.DataIter)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self) -> DataBatch:
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        return 0
+
+
+class ResizeIter(DataIter):
+    """Truncate/loop an iterator to a fixed number of batches per epoch
+    (reference: io.ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+        for attr in ("provide_data", "provide_label", "default_bucket_key"):
+            if hasattr(data_iter, attr):
+                setattr(self, attr, getattr(data_iter, attr))
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetch over one or more iterators
+    (reference: io.PrefetchingIter ≙ src/io PrefetcherIter)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 prefetch_depth=2):
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        if len(iters) != 1 and (rename_data is None
+                                or rename_label is None):
+            raise MXNetError("multiple iters require rename_data/label")
+        self.iters = iters
+        # rename_*: one {old_name: new_name} dict per inner iter
+        self._rename_data = rename_data
+        self._rename_label = rename_label
+        super().__init__(iters[0].batch_size)
+        self._depth = prefetch_depth
+        self._queue = None
+        self._thread = None
+        self._done = False
+        self._start()
+
+    def _renamed(self, attr, renames):
+        descs = []
+        for i, it in enumerate(self.iters):
+            mapping = renames[i] if renames else {}
+            for d in getattr(it, attr, []):
+                descs.append(d._replace(name=mapping.get(d.name, d.name)))
+        return descs
+
+    @property
+    def provide_data(self):
+        return self._renamed("provide_data", self._rename_data)
+
+    @property
+    def provide_label(self):
+        return self._renamed("provide_label", self._rename_label)
+
+    def _start(self):
+        self._queue = _queue.Queue(maxsize=self._depth)
+        self._stop_evt = threading.Event()
+
+        def worker():
+            try:
+                while not self._stop_evt.is_set():
+                    try:
+                        batches = [it.next() for it in self.iters]
+                    except StopIteration:
+                        self._queue.put(None)
+                        return
+                    self._queue.put(batches)
+            except Exception as e:  # propagate to consumer
+                self._queue.put(e)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        self._stop_evt.set()
+        # drain so the worker can observe the stop event
+        try:
+            while True:
+                self._queue.get_nowait()
+        except _queue.Empty:
+            pass
+        self._thread.join(timeout=5)
+        for it in self.iters:
+            it.reset()
+        self._done = False
+        self._start()
+
+    def next(self):
+        if self._done:
+            raise StopIteration
+        got = self._queue.get()
+        if got is None:
+            self._done = True  # producer exited; don't block on next call
+            raise StopIteration
+        if isinstance(got, Exception):
+            self._done = True
+            raise got
+        if len(self.iters) == 1:
+            return got[0]
+        return DataBatch(
+            data=[d for b in got for d in b.data],
+            label=[l for b in got for l in (b.label or [])],
+            pad=got[0].pad)
+
+    def iter_next(self):
+        raise MXNetError("PrefetchingIter supports next() only")
+
+
+def _init_data(data, allow_empty, default_name):
+    """-> list of (name, ndarray) (reference: io._init_data)."""
+    if data is None:
+        if not allow_empty:
+            raise MXNetError("data cannot be None")
+        return []
+    if isinstance(data, (np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        pairs = []
+        for i, d in enumerate(data):
+            name = default_name if len(data) == 1 \
+                else f"_{i}_{default_name}"
+            pairs.append((name, d))
+    elif isinstance(data, dict):
+        pairs = list(data.items())
+    else:
+        raise MXNetError(f"unsupported data type {type(data)}")
+    out = []
+    for name, d in pairs:
+        if isinstance(d, NDArray):
+            d = d.asnumpy()
+        d = np.asarray(d)
+        if d.dtype == np.float64:
+            d = d.astype(np.float32)
+        out.append((name, d))
+    return out
+
+
+class NDArrayIter(DataIter):
+    """Batches over in-memory arrays with pad/discard/roll_over handling
+    (reference: io.NDArrayIter)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, False, data_name)
+        self.label = _init_data(label, True, label_name)
+        self.num_data = self.data[0][1].shape[0]
+        for name, arr in self.data + self.label:
+            if arr.shape[0] != self.num_data:
+                raise MXNetError(
+                    f"field {name!r} has {arr.shape[0]} samples, expected "
+                    f"{self.num_data}")
+        if last_batch_handle not in ("pad", "discard", "roll_over"):
+            raise MXNetError(
+                f"invalid last_batch_handle {last_batch_handle!r}")
+        if last_batch_handle == "discard" and self.num_data < batch_size:
+            raise MXNetError("not enough data for even one batch")
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self._carry = None  # roll_over: sample indices left from last epoch
+        self._order = np.arange(self.num_data)
+        self.cursor = -batch_size
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(name, (self.batch_size,) + arr.shape[1:],
+                         arr.dtype) for name, arr in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(name, (self.batch_size,) + arr.shape[1:],
+                         arr.dtype) for name, arr in self.label]
+
+    def reset(self):
+        idx = np.arange(self.num_data)
+        if self.shuffle:
+            np.random.shuffle(idx)
+        if self.last_batch_handle == "roll_over" and self._carry is not None:
+            # leftover samples from the previous epoch lead this one
+            self._order = np.concatenate([self._carry, idx])
+            self._carry = None
+        else:
+            self._order = idx
+        self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        n = len(self._order)
+        if self.last_batch_handle == "pad":
+            return self.cursor < n
+        if self.cursor + self.batch_size <= n:
+            return True
+        if self.last_batch_handle == "roll_over" and self.cursor < n:
+            self._carry = self._order[self.cursor:]
+        return False
+
+    def _take(self, arrs):
+        n = len(self._order)
+        start = self.cursor
+        end = start + self.batch_size
+        out = []
+        for _, arr in arrs:
+            if end <= n:
+                sel = arr[self._order[start:end]]
+            else:  # pad: wrap around to the epoch start
+                sel = np.concatenate([arr[self._order[start:]],
+                                      arr[self._order[:end - n]]])
+            out.append(nd.array(sel, dtype=sel.dtype))
+        return out
+
+    def getdata(self):
+        return self._take(self.data)
+
+    def getlabel(self):
+        return self._take(self.label)
+
+    def getpad(self):
+        end = self.cursor + self.batch_size
+        if self.last_batch_handle == "pad" and end > len(self._order):
+            return end - len(self._order)
+        return 0
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        return DataBatch(data=self.getdata(), label=self.getlabel(),
+                         pad=self.getpad(), index=None,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+
+def _shard_range(n, num_parts, part_index):
+    """The reference's num_parts/part_index shard contract."""
+    if not 0 <= part_index < num_parts:
+        raise MXNetError(
+            f"part_index {part_index} out of range for {num_parts} parts")
+    per = n // num_parts
+    start = per * part_index
+    end = per * (part_index + 1) if part_index < num_parts - 1 else n
+    return start, end
+
+
+class CSVIter(NDArrayIter):
+    """CSV reader (reference: src/io/iter_csv.cc / io.CSVIter)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None,
+                 label_shape=None, batch_size=1, round_batch=True,
+                 num_parts=1, part_index=0, data_name="data",
+                 label_name="softmax_label"):
+        data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32,
+                          ndmin=2)
+        n = data.shape[0]
+        data = data.reshape((n,) + tuple(data_shape))
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",", dtype=np.float32,
+                               ndmin=2)
+            if label_shape is not None:
+                label = label.reshape((n,) + tuple(label_shape))
+            else:
+                label = label.reshape(n)
+        else:
+            label = np.zeros(n, dtype=np.float32)
+        s, e = _shard_range(n, num_parts, part_index)
+        super().__init__(data[s:e], label[s:e], batch_size,
+                         last_batch_handle="pad" if round_batch
+                         else "discard",
+                         data_name=data_name, label_name=label_name)
+
+
+def _read_idx_file(path):
+    """MNIST idx format (magic 0x801/0x803 big-endian)."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        raw = f.read()
+    magic, = struct.unpack(">I", raw[:4])
+    ndim = magic & 0xff
+    dims = struct.unpack(f">{ndim}I", raw[4:4 + 4 * ndim])
+    data = np.frombuffer(raw, dtype=np.uint8, offset=4 + 4 * ndim)
+    return data.reshape(dims)
+
+
+class MNISTIter(DataIter):
+    """MNIST idx reader (reference: src/io/iter_mnist.cc)."""
+
+    def __init__(self, image, label, batch_size=128, shuffle=True,
+                 flat=False, seed=0, num_parts=1, part_index=0,
+                 silent=True):
+        super().__init__(batch_size)
+        images = _read_idx_file(image).astype(np.float32) / 255.0
+        labels = _read_idx_file(label).astype(np.float32)
+        if images.shape[0] != labels.shape[0]:
+            raise MXNetError("image/label count mismatch")
+        s, e = _shard_range(images.shape[0], num_parts, part_index)
+        images, labels = images[s:e], labels[s:e]
+        if flat:
+            images = images.reshape(images.shape[0], -1)
+        else:
+            images = images[:, None, :, :]  # NCHW
+        if shuffle:
+            order = np.random.RandomState(seed).permutation(len(images))
+            images, labels = images[order], labels[order]
+        self._inner = NDArrayIter(images, labels, batch_size,
+                                  last_batch_handle="discard")
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    def iter_next(self):
+        return self._inner.iter_next()
+
+
+class ImageRecordIter(DataIter):
+    """RecordIO image pipeline: shard → decode → augment → batch
+    (reference: src/io/iter_image_recordio_2.cc).
+
+    A background producer thread assembles batches ahead of the consumer
+    (queue depth ``prefetch_buffer``) and fans decode/augment work out to
+    ``preprocess_threads`` pool workers; augmentations cover the default
+    ImageAugmenter set (resize, center/rand crop, mirror, mean
+    subtraction, scale).
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size,
+                 path_imgidx=None, shuffle=False, rand_crop=False,
+                 rand_mirror=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                 scale=1.0, resize=-1, num_parts=1, part_index=0,
+                 label_width=1, round_batch=True, seed=0,
+                 preprocess_threads=1, prefetch_buffer=4):
+        super().__init__(batch_size)
+        if len(data_shape) != 3:
+            raise MXNetError("data_shape must be (C, H, W)")
+        self.data_shape = tuple(data_shape)
+        self.rand_crop = rand_crop
+        self.rand_mirror = rand_mirror
+        self.mean = np.array([mean_r, mean_g, mean_b],
+                             np.float32).reshape(3, 1, 1)
+        self.scale = scale
+        self.resize = resize
+        self.label_width = label_width
+        self.round_batch = round_batch
+        self._rng = np.random.RandomState(seed)
+        self._shuffle = shuffle
+
+        # index the record file once, then shard
+        self._rec = recordio.MXIndexedRecordIO(
+            path_imgidx or path_imgrec + ".idx", path_imgrec, "r") \
+            if (path_imgidx or os.path.exists(path_imgrec + ".idx")) \
+            else None
+        if self._rec is not None and self._rec.keys:
+            keys = list(self._rec.keys)
+        else:
+            # no index: scan once recording offsets
+            self._rec = None
+            self._offsets = []
+            reader = recordio.MXRecordIO(path_imgrec, "r")
+            while True:
+                pos = reader.tell()
+                if reader.read() is None:
+                    break
+                self._offsets.append(pos)
+            reader.close()
+            keys = list(range(len(self._offsets)))
+            self._plain_reader = recordio.MXRecordIO(path_imgrec, "r")
+        s, e = _shard_range(len(keys), num_parts, part_index)
+        self._keys = keys[s:e]
+        self._order = list(range(len(self._keys)))
+        self._pos = 0
+        from concurrent.futures import ThreadPoolExecutor
+        self._pool = ThreadPoolExecutor(max(1, preprocess_threads)) \
+            if preprocess_threads > 1 else None
+        self._depth = max(1, prefetch_buffer)
+        self._queue = None
+        self._producer = None
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 \
+            else (self.batch_size, self.label_width)
+        return [DataDesc("softmax_label", shape)]
+
+    def reset(self):
+        self._stop_producer()
+        if self._shuffle:
+            self._rng.shuffle(self._order)
+        self._pos = 0
+        self._done = False
+        self._start_producer()
+
+    # ------------------------------------------------------- prefetch plumbing
+    def _start_producer(self):
+        self._queue = _queue.Queue(maxsize=self._depth)
+        self._stop_evt = threading.Event()
+
+        def produce():
+            try:
+                while not self._stop_evt.is_set():
+                    try:
+                        batch = self._next_batch_sync()
+                    except StopIteration:
+                        self._queue.put(None)
+                        return
+                    self._queue.put(batch)
+            except Exception as e:
+                self._queue.put(e)
+
+        self._producer = threading.Thread(target=produce, daemon=True)
+        self._producer.start()
+
+    def _stop_producer(self):
+        if self._producer is None:
+            return
+        self._stop_evt.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except _queue.Empty:
+            pass
+        self._producer.join(timeout=5)
+        self._producer = None
+
+    def next(self):
+        if self._done:
+            raise StopIteration
+        got = self._queue.get()
+        if got is None:
+            self._done = True
+            raise StopIteration
+        if isinstance(got, Exception):
+            self._done = True
+            raise got
+        return got
+
+    def iter_next(self):
+        raise MXNetError(
+            "ImageRecordIter prefetches in the background; use next()")
+
+    # ---------------------------------------------------------- decode path
+    def _read_record(self, key):
+        if self._rec is not None:
+            return self._rec.read_idx(key)
+        self._plain_reader._f.seek(self._offsets[key])
+        return self._plain_reader.read()
+
+    def _decode_one(self, payload, rng):
+        import cv2
+        header, img = recordio.unpack_img(payload, iscolor=1)
+        if self.resize > 0:
+            h, w = img.shape[:2]
+            if h < w:
+                new = (int(w * self.resize / h), self.resize)
+            else:
+                new = (self.resize, int(h * self.resize / w))
+            img = cv2.resize(img, new)
+        c, th, tw = self.data_shape
+        h, w = img.shape[:2]
+        if h < th or w < tw:
+            img = cv2.resize(img, (max(w, tw), max(h, th)))
+            h, w = img.shape[:2]
+        if self.rand_crop:
+            y = rng.randint(0, h - th + 1)
+            x = rng.randint(0, w - tw + 1)
+        else:
+            y, x = (h - th) // 2, (w - tw) // 2
+        img = img[y:y + th, x:x + tw]
+        if self.rand_mirror and rng.rand() < 0.5:
+            img = img[:, ::-1]
+        img = img[:, :, ::-1]  # BGR (cv2) -> RGB
+        chw = np.transpose(img, (2, 0, 1)).astype(np.float32)
+        chw = (chw - self.mean) * self.scale
+        label = np.atleast_1d(np.asarray(header.label, np.float32))
+        if label.size < self.label_width:
+            raise MXNetError(
+                f"record id={header.id} has {label.size} label value(s), "
+                f"label_width={self.label_width} requested")
+        return chw, label[:self.label_width]
+
+    def _next_batch_sync(self):
+        """Assemble one batch; record reads stay on the producer thread,
+        decode/augment fans out to the worker pool."""
+        n = len(self._keys)
+        if self._pos >= n:
+            raise StopIteration
+        idxs = []
+        for i in range(self.batch_size):
+            j = self._pos + i
+            if j < n:
+                idxs.append(self._order[j])
+            elif self.round_batch:
+                idxs.append(self._order[j % n])
+            else:
+                break
+        if not idxs or (len(idxs) < self.batch_size
+                        and not self.round_batch):
+            raise StopIteration
+        pad = self.batch_size - min(n - self._pos, self.batch_size)
+        self._pos += self.batch_size
+        payloads = [self._read_record(self._keys[k]) for k in idxs]
+        # per-record RNG decided here so pool workers never share state
+        rngs = [np.random.RandomState(self._rng.randint(0, 2**31))
+                for _ in idxs]
+        if self._pool is not None:
+            decoded = list(self._pool.map(self._decode_one, payloads,
+                                          rngs))
+        else:
+            decoded = [self._decode_one(p, r)
+                       for p, r in zip(payloads, rngs)]
+        data = np.empty((len(idxs),) + self.data_shape, np.float32)
+        labels = np.empty((len(idxs), self.label_width), np.float32)
+        for i, (img, lab) in enumerate(decoded):
+            data[i] = img
+            labels[i] = lab
+        label_arr = labels[:, 0] if self.label_width == 1 else labels
+        return DataBatch(data=[nd.array(data)],
+                         label=[nd.array(label_arr)], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)

@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""im2rec: build RecordIO image datasets (reference: tools/im2rec.py).
+
+Two modes, matching the reference CLI:
+
+1. ``--list``: walk an image root and write a ``.lst`` file
+   (``index\\tlabel\\trelative/path``), one label per subdirectory.
+2. default: read a ``.lst`` file and write ``prefix.rec`` + ``prefix.idx``
+   with JPEG/PNG-encoded payloads (IRHeader framing), optionally resized.
+
+Usage:
+    python tools/im2rec.py --list prefix image_root
+    python tools/im2rec.py prefix image_root [--resize N] [--quality Q]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+from mxnet_tpu import recordio  # noqa: E402
+
+_EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def make_list(prefix, root):
+    classes = sorted(
+        d for d in os.listdir(root)
+        if os.path.isdir(os.path.join(root, d)))
+    entries = []
+    if classes:
+        for label, cls in enumerate(classes):
+            for fn in sorted(os.listdir(os.path.join(root, cls))):
+                if fn.lower().endswith(_EXTS):
+                    entries.append((float(label),
+                                    os.path.join(cls, fn)))
+    else:
+        for fn in sorted(os.listdir(root)):
+            if fn.lower().endswith(_EXTS):
+                entries.append((0.0, fn))
+    with open(prefix + ".lst", "w") as f:
+        for i, (label, path) in enumerate(entries):
+            f.write(f"{i}\t{label}\t{path}\n")
+    print(f"wrote {len(entries)} entries to {prefix}.lst")
+
+
+def make_record(prefix, root, resize=0, quality=95, color=1):
+    import cv2
+    lst_path = prefix + ".lst"
+    if not os.path.exists(lst_path):
+        raise SystemExit(f"{lst_path} not found; run --list first")
+    writer = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec",
+                                        "w")
+    count = 0
+    with open(lst_path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx, rest, path = int(parts[0]), parts[1:-1], parts[-1]
+            label = np.array([float(x) for x in rest], np.float32)
+            label = float(label[0]) if label.size == 1 else label
+            img = cv2.imread(os.path.join(root, path), color)
+            if img is None:
+                print(f"skip unreadable {path}", file=sys.stderr)
+                continue
+            if resize:
+                h, w = img.shape[:2]
+                if h < w:
+                    img = cv2.resize(img, (int(w * resize / h), resize))
+                else:
+                    img = cv2.resize(img, (resize, int(h * resize / w)))
+            header = recordio.IRHeader(0, label, idx, 0)
+            writer.write_idx(idx, recordio.pack_img(
+                header, img, quality=quality, img_fmt=".jpg"))
+            count += 1
+    writer.close()
+    print(f"wrote {count} records to {prefix}.rec")
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prefix")
+    ap.add_argument("root")
+    ap.add_argument("--list", action="store_true",
+                    help="generate the .lst file instead of the record")
+    ap.add_argument("--resize", type=int, default=0)
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--color", type=int, default=1)
+    args = ap.parse_args()
+    if args.list:
+        make_list(args.prefix, args.root)
+    else:
+        make_record(args.prefix, args.root, args.resize, args.quality,
+                    args.color)
+
+
+if __name__ == "__main__":
+    main()
