@@ -36,7 +36,7 @@ class BERTEncoder(HybridBlock):
 
     def __init__(self, units=768, hidden_size=3072, num_layers=12,
                  num_heads=12, dropout=0.1, max_length=512,
-                 layer_norm_eps=1e-12, **kwargs):
+                 layer_norm_eps=1e-12, use_flash=False, **kwargs):
         super().__init__(**kwargs)
         self._units = units
         self._num_heads = num_heads
@@ -52,16 +52,18 @@ class BERTEncoder(HybridBlock):
             for _ in range(num_layers):
                 self.transformer_cells.add(TransformerEncoderCell(
                     units, hidden_size, num_heads, dropout,
-                    activation="gelu", layer_norm_eps=layer_norm_eps))
+                    activation="gelu", layer_norm_eps=layer_norm_eps,
+                    use_flash=use_flash))
 
-    def hybrid_forward(self, F, x, mask=None, position_weight=None):
+    def hybrid_forward(self, F, x, mask=None, valid_length=None,
+                       position_weight=None):
         # x: (L, B, C)
         L = x.shape[0]
         pos = position_weight.slice_axis(axis=0, begin=0, end=L)
         x = x + pos.expand_dims(1)
         x = self.dropout_layer(self.layer_norm(x))
         for cell in self.transformer_cells:
-            x = cell(x, mask)
+            x = cell(x, mask, valid_length)
         return x
 
 
@@ -75,11 +77,12 @@ class BERTModel(HybridBlock):
     def __init__(self, units=768, hidden_size=3072, num_layers=12,
                  num_heads=12, vocab_size=30522, token_type_vocab_size=2,
                  max_length=512, dropout=0.1, layer_norm_eps=1e-12,
-                 use_pooler=True, **kwargs):
+                 use_pooler=True, use_flash=False, **kwargs):
         super().__init__(**kwargs)
         self._units = units
         self._num_heads = num_heads
         self._use_pooler = use_pooler
+        self._use_flash = use_flash
         with self.name_scope():
             self.word_embed = nn.Embedding(vocab_size, units,
                                            weight_initializer="normal")
@@ -88,7 +91,8 @@ class BERTModel(HybridBlock):
                                                  weight_initializer="normal")
             self.encoder = BERTEncoder(units, hidden_size, num_layers,
                                        num_heads, dropout, max_length,
-                                       layer_norm_eps)
+                                       layer_norm_eps,
+                                       use_flash=use_flash)
             if use_pooler:
                 self.pooler = nn.Dense(units, in_units=units,
                                        activation="tanh", flatten=False)
@@ -110,10 +114,15 @@ class BERTModel(HybridBlock):
         if token_types is not None:
             emb = emb + self.token_type_embed(token_types)
         x = emb.swapaxes(0, 1)                                  # (L, B, C)
-        mask = None
-        if valid_length is not None:
-            mask = self._make_mask(F, valid_length, L)
-        out = self.encoder(x, mask)                             # (L, B, C)
+        if self._use_flash:
+            # padding rides the flash kernel's lengths vector; no O(L^2)
+            # mask is ever materialized
+            out = self.encoder(x, None, valid_length=valid_length)
+        else:
+            mask = None
+            if valid_length is not None:
+                mask = self._make_mask(F, valid_length, L)
+            out = self.encoder(x, mask)                         # (L, B, C)
         seq = out.swapaxes(0, 1)                                # (B, L, C)
         if not self._use_pooler:
             return seq

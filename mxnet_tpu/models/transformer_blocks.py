@@ -61,15 +61,31 @@ class PositionwiseFFN(HybridBlock):
 
 class MultiHeadSelfAttention(HybridBlock):
     """Self-attention over (L, B, C) via the interleaved qkv kernels
-    (reference op: _contrib_interleaved_matmul_selfatt_qk/valatt)."""
+    (reference op: _contrib_interleaved_matmul_selfatt_qk/valatt).
 
-    def __init__(self, units, num_heads, dropout=0.0, **kwargs):
+    ``use_flash=True`` routes the qk→softmax→valatt chain to the fused
+    Pallas flash-attention kernel (ops/pallas_kernels.py) whenever the
+    mask is expressible as key valid-lengths (+ optional causal), i.e.
+    ``mask is None``; an explicit additive ``mask`` falls back to the
+    dense path.  The flash path has no attention-prob dropout (the score
+    matrix never materializes); dropout is applied to the attention
+    output instead.
+    """
+
+    def __init__(self, units, num_heads, dropout=0.0, use_flash=False,
+                 causal=False, **kwargs):
         super().__init__(**kwargs)
         if units % num_heads:
             raise MXNetError(f"units {units} not divisible by heads "
                              f"{num_heads}")
+        if causal and not use_flash:
+            raise MXNetError(
+                "causal=True requires use_flash=True; on the dense path "
+                "pass an explicit additive causal mask instead")
         self._units = units
         self._heads = num_heads
+        self._use_flash = use_flash
+        self._causal = causal
         with self.name_scope():
             self.qkv = nn.Dense(3 * units, in_units=units, flatten=False,
                                 prefix="qkv_")
@@ -77,9 +93,24 @@ class MultiHeadSelfAttention(HybridBlock):
                                      prefix="out_proj_")
             self.dropout_layer = nn.Dropout(dropout)
 
-    def hybrid_forward(self, F, x, mask=None):
+    def hybrid_forward(self, F, x, mask=None, valid_length=None):
         # x: (L, B, C). qkv: (L, B, 3C) interleaved per head [q|k|v]
         qkv = self.qkv(x)
+        if self._use_flash and mask is None:
+            if valid_length is None:
+                out = F.flash_selfatt_nomask(qkv, heads=self._heads,
+                                             causal=self._causal)
+            else:
+                out = F.flash_selfatt(qkv, valid_length,
+                                      heads=self._heads,
+                                      causal=self._causal)
+            return self.out_proj(self.dropout_layer(out))
+        if valid_length is not None:
+            raise MXNetError(
+                "valid_length is only consumed by the flash path "
+                "(use_flash=True, mask=None); the dense path needs an "
+                "explicit additive mask — it would otherwise be silently "
+                "ignored")
         scores = F._contrib_interleaved_matmul_selfatt_qk(
             qkv, heads=self._heads)            # (B*H, L, L)
         if mask is not None:
@@ -127,12 +158,13 @@ class TransformerEncoderCell(HybridBlock):
 
     def __init__(self, units, hidden_size, num_heads, dropout=0.0,
                  activation="gelu", layer_norm_eps=1e-5, pre_norm=False,
-                 **kwargs):
+                 use_flash=False, **kwargs):
         super().__init__(**kwargs)
         self._pre_norm = pre_norm
         with self.name_scope():
             self.attention = MultiHeadSelfAttention(units, num_heads,
-                                                    dropout)
+                                                    dropout,
+                                                    use_flash=use_flash)
             self.attn_norm = nn.LayerNorm(in_channels=units,
                                           epsilon=layer_norm_eps)
             self.dropout_layer = nn.Dropout(dropout)
@@ -140,10 +172,10 @@ class TransformerEncoderCell(HybridBlock):
                                        activation, layer_norm_eps,
                                        pre_norm)
 
-    def hybrid_forward(self, F, x, mask=None):
+    def hybrid_forward(self, F, x, mask=None, valid_length=None):
         residual = x
         h = self.attn_norm(x) if self._pre_norm else x
-        h = self.attention(h, mask)
+        h = self.attention(h, mask, valid_length)
         h = self.dropout_layer(h)
         h = h + residual
         if not self._pre_norm:

@@ -1,0 +1,405 @@
+"""Pallas TPU kernels: fused flash attention (forward + backward).
+
+The reference's attention kernels (``src/operator/contrib/transformer.cc``,
+``_contrib_interleaved_matmul_selfatt_*``) materialize the (L, L) score
+matrix — O(L^2) HBM traffic.  This module supplies the TPU-native
+replacement (SURVEY.md §5.7 flash/splash mandate): an online-softmax
+flash-attention kernel that keeps scores in VMEM tiles, with the standard
+FlashAttention-2 backward (recompute P blockwise from the saved
+logsumexp).
+
+Design notes:
+- grid = (batch*heads, q_blocks, k_blocks), innermost k sequential; the
+  running max / denominator / output accumulator live in VMEM scratch and
+  carry across k iterations (canonical TPU flash pattern).
+- per-row key-length masking (padding masks) rides a scalar-prefetch
+  lengths vector; causal masking is an in-kernel iota comparison, and
+  fully-masked k blocks are skipped with ``pl.when``.
+- matmuls request float32 accumulation (``preferred_element_type``) so
+  bf16 inputs hit the MXU without losing the softmax statistics.
+- On CPU backends the kernels run in the Pallas interpreter, so the same
+  code path is exercised by the virtual-mesh test suite.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+    _HAVE_PLTPU = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _HAVE_PLTPU = False
+
+from .registry import register
+
+__all__ = ["flash_attention", "pallas_available"]
+
+_NEG_INF = -1e30
+
+
+def pallas_available() -> bool:
+    """True when the Pallas kernels in this module can execute (compiled
+    on TPU, interpreted on CPU; both need the pltpu scratch/memory-space
+    constructors)."""
+    return _HAVE_PLTPU
+
+
+def _scratch(shape, dtype):
+    return pltpu.VMEM(shape, dtype)
+
+
+def _lens_spec():
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def _block_mask(s, kv_len, q_start, k_start, causal, block_q, block_k):
+    """Mask a (block_q, block_k) score tile: key padding + causal."""
+    k_idx = k_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    mask = k_idx < kv_len
+    if causal:
+        q_idx = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        mask = jnp.logical_and(mask, k_idx <= q_idx)
+    return jnp.where(mask, s, _NEG_INF)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+def _fwd_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, sm_scale, causal, block_q,
+                block_k, nk):
+    b = pl.program_id(0)
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    kv_len = lens_ref[b]
+    q_start = iq * block_q
+    k_start = ik * block_k
+    # any work in this block? (causal: block fully above the diagonal;
+    # padding: block fully past the key length)
+    needed = k_start < kv_len
+    if causal:
+        needed = jnp.logical_and(needed,
+                                 k_start <= q_start + block_q - 1)
+
+    @pl.when(needed)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # (bq, bk)
+        s = _block_mask(s, kv_len, q_start, k_start, causal, block_q,
+                        block_k)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                              # (bq, bk)
+        l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        l = l_scr[:]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+        lse_ref[0] = jnp.where(l == 0.0, _NEG_INF,
+                               m_scr[:] + jnp.log(safe_l))
+
+
+# ---------------------------------------------------------------------------
+# backward (FlashAttention-2: dQ pass + dK/dV pass, P recomputed)
+# ---------------------------------------------------------------------------
+def _bwd_dq_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                   delta_ref, dq_ref, dq_scr, *, sm_scale, causal,
+                   block_q, block_k, nk):
+    b = pl.program_id(0)
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    kv_len = lens_ref[b]
+    q_start = iq * block_q
+    k_start = ik * block_k
+    needed = k_start < kv_len
+    if causal:
+        needed = jnp.logical_and(needed,
+                                 k_start <= q_start + block_q - 1)
+
+    @pl.when(needed)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        s = _block_mask(s, kv_len, q_start, k_start, causal, block_q,
+                        block_k)
+        p = jnp.exp(s - lse_ref[0])                # (bq, bk)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0]) * sm_scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
+                    sm_scale, causal, block_q, block_k, nq):
+    b = pl.program_id(0)
+    ik = pl.program_id(1)
+    iq = pl.program_id(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    kv_len = lens_ref[b]
+    q_start = iq * block_q
+    k_start = ik * block_k
+    needed = k_start < kv_len
+    if causal:
+        needed = jnp.logical_and(needed,
+                                 q_start + block_q - 1 >= k_start)
+
+    @pl.when(needed)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        s = _block_mask(s, kv_len, q_start, k_start, causal, block_q,
+                        block_k)
+        p = jnp.exp(s - lse_ref[0])                # (bq, bk)
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # (bk, D)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)             # (bq, bk)
+        ds = p * (dp - delta_ref[0]) * sm_scale
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # (bk, D)
+
+    @pl.when(iq == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# pallas_call plumbing
+# ---------------------------------------------------------------------------
+def _specs(block_q, block_k, D, Lq, Lk, order):
+    """BlockSpecs for (lens, q, k, v[, do, lse, delta]) given grid axis
+    order: 'qk' = (b, iq, ik), 'kq' = (b, ik, iq)."""
+    if order == "qk":
+        qi = lambda b, i, j: (b, i, 0)          # noqa: E731
+        ki = lambda b, i, j: (b, j, 0)          # noqa: E731
+        rowi = lambda b, i, j: (b, i, 0)        # noqa: E731
+    else:
+        qi = lambda b, i, j: (b, j, 0)          # noqa: E731
+        ki = lambda b, i, j: (b, i, 0)          # noqa: E731
+        rowi = lambda b, i, j: (b, j, 0)        # noqa: E731
+    q_spec = pl.BlockSpec((1, block_q, D), qi)
+    k_spec = pl.BlockSpec((1, block_k, D), ki)
+    row_spec = pl.BlockSpec((1, block_q, 1), rowi)
+    return q_spec, k_spec, row_spec
+
+
+def _run(kernel, grid, in_specs, out_shape, out_specs, scratch, inputs,
+         interpret):
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_shape=out_shape,
+        out_specs=out_specs,
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(*inputs)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, lens, causal, sm_scale, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, lens, causal, sm_scale, block_q,
+                        block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, lens, causal, sm_scale, block_q, block_k,
+               interpret):
+    BH, Lq, D = q.shape
+    Lk = k.shape[1]
+    nq, nk = Lq // block_q, Lk // block_k
+    q_spec, k_spec, row_spec = _specs(block_q, block_k, D, Lq, Lk, "qk")
+    lens_spec = _lens_spec()
+    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale,
+                               causal=causal, block_q=block_q,
+                               block_k=block_k, nk=nk)
+    out, lse = _run(
+        kernel, (BH, nq, nk),
+        [lens_spec, q_spec, k_spec, k_spec],
+        (jax.ShapeDtypeStruct((BH, Lq, D), q.dtype),
+         jax.ShapeDtypeStruct((BH, Lq, 1), jnp.float32)),
+        (q_spec, row_spec),
+        [_scratch((block_q, 1), jnp.float32),
+         _scratch((block_q, 1), jnp.float32),
+         _scratch((block_q, D), jnp.float32)],
+        (lens, q, k, v), interpret)
+    return out, (q, k, v, lens, out, lse)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, dout):
+    q, k, v, lens, out, lse = res
+    BH, Lq, D = q.shape
+    Lk = k.shape[1]
+    nq, nk = Lq // block_q, Lk // block_k
+    delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)                  # (BH, Lq, 1)
+    lens_spec = _lens_spec()
+
+    q_spec, k_spec, row_spec = _specs(block_q, block_k, D, Lq, Lk, "qk")
+    dq = _run(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=block_q,
+                          block_k=block_k, nk=nk),
+        (BH, nq, nk),
+        [lens_spec, q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+        jax.ShapeDtypeStruct((BH, Lq, D), q.dtype),
+        q_spec,
+        [_scratch((block_q, D), jnp.float32)],
+        (lens, q, k, v, dout, lse, delta), interpret)
+
+    q_spec2, k_spec2, row_spec2 = _specs(block_q, block_k, D, Lq, Lk,
+                                         "kq")
+    dk, dv = _run(
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=block_q,
+                          block_k=block_k, nq=nq),
+        (BH, nk, nq),
+        [lens_spec, q_spec2, k_spec2, k_spec2, q_spec2, row_spec2,
+         row_spec2],
+        (jax.ShapeDtypeStruct((BH, Lk, D), k.dtype),
+         jax.ShapeDtypeStruct((BH, Lk, D), v.dtype)),
+        (k_spec2, k_spec2),
+        [_scratch((block_k, D), jnp.float32),
+         _scratch((block_k, D), jnp.float32)],
+        (lens, q, k, v, dout, lse, delta), interpret)
+    dlens = np.zeros(lens.shape, jax.dtypes.float0)
+    return dq, dk, dv, dlens
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _ceil_to(x, m):
+    return (x + m - 1) // m * m
+
+
+def flash_attention(q, k, v, lengths=None, causal=False, sm_scale=None,
+                    block_q=128, block_k=128, interpret=None):
+    """Fused attention over (B*H, L, D) tensors.
+
+    ``lengths``: optional int32 (B*H,) valid key lengths (padding mask).
+    Returns (B*H, Lq, D) in the query dtype.
+    """
+    if not pallas_available():
+        from ..base import MXNetError
+        raise MXNetError(
+            "flash_attention requires jax.experimental.pallas.tpu "
+            "(check mx.runtime.Features()['PALLAS'])")
+    BH, Lq, D = q.shape
+    Lk = k.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(D))
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    block_q = min(block_q, _ceil_to(Lq, 8))
+    block_k = min(block_k, _ceil_to(Lk, 8))
+    Lq_p, Lk_p = _ceil_to(Lq, block_q), _ceil_to(Lk, block_k)
+    if lengths is None:
+        lengths = jnp.full((BH,), Lk, jnp.int32)
+    else:
+        lengths = lengths.astype(jnp.int32)
+    if Lq_p != Lq:
+        q = jnp.pad(q, ((0, 0), (0, Lq_p - Lq), (0, 0)))
+    if Lk_p != Lk:
+        k = jnp.pad(k, ((0, 0), (0, Lk_p - Lk), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, Lk_p - Lk), (0, 0)))
+    out = _flash(q, k, v, lengths, causal, float(sm_scale), block_q,
+                 block_k, bool(interpret))
+    return out[:, :Lq] if Lq_p != Lq else out
+
+
+# ---------------------------------------------------------------------------
+# op-registry frontends (layout contract of the interleaved MHA ops:
+# qkv (L, B, H*3*D) -> out (L, B, H*D); reference transformer.cc)
+# ---------------------------------------------------------------------------
+@register("_contrib_flash_selfatt", num_inputs=2,
+          aliases=["flash_selfatt"])
+def flash_selfatt(queries_keys_values, valid_length, *, heads: int = 1,
+                  causal: bool = False):
+    """Flash-attention drop-in for the interleaved selfatt qk->softmax->
+    valatt chain.  ``valid_length``: (B,) float/int valid KEY lengths.
+    """
+    L, B, H3D = queries_keys_values.shape
+    D = H3D // (heads * 3)
+    x = queries_keys_values.reshape(L, B, heads, 3, D)
+    # (L, B, H, D) -> (B*H, L, D)
+    q, k, v = (x[:, :, :, i, :].transpose(1, 2, 0, 3)
+               .reshape(B * heads, L, D) for i in range(3))
+    lens = jnp.repeat(valid_length.astype(jnp.int32), heads)
+    out = flash_attention(q, k, v, lengths=lens, causal=causal)
+    return out.reshape(B, heads, L, D).transpose(2, 0, 1, 3).reshape(
+        L, B, heads * D)
+
+
+@register("_contrib_flash_selfatt_nomask", num_inputs=1,
+          aliases=["flash_selfatt_nomask"])
+def flash_selfatt_nomask(queries_keys_values, *, heads: int = 1,
+                         causal: bool = False):
+    """flash_selfatt without a padding mask (full key length)."""
+    L, B, H3D = queries_keys_values.shape
+    D = H3D // (heads * 3)
+    x = queries_keys_values.reshape(L, B, heads, 3, D)
+    q, k, v = (x[:, :, :, i, :].transpose(1, 2, 0, 3)
+               .reshape(B * heads, L, D) for i in range(3))
+    out = flash_attention(q, k, v, causal=causal)
+    return out.reshape(B, heads, L, D).transpose(2, 0, 1, 3).reshape(
+        L, B, heads * D)

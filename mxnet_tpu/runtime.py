@@ -16,6 +16,14 @@ class Feature:
         return f"[{'✔' if self.enabled else '✖'} {self.name}]"
 
 
+def _pallas_enabled() -> bool:
+    try:
+        from .ops.pallas_kernels import pallas_available
+        return pallas_available()
+    except Exception:
+        return False
+
+
 def _detect() -> Dict[str, bool]:
     import jax
     feats = {
@@ -29,7 +37,7 @@ def _detect() -> Dict[str, bool]:
         "DIST_KVSTORE": True,   # xla collectives backend
         "INT64_TENSOR_SIZE": True,
         "SIGNAL_HANDLER": True,
-        "PALLAS": True,
+        "PALLAS": _pallas_enabled(),
         "BF16": True,
         "NATIVE_IO": False,     # flipped true when the C++ recordio lib loads
     }
