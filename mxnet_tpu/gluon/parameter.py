@@ -326,11 +326,18 @@ class ParameterDict:
             param = Parameter(full, **kwargs)
             self._params[full] = param
         else:
-            # reconcile attrs (reference behavior: inherit unknown shape)
+            # reconcile attrs (reference behavior: inherit unknown shape,
+            # assert compatibility when both sides are fully known)
             shape = kwargs.get("shape")
             if shape is not None and param.shape is not None:
                 if _shape_is_known(param.shape):
-                    pass
+                    if (_shape_is_known(shape)
+                            and tuple(shape) != tuple(param.shape)):
+                        raise MXNetError(
+                            f"ParameterDict.get({name!r}): requested shape "
+                            f"{tuple(shape)} conflicts with existing shape "
+                            f"{tuple(param.shape)} of shared parameter "
+                            f"{full!r}")
                 else:
                     param.shape = tuple(shape)
         return param

@@ -355,12 +355,13 @@ class ZoneoutCell(ModifierCell):
         next_output, next_states = self.base_cell(inputs, states)
         if not autograd.is_training():
             return next_output, next_states
-        import numpy as np
+        from ... import ndarray as _nd_api
 
         def mask(p, like):
-            keep = nd.array(
-                (np.random.rand(*like.shape) >= p).astype("float32"))
-            return keep
+            # framework RNG: respects mx.random.seed and stays stochastic
+            # under a jit trace (keys are threaded through trace_key_scope)
+            u = _nd_api.random.uniform(0.0, 1.0, shape=like.shape)
+            return (u >= p).astype("float32")
         prev = self._prev_output
         if prev is None:
             prev = nd.zeros(next_output.shape)

@@ -283,13 +283,15 @@ class AdamW(Optimizer):
         self._update_count(index)
         t = self._index_update_count[index]
         lr = self._get_lr(index)
-        # bias correction folded into eta (reference adamw semantics)
-        eta = lr * math.sqrt(1. - self.beta2 ** t) / (1. - self.beta1 ** t)
+        # bias correction applies only to the gradient term; decoupled decay
+        # is scaled by lr alone: w -= eta*(lr*m/(sqrt(v)+eps) + wd*w) with
+        # eta=lr, lr=corr gives  lr*corr*m_hat + lr*wd*w
+        corr = math.sqrt(1. - self.beta2 ** t) / (1. - self.beta1 ** t)
         mean, var = state
         rescale = nd.full((1,), self.rescale_grad, ctx=weight.context)
         new_w, new_m, new_v = _apply(
             "adamw_update", [weight, grad, mean, var, rescale],
-            lr=1.0, eta=eta, beta1=self.beta1, beta2=self.beta2,
+            lr=corr, eta=lr, beta1=self.beta1, beta2=self.beta2,
             epsilon=self.epsilon, wd=self._get_wd(index),
             clip_gradient=self.clip_gradient or -1.0)
         weight._set_data(new_w._data)
