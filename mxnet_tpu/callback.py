@@ -1,0 +1,97 @@
+"""Training callbacks (reference: ``python/mxnet/callback.py``)."""
+from __future__ import annotations
+
+import logging
+import time
+
+__all__ = ["Speedometer", "do_checkpoint", "ProgressBar",
+           "LogValidationMetricsCallback", "module_checkpoint"]
+
+
+def do_checkpoint(prefix, period=1):
+    """Epoch-end callback saving symbol+params (reference: do_checkpoint)."""
+    from .module.module import save_checkpoint
+    period = int(max(1, period))
+
+    def _callback(epoch, sym, arg_params, aux_params):
+        if (epoch + 1) % period == 0:
+            save_checkpoint(prefix, epoch, sym, arg_params, aux_params)
+    return _callback
+
+
+def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
+    period = int(max(1, period))
+
+    def _callback(epoch, sym=None, arg=None, aux=None):
+        if (epoch + 1) % period == 0:
+            mod.save_checkpoint(prefix, epoch, save_optimizer_states)
+    return _callback
+
+
+class Speedometer:
+    """Log samples/sec every `frequent` batches (reference: Speedometer)."""
+
+    def __init__(self, batch_size, frequent=50, auto_reset=True):
+        self.batch_size = batch_size
+        self.frequent = frequent
+        self.auto_reset = auto_reset
+        self.init = False
+        self.tic = 0
+        self.last_count = 0
+
+    def __call__(self, param):
+        count = param.nbatch
+        if self.last_count > count:
+            self.init = False
+        self.last_count = count
+        if self.init:
+            if count % self.frequent == 0:
+                speed = self.frequent * self.batch_size / \
+                    (time.time() - self.tic)
+                if param.eval_metric is not None:
+                    names, vals = param.eval_metric.get()
+                    if not isinstance(names, list):
+                        names, vals = [names], [vals]
+                    msg = " ".join(f"{n}={v:.6f}" for n, v in
+                                   zip(names, vals))
+                    logging.info("Epoch[%d] Batch [%d] Speed: %.2f "
+                                 "samples/sec %s", param.epoch, count,
+                                 speed, msg)
+                    if self.auto_reset:
+                        param.eval_metric.reset()
+                else:
+                    logging.info("Epoch[%d] Batch [%d] Speed: %.2f "
+                                 "samples/sec", param.epoch, count, speed)
+                self.tic = time.time()
+        else:
+            self.init = True
+            self.tic = time.time()
+
+
+class ProgressBar:
+    """Text progress bar per epoch (reference: ProgressBar)."""
+
+    def __init__(self, total, length=80):
+        self.bar_len = length
+        self.total = total
+
+    def __call__(self, param):
+        count = param.nbatch
+        filled = int(round(self.bar_len * count / float(self.total)))
+        pct = round(100.0 * count / float(self.total), 1)
+        bar = "=" * filled + "-" * (self.bar_len - filled)
+        logging.info("[%s] %s%%", bar, pct)
+
+
+class LogValidationMetricsCallback:
+    """reference: LogValidationMetricsCallback."""
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        names, vals = param.eval_metric.get()
+        if not isinstance(names, list):
+            names, vals = [names], [vals]
+        for name, value in zip(names, vals):
+            logging.info("Epoch[%d] Validation-%s=%f", param.epoch, name,
+                         value)

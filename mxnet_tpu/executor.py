@@ -1,0 +1,266 @@
+"""Symbolic executor: Bind/SimpleBind over one compiled XLA program.
+
+Reference surface being re-created: ``src/executor/graph_executor.cc``
+(``GraphExecutor::Bind/SimpleBind/Forward/Backward``) and
+``python/mxnet/executor.py`` (SURVEY.md 2.1 "Symbolic executor", 3.5).
+
+TPU-native redesign: the reference walks an nnvm graph and pushes one engine
+op per node, with a memory-planning pass (PlanMemory) assigning storage.
+Here the whole graph is *one* ``jax.jit``-compiled program — XLA performs
+fusion, scheduling and buffer assignment, which subsumes the nnvm pass
+pipeline.  Backward is the ``jax.vjp`` of the same interpreted graph,
+compiled jointly so XLA shares forward work between fwd and bwd.
+
+Compile caching: one compiled program per (shape, dtype, train) signature —
+the executor is re-usable across batches like the reference's
+(re)allocated executor, and ``num_compiles`` exposes the trace count so
+bucketing policies (module/bucketing_module.py) can bound recompiles.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from . import ndarray as nd
+from .ndarray import NDArray
+
+__all__ = ["Executor"]
+
+
+class Executor:
+    """Executes a Symbol graph with bound argument/aux arrays.
+
+    Parameters mirror ``Symbol.bind`` (reference: MXExecutorBindEX):
+
+    args       : dict name->NDArray, or list in ``list_arguments()`` order
+    args_grad  : same container type; receives gradients after backward()
+    grad_req   : 'write' | 'add' | 'null', or dict/list per-argument
+    aux_states : dict/list for auxiliary (non-differentiable) states
+    """
+
+    def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
+                 aux_states=None):
+        self._symbol = symbol
+        self._ctx = ctx
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        self.arg_dict: Dict[str, NDArray] = _as_dict(args, arg_names, "args")
+        self.aux_dict: Dict[str, NDArray] = _as_dict(
+            aux_states or {}, aux_names, "aux_states")
+        missing = [n for n in arg_names if n not in self.arg_dict]
+        if missing:
+            raise MXNetError(f"bind: missing arguments {missing}")
+
+        if isinstance(grad_req, str):
+            self._grad_req = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self._grad_req = dict(zip(arg_names, grad_req))
+        else:
+            self._grad_req = {n: grad_req.get(n, "null") for n in arg_names}
+
+        self.grad_dict: Dict[str, NDArray] = {}
+        if args_grad is not None:
+            self.grad_dict = _as_dict(args_grad, arg_names, "args_grad")
+        for n, req in self._grad_req.items():
+            if req not in ("write", "add", "null"):
+                raise MXNetError(f"invalid grad_req {req!r} for {n!r}")
+            if req != "null" and n not in self.grad_dict:
+                self.grad_dict[n] = nd.zeros_like(self.arg_dict[n])
+
+        self.outputs: List[NDArray] = []
+        self._last_feed = None
+        self._is_train = False
+        # compile caches keyed on (shapes, dtypes) signature
+        self._fwd_cache: Dict[tuple, object] = {}
+        self._bwd_cache: Dict[tuple, object] = {}
+        self.num_compiles = 0
+
+    # ------------------------------------------------------------ properties
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._symbol.list_arguments()]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n)
+                for n in self._symbol.list_arguments()]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n]
+                for n in self._symbol.list_auxiliary_states()]
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._symbol.list_outputs(), self.outputs))
+
+    # -------------------------------------------------------------- compile
+    def _signature(self, feed):
+        return tuple((k, v.shape, str(v.dtype))
+                     for k, v in sorted(feed.items()))
+
+    def _get_fwd(self, feed, train):
+        key = (self._signature(feed), train)
+        fn = self._fwd_cache.get(key)
+        if fn is None:
+            self.num_compiles += 1
+            sym = self._symbol
+            from . import random as mxrand
+
+            @jax.jit
+            def fn(f, rng):
+                # traced rng key: Dropout et al. stay stochastic per call
+                with mxrand.trace_key_scope(rng):
+                    aux_up = {}
+                    outs = sym._interpret(
+                        f, train=train,
+                        aux_updates=aux_up if train else None)
+                return outs, aux_up
+
+            self._fwd_cache[key] = fn
+        return fn
+
+    def _get_bwd(self, diff_feed, const_feed, n_ograds):
+        key = (self._signature(diff_feed), self._signature(const_feed))
+        fn = self._bwd_cache.get(key)
+        if fn is None:
+            self.num_compiles += 1
+            sym = self._symbol
+            from . import random as mxrand
+
+            @jax.jit
+            def fn(diff, const, ograds, rng):
+                def run(d):
+                    merged = dict(d)
+                    merged.update(const)
+                    # same rng as the forward pass: identical dropout masks
+                    with mxrand.trace_key_scope(rng):
+                        return tuple(sym._interpret(merged, train=True))
+
+                _, vjp = jax.vjp(run, diff)
+                return vjp(tuple(ograds))[0]
+
+            self._bwd_cache[key] = fn
+        return fn
+
+    # -------------------------------------------------------------- forward
+    def forward(self, is_train=False, **kwargs):
+        """Run the graph; returns ``self.outputs``.
+
+        kwargs overwrite bound argument arrays by name (the reference copies
+        into the bound NDArrays; here we rebind the device buffer, which is
+        the same observable behavior without the copy).
+        """
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError(f"forward: unknown argument {k!r}")
+            if not isinstance(v, NDArray):
+                v = nd.array(v)
+            self.arg_dict[k]._set_data(v._data)
+        feed = {n: a._data for n, a in self.arg_dict.items()}
+        feed.update({n: a._data for n, a in self.aux_dict.items()})
+        self._last_feed = feed
+        self._is_train = bool(is_train)
+        from . import random as mxrand
+        self._last_rng = mxrand.next_key()
+        outs, aux_up = self._get_fwd(feed, self._is_train)(
+            feed, self._last_rng)
+        for name, val in aux_up.items():
+            if name in self.aux_dict:
+                self.aux_dict[name]._set_data(val)
+        self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]
+        return self.outputs
+
+    # ------------------------------------------------------------- backward
+    def backward(self, out_grads=None):
+        """Gradient of outputs wrt grad-requested args, honoring grad_req.
+
+        With ``out_grads=None`` the cotangent is ones for every output —
+        matching the reference head-gradient default for loss-style graphs
+        (SoftmaxOutput/make_loss ignore the incoming cotangent anyway).
+        """
+        if self._last_feed is None:
+            raise MXNetError("backward called before forward")
+        if not self._is_train:
+            raise MXNetError("backward requires forward(is_train=True)")
+        diff_names = [n for n, r in self._grad_req.items() if r != "null"]
+        if not diff_names:
+            return
+        diff = {n: self._last_feed[n] for n in diff_names}
+        const = {n: v for n, v in self._last_feed.items()
+                 if n not in diff}
+        if out_grads is None:
+            ograds = [jnp.ones_like(o._data) for o in self.outputs]
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            ograds = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                      for g in out_grads]
+        grads = self._get_bwd(diff, const, len(ograds))(
+            diff, const, ograds, self._last_rng)
+        for n in diff_names:
+            dst = self.grad_dict[n]
+            g = grads[n].astype(dst._data.dtype)
+            if self._grad_req[n] == "add":
+                dst._set_data(dst._data + g)
+            else:
+                dst._set_data(g)
+
+    # ------------------------------------------------------------- utility
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        """reference: Executor.copy_params_from."""
+        for name, arr in arg_params.items():
+            if name in self.arg_dict:
+                if tuple(arr.shape) != tuple(self.arg_dict[name].shape):
+                    raise MXNetError(
+                        f"copy_params_from: shape mismatch for {name!r}: "
+                        f"{arr.shape} vs bound {self.arg_dict[name].shape}")
+                self.arg_dict[name]._set_data(jnp.asarray(arr._data))
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown parameter {name!r}")
+        if aux_params:
+            for name, arr in aux_params.items():
+                if name in self.aux_dict:
+                    self.aux_dict[name]._set_data(jnp.asarray(arr._data))
+                elif not allow_extra_params:
+                    raise MXNetError(f"unknown aux state {name!r}")
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False,
+                **kwargs):
+        """Return a new executor bound with new shapes (reference:
+        Executor.reshape).  Compile caches are fresh; arrays are re-allocated
+        for changed shapes and shared otherwise."""
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        args = {}
+        for n, s in zip(self._symbol.list_arguments(), arg_shapes):
+            cur = self.arg_dict[n]
+            args[n] = cur if tuple(cur.shape) == tuple(s) else \
+                nd.zeros(s, dtype=cur.dtype)
+        aux = {}
+        for n, s in zip(self._symbol.list_auxiliary_states(), aux_shapes):
+            cur = self.aux_dict[n]
+            aux[n] = cur if tuple(cur.shape) == tuple(s) else \
+                nd.zeros(s, dtype=cur.dtype)
+        grads = None
+        if self.grad_dict:
+            grads = {n: (g if tuple(g.shape) == tuple(args[n].shape)
+                         else nd.zeros_like(args[n]))
+                     for n, g in self.grad_dict.items()}
+        return Executor(self._symbol, self._ctx, args, grads,
+                        self._grad_req, aux)
+
+
+def _as_dict(container, names, what) -> Dict[str, NDArray]:
+    if isinstance(container, dict):
+        return dict(container)
+    if isinstance(container, (list, tuple)):
+        if len(container) != len(names):
+            raise MXNetError(
+                f"{what}: expected {len(names)} arrays ({names}), "
+                f"got {len(container)}")
+        return dict(zip(names, container))
+    raise MXNetError(f"{what} must be a dict or list of NDArray")

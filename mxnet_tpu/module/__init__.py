@@ -1,0 +1,13 @@
+"""Legacy Module API (reference: ``python/mxnet/module/`` — SURVEY.md 2.2).
+
+The imperative Gluon API (``mxnet_tpu.gluon``) is the modern path; this
+package re-creates the symbolic training surface — ``Module.fit`` over a
+bound Executor, and ``BucketingModule``'s explicit compile-cache policy for
+variable-length inputs (SURVEY.md 2.4 P8).
+"""
+from .base_module import BaseModule
+from .module import Module, save_checkpoint, load_checkpoint
+from .bucketing_module import BucketingModule
+
+__all__ = ["BaseModule", "Module", "BucketingModule", "save_checkpoint",
+           "load_checkpoint"]

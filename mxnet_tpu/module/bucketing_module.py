@@ -1,0 +1,158 @@
+"""BucketingModule: bounded compile-cache policy for variable-length input.
+
+Reference: ``python/mxnet/module/bucketing_module.py`` (SURVEY.md 2.2) and
+the §2.4 P8 mandate — on TPU every distinct shape is a fresh XLA
+compilation, so the reference's bucketing idea (bin variable-length
+sequences into a small fixed set of shapes, one executor per bucket,
+parameters shared) is *more* load-bearing here than on GPU.  The bucket
+registry is explicit: ``num_compiles``/``active_buckets`` expose exactly how
+many programs exist, and ``bucket_keys`` fixed at construction caps them.
+"""
+from __future__ import annotations
+
+import logging
+
+from ..base import MXNetError
+from .base_module import BaseModule
+from .module import Module
+
+__all__ = ["BucketingModule"]
+
+
+class BucketingModule(BaseModule):
+    """reference: mx.mod.BucketingModule(sym_gen, default_bucket_key).
+
+    sym_gen(bucket_key) -> (symbol, data_names, label_names)
+    """
+
+    def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None, bucket_keys=None):
+        super().__init__(logger=logger)
+        if default_bucket_key is None:
+            raise MXNetError("BucketingModule: default_bucket_key required")
+        self._sym_gen = sym_gen
+        self._default_bucket_key = default_bucket_key
+        self._context = context
+        self._fixed_param_names = fixed_param_names
+        # explicit compile-cache policy: when bucket_keys is given, only
+        # those keys may ever be bound (a hard cap on XLA programs)
+        self._allowed_keys = set(bucket_keys) | {default_bucket_key} \
+            if bucket_keys is not None else None
+        self._buckets = {}
+        self._curr_module = None
+        self._curr_bucket_key = None
+
+    # ---------------------------------------------------------------- state
+    @property
+    def default_bucket_key(self):
+        return self._default_bucket_key
+
+    @property
+    def active_buckets(self):
+        return sorted(self._buckets)
+
+    @property
+    def num_compiles(self):
+        """Total XLA programs traced across all bucket executors."""
+        return sum(m.num_compiles for m in self._buckets.values())
+
+    @property
+    def symbol(self):
+        return self._curr_module.symbol if self._curr_module else None
+
+    # ----------------------------------------------------------------- bind
+    def _gen_module(self, bucket_key):
+        if self._allowed_keys is not None and \
+                bucket_key not in self._allowed_keys:
+            raise MXNetError(
+                f"bucket key {bucket_key!r} not in the registered bucket "
+                f"set {sorted(self._allowed_keys)}; refusing an unbounded "
+                f"compile (P8 policy)")
+        symbol, data_names, label_names = self._sym_gen(bucket_key)
+        return Module(symbol, data_names, label_names, logger=self.logger,
+                      context=self._context,
+                      fixed_param_names=self._fixed_param_names)
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        self._bind_args = dict(for_training=for_training,
+                               inputs_need_grad=inputs_need_grad,
+                               grad_req=grad_req)
+        module = self._gen_module(self._default_bucket_key)
+        module.bind(data_shapes, label_shapes, **self._bind_args)
+        self._buckets[self._default_bucket_key] = module
+        self._curr_module = module
+        self._curr_bucket_key = self._default_bucket_key
+        self.binded = True
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        """Bind (or reuse) the executor for bucket_key, sharing parameters
+        with the default bucket's module (reference: switch_bucket)."""
+        if not self.binded:
+            raise MXNetError("switch_bucket: call bind first")
+        if bucket_key not in self._buckets:
+            module = self._gen_module(bucket_key)
+            module.bind(data_shapes, label_shapes,
+                        shared_module=self._buckets[self._default_bucket_key],
+                        **self._bind_args)
+            if self.optimizer_initialized:
+                module._optimizer = self._curr_module._optimizer
+                module._updater = self._curr_module._updater
+                module.optimizer_initialized = True
+            self._buckets[bucket_key] = module
+        self._curr_module = self._buckets[bucket_key]
+        self._curr_bucket_key = bucket_key
+
+    # ------------------------------------------------------------- delegate
+    def init_params(self, *args, **kwargs):
+        self._curr_module.init_params(*args, **kwargs)
+        self.params_initialized = True
+        for m in self._buckets.values():
+            m.params_initialized = True
+
+    def init_optimizer(self, *args, **kwargs):
+        self._curr_module.init_optimizer(*args, **kwargs)
+        self.optimizer_initialized = True
+        # one optimizer/updater instance drives every bucket: shared params
+        # must see one consistent state/update-count stream
+        for m in self._buckets.values():
+            m._optimizer = self._curr_module._optimizer
+            m._updater = self._curr_module._updater
+            m.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        key = getattr(data_batch, "bucket_key", None)
+        if key is None:
+            key = self._curr_bucket_key
+        if key != self._curr_bucket_key:
+            self.switch_bucket(key, data_batch.provide_data,
+                               data_batch.provide_label)
+        self._curr_module.forward(data_batch, is_train=is_train)
+
+    def backward(self, out_grads=None):
+        self._curr_module.backward(out_grads=out_grads)
+
+    def update(self):
+        self._curr_module.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._curr_module.get_outputs(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels):
+        self._curr_module.update_metric(eval_metric, labels)
+
+    def get_params(self):
+        return self._buckets[self._default_bucket_key].get_params()
+
+    def set_params(self, arg_params, aux_params, **kwargs):
+        self._buckets[self._default_bucket_key].set_params(
+            arg_params, aux_params, **kwargs)
+        self.params_initialized = True
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        self._buckets[self._default_bucket_key].save_checkpoint(
+            prefix, epoch, save_optimizer_states)

@@ -1,0 +1,278 @@
+"""Module: intermediate-level symbolic training on a bound Executor.
+
+Reference: ``python/mxnet/module/module.py`` (SURVEY.md 2.2, 3.5 call stack
+Module.fit -> forward_backward -> executor group -> engine).  Here the
+"executor group" is a single Executor whose whole graph is one XLA program;
+data parallelism over devices is the kvstore/Trainer tier's job
+(``mxnet_tpu.kvstore``, ``mxnet_tpu.parallel``), matching the TPU design
+where SPMD sharding — not per-device executor replicas — scales the step.
+"""
+from __future__ import annotations
+
+import logging
+
+from ..base import MXNetError
+from .. import context as ctx_mod
+from .. import initializer as init_mod
+from .. import ndarray as nd
+from .. import optimizer as opt_mod
+from ..initializer import InitDesc
+from ..optimizer.optimizer import get_updater
+from .base_module import BaseModule
+
+__all__ = ["Module", "save_checkpoint", "load_checkpoint"]
+
+
+class Module(BaseModule):
+    """reference: mx.mod.Module(symbol, data_names, label_names, context)."""
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None):
+        super().__init__(logger=logger)
+        self._symbol = symbol
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._context = context if context is not None \
+            else ctx_mod.current_context()
+        if isinstance(self._context, (list, tuple)):
+            # multi-device replicas are served by the SPMD tier; a Module
+            # executes on one (possibly sharded) context
+            self._context = self._context[0]
+        self._fixed_param_names = set(fixed_param_names or [])
+        arg_names = symbol.list_arguments()
+        unknown_data = set(self._data_names) - set(arg_names)
+        if unknown_data:
+            raise MXNetError(
+                f"Module: data names {sorted(unknown_data)} not found in "
+                f"symbol arguments {arg_names}")
+        # labels absent from the graph are tolerated (inference-only
+        # symbols; reference _check_input_names uses throw=False here)
+        missing_labels = set(self._label_names) - set(arg_names)
+        if missing_labels:
+            self.logger.warning(
+                "Module: label names %s not used by the symbol; ignoring",
+                sorted(missing_labels))
+            self._label_names = [n for n in self._label_names
+                                 if n in arg_names]
+        input_names = set(self._data_names) | set(self._label_names)
+        self._param_names = [n for n in arg_names if n not in input_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._exec = None
+        self._optimizer = None
+        self._updater = None
+        self._data_shapes = None
+        self._label_shapes = None
+        self._preloaded = None          # set by Module.load
+        self._preloaded_states = None
+
+    # ------------------------------------------------------------------ bind
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        self._data_shapes = _norm_shapes(data_shapes, self._data_names)
+        self._label_shapes = _norm_shapes(label_shapes, self._label_names) \
+            if label_shapes else []
+        self._for_training = for_training
+        self._inputs_need_grad = inputs_need_grad
+
+        shapes = {n: s for n, s in self._data_shapes + self._label_shapes}
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**shapes)
+        arg_names = self._symbol.list_arguments()
+
+        args, reqs = {}, {}
+        shared = shared_module._exec if shared_module is not None else None
+        for name, shape in zip(arg_names, arg_shapes):
+            if shared is not None and name in shared.arg_dict and \
+                    name in self._param_names:
+                args[name] = shared.arg_dict[name]      # shared storage
+            else:
+                args[name] = nd.zeros(shape, ctx=self._context)
+            if not for_training:
+                reqs[name] = "null"
+            elif name in self._fixed_param_names:
+                reqs[name] = "null"
+            elif name in self._param_names:
+                reqs[name] = grad_req
+            else:  # data/label inputs
+                reqs[name] = grad_req if (inputs_need_grad and
+                                          name in self._data_names) \
+                    else "null"
+        aux = {}
+        for name, shape in zip(self._aux_names, aux_shapes):
+            if shared is not None and name in shared.aux_dict:
+                aux[name] = shared.aux_dict[name]
+            else:
+                aux[name] = nd.zeros(shape, ctx=self._context)
+
+        from ..executor import Executor
+        self._exec = Executor(self._symbol, self._context, args,
+                              args_grad=None, grad_req=reqs, aux_states=aux)
+        self.binded = True
+        if shared_module is not None and shared_module.params_initialized:
+            self.params_initialized = True
+        elif self._preloaded is not None:
+            # Module.load: restore checkpointed params into the fresh bind
+            arg_params, aux_params = self._preloaded
+            self.init_params(arg_params=arg_params, aux_params=aux_params,
+                             allow_extra=True)
+
+    # ---------------------------------------------------------------- params
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        if not self.binded:
+            raise MXNetError("init_params: call bind first")
+        initializer = initializer or init_mod.Uniform(0.01)
+        for name in self._param_names:
+            arr = self._exec.arg_dict[name]
+            if arg_params is not None and name in arg_params:
+                arr._set_data(nd.array(arg_params[name].asnumpy())._data)
+            elif arg_params is not None and not allow_missing:
+                raise MXNetError(f"init_params: missing arg {name!r}")
+            else:
+                initializer(InitDesc(name), arr)
+        for name in self._aux_names:
+            arr = self._exec.aux_dict[name]
+            if aux_params is not None and name in aux_params:
+                arr._set_data(nd.array(aux_params[name].asnumpy())._data)
+            elif aux_params is not None and not allow_missing:
+                raise MXNetError(f"init_params: missing aux {name!r}")
+            else:
+                initializer(InitDesc(name), arr)
+        if arg_params is not None and not allow_extra:
+            extra = set(arg_params) - set(self._param_names)
+            if extra:
+                raise MXNetError(
+                    f"init_params: extra parameters {sorted(extra)} "
+                    f"(pass allow_extra=True to ignore)")
+        self.params_initialized = True
+
+    def get_params(self):
+        if not self.binded:
+            raise MXNetError("get_params: module not bound")
+        args = {n: self._exec.arg_dict[n].copy() for n in self._param_names}
+        aux = {n: self._exec.aux_dict[n].copy() for n in self._aux_names}
+        return args, aux
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self.init_params(initializer=None, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init, allow_extra=allow_extra)
+
+    # ------------------------------------------------------------- optimizer
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        if self.optimizer_initialized and not force_init:
+            return
+        if isinstance(optimizer, opt_mod.Optimizer):
+            self._optimizer = optimizer
+        else:
+            batch_size = self._data_shapes[0][1][0]
+            params = dict(optimizer_params)
+            params.setdefault("rescale_grad", 1.0 / batch_size)
+            self._optimizer = opt_mod.create(optimizer, **params)
+        self._updater = get_updater(self._optimizer)
+        if self._preloaded_states is not None:
+            self._updater.set_states(self._preloaded_states)
+            self._preloaded_states = None
+        self.optimizer_initialized = True
+
+    # ----------------------------------------------------------- step pieces
+    def forward(self, data_batch, is_train=None):
+        if not self.binded:
+            raise MXNetError("forward: module not bound")
+        if is_train is None:
+            is_train = self._for_training
+        feeds = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            feeds[name] = arr
+        if self._label_names and data_batch.label is not None:
+            for name, arr in zip(self._label_names, data_batch.label):
+                feeds[name] = arr
+        self._exec.forward(is_train=is_train, **feeds)
+
+    def backward(self, out_grads=None):
+        self._exec.backward(out_grads=out_grads)
+
+    def update(self):
+        if not self.optimizer_initialized:
+            raise MXNetError("update: call init_optimizer first")
+        # keyed by parameter *name*: updater state stays correct when the
+        # updater is shared across bucket modules whose positional order
+        # may differ (reference kvstore keys are strings for the same reason)
+        for name in self._param_names:
+            if self._exec._grad_req.get(name, "null") == "null":
+                continue
+            self._updater(name, self._exec.grad_dict[name],
+                          self._exec.arg_dict[name])
+
+    def get_outputs(self, merge_multi_context=True):
+        return list(self._exec.outputs)
+
+    def get_input_grads(self, merge_multi_context=True):
+        if not self._inputs_need_grad:
+            raise MXNetError("bind with inputs_need_grad=True first")
+        return [self._exec.grad_dict[n] for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels, self.get_outputs())
+
+    # ------------------------------------------------------------ checkpoint
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        save_checkpoint(prefix, epoch, self._symbol, *self.get_params())
+        if save_optimizer_states and self._updater is not None:
+            with open(f"{prefix}-{epoch:04d}.states", "wb") as f:
+                f.write(self._updater.get_states(dump_optimizer=False))
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        sym, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        mod = Module(sym, **kwargs)
+        mod._preloaded = (arg_params, aux_params)  # applied at bind()
+        if load_optimizer_states:
+            with open(f"{prefix}-{epoch:04d}.states", "rb") as f:
+                mod._preloaded_states = f.read()  # applied at init_optimizer
+        return mod
+
+    @property
+    def num_compiles(self):
+        return self._exec.num_compiles if self._exec is not None else 0
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+    """reference: mx.model.save_checkpoint — symbol JSON + params file."""
+    symbol.save(f"{prefix}-symbol.json")
+    payload = {f"arg:{k}": v for k, v in arg_params.items()}
+    payload.update({f"aux:{k}": v for k, v in aux_params.items()})
+    nd.save(f"{prefix}-{epoch:04d}.params", payload)
+
+
+def load_checkpoint(prefix, epoch):
+    """reference: mx.model.load_checkpoint."""
+    from .. import symbol as sym_mod
+    symbol = sym_mod.load(f"{prefix}-symbol.json")
+    payload = nd.load(f"{prefix}-{epoch:04d}.params")
+    arg_params, aux_params = {}, {}
+    for k, v in payload.items():
+        kind, name = k.split(":", 1)
+        (arg_params if kind == "arg" else aux_params)[name] = v
+    return symbol, arg_params, aux_params
+
+
+def _norm_shapes(shapes, names):
+    """Accept [(name, shape)...] / [DataDesc...]; return [(name, shape)...]"""
+    out = []
+    for entry in shapes or []:
+        if hasattr(entry, "name"):       # DataDesc namedtuple
+            out.append((entry.name, tuple(entry.shape)))
+        else:
+            name, shape = entry[0], entry[1]
+            out.append((name, tuple(shape)))
+    return out

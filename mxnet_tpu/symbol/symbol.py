@@ -147,18 +147,38 @@ class Symbol:
         return Symbol([(clone(n), i) for n, i in self._outputs])
 
     # -- evaluation helpers -------------------------------------------------
-    def _interpret(self, feed: Dict[str, object], train: bool = False):
+    def _interpret(self, feed: Dict[str, object], train: bool = False,
+                   aux_updates: Optional[Dict[str, object]] = None):
         """Evaluate graph given raw jax arrays for variables.  Pure: usable
-        under jax.jit / jax.grad (this is the executor's compiled body)."""
+        under jax.jit / jax.grad (this is the executor's compiled body).
+
+        ``train=True`` enters autograd train-mode for the evaluation so
+        mode-dependent ops (Dropout, BatchNorm) trace their training branch.
+        ``aux_updates``: when given (and training), stateful-op state
+        transitions — BatchNorm moving-stat updates — are written into it
+        keyed by the aux variable's name, mirroring the reference executor's
+        in-op aux mutation in a jit-pure way.
+        """
+        import contextlib
         import functools
+        from .. import autograd
+        scope = autograd.train_mode() if train else contextlib.nullcontext()
         values: Dict[int, tuple] = {}
-        for node in self._topo():
-            if node.is_variable:
-                if node.name not in feed:
-                    raise MXNetError(f"missing argument {node.name!r}")
-                values[id(node)] = (feed[node.name],)
-            else:
+        with scope:
+            for node in self._topo():
+                if node.is_variable:
+                    if node.name not in feed:
+                        raise MXNetError(f"missing argument {node.name!r}")
+                    values[id(node)] = (feed[node.name],)
+                    continue
                 args = [values[id(n)][i] for n, i in node.inputs]
+                if (aux_updates is not None and train
+                        and node.op.name == "BatchNorm"
+                        and not node.kwargs.get("use_global_stats", False)
+                        and not node.kwargs.get("output_mean_var", False)):
+                    values[id(node)] = (_bn_with_aux(node, args,
+                                                    aux_updates),)
+                    continue
                 fn = node.op.fn
                 if node.kwargs:
                     fn = functools.partial(fn, **node.kwargs)
@@ -169,32 +189,45 @@ class Symbol:
         return [values[id(n)][i] for n, i in self._outputs]
 
     def infer_shape(self, **kwargs):
-        """Shape inference via jax.eval_shape over the interpreted graph
-        (replaces the nnvm InferShape pass)."""
-        import jax
-        import jax.numpy as jnp
+        """Full shape inference (nnvm InferShape pass equivalent).
+
+        Accepts partial input: layer parameter shapes are deduced backward
+        from data shapes + op kwargs (symbol/infer.py).  Raises when the
+        graph cannot be fully resolved (reference behavior); use
+        ``infer_shape_partial`` for a best-effort result with None holes.
+        """
+        arg_shapes, out_shapes, aux_shapes = self.infer_shape_partial(
+            **kwargs)
+        unresolved = [n for n, s in
+                      zip(self.list_arguments() +
+                          self.list_auxiliary_states(),
+                          list(arg_shapes) + list(aux_shapes)) if s is None]
+        if unresolved or any(s is None for s in out_shapes):
+            raise MXNetError(
+                f"infer_shape: could not resolve shapes for {unresolved}; "
+                f"provide them explicitly")
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_shape_partial(self, **kwargs):
+        """Best-effort inference; unknown entries are None (reference:
+        Symbol.infer_shape_partial)."""
+        from .infer import infer_shape_graph
+        known = {k: tuple(v) for k, v in kwargs.items() if v is not None}
+        var_shapes, out_shapes = infer_shape_graph(self, known)
         args = self.list_arguments()
         aux = self.list_auxiliary_states()
-        known = {k: tuple(v) for k, v in kwargs.items()}
-
-        feed = {}
-        for name in args + aux:
-            if name in known:
-                feed[name] = jax.ShapeDtypeStruct(known[name], jnp.float32)
-            else:
-                raise MXNetError(
-                    f"infer_shape: partial inference not supported; missing "
-                    f"shape for {name!r}")
-        outs = jax.eval_shape(
-            lambda f: self._interpret(f), feed)
-        arg_shapes = [known[n] for n in args]
-        aux_shapes = [known[n] for n in aux]
-        return arg_shapes, [tuple(o.shape) for o in outs], aux_shapes
+        return ([var_shapes.get(n) for n in args], out_shapes,
+                [var_shapes.get(n) for n in aux])
 
     def infer_type(self, **kwargs):
+        """Dtype propagation (nnvm InferType pass equivalent); unknown
+        variables default to float32 like the reference."""
+        from .infer import infer_type_graph
+        var_types, out_types = infer_type_graph(self, dict(kwargs))
         args = self.list_arguments()
-        return ([np.float32] * len(args),
-                [np.float32] * len(self._outputs), [])
+        aux = self.list_auxiliary_states()
+        return ([var_types.get(n) for n in args], out_types,
+                [var_types.get(n) for n in aux])
 
     def eval(self, ctx=None, **kwargs):
         from ..ndarray import NDArray
@@ -277,6 +310,23 @@ class Symbol:
     def __repr__(self):
         name = self.name or "grouped"
         return f"<Symbol {name}>"
+
+
+def _bn_with_aux(node, args, aux_updates):
+    """Run a BatchNorm node in training mode, recording the moving-stat
+    transition for its aux variables (reference: batch_norm.cc writes
+    moving_mean/var in Forward; here the update is returned functionally)."""
+    kw = dict(node.kwargs, output_mean_var=True)
+    out, mean, inv_std = node.op.fn(*args, **kw)
+    eps = float(node.kwargs.get("eps", 1e-3))
+    mom = float(node.kwargs.get("momentum", 0.9))
+    var = 1.0 / (inv_std * inv_std) - eps
+    for slot, batch_stat in ((3, mean), (4, var)):
+        src, _ = node.inputs[slot]
+        if src.is_variable:
+            aux_updates[src.name] = mom * args[slot] + (1.0 - mom) * \
+                batch_stat.astype(args[slot].dtype)
+    return out
 
 
 def _sym_binary(opname, scalar_opname, lhs, rhs):

@@ -1,0 +1,343 @@
+"""Executor (bind/simple_bind) + legacy Module/BucketingModule tests.
+
+Reference strategy: tests/python/unittest/test_module.py,
+test_executor.py (SURVEY.md §4).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.io import NDArrayIter, DataBatch
+
+
+def _mlp_symbol(num_hidden=16, num_classes=4):
+    data = sym.var("data")
+    label = sym.var("softmax_label")
+    h = sym.FullyConnected(data, sym.var("fc1_weight"), sym.var("fc1_bias"),
+                           num_hidden=num_hidden, name="fc1")
+    h = sym.Activation(h, act_type="relu")
+    out = sym.FullyConnected(h, sym.var("fc2_weight"), sym.var("fc2_bias"),
+                             num_hidden=num_classes, name="fc2")
+    return sym.SoftmaxOutput(out, label, name="softmax")
+
+
+class TestExecutor:
+    def test_simple_bind_forward(self):
+        x = sym.var("x")
+        y = sym.var("y")
+        z = 2.0 * x + y
+        ex = z.simple_bind(mx.cpu(), x=(2, 3), y=(2, 3))
+        ex.arg_dict["x"][:] = 1.0
+        ex.arg_dict["y"][:] = 3.0
+        out = ex.forward()[0]
+        np.testing.assert_allclose(out.asnumpy(), np.full((2, 3), 5.0))
+
+    def test_bind_backward_grads(self):
+        x = sym.var("x")
+        w = sym.var("w")
+        z = sym.sum(x * w)
+        xv = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+        wv = nd.array(np.full((2, 3), 2.0, dtype=np.float32))
+        gx = nd.zeros((2, 3))
+        gw = nd.zeros((2, 3))
+        ex = z.bind(mx.cpu(), {"x": xv, "w": wv},
+                    args_grad={"x": gx, "w": gw})
+        ex.forward(is_train=True)
+        ex.backward()
+        np.testing.assert_allclose(gx.asnumpy(), wv.asnumpy())
+        np.testing.assert_allclose(gw.asnumpy(), xv.asnumpy())
+
+    def test_grad_req_add_and_null(self):
+        x = sym.var("x")
+        z = sym.sum(x * x)
+        xv = nd.array(np.ones((3,), dtype=np.float32))
+        gx = nd.zeros((3,))
+        ex = z.bind(mx.cpu(), {"x": xv}, args_grad={"x": gx},
+                    grad_req="add")
+        for _ in range(3):
+            ex.forward(is_train=True)
+            ex.backward()
+        np.testing.assert_allclose(gx.asnumpy(), np.full((3,), 6.0))
+        ex2 = z.bind(mx.cpu(), {"x": xv}, grad_req="null")
+        ex2.forward(is_train=True)
+        ex2.backward()   # no-op, no crash
+        assert ex2.grad_dict == {}
+
+    def test_compile_cache_reused(self):
+        x = sym.var("x")
+        z = sym.exp(x) + 1.0
+        xv = nd.zeros((4, 4))
+        ex = z.bind(mx.cpu(), {"x": xv})
+        ex.forward()
+        n = ex.num_compiles
+        for _ in range(5):
+            ex.forward(x=nd.array(np.random.rand(4, 4).astype(np.float32)))
+        assert ex.num_compiles == n  # same signature -> no retrace
+
+    def test_copy_params_and_outputs_dict(self):
+        x = sym.var("x")
+        w = sym.var("w")
+        z = x + w
+        ex = z.simple_bind(mx.cpu(), x=(2,), w=(2,))
+        ex.copy_params_from({"w": nd.array(np.array([5., 7.],
+                                                    dtype=np.float32))},
+                            allow_extra_params=True)
+        ex.forward(x=nd.zeros((2,)))
+        assert list(ex.output_dict)  # named outputs exist
+        np.testing.assert_allclose(ex.outputs[0].asnumpy(), [5., 7.])
+
+
+class TestExecutorModes:
+    def test_dropout_active_in_train_mode(self):
+        x = sym.var("x")
+        y = sym.Dropout(x, p=0.5)
+        xv = nd.array(np.ones((64, 64), dtype=np.float32))
+        ex = y.bind(mx.cpu(), {"x": xv})
+        train_out = ex.forward(is_train=True)[0].asnumpy()
+        assert (train_out == 0).sum() > 0          # dropout applied
+        # and stochastic across calls (traced rng key, not baked constant)
+        second = ex.forward(is_train=True)[0].asnumpy()
+        assert not np.array_equal(train_out, second)
+        eval_out = ex.forward(is_train=False)[0].asnumpy()
+        np.testing.assert_array_equal(eval_out, np.ones((64, 64)))
+
+    def test_batchnorm_aux_updated_by_executor(self):
+        data = sym.var("data")
+        bn = sym.BatchNorm(data, sym.var("gamma"), sym.var("beta"),
+                           sym.var("mm", attr=None), sym.var("mv"),
+                           momentum=0.5, fix_gamma=False)
+        bn._outputs[0][0].inputs[3][0].attrs["__aux__"] = "1"
+        bn._outputs[0][0].inputs[4][0].attrs["__aux__"] = "1"
+        rng = np.random.RandomState(0)
+        x = rng.randn(32, 4).astype(np.float32) * 3 + 7
+        args = {"data": nd.array(x), "gamma": nd.ones((4,)),
+                "beta": nd.zeros((4,))}
+        aux = {"mm": nd.zeros((4,)), "mv": nd.ones((4,))}
+        ex = bn.bind(mx.cpu(), args, aux_states=aux, grad_req="null")
+        ex.forward(is_train=True)
+        # moving stats moved toward batch stats (momentum=0.5)
+        expect_mm = 0.5 * 0.0 + 0.5 * x.mean(axis=0)
+        np.testing.assert_allclose(ex.aux_dict["mm"].asnumpy(), expect_mm,
+                                   rtol=1e-4, atol=1e-4)
+        assert np.all(ex.aux_dict["mv"].asnumpy() > 1.5)  # var(x) >> 1
+        # eval mode must not touch them
+        before = ex.aux_dict["mm"].asnumpy().copy()
+        ex.forward(is_train=False)
+        np.testing.assert_array_equal(ex.aux_dict["mm"].asnumpy(), before)
+
+    def test_module_load_restores_params(self, tmp_path):
+        x = sym.var("data")
+        out = sym.FullyConnected(x, sym.var("w"), sym.var("b"),
+                                 num_hidden=3)
+        mod = mx.module.Module(out, label_names=None, context=mx.cpu())
+        mod.bind(data_shapes=[("data", (2, 5))], for_training=False)
+        mod.init_params(initializer=mx.init.Xavier())
+        prefix = str(tmp_path / "m")
+        mod.save_checkpoint(prefix, 3)
+        mod2 = mx.module.Module.load(prefix, 3, label_names=None,
+                                     context=mx.cpu())
+        mod2.bind(data_shapes=[("data", (2, 5))], for_training=False)
+        # loaded params must be live without an explicit set_params call
+        np.testing.assert_array_equal(
+            mod2._exec.arg_dict["w"].asnumpy(),
+            mod._exec.arg_dict["w"].asnumpy())
+
+    def test_module_tolerates_missing_label(self):
+        x = sym.var("data")
+        out = sym.FullyConnected(x, sym.var("w"), sym.var("b"),
+                                 num_hidden=3)
+        mod = mx.module.Module(out, context=mx.cpu())  # default label names
+        assert "w" in mod._param_names
+
+
+class TestInference:
+    def test_partial_shape_inference_mlp(self):
+        s = _mlp_symbol(num_hidden=16, num_classes=4)
+        arg_shapes, out_shapes, _ = s.infer_shape(
+            data=(32, 8), softmax_label=(32,))
+        shapes = dict(zip(s.list_arguments(), arg_shapes))
+        assert shapes["fc1_weight"] == (16, 8)
+        assert shapes["fc1_bias"] == (16,)
+        assert shapes["fc2_weight"] == (4, 16)
+        assert out_shapes == [(32, 4)]
+
+    def test_partial_shape_inference_conv_bn(self):
+        data = sym.var("data")
+        h = sym.Convolution(data, sym.var("w"), sym.var("b"),
+                            kernel=(3, 3), num_filter=8, pad=(1, 1))
+        h = sym.BatchNorm(h, sym.var("gamma"), sym.var("beta"),
+                          sym.var("mm"), sym.var("mv"))
+        args, outs, _ = h.infer_shape(data=(2, 3, 16, 16))
+        shapes = dict(zip(h.list_arguments(), args))
+        assert shapes["w"] == (8, 3, 3, 3)
+        assert shapes["gamma"] == (8,)
+        assert outs == [(2, 8, 16, 16)]
+
+    def test_infer_shape_partial_returns_none_holes(self):
+        x = sym.var("x")
+        y = sym.var("y")
+        z = x + y
+        args, outs, _ = z.infer_shape_partial(x=(2, 3))
+        assert args[z.list_arguments().index("y")] is None
+        assert outs == [None]
+        with pytest.raises(mx.MXNetError):
+            z.infer_shape(x=(2, 3))
+
+    def test_infer_type_propagates(self):
+        x = sym.var("x")
+        y = sym.Cast(x, dtype="float16")
+        types = y.infer_type(x=np.float32)
+        assert types[1][0] == np.dtype("float16")
+        i = sym.var("i")
+        e = sym.Embedding(i, sym.var("w"), input_dim=10, output_dim=4)
+        _, outs, _ = e.infer_type(i=np.int32, w=np.float32)
+        assert outs[0] == np.dtype("float32")
+
+
+class TestModule:
+    def _toy_data(self, n=64, num_classes=4, seed=0):
+        rng = np.random.RandomState(seed)
+        centers = rng.randn(num_classes, 8).astype(np.float32) * 3
+        y = rng.randint(0, num_classes, size=n)
+        x = centers[y] + rng.randn(n, 8).astype(np.float32) * 0.1
+        return x, y.astype(np.float32)
+
+    def test_module_fit_converges(self):
+        x, y = self._toy_data()
+        it = NDArrayIter(x, y, batch_size=16, shuffle=True,
+                         label_name="softmax_label")
+        mod = mx.module.Module(_mlp_symbol(), context=mx.cpu())
+        mod.fit(it, num_epoch=12, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.5},
+                eval_metric="acc",
+                initializer=mx.init.Xavier())
+        score = mod.score(it, "acc")
+        assert dict(score)["accuracy"] > 0.9
+
+    def test_module_predict_shapes(self):
+        x, y = self._toy_data(n=50)
+        it = NDArrayIter(x, y, batch_size=16, label_name="softmax_label")
+        mod = mx.module.Module(_mlp_symbol(), context=mx.cpu())
+        mod.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label)
+        mod.init_params()
+        out = mod.predict(it)
+        assert out.shape == (50, 4)  # pad rows stripped
+
+    def test_module_checkpoint_roundtrip(self, tmp_path):
+        x, y = self._toy_data()
+        it = NDArrayIter(x, y, batch_size=16, label_name="softmax_label")
+        mod = mx.module.Module(_mlp_symbol(), context=mx.cpu())
+        mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+        mod.init_params(initializer=mx.init.Xavier())
+        prefix = str(tmp_path / "toy")
+        mod.save_checkpoint(prefix, 0)
+        symbol, arg_params, aux_params = mx.module.load_checkpoint(prefix, 0)
+        mod2 = mx.module.Module(symbol, context=mx.cpu())
+        mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+        mod2.set_params(arg_params, aux_params)
+        it.reset()
+        batch = next(it)
+        mod.forward(batch, is_train=False)
+        mod2.forward(batch, is_train=False)
+        np.testing.assert_allclose(mod.get_outputs()[0].asnumpy(),
+                                   mod2.get_outputs()[0].asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_fixed_params_not_updated(self):
+        x, y = self._toy_data()
+        it = NDArrayIter(x, y, batch_size=16, label_name="softmax_label")
+        mod = mx.module.Module(_mlp_symbol(), context=mx.cpu(),
+                               fixed_param_names=["fc1_weight"])
+        mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+        mod.init_params(initializer=mx.init.Xavier())
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.5})
+        before = mod.get_params()[0]["fc1_weight"].asnumpy().copy()
+        batch = next(it)
+        mod.forward_backward(batch)
+        mod.update()
+        after = mod.get_params()[0]["fc1_weight"].asnumpy()
+        np.testing.assert_array_equal(before, after)
+
+
+class TestBucketingModule:
+    """Variable-length 'RNN-ish' training with a bounded compile cache."""
+
+    @staticmethod
+    def _sym_gen(seq_len):
+        data = sym.var("data")          # (N, seq_len, F)
+        label = sym.var("softmax_label")
+        w = sym.var("cls_weight")
+        b = sym.var("cls_bias")
+        # weight shared across buckets: pool over the variable time axis
+        h = sym.mean(data, axis=1)
+        out = sym.FullyConnected(h, w, b, num_hidden=3, name="cls")
+        return sym.SoftmaxOutput(out, label, name="softmax"), \
+            ("data",), ("softmax_label",)
+
+    def _batch(self, seq_len, n=8, seed=0):
+        rng = np.random.RandomState(seed + seq_len)
+        y = rng.randint(0, 3, size=n).astype(np.float32)
+        x = rng.randn(n, seq_len, 4).astype(np.float32) + y[:, None, None]
+        b = DataBatch(data=[nd.array(x)], label=[nd.array(y)],
+                      provide_data=[("data", (n, seq_len, 4))],
+                      provide_label=[("softmax_label", (n,))])
+        b.bucket_key = seq_len
+        return b
+
+    def test_bucketing_bounded_compiles(self):
+        keys = [4, 8, 16]
+        bm = mx.module.BucketingModule(self._sym_gen, default_bucket_key=16,
+                                       context=mx.cpu(), bucket_keys=keys)
+        b16 = self._batch(16)
+        bm.bind(data_shapes=b16.provide_data,
+                label_shapes=b16.provide_label)
+        bm.init_params(initializer=mx.init.Xavier())
+        bm.init_optimizer(optimizer="sgd",
+                          optimizer_params={"learning_rate": 0.1})
+        # many steps across shuffled bucket sizes
+        for step in range(12):
+            b = self._batch(keys[step % 3], seed=step)
+            bm.forward(b, is_train=True)
+            bm.backward()
+            bm.update()
+        assert set(bm.active_buckets) == set(keys)
+        # compile-count bound: fwd+bwd per bucket = 2 programs
+        assert bm.num_compiles <= 2 * len(keys)
+        # params are genuinely shared: one weight object across buckets
+        w_def = bm._buckets[16]._exec.arg_dict["cls_weight"]
+        for k in (4, 8):
+            assert bm._buckets[k]._exec.arg_dict["cls_weight"] is w_def
+
+    def test_bucketing_rejects_unregistered_key(self):
+        bm = mx.module.BucketingModule(self._sym_gen, default_bucket_key=8,
+                                       context=mx.cpu(), bucket_keys=[8])
+        b8 = self._batch(8)
+        bm.bind(data_shapes=b8.provide_data, label_shapes=b8.provide_label)
+        bm.init_params()
+        with pytest.raises(mx.MXNetError):
+            bm.switch_bucket(32, self._batch(32).provide_data)
+
+    def test_bucketing_training_converges(self):
+        keys = [4, 8]
+        bm = mx.module.BucketingModule(self._sym_gen, default_bucket_key=8,
+                                       context=mx.cpu(), bucket_keys=keys)
+        b8 = self._batch(8)
+        bm.bind(data_shapes=b8.provide_data, label_shapes=b8.provide_label)
+        bm.init_params(initializer=mx.init.Xavier())
+        bm.init_optimizer(optimizer="sgd",
+                          optimizer_params={"learning_rate": 0.3})
+        metric = mx.metric.create("acc")
+        for step in range(60):
+            b = self._batch(keys[step % 2], seed=step % 5)
+            bm.forward(b, is_train=True)
+            bm.backward()
+            bm.update()
+        metric.reset()
+        for s in range(5):
+            b = self._batch(keys[s % 2], seed=s)
+            bm.forward(b, is_train=False)
+            bm.update_metric(metric, b.label)
+        assert metric.get()[1] > 0.8
