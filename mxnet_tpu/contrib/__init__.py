@@ -1,0 +1,6 @@
+"""contrib: experimental / auxiliary subsystems
+(reference: ``python/mxnet/contrib/`` — SURVEY.md 2.2 contrib row).
+"""
+from . import amp
+
+__all__ = ["amp"]

@@ -1,0 +1,215 @@
+"""Automatic Mixed Precision.
+
+Reference surface: ``python/mxnet/contrib/amp/amp.py`` — ``amp.init()``
+patches the generated op namespaces so MXU-bound ops execute in the target
+dtype, numerically-sensitive ops in fp32; ``init_trainer``/``scale_loss``
+add dynamic loss scaling; optimizer ``multi_precision`` keeps fp32 master
+weights (optimizer/optimizer.py create_state_multi_precision).
+
+TPU-native redesign: target dtype defaults to **bfloat16** (the MXU's
+native input type).  The patching wraps the registry-generated frontends in
+``mx.nd``/``mx.sym`` (and their ``.op`` submodules), so eager, hybridized
+(CachedOp traces through the patched frontends), and symbolic paths all see
+the same rewrite.  Casts are jnp ``astype`` — XLA fuses them into the
+adjacent matmul, so the rewrite costs no extra HBM traffic.
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+from typing import Dict
+
+from ...base import MXNetError
+from . import lists
+from .loss_scaler import LossScaler
+
+__all__ = ["init", "init_trainer", "scale_loss", "unscale",
+           "convert_hybrid_block", "list_lp16_ops", "list_fp32_ops"]
+
+_amp_state: Dict = {"initialized": False, "target_dtype": None,
+                    "originals": {}}
+
+
+def list_lp16_ops(target_dtype="bfloat16"):
+    return list(lists.TARGET_DTYPE_OPS)
+
+
+def list_fp32_ops(target_dtype="bfloat16"):
+    return list(lists.FP32_OPS)
+
+
+def _wrap_cast(fn, dtype, float_only=True):
+    """Wrap a frontend: cast array inputs to `dtype` before dispatch."""
+    from ...ndarray import NDArray
+    from ...symbol import Symbol
+    from ... import ndarray as nd_mod
+
+    def _cast(a):
+        if isinstance(a, NDArray):
+            if not float_only or str(a.dtype).startswith(("float", "bfloat")):
+                if str(a.dtype) != dtype:
+                    return nd_mod.amp_cast(a, dtype=dtype)
+            return a
+        if isinstance(a, Symbol):
+            from ...ops.registry import get_op
+            from ...symbol.symbol import invoke_symbolic
+            return invoke_symbolic(get_op("amp_cast"), (a,),
+                                   {"dtype": dtype})
+        if isinstance(a, (list, tuple)):
+            return type(a)(_cast(x) for x in a)
+        return a
+
+    def wrapped(*args, **kwargs):
+        return fn(*tuple(_cast(a) for a in args), **kwargs)
+
+    wrapped.__name__ = getattr(fn, "__name__", "amp_wrapped")
+    wrapped.__doc__ = fn.__doc__
+    wrapped._amp_original = fn
+    return wrapped
+
+
+def _wrap_widest(fn):
+    """Wrap a multi-input frontend: unify input dtypes to the widest."""
+    from ...ndarray import NDArray
+    from ... import ndarray as nd_mod
+    import numpy as np
+
+    def wrapped(*args, **kwargs):
+        arrs = [a for a in args if isinstance(a, NDArray)]
+        if len(arrs) > 1:
+            widest = str(np.result_type(*[np.dtype(str(a.dtype))
+                                          for a in arrs]))
+            args = tuple(nd_mod.amp_cast(a, dtype=widest)
+                         if isinstance(a, NDArray) and
+                         str(a.dtype) != widest else a for a in args)
+        return fn(*args, **kwargs)
+
+    wrapped.__name__ = getattr(fn, "__name__", "amp_wrapped")
+    wrapped._amp_original = fn
+    return wrapped
+
+
+def _patch_targets():
+    """The namespaces holding generated frontends."""
+    from ... import ndarray as nd_mod
+    from ... import symbol as sym_mod
+    return [nd_mod, nd_mod.op, sym_mod, sym_mod.op]
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Enable AMP by patching the op namespaces (reference: amp.init).
+
+    target_dtype: 'bfloat16' (TPU-native default) or 'float16'.
+    target_precision_ops / fp32_ops: override the default lists.
+    """
+    if _amp_state["initialized"]:
+        if _amp_state["target_dtype"] != target_dtype:
+            raise MXNetError(
+                f"amp.init already called with "
+                f"{_amp_state['target_dtype']!r}")
+        return
+    if target_dtype not in ("bfloat16", "float16"):
+        raise MXNetError("target_dtype must be bfloat16 or float16")
+    lp_ops = list(target_precision_ops if target_precision_ops is not None
+                  else lists.TARGET_DTYPE_OPS)
+    f32_ops = list(fp32_ops if fp32_ops is not None else lists.FP32_OPS)
+    if conditional_fp32_ops:
+        f32_ops += [name for name, _, _ in conditional_fp32_ops]
+    overlap = set(lp_ops) & set(f32_ops)
+    if overlap:
+        raise MXNetError(f"ops in both lists: {sorted(overlap)}")
+
+    targets = _patch_targets()
+    originals = {}
+    for names, wrapper in ((lp_ops, lambda f: _wrap_cast(f, target_dtype)),
+                           (f32_ops, lambda f: _wrap_cast(f, "float32")),
+                           (lists.WIDEST_TYPE_CASTS,
+                            lambda f: _wrap_widest(f))):
+        for opname in names:
+            for mod in targets:
+                fn = getattr(mod, opname, None)
+                if fn is None or hasattr(fn, "_amp_original"):
+                    continue
+                originals[(id(mod), opname)] = (mod, opname, fn)
+                setattr(mod, opname, wrapper(fn))
+    _amp_state.update(initialized=True, target_dtype=target_dtype,
+                      originals=originals)
+    logging.info("AMP initialized (target dtype %s)", target_dtype)
+
+
+def _deinit():
+    """Undo init() — test hook; the reference has no public equivalent."""
+    for mod, opname, fn in _amp_state["originals"].values():
+        setattr(mod, opname, fn)
+    _amp_state.update(initialized=False, target_dtype=None, originals={})
+
+
+def init_trainer(trainer):
+    """Attach a dynamic LossScaler and overflow-skipping step to a Gluon
+    Trainer (reference: amp.init_trainer)."""
+    from ...gluon.trainer import Trainer
+    if not isinstance(trainer, Trainer):
+        raise MXNetError("init_trainer expects a gluon Trainer")
+    if getattr(trainer, "_amp_loss_scaler", None) is not None:
+        return trainer
+    scaler = LossScaler()
+    trainer._amp_loss_scaler = scaler
+    trainer._amp_original_step = trainer.step
+
+    def amp_step(batch_size, ignore_stale_grad=False):
+        if scaler.has_overflow(trainer._params):
+            scaler.update_scale(True)
+            logging.warning("AMP: gradient overflow, skipping step "
+                            "(loss scale -> %g)", scaler.loss_scale)
+            trainer._scale = 1.0
+            return
+        trainer._amp_original_step(batch_size, ignore_stale_grad)
+        scaler.update_scale(False)
+        trainer._scale = 1.0
+
+    trainer.step = amp_step
+    return trainer
+
+
+@contextlib.contextmanager
+def scale_loss(loss, trainer):
+    """``with amp.scale_loss(loss, trainer) as l: l.backward()`` —
+    multiplies the loss by the current scale and arranges for the next
+    ``trainer.step`` to divide gradients back down (via Trainer._scale)."""
+    from ... import autograd
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        raise MXNetError("call amp.init_trainer(trainer) first")
+    trainer._scale = 1.0 / scaler.loss_scale
+    # scale inside a record scope so the multiply lands on the tape even
+    # when the caller invokes scale_loss outside `with autograd.record()`
+    with autograd.record():
+        if isinstance(loss, (list, tuple)):
+            scaled = [l * scaler.loss_scale for l in loss]
+        else:
+            scaled = loss * scaler.loss_scale
+    yield scaled
+
+
+def unscale(trainer):
+    """Divide gradients by the loss scale in place (reference:
+    amp.unscale) — for gradient clipping between backward and step."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        raise MXNetError("call amp.init_trainer(trainer) first")
+    inv = 1.0 / scaler.loss_scale
+    for p in trainer._params:
+        if p.grad_req == "null":
+            continue
+        for g in p.list_grad():
+            g._set_data(g._data * inv)
+    trainer._scale = 1.0
+
+
+def convert_hybrid_block(block, target_dtype="bfloat16"):
+    """Cast a HybridBlock's parameters to the target dtype for pure
+    low-precision inference (reference: amp.convert_hybrid_block).
+    For training, prefer amp.init() + multi_precision optimizers."""
+    block.cast(target_dtype)
+    return block

@@ -1,0 +1,73 @@
+"""AMP op classification lists.
+
+Reference: ``python/mxnet/contrib/amp/lists/symbol_fp16.py`` — the
+allow/deny lists deciding which ops run in reduced precision.
+
+TPU-native note: the target dtype is **bfloat16** (MXU-native; same
+exponent range as fp32, so the fp16 overflow pathology the reference's
+lists guard against is far milder) — but the structure is kept so loss
+scaling and the op classification remain reference-shaped, and fp16 can be
+selected explicitly.
+"""
+
+# MXU-bound ops: the FLOPs live here — run in the target (bf16) dtype.
+TARGET_DTYPE_OPS = [
+    "FullyConnected",
+    "Convolution",
+    "Deconvolution",
+    "dot",
+    "batch_dot",
+    "RNN",
+    "_contrib_interleaved_matmul_selfatt_qk",
+    "_contrib_interleaved_matmul_selfatt_valatt",
+    "_contrib_interleaved_matmul_encdec_qk",
+    "_contrib_interleaved_matmul_encdec_valatt",
+]
+
+# Numerically sensitive ops: always fp32 (reductions, exp/log families,
+# losses, normalizations that divide by small variances).
+FP32_OPS = [
+    "softmax",
+    "log_softmax",
+    "softmin",
+    "SoftmaxActivation",
+    "SoftmaxOutput",
+    "softmax_cross_entropy",
+    "CTCLoss",
+    "BatchNorm",
+    "LayerNorm",
+    "InstanceNorm",
+    "GroupNorm",
+    "L2Normalization",
+    "LRN",
+    "norm",
+    "exp",
+    "log",
+    "log2",
+    "log10",
+    "expm1",
+    "log1p",
+    "mean",
+    "sum",
+    "erfinv",
+    "reciprocal",
+    "rsqrt",
+    "rcbrt",
+    "smooth_l1",
+]
+
+# Multi-input elementwise ops whose inputs must agree: cast to the widest
+# input dtype (reference: WIDEST_TYPE_CASTS).
+WIDEST_TYPE_CASTS = [
+    "broadcast_add",
+    "broadcast_sub",
+    "broadcast_mul",
+    "broadcast_div",
+    "elemwise_add",
+    "elemwise_sub",
+    "elemwise_mul",
+    "elemwise_div",
+    "add_n",
+    "concat",
+    "where",
+]

@@ -159,7 +159,16 @@ class Executor:
                 raise MXNetError(f"forward: unknown argument {k!r}")
             if not isinstance(v, NDArray):
                 v = nd.array(v)
-            self.arg_dict[k]._set_data(v._data)
+            dst = self.arg_dict[k]
+            if tuple(v.shape) != tuple(dst.shape):
+                raise MXNetError(
+                    f"forward: shape mismatch for {k!r}: got {v.shape}, "
+                    f"bound {dst.shape} (use Executor.reshape / a "
+                    f"BucketingModule for new shapes)")
+            data = v._data
+            if data.dtype != dst._data.dtype:
+                data = data.astype(dst._data.dtype)
+            dst._set_data(data)
         feed = {n: a._data for n, a in self.arg_dict.items()}
         feed.update({n: a._data for n, a in self.aux_dict.items()})
         self._last_feed = feed

@@ -39,8 +39,7 @@ from .base import KVStoreBase
 __all__ = ["KVStore", "create"]
 
 
-def _as_list(x):
-    return x if isinstance(x, (list, tuple)) else [x]
+from ..util import as_list as _as_list
 
 
 def _normalize(key, value):

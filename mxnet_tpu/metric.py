@@ -29,8 +29,7 @@ def _to_numpy(x):
     return np.asarray(x)
 
 
-def _as_list(x):
-    return x if isinstance(x, (list, tuple)) else [x]
+from .util import as_list as _as_list
 
 
 class EvalMetric:

@@ -13,6 +13,7 @@ import time
 from ..base import MXNetError
 from .. import metric as metric_mod
 from .. import ndarray as nd
+from ..util import as_list as _as_list
 
 __all__ = ["BaseModule"]
 
@@ -196,7 +197,3 @@ class _BatchEndParam:
         self.locals = locals
 
 
-def _as_list(x):
-    if isinstance(x, (list, tuple)):
-        return list(x)
-    return [x]

@@ -79,6 +79,9 @@ class BucketingModule(BaseModule):
              grad_req="write"):
         if self.binded and not force_rebind:
             return
+        # a rebind invalidates every bucket executor: stale modules would
+        # keep sharing storage with the *old* default module
+        self._buckets = {}
         self._bind_args = dict(for_training=for_training,
                                inputs_need_grad=inputs_need_grad,
                                grad_req=grad_req)

@@ -73,6 +73,11 @@ class Module(BaseModule):
              grad_req="write"):
         if self.binded and not force_rebind:
             return
+        # rebinding must not lose trained values (reference: Module.bind
+        # re-copies arg_params into the new executor group)
+        preserved = None
+        if self.binded and self.params_initialized:
+            preserved = self.get_params()
         self._data_shapes = _norm_shapes(data_shapes, self._data_names)
         self._label_shapes = _norm_shapes(label_shapes, self._label_names) \
             if label_shapes else []
@@ -112,7 +117,12 @@ class Module(BaseModule):
         self._exec = Executor(self._symbol, self._context, args,
                               args_grad=None, grad_req=reqs, aux_states=aux)
         self.binded = True
-        if shared_module is not None and shared_module.params_initialized:
+        if preserved is not None:
+            arg_params, aux_params = preserved
+            self.init_params(initializer=None, arg_params=arg_params,
+                             aux_params=aux_params, allow_missing=True,
+                             force_init=True, allow_extra=True)
+        elif shared_module is not None and shared_module.params_initialized:
             self.params_initialized = True
         elif self._preloaded is not None:
             # Module.load: restore checkpointed params into the fresh bind
@@ -121,28 +131,34 @@ class Module(BaseModule):
                              allow_extra=True)
 
     # ---------------------------------------------------------------- params
-    def init_params(self, initializer=None, arg_params=None, aux_params=None,
-                    allow_missing=False, force_init=False, allow_extra=False):
+    _DEFAULT_INIT = object()  # distinguish "not given" from explicit None
+
+    def init_params(self, initializer=_DEFAULT_INIT, arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
         if self.params_initialized and not force_init:
             return
         if not self.binded:
             raise MXNetError("init_params: call bind first")
-        initializer = initializer or init_mod.Uniform(0.01)
+        if initializer is Module._DEFAULT_INIT:
+            initializer = init_mod.Uniform(0.01)
         for name in self._param_names:
             arr = self._exec.arg_dict[name]
             if arg_params is not None and name in arg_params:
                 arr._set_data(nd.array(arg_params[name].asnumpy())._data)
             elif arg_params is not None and not allow_missing:
                 raise MXNetError(f"init_params: missing arg {name!r}")
-            else:
+            elif initializer is not None:
                 initializer(InitDesc(name), arr)
+            # initializer=None + missing: keep the current value
+            # (reference set_params semantics for partial fine-tune loads)
         for name in self._aux_names:
             arr = self._exec.aux_dict[name]
             if aux_params is not None and name in aux_params:
                 arr._set_data(nd.array(aux_params[name].asnumpy())._data)
             elif aux_params is not None and not allow_missing:
                 raise MXNetError(f"init_params: missing aux {name!r}")
-            else:
+            elif initializer is not None:
                 initializer(InitDesc(name), arr)
         if arg_params is not None and not allow_extra:
             extra = set(arg_params) - set(self._param_names)
@@ -161,6 +177,8 @@ class Module(BaseModule):
 
     def set_params(self, arg_params, aux_params, allow_missing=False,
                    force_init=True, allow_extra=False):
+        # initializer=None: params absent from the dicts keep their
+        # current values (partial fine-tune load), never re-randomized
         self.init_params(initializer=None, arg_params=arg_params,
                          aux_params=aux_params, allow_missing=allow_missing,
                          force_init=force_init, allow_extra=allow_extra)

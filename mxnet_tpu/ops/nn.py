@@ -324,6 +324,27 @@ def BatchNorm(data, gamma, beta, moving_mean, moving_var, *,
     return out
 
 
+def _batchnorm_aux_update(args, kwargs):
+    """OpDef.aux_update hook: training-time moving-stat transition
+    (reference: batch_norm.cc mutates moving_mean/var in Forward; here the
+    executor applies the returned update functionally)."""
+    if kwargs.get("use_global_stats") or kwargs.get("output_mean_var"):
+        return None
+    out, mean, inv_std = BatchNorm(*args,
+                                   **dict(kwargs, output_mean_var=True))
+    eps = float(kwargs.get("eps", 1e-3))
+    mom = float(kwargs.get("momentum", 0.9))
+    var = 1.0 / (inv_std * inv_std) - eps
+    return (out,), {
+        3: mom * args[3] + (1.0 - mom) * mean.astype(args[3].dtype),
+        4: mom * args[4] + (1.0 - mom) * var.astype(args[4].dtype),
+    }
+
+
+from .registry import get_op as _get_op  # noqa: E402
+_get_op("BatchNorm").aux_update = _batchnorm_aux_update
+
+
 @register("LayerNorm", num_inputs=3, num_outputs=_bn_nout,
           aliases=["layer_norm"])
 def LayerNorm(data, gamma, beta, *, axis: int = -1, eps: float = 1e-5,

@@ -50,7 +50,7 @@ class OpDef:
     """
 
     __slots__ = ("name", "fn", "num_inputs", "num_outputs", "differentiable",
-                 "params", "doc", "aliases", "mutates_rng")
+                 "params", "doc", "aliases", "mutates_rng", "aux_update")
 
     def __init__(self, name: str, fn: Callable, num_inputs, num_outputs,
                  differentiable: bool, mutates_rng: bool = False):
@@ -60,6 +60,12 @@ class OpDef:
         self.num_outputs = num_outputs
         self.differentiable = differentiable
         self.mutates_rng = mutates_rng
+        # optional stateful-op hook for graph executors: called as
+        # aux_update(args, kwargs) during a *training* interpretation;
+        # returns None (not applicable) or (outputs_tuple,
+        # {input_slot: new_aux_value}) — the jit-pure equivalent of the
+        # reference's in-op aux-state mutation (e.g. BatchNorm moving stats)
+        self.aux_update = None
         self.aliases: List[str] = []
         sig = inspect.signature(fn)
         self.params: Dict[str, inspect.Parameter] = {

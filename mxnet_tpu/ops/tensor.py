@@ -720,3 +720,37 @@ def arange_like(data, *, start: float = 0.0, step: float = 1.0,
         return (jnp.arange(n, dtype=data.dtype) * step + start).reshape(data.shape)
     n = data.shape[axis]
     return jnp.arange(n, dtype=data.dtype) * step + start
+
+
+# ---------------------------------------------------------------------------
+# AMP support ops (reference: src/operator/tensor/amp_cast.cc)
+# ---------------------------------------------------------------------------
+
+@register("amp_cast")
+def amp_cast(data, *, dtype: str = "float32"):
+    """Dtype cast inserted by AMP (reference: amp_cast.cc).  Identical to
+    Cast; a distinct op so AMP graph rewrites are identifiable."""
+    return data.astype(jnp.dtype(dtype))
+
+
+def _amp_multicast_nout(kw):
+    return int(kw.get("num_outputs", 1))
+
+
+@register("amp_multicast", num_inputs=None, num_outputs=_amp_multicast_nout)
+def amp_multicast(*data, num_outputs: int = 1):
+    """Cast all inputs to the widest dtype among them (reference:
+    amp_cast.cc AMPMultiCast)."""
+    widest = jnp.result_type(*[d.dtype for d in data])
+    return tuple(d.astype(widest) for d in data)
+
+
+@register("all_finite", num_inputs=None, differentiable=False)
+def all_finite(*data, init_output: bool = True):
+    """1.0 if every element of every input is finite else 0.0 (reference:
+    contrib/all_finite.cc — AMP's overflow test).  One fused reduction so
+    dynamic loss scaling costs a single scalar readback."""
+    ok = jnp.ones((), dtype=jnp.bool_)
+    for d in data:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(d)))
+    return ok.astype(jnp.float32).reshape(1)

@@ -173,12 +173,16 @@ class Symbol:
                     continue
                 args = [values[id(n)][i] for n, i in node.inputs]
                 if (aux_updates is not None and train
-                        and node.op.name == "BatchNorm"
-                        and not node.kwargs.get("use_global_stats", False)
-                        and not node.kwargs.get("output_mean_var", False)):
-                    values[id(node)] = (_bn_with_aux(node, args,
-                                                    aux_updates),)
-                    continue
+                        and node.op.aux_update is not None):
+                    res = node.op.aux_update(args, node.kwargs)
+                    if res is not None:
+                        outs, slot_updates = res
+                        for slot, val in slot_updates.items():
+                            src, _ = node.inputs[slot]
+                            if src.is_variable:
+                                aux_updates[src.name] = val
+                        values[id(node)] = tuple(outs)
+                        continue
                 fn = node.op.fn
                 if node.kwargs:
                     fn = functools.partial(fn, **node.kwargs)
@@ -310,23 +314,6 @@ class Symbol:
     def __repr__(self):
         name = self.name or "grouped"
         return f"<Symbol {name}>"
-
-
-def _bn_with_aux(node, args, aux_updates):
-    """Run a BatchNorm node in training mode, recording the moving-stat
-    transition for its aux variables (reference: batch_norm.cc writes
-    moving_mean/var in Forward; here the update is returned functionally)."""
-    kw = dict(node.kwargs, output_mean_var=True)
-    out, mean, inv_std = node.op.fn(*args, **kw)
-    eps = float(node.kwargs.get("eps", 1e-3))
-    mom = float(node.kwargs.get("momentum", 0.9))
-    var = 1.0 / (inv_std * inv_std) - eps
-    for slot, batch_stat in ((3, mean), (4, var)):
-        src, _ = node.inputs[slot]
-        if src.is_variable:
-            aux_updates[src.name] = mom * args[slot] + (1.0 - mom) * \
-                batch_stat.astype(args[slot].dtype)
-    return out
 
 
 def _sym_binary(opname, scalar_opname, lhs, rhs):

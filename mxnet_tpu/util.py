@@ -8,7 +8,14 @@ import threading
 from .base import get_env, list_env_vars
 
 __all__ = ["makedirs", "use_np", "np_shape", "np_array", "getenv", "setenv",
-           "NameManager", "AttrScope"]
+           "NameManager", "AttrScope", "as_list"]
+
+
+def as_list(x):
+    """Wrap a non-list in a one-element list (shared helper)."""
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
 
 
 def makedirs(d):

@@ -1,0 +1,166 @@
+"""AMP tests (reference strategy: tests/python/.../test_amp.py).
+
+bf16 training must reach the same loss as fp32 within tolerance, the op
+namespace patching must route MXU ops to bf16 / sensitive ops to fp32, and
+dynamic loss scaling must skip overflowed steps.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+from mxnet_tpu.contrib import amp
+
+
+@pytest.fixture
+def amp_on():
+    amp.init(target_dtype="bfloat16")
+    yield
+    amp.amp._deinit()
+
+
+def _toy(n=256, seed=3):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(16, 1).astype(np.float32)
+    x = rng.randn(n, 16).astype(np.float32)
+    y = x @ w + 0.1 * rng.randn(n, 1).astype(np.float32)
+    return x, y
+
+
+def _train_mlp(x, y, use_amp, epochs=60, mp=False):
+    mx.random.seed(0)
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(32, activation="relu"))
+    net.add(gluon.nn.Dense(1))
+    net.initialize(mx.init.Xavier())
+    opt_params = {"learning_rate": 0.05}
+    if mp:
+        opt_params["multi_precision"] = True
+    trainer = gluon.Trainer(net.collect_params(), "sgd", opt_params)
+    if use_amp:
+        amp.init_trainer(trainer)
+    loss_fn = gluon.loss.L2Loss()
+    xs, ys = nd.array(x), nd.array(y)
+    final = None
+    for _ in range(epochs):
+        with autograd.record():
+            loss = loss_fn(net(xs), ys)
+        if use_amp:
+            with amp.scale_loss(loss, trainer) as scaled:
+                scaled.backward()
+        else:
+            loss.backward()
+        trainer.step(x.shape[0])
+        final = float(loss.mean().asscalar())
+    return final
+
+
+class TestAmpInit:
+    def test_bf16_ops_patched(self, amp_on):
+        x = nd.array(np.random.rand(4, 8).astype(np.float32))
+        w = nd.array(np.random.rand(3, 8).astype(np.float32))
+        b = nd.zeros((3,))
+        out = nd.FullyConnected(x, w, b, num_hidden=3)
+        assert str(out.dtype) == "bfloat16"      # MXU op ran in bf16
+        sm = nd.softmax(out)
+        assert str(sm.dtype) == "float32"        # sensitive op forced fp32
+
+    def test_symbolic_path_patched(self, amp_on):
+        from mxnet_tpu import sym
+        data = sym.var("data")
+        out = sym.FullyConnected(data, sym.var("w"), sym.var("b"),
+                                 num_hidden=4)
+        # the rewrite inserted amp_cast nodes into the graph
+        assert "amp_cast" in out.tojson()
+
+    def test_double_init_consistent(self, amp_on):
+        amp.init(target_dtype="bfloat16")  # idempotent
+        with pytest.raises(mx.MXNetError):
+            amp.init(target_dtype="float16")
+
+    def test_widest_cast(self, amp_on):
+        a = nd.array(np.ones((2, 2), np.float32)).astype("bfloat16")
+        b = nd.array(np.ones((2, 2), np.float32))
+        out = nd.broadcast_add(a, b)
+        assert str(out.dtype) == "float32"
+
+
+class TestAmpTraining:
+    def test_bf16_matches_fp32_loss(self, amp_on):
+        x, y = _toy()
+        loss_amp = _train_mlp(x, y, use_amp=True)
+        amp.amp._deinit()
+        loss_fp32 = _train_mlp(x, y, use_amp=False)
+        # converged losses agree within tolerance
+        assert abs(loss_amp - loss_fp32) < 0.02, (loss_amp, loss_fp32)
+        assert loss_amp < 0.15  # converged well below the init loss (~0.5)
+
+    def test_multi_precision_master_weights(self):
+        """bf16 params + multi_precision: fp32 master copy drives updates."""
+        mx.random.seed(0)
+        net = gluon.nn.Dense(4, in_units=8)
+        net.initialize(mx.init.Xavier())
+        net.cast("bfloat16")
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1,
+                                 "multi_precision": True})
+        x = nd.array(np.random.rand(16, 8).astype(np.float32)) \
+            .astype("bfloat16")
+        with autograd.record():
+            loss = net(x).sum()
+        loss.backward()
+        trainer.step(16)
+        w = net.weight.data()
+        assert str(w.dtype) == "bfloat16"
+        # master weights exist in the updater state as fp32
+        updater = trainer._dev_updaters[0]
+        state = updater.states[0]
+        assert isinstance(state, tuple)
+        assert str(state[0].dtype) == "float32"
+
+
+class TestLossScaler:
+    def test_overflow_skips_step_and_halves_scale(self):
+        net = gluon.nn.Dense(2, in_units=4)
+        net.initialize()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1})
+        amp.init_trainer(trainer)
+        scaler = trainer._amp_loss_scaler
+        s0 = scaler.loss_scale
+        w_before = net.weight.data().asnumpy().copy()
+        x = nd.array(np.random.rand(4, 4).astype(np.float32))
+        with autograd.record():
+            loss = net(x).sum()
+        loss.backward()
+        # poison the gradient with inf
+        g = net.weight.grad()
+        g._set_data(g._data.at[0, 0].set(np.inf))
+        trainer.step(4)
+        np.testing.assert_array_equal(net.weight.data().asnumpy(),
+                                      w_before)          # step skipped
+        assert scaler.loss_scale == s0 / 2
+
+    def test_scale_grows_after_window(self):
+        scaler = amp.LossScaler(init_scale=4.0, scale_window=3)
+        for _ in range(3):
+            scaler.update_scale(False)
+        assert scaler.loss_scale == 8.0
+
+    def test_scale_loss_divides_grads(self):
+        net = gluon.nn.Dense(1, in_units=2, use_bias=False)
+        net.initialize(mx.init.One())
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.0})
+        amp.init_trainer(trainer)
+        scale = trainer._amp_loss_scaler.loss_scale
+        x = nd.array(np.ones((1, 2), np.float32))
+        with autograd.record():
+            loss = net(x).sum()
+        with amp.scale_loss(loss, trainer) as scaled:
+            scaled.backward()
+        raw = net.weight.grad().asnumpy()
+        np.testing.assert_allclose(raw, scale * np.ones((1, 2)))
+        amp.unscale(trainer)
+        np.testing.assert_allclose(net.weight.grad().asnumpy(),
+                                   np.ones((1, 2)))

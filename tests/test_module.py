@@ -262,6 +262,38 @@ class TestModule:
         np.testing.assert_array_equal(before, after)
 
 
+class TestModuleRebind:
+    def _mod(self):
+        x = sym.var("data")
+        out = sym.FullyConnected(x, sym.var("w"), sym.var("b"), num_hidden=3)
+        mod = mx.module.Module(out, label_names=None, context=mx.cpu())
+        mod.bind(data_shapes=[("data", (2, 5))], for_training=False)
+        mod.init_params(initializer=mx.init.Xavier())
+        return mod
+
+    def test_force_rebind_preserves_params(self):
+        mod = self._mod()
+        w = mod._exec.arg_dict["w"].asnumpy().copy()
+        mod.bind(data_shapes=[("data", (4, 5))], for_training=False,
+                 force_rebind=True)
+        mod.init_params()  # early-returns; must not be needed
+        np.testing.assert_array_equal(mod._exec.arg_dict["w"].asnumpy(), w)
+
+    def test_partial_set_params_keeps_others(self):
+        mod = self._mod()
+        w = mod._exec.arg_dict["w"].asnumpy().copy()
+        mod.set_params({"b": nd.ones((3,))}, {}, allow_missing=True)
+        np.testing.assert_array_equal(mod._exec.arg_dict["w"].asnumpy(), w)
+        np.testing.assert_array_equal(mod._exec.arg_dict["b"].asnumpy(),
+                                      np.ones((3,)))
+
+    def test_forward_shape_mismatch_raises(self):
+        mod = self._mod()
+        ex = mod._exec
+        with pytest.raises(mx.MXNetError):
+            ex.forward(data=nd.zeros((7, 5)))
+
+
 class TestBucketingModule:
     """Variable-length 'RNN-ish' training with a bounded compile cache."""
 
