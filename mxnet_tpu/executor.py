@@ -174,9 +174,11 @@ class Executor:
         self._last_feed = feed
         self._is_train = bool(is_train)
         from . import random as mxrand
+        from . import profiler as _prof
         self._last_rng = mxrand.next_key()
-        outs, aux_up = self._get_fwd(feed, self._is_train)(
-            feed, self._last_rng)
+        with _prof.scope("Executor::forward", "symbolic"):
+            outs, aux_up = self._get_fwd(feed, self._is_train)(
+                feed, self._last_rng)
         for name, val in aux_up.items():
             if name in self.aux_dict:
                 self.aux_dict[name]._set_data(val)
@@ -208,8 +210,10 @@ class Executor:
                 out_grads = [out_grads]
             ograds = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
                       for g in out_grads]
-        grads = self._get_bwd(diff, const, len(ograds))(
-            diff, const, ograds, self._last_rng)
+        from . import profiler as _prof
+        with _prof.scope("Executor::backward", "symbolic"):
+            grads = self._get_bwd(diff, const, len(ograds))(
+                diff, const, ograds, self._last_rng)
         for n in diff_names:
             dst = self.grad_dict[n]
             g = grads[n].astype(dst._data.dtype)

@@ -88,9 +88,15 @@ class BaseModule:
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
                   for_training=True, force_rebind=force_rebind)
-        self.init_params(initializer=initializer, arg_params=arg_params,
-                         aux_params=aux_params, allow_missing=allow_missing,
-                         force_init=force_init)
+        init_kwargs = dict(arg_params=arg_params, aux_params=aux_params,
+                           allow_missing=allow_missing,
+                           force_init=force_init)
+        if initializer is not None:
+            # None = "use the module's default initializer"; an explicit
+            # init_params(initializer=None) means keep-current, which is
+            # not what fit's optional argument expresses
+            init_kwargs["initializer"] = initializer
+        self.init_params(**init_kwargs)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
         eval_metric = _as_metric(eval_metric)

@@ -80,8 +80,13 @@ class BucketingModule(BaseModule):
         if self.binded and not force_rebind:
             return
         # a rebind invalidates every bucket executor: stale modules would
-        # keep sharing storage with the *old* default module
+        # keep sharing storage with the *old* default module.  Trained
+        # values survive via the same preserve/restore Module.bind does.
+        preserved = None
+        if self.binded and self.params_initialized:
+            preserved = self.get_params()
         self._buckets = {}
+        self.params_initialized = False
         self._bind_args = dict(for_training=for_training,
                                inputs_need_grad=inputs_need_grad,
                                grad_req=grad_req)
@@ -91,6 +96,8 @@ class BucketingModule(BaseModule):
         self._curr_module = module
         self._curr_bucket_key = self._default_bucket_key
         self.binded = True
+        if preserved is not None:
+            self.set_params(*preserved, allow_missing=True)
 
     def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
         """Bind (or reuse) the executor for bucket_key, sharing parameters

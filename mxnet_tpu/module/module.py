@@ -119,9 +119,24 @@ class Module(BaseModule):
         self.binded = True
         if preserved is not None:
             arg_params, aux_params = preserved
-            self.init_params(initializer=None, arg_params=arg_params,
-                             aux_params=aux_params, allow_missing=True,
-                             force_init=True, allow_extra=True)
+            # a rebind that changes a *parameter* shape cannot reuse the
+            # trained value; keep the fresh buffer and say so
+            def _compat(params, bound):
+                out = {}
+                for n, v in params.items():
+                    if n in bound and tuple(bound[n].shape) == \
+                            tuple(v.shape):
+                        out[n] = v
+                    else:
+                        self.logger.warning(
+                            "bind(force_rebind): parameter %r changed "
+                            "shape; re-initialized", n)
+                return out
+            self.init_params(
+                initializer=None,
+                arg_params=_compat(arg_params, self._exec.arg_dict),
+                aux_params=_compat(aux_params, self._exec.aux_dict),
+                allow_missing=True, force_init=True, allow_extra=True)
         elif shared_module is not None and shared_module.params_initialized:
             self.params_initialized = True
         elif self._preloaded is not None:
@@ -142,10 +157,17 @@ class Module(BaseModule):
             raise MXNetError("init_params: call bind first")
         if initializer is Module._DEFAULT_INIT:
             initializer = init_mod.Uniform(0.01)
+        def _copy_in(name, arr, src, kind):
+            if tuple(src.shape) != tuple(arr.shape):
+                raise MXNetError(
+                    f"init_params: shape mismatch for {kind} {name!r}: "
+                    f"provided {tuple(src.shape)}, bound {tuple(arr.shape)}")
+            arr._set_data(nd.array(src.asnumpy())._data)
+
         for name in self._param_names:
             arr = self._exec.arg_dict[name]
             if arg_params is not None and name in arg_params:
-                arr._set_data(nd.array(arg_params[name].asnumpy())._data)
+                _copy_in(name, arr, arg_params[name], "arg")
             elif arg_params is not None and not allow_missing:
                 raise MXNetError(f"init_params: missing arg {name!r}")
             elif initializer is not None:
@@ -155,7 +177,7 @@ class Module(BaseModule):
         for name in self._aux_names:
             arr = self._exec.aux_dict[name]
             if aux_params is not None and name in aux_params:
-                arr._set_data(nd.array(aux_params[name].asnumpy())._data)
+                _copy_in(name, arr, aux_params[name], "aux")
             elif aux_params is not None and not allow_missing:
                 raise MXNetError(f"init_params: missing aux {name!r}")
             elif initializer is not None:

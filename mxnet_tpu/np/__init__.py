@@ -1,0 +1,287 @@
+"""``mx.np``: the NumPy-compatible array namespace.
+
+Reference: ``python/mxnet/numpy/`` (SURVEY.md 2.2 ndarray row) — a
+NumPy-semantics API (true broadcasting, zero-size and 0-d shapes, numpy
+promotion rules) next to the legacy ``mx.nd`` namespace.
+
+TPU-native redesign: the reference needed a parallel operator stack
+(``_np_*`` kernels) because legacy MXNet ops had non-numpy semantics.
+Here the array IS jax-backed, and ``jax.numpy`` already implements NumPy's
+semantics exactly — so ``mx.np`` is a *generated veneer*: each function
+unwraps NDArray→jax.Array, calls the ``jax.numpy`` twin, and re-wraps.
+One source of truth for numerics; differentiable and jittable for free
+(the wrappers tape through the autograd dispatcher's pause-free path —
+arrays used under ``autograd.record`` should go through ``mx.nd`` ops or
+Gluon; ``mx.np`` targets the data/numerics API surface).
+"""
+from __future__ import annotations
+
+import builtins as _builtins
+import sys as _sys
+import types as _types
+
+import numpy as _onp
+import jax as _jax
+import jax.numpy as _jnp
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+
+ndarray = NDArray   # mx.np.ndarray is the same runtime array type
+
+# dtype / constant re-exports (reference: mxnet.numpy exposes numpy dtypes)
+float16 = _onp.float16
+float32 = _onp.float32
+float64 = _onp.float64
+bfloat16 = _jnp.bfloat16
+int8 = _onp.int8
+int16 = _onp.int16
+int32 = _onp.int32
+int64 = _onp.int64
+uint8 = _onp.uint8
+bool_ = _onp.bool_
+pi = _onp.pi
+e = _onp.e
+euler_gamma = _onp.euler_gamma
+inf = _onp.inf
+nan = _onp.nan
+newaxis = None
+dtype = _onp.dtype
+
+
+def _unwrap(x):
+    # NB: use _builtins.any — this module's globals later gain a generated
+    # `any` (the numpy reduction), which would shadow the builtin here
+    if isinstance(x, NDArray):
+        return x._data
+    if isinstance(x, (list, tuple)) and _builtins.any(
+            isinstance(e, NDArray) for e in x):
+        return type(x)(_unwrap(e) for e in x)
+    return x
+
+
+def _wrap_out(out):
+    if isinstance(out, (list, tuple)):
+        return type(out)(_wrap_out(o) for o in out)
+    if hasattr(out, "dtype") and hasattr(out, "shape"):
+        return NDArray(_jnp.asarray(out))
+    return out
+
+
+def _make(jfn, name):
+    def f(*args, **kwargs):
+        args = tuple(_unwrap(a) for a in args)
+        kwargs = {k: _unwrap(v) for k, v in kwargs.items()}
+        try:
+            out = jfn(*args, **kwargs)
+        except Exception as exc:
+            raise MXNetError(f"np.{name}: {exc}") from exc
+        return _wrap_out(out)
+
+    f.__name__ = name
+    f.__qualname__ = name
+    f.__doc__ = (f"NumPy-semantics ``{name}`` (delegates to "
+                 f"jax.numpy.{name}; see numpy docs).")
+    return f
+
+
+# Functions lifted verbatim from jax.numpy (numpy semantics by
+# construction).  Grouped as the reference's mxnet/numpy modules do.
+_FUNCS = [
+    # creation
+    "array", "asarray", "zeros", "ones", "full", "empty", "zeros_like",
+    "ones_like", "full_like", "empty_like", "arange", "linspace",
+    "logspace", "eye", "identity", "tri", "tril", "triu", "diag",
+    "diagflat", "meshgrid", "indices", "fromfunction",
+    # manipulation
+    "reshape", "ravel", "transpose", "swapaxes", "moveaxis", "rollaxis",
+    "expand_dims", "squeeze", "concatenate", "stack", "vstack", "hstack",
+    "dstack", "column_stack", "split", "array_split", "hsplit", "vsplit",
+    "dsplit", "tile", "repeat", "flip", "fliplr", "flipud", "roll",
+    "rot90", "broadcast_to", "broadcast_arrays", "atleast_1d",
+    "atleast_2d", "atleast_3d", "insert", "delete", "append", "pad",
+    "trim_zeros", "unique",
+    # math
+    "add", "subtract", "multiply", "divide", "true_divide", "floor_divide",
+    "power", "float_power", "mod", "remainder", "fmod", "divmod", "negative",
+    "positive", "reciprocal", "abs", "absolute", "fabs", "sign", "rint",
+    "exp", "exp2", "expm1", "log", "log2", "log10", "log1p", "sqrt", "cbrt",
+    "square", "sin", "cos", "tan", "arcsin", "arccos", "arctan", "arctan2",
+    "sinh", "cosh", "tanh", "arcsinh", "arccosh", "arctanh", "hypot",
+    "degrees", "radians", "deg2rad", "rad2deg", "floor", "ceil", "trunc",
+    "round", "around", "clip", "maximum", "minimum", "fmax", "fmin",
+    "nan_to_num", "real", "imag", "conj", "conjugate", "angle", "i0",
+    "sinc", "gcd", "lcm", "heaviside", "copysign", "frexp", "ldexp",
+    "interp", "convolve", "correlate", "cross", "trapezoid", "ediff1d",
+    "gradient", "diff", "cumsum", "cumprod", "nancumsum", "nancumprod",
+    # NB "fix" omitted: deprecated in jax (alias of trunc)
+    # reductions
+    "sum", "prod", "mean", "std", "var", "min", "max", "amin", "amax",
+    "nansum", "nanprod", "nanmean", "nanstd", "nanvar", "nanmin", "nanmax",
+    "argmin", "argmax", "nanargmin", "nanargmax", "ptp", "median",
+    "average", "percentile", "quantile", "count_nonzero", "any", "all",
+    # sorting / searching
+    "sort", "argsort", "partition", "argpartition", "searchsorted",
+    "nonzero", "flatnonzero", "argwhere", "where", "extract", "take",
+    "take_along_axis", "choose", "compress", "select", "digitize",
+    # logic / comparison
+    "equal", "not_equal", "greater", "greater_equal", "less", "less_equal",
+    "logical_and", "logical_or", "logical_xor", "logical_not", "isfinite",
+    "isinf", "isnan", "isneginf", "isposinf", "isclose", "allclose",
+    "array_equal", "array_equiv", "signbit",
+    # linear algebra (top-level)
+    "dot", "vdot", "inner", "outer", "matmul", "tensordot", "einsum",
+    "kron", "trace",
+    # bit ops
+    "bitwise_and", "bitwise_or", "bitwise_xor", "invert", "left_shift",
+    "right_shift",
+    # stats
+    "histogram", "histogram2d", "histogram_bin_edges", "bincount", "cov",
+    "corrcoef",
+    # sets
+    "intersect1d", "union1d", "setdiff1d", "setxor1d", "isin",
+    # misc
+    "shape", "ndim", "size", "copy", "result_type", "promote_types",
+    "can_cast", "may_share_memory", "shares_memory", "iscomplexobj",
+    "isrealobj", "isscalar", "vander", "unravel_index", "ravel_multi_index",
+    "tril_indices", "triu_indices", "diag_indices",
+]
+
+_g = globals()
+for _name in _FUNCS:
+    _j = getattr(_jnp, _name, None)
+    if _j is not None and _name not in _g:
+        _g[_name] = _make(_j, _name)
+
+
+# ---------------------------------------------------------------------------
+# np.random / np.linalg / np.fft submodules
+# ---------------------------------------------------------------------------
+
+def _make_random():
+    mod = _types.ModuleType(__name__ + ".random")
+    mod.__doc__ = ("NumPy-style sampling over the framework PRNG "
+                   "(mx.random.seed controls it; threefry keys under the "
+                   "hood — reference: mxnet/numpy/random.py)")
+    from .. import random as _mxrand
+
+    def _key():
+        return _mxrand.next_key()
+
+    def uniform(low=0.0, high=1.0, size=None, dtype=None):
+        shape = _norm_size(size)
+        return NDArray(_jax.random.uniform(
+            _key(), shape, minval=low, maxval=high,
+            dtype=_jnp.dtype(dtype or "float32")))
+
+    def normal(loc=0.0, scale=1.0, size=None, dtype=None):
+        shape = _norm_size(size)
+        return NDArray(_jax.random.normal(
+            _key(), shape, dtype=_jnp.dtype(dtype or "float32"))
+            * scale + loc)
+
+    def randn(*shape):
+        return normal(size=shape or ())
+
+    def rand(*shape):
+        return uniform(size=shape or ())
+
+    def randint(low, high=None, size=None, dtype="int32"):
+        if high is None:
+            low, high = 0, low
+        shape = _norm_size(size)
+        return NDArray(_jax.random.randint(_key(), shape, low, high,
+                                           dtype=_jnp.dtype(dtype)))
+
+    def choice(a, size=None, replace=True, p=None):
+        shape = _norm_size(size)
+        a_arr = _unwrap(a)
+        if isinstance(a_arr, int):
+            a_arr = _jnp.arange(a_arr)
+        return NDArray(_jax.random.choice(_key(), a_arr, shape, replace,
+                                          _unwrap(p)))
+
+    def permutation(x):
+        if isinstance(x, int):
+            return NDArray(_jax.random.permutation(_key(), x))
+        return NDArray(_jax.random.permutation(_key(), _unwrap(x)))
+
+    def shuffle(x):
+        if not isinstance(x, NDArray):
+            raise MXNetError("np.random.shuffle expects an ndarray")
+        x._set_data(_jax.random.permutation(_key(), x._data))
+
+    def seed(s):
+        _mxrand.seed(s)
+
+    def exponential(scale=1.0, size=None):
+        shape = _norm_size(size)
+        return NDArray(_jax.random.exponential(_key(), shape) * scale)
+
+    def gamma(shape_param, scale=1.0, size=None):
+        shp = _norm_size(size)
+        return NDArray(_jax.random.gamma(_key(), shape_param, shp) * scale)
+
+    def beta(a, b, size=None):
+        shp = _norm_size(size)
+        return NDArray(_jax.random.beta(_key(), a, b, shp))
+
+    def binomial(n, p, size=None):
+        shp = _norm_size(size)
+        return NDArray(_jax.random.binomial(_key(), n, p, shape=shp))
+
+    def multinomial(n, pvals, size=None):
+        pv = _unwrap(pvals)
+        shp = _norm_size(size)
+        draws = _jax.random.categorical(
+            _key(), _jnp.log(_jnp.asarray(pv)), shape=shp + (n,))
+        counts = _jax.vmap(lambda d: _jnp.bincount(
+            d, length=len(pv)))(draws.reshape(-1, n))
+        return NDArray(counts.reshape(shp + (len(pv),)))
+
+    for fn in (uniform, normal, randn, rand, randint, choice, permutation,
+               shuffle, seed, exponential, gamma, beta, binomial,
+               multinomial):
+        setattr(mod, fn.__name__, fn)
+    return mod
+
+
+def _norm_size(size):
+    if size is None:
+        return ()
+    if isinstance(size, int):
+        return (size,)
+    return tuple(size)
+
+
+def _make_linalg():
+    mod = _types.ModuleType(__name__ + ".linalg")
+    mod.__doc__ = "numpy.linalg semantics via jax.numpy.linalg."
+    for name in ("norm", "inv", "pinv", "det", "slogdet", "cholesky",
+                 "qr", "svd", "eig", "eigh", "eigvals", "eigvalsh",
+                 "solve", "lstsq", "matrix_rank", "matrix_power",
+                 "tensorsolve", "tensorinv", "multi_dot"):
+        jfn = getattr(_jnp.linalg, name, None)
+        if jfn is not None:
+            setattr(mod, name, _make(jfn, f"linalg.{name}"))
+    return mod
+
+
+def _make_fft():
+    mod = _types.ModuleType(__name__ + ".fft")
+    mod.__doc__ = "numpy.fft semantics via jax.numpy.fft."
+    for name in ("fft", "ifft", "fft2", "ifft2", "fftn", "ifftn", "rfft",
+                 "irfft", "rfft2", "irfft2", "rfftn", "irfftn", "fftfreq",
+                 "rfftfreq", "fftshift", "ifftshift"):
+        jfn = getattr(_jnp.fft, name, None)
+        if jfn is not None:
+            setattr(mod, name, _make(jfn, f"fft.{name}"))
+    return mod
+
+
+random = _make_random()
+linalg = _make_linalg()
+fft = _make_fft()
+_sys.modules[random.__name__] = random
+_sys.modules[linalg.__name__] = linalg
+_sys.modules[fft.__name__] = fft

@@ -155,10 +155,14 @@ def invoke(opdef: OpDef, inputs, kwargs: Dict[str, Any], out=None):
     else:
         fn = opdef.fn
 
+    from .. import profiler as _prof
+    t0 = _prof._now_us() if _prof._ACTIVE else None
     try:
         result = fn(*raw)
     except Exception as e:
         raise MXNetError(f"operator {opdef.name} failed: {e}") from e
+    if t0 is not None:
+        _prof.record_op(opdef.name, t0, _prof._now_us())
 
     nout = opdef.n_outputs(kwargs)
     outs_raw = (result,) if nout == 1 and not isinstance(result, tuple) \

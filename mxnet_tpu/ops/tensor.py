@@ -738,9 +738,15 @@ def _amp_multicast_nout(kw):
 
 
 @register("amp_multicast", num_inputs=None, num_outputs=_amp_multicast_nout)
-def amp_multicast(*data, num_outputs: int = 1):
+def amp_multicast(*data, num_outputs: int = 0):
     """Cast all inputs to the widest dtype among them (reference:
-    amp_cast.cc AMPMultiCast)."""
+    amp_cast.cc AMPMultiCast).  num_outputs must equal the input count —
+    validated like the reference, since the dispatcher uses it to decide
+    how many outputs to hand back."""
+    if num_outputs != len(data):
+        raise ValueError(
+            f"amp_multicast: num_outputs={num_outputs} must equal the "
+            f"number of inputs ({len(data)})")
     widest = jnp.result_type(*[d.dtype for d in data])
     return tuple(d.astype(widest) for d in data)
 

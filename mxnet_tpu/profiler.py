@@ -1,0 +1,259 @@
+"""Profiler: chrome-trace host spans + XLA device traces.
+
+Reference surface: ``python/mxnet/profiler.py`` over ``src/profiler/``
+(``MXSetProcessProfilerConfig``/``MXDumpProfile`` — SURVEY.md 5.1): a
+``set_config``/``start``/``stop`` lifecycle that writes a chrome://tracing
+JSON file with per-op and user-scoped events, plus aggregate summaries.
+
+TPU-native redesign: host spans (op dispatch, user scopes, steps) are
+recorded by the imperative dispatcher itself; *device* time lives in XLA,
+so ``set_config(device_trace=...)`` tees ``jax.profiler`` into a TensorBoard
+trace directory alongside the chrome JSON — the TPU equivalent of the
+reference's GPU kernel timeline.  Dispatch spans are wall-clock on the
+host; XLA execution is async, so a span measures dispatch+trace cost, not
+device occupancy (that is what the device trace is for).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .base import MXNetError
+
+__all__ = ["set_config", "set_state", "start", "stop", "pause", "resume",
+           "dump", "dumps", "scope", "Task", "Frame", "Event", "Counter",
+           "Marker"]
+
+_lock = threading.Lock()
+_state = {
+    "running": False,
+    "paused": False,
+    "filename": "profile.json",
+    "profile_imperative": True,
+    "profile_symbolic": True,
+    "profile_memory": False,
+    "profile_api": True,
+    "aggregate_stats": False,
+    "device_trace": None,       # logdir for jax.profiler, or None
+    "events": [],               # chrome trace events
+    "t0": None,
+    "_jax_tracing": False,
+}
+
+# fast-path flag read by the dispatcher on every op call
+_ACTIVE = False
+
+
+def _now_us():
+    return time.perf_counter() * 1e6
+
+
+def set_config(**kwargs):
+    """Configure (reference: profiler.set_config).  Accepted keys:
+    filename, profile_all, profile_imperative, profile_symbolic,
+    profile_memory, profile_api, aggregate_stats, device_trace (logdir
+    for the XLA/TensorBoard device trace)."""
+    if _state["running"]:
+        raise MXNetError("set_config while profiler is running")
+    allowed = {"filename", "profile_all", "profile_imperative",
+               "profile_symbolic", "profile_memory", "profile_api",
+               "aggregate_stats", "device_trace", "continuous_dump"}
+    for k, v in kwargs.items():
+        if k not in allowed:
+            raise MXNetError(f"set_config: unknown option {k!r}")
+        if k == "profile_all" and v:
+            _state.update(profile_imperative=True, profile_symbolic=True,
+                          profile_api=True, profile_memory=True)
+        elif k != "profile_all":
+            _state[k] = v
+
+
+def set_state(state: str):
+    """'run' | 'stop' (reference: profiler.set_state)."""
+    if state == "run":
+        start()
+    elif state == "stop":
+        stop()
+    else:
+        raise MXNetError(f"invalid profiler state {state!r}")
+
+
+def start():
+    global _ACTIVE
+    with _lock:
+        if _state["running"]:
+            return
+        _state["running"] = True
+        _state["paused"] = False
+        _state["t0"] = _now_us()
+        _state["events"] = []
+        _ACTIVE = True
+        if _state["device_trace"]:
+            try:
+                import jax
+                jax.profiler.start_trace(_state["device_trace"])
+                _state["_jax_tracing"] = True
+            except Exception:   # tracing backend unavailable: host-only
+                _state["_jax_tracing"] = False
+
+
+def stop():
+    global _ACTIVE
+    with _lock:
+        if not _state["running"]:
+            return
+        _state["running"] = False
+        _ACTIVE = False
+        if _state["_jax_tracing"]:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            _state["_jax_tracing"] = False
+
+
+def pause():
+    global _ACTIVE
+    _state["paused"] = True
+    _ACTIVE = False
+
+
+def resume():
+    global _ACTIVE
+    _state["paused"] = False
+    _ACTIVE = _state["running"]
+
+
+def _record(name: str, cat: str, t_start_us: float, dur_us: float,
+            args: Optional[dict] = None):
+    ev = {"name": name, "cat": cat, "ph": "X",
+          "ts": t_start_us - _state["t0"], "dur": dur_us,
+          "pid": os.getpid(), "tid": threading.get_ident()}
+    if args:
+        ev["args"] = args
+    _state["events"].append(ev)
+
+
+def record_op(opname: str, t_start_us: float, t_end_us: float):
+    """Called by the imperative dispatcher (ops/registry.invoke)."""
+    if not _ACTIVE or not _state["profile_imperative"]:
+        return
+    _record(opname, "operator", t_start_us, t_end_us - t_start_us)
+
+
+class scope:
+    """``with profiler.scope("step"):`` — a named host span (reference:
+    profiler scope/Task API)."""
+
+    def __init__(self, name: str, cat: str = "user"):
+        self._name = name
+        self._cat = cat
+        self._t0 = None
+
+    def __enter__(self):
+        self._t0 = _now_us()
+        return self
+
+    def __exit__(self, *exc):
+        if not _ACTIVE:
+            return
+        if self._cat == "symbolic" and not _state["profile_symbolic"]:
+            return
+        _record(self._name, self._cat, self._t0, _now_us() - self._t0)
+
+
+class _Domain:
+    def __init__(self, name="default"):
+        self.name = name
+
+
+class Task(scope):
+    def __init__(self, domain=None, name="task"):
+        super().__init__(name, "task")
+
+    start = scope.__enter__
+
+    def stop(self):
+        self.__exit__()
+
+
+Frame = Task
+Event = Task
+
+
+class Counter:
+    """Named counter events (reference: profiler.Counter)."""
+
+    def __init__(self, domain=None, name="counter", value=0):
+        self.name = name
+        self._value = value
+
+    def set_value(self, value):
+        self._value = value
+        if _ACTIVE:
+            _state["events"].append({
+                "name": self.name, "ph": "C",
+                "ts": _now_us() - _state["t0"], "pid": os.getpid(),
+                "args": {self.name: self._value}})
+
+    def increment(self, delta=1):
+        self.set_value(self._value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self._value - delta)
+
+
+class Marker:
+    """Instant event (reference: profiler.Marker)."""
+
+    def __init__(self, domain=None, name="marker"):
+        self.name = name
+
+    def mark(self, scope_kind="process"):
+        if _ACTIVE:
+            _state["events"].append({
+                "name": self.name, "ph": "i",
+                "ts": _now_us() - _state["t0"], "pid": os.getpid(),
+                "tid": threading.get_ident(),
+                "s": {"process": "p", "thread": "t",
+                      "global": "g"}.get(scope_kind, "p")})
+
+
+def dumps(reset=False, format="json") -> str:
+    """Serialized profile.  format='json': chrome trace; 'table': the
+    reference's aggregate-stats text summary."""
+    with _lock:
+        events = list(_state["events"])
+        if reset:
+            _state["events"] = []
+    if format == "json":
+        return json.dumps({"traceEvents": events,
+                           "displayTimeUnit": "ms"}, indent=1)
+    if format != "table":
+        raise MXNetError(f"unknown dump format {format!r}")
+    agg: Dict[str, List[float]] = {}
+    for ev in events:
+        if ev.get("ph") == "X":
+            agg.setdefault(ev["name"], []).append(ev["dur"])
+    lines = [f"{'Name':<40}{'Calls':>8}{'Total(us)':>14}{'Avg(us)':>12}"
+             f"{'Max(us)':>12}"]
+    for name, durs in sorted(agg.items(),
+                             key=lambda kv: -sum(kv[1])):
+        lines.append(f"{name:<40}{len(durs):>8}{sum(durs):>14.1f}"
+                     f"{sum(durs) / len(durs):>12.1f}{max(durs):>12.1f}")
+    return "\n".join(lines)
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write the chrome-trace file (reference: profiler.dump)."""
+    path = _state["filename"]
+    with open(path, "w") as f:
+        f.write(dumps())
+    if _state["aggregate_stats"]:
+        with open(path + ".summary.txt", "w") as f:
+            f.write(dumps(format="table"))
+    return path

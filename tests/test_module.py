@@ -215,6 +215,17 @@ class TestModule:
         score = mod.score(it, "acc")
         assert dict(score)["accuracy"] > 0.9
 
+    def test_module_fit_default_initializer(self):
+        """fit() without an explicit initializer must still break symmetry
+        (regression: None once meant keep-current-zeros)."""
+        x, y = self._toy_data()
+        it = NDArrayIter(x, y, batch_size=16, label_name="softmax_label")
+        mod = mx.module.Module(_mlp_symbol(), context=mx.cpu())
+        mod.fit(it, num_epoch=6, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.5})
+        assert dict(mod.score(it, "acc"))["accuracy"] > 0.8
+        assert np.abs(mod.get_params()[0]["fc1_weight"].asnumpy()).max() > 0
+
     def test_module_predict_shapes(self):
         x, y = self._toy_data(n=50)
         it = NDArrayIter(x, y, batch_size=16, label_name="softmax_label")
@@ -342,6 +353,19 @@ class TestBucketingModule:
         w_def = bm._buckets[16]._exec.arg_dict["cls_weight"]
         for k in (4, 8):
             assert bm._buckets[k]._exec.arg_dict["cls_weight"] is w_def
+
+    def test_bucketing_force_rebind_preserves_params(self):
+        bm = mx.module.BucketingModule(self._sym_gen, default_bucket_key=8,
+                                       context=mx.cpu(), bucket_keys=[4, 8])
+        b8 = self._batch(8)
+        bm.bind(data_shapes=b8.provide_data, label_shapes=b8.provide_label)
+        bm.init_params(initializer=mx.init.Xavier())
+        w = bm.get_params()[0]["cls_weight"].asnumpy().copy()
+        assert np.abs(w).max() > 0
+        bm.bind(data_shapes=b8.provide_data, label_shapes=b8.provide_label,
+                force_rebind=True)
+        np.testing.assert_array_equal(
+            bm.get_params()[0]["cls_weight"].asnumpy(), w)
 
     def test_bucketing_rejects_unregistered_key(self):
         bm = mx.module.BucketingModule(self._sym_gen, default_bucket_key=8,

@@ -1,0 +1,141 @@
+"""mx.np / mx.npx tests (reference strategy: tests/python/unittest/
+test_numpy_op.py / test_numpy_ndarray.py — numpy-semantics parity checks
+against real numpy)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mnp
+from mxnet_tpu import npx
+from mxnet_tpu.ndarray import NDArray
+
+
+class TestNpCreation:
+    def test_array_zeros_ones(self):
+        a = mnp.array([[1, 2], [3, 4]])
+        assert isinstance(a, NDArray)
+        assert a.shape == (2, 2)
+        onp.testing.assert_array_equal(mnp.zeros((2, 3)).asnumpy(),
+                                       onp.zeros((2, 3)))
+        onp.testing.assert_array_equal(
+            mnp.ones((2,), dtype=mnp.int32).asnumpy(),
+            onp.ones((2,), onp.int32))
+
+    def test_zero_dim_and_zero_size(self):
+        """np-shape semantics: 0-d and 0-size arrays are first-class."""
+        s = mnp.array(3.5)
+        assert s.shape == ()
+        assert float(s.asnumpy()) == 3.5
+        z = mnp.zeros((0, 4))
+        assert z.shape == (0, 4)
+        assert mnp.concatenate([z, z]).shape == (0, 4)
+
+    def test_arange_linspace(self):
+        onp.testing.assert_allclose(mnp.arange(5).asnumpy(), onp.arange(5))
+        onp.testing.assert_allclose(mnp.linspace(0, 1, 5).asnumpy(),
+                                    onp.linspace(0, 1, 5))
+
+
+class TestNpBroadcastSemantics:
+    def test_true_numpy_broadcasting(self):
+        a = mnp.ones((3, 1, 4))
+        b = mnp.arange(2).reshape((2, 1)).astype("float32")
+        out = mnp.add(a, b)
+        ref = onp.ones((3, 1, 4)) + onp.arange(2).reshape(2, 1)
+        assert out.shape == ref.shape == (3, 2, 4)
+        onp.testing.assert_allclose(out.asnumpy(), ref)
+
+    def test_where_and_comparison(self):
+        x = mnp.array([1.0, -2.0, 3.0])
+        out = mnp.where(mnp.greater(x, 0), x, mnp.zeros_like(x))
+        onp.testing.assert_allclose(out.asnumpy(), [1.0, 0.0, 3.0])
+
+    def test_reductions_match_numpy(self):
+        rng = onp.random.RandomState(0)
+        x = rng.randn(3, 4, 5).astype(onp.float32)
+        m = mnp.array(x)
+        for red in ("sum", "mean", "max", "min", "var", "std", "prod"):
+            got = getattr(mnp, red)(m, axis=1).asnumpy()
+            want = getattr(onp, red)(x, axis=1)
+            onp.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-5)
+
+    def test_einsum_matmul(self):
+        rng = onp.random.RandomState(1)
+        a = rng.randn(2, 3).astype(onp.float32)
+        b = rng.randn(3, 4).astype(onp.float32)
+        onp.testing.assert_allclose(
+            mnp.einsum("ij,jk->ik", mnp.array(a), mnp.array(b)).asnumpy(),
+            a @ b, rtol=1e-5)
+        onp.testing.assert_allclose(
+            mnp.matmul(mnp.array(a), mnp.array(b)).asnumpy(), a @ b,
+            rtol=1e-5)
+
+    def test_split_returns_ndarrays(self):
+        parts = mnp.split(mnp.arange(12).reshape((3, 4)), 2, axis=1)
+        assert len(parts) == 2
+        assert all(isinstance(p, NDArray) for p in parts)
+        assert parts[0].shape == (3, 2)
+
+
+class TestNpSubmodules:
+    def test_linalg(self):
+        a = onp.array([[4.0, 0.0], [0.0, 9.0]], onp.float32)
+        onp.testing.assert_allclose(
+            mnp.linalg.norm(mnp.array(a)).asnumpy(),
+            onp.linalg.norm(a), rtol=1e-6)
+        inv = mnp.linalg.inv(mnp.array(a)).asnumpy()
+        onp.testing.assert_allclose(inv, onp.linalg.inv(a), rtol=1e-5)
+
+    def test_fft_roundtrip(self):
+        x = onp.random.RandomState(0).randn(8).astype(onp.float32)
+        back = mnp.fft.ifft(mnp.fft.fft(mnp.array(x)))
+        onp.testing.assert_allclose(back.asnumpy().real, x, atol=1e-5)
+
+    def test_random_seeded(self):
+        mnp.random.seed(42)
+        a = mnp.random.uniform(size=(4,)).asnumpy()
+        mnp.random.seed(42)
+        b = mnp.random.uniform(size=(4,)).asnumpy()
+        onp.testing.assert_array_equal(a, b)
+        assert mnp.random.randint(0, 10, size=(100,)).asnumpy().max() < 10
+        n = mnp.random.normal(2.0, 0.5, size=(2000,)).asnumpy()
+        assert abs(n.mean() - 2.0) < 0.1
+
+    def test_error_wraps_mxnet_error(self):
+        with pytest.raises(mx.MXNetError):
+            mnp.reshape(mnp.zeros((4,)), (3,))
+
+
+class TestNpx:
+    def test_set_np_flags(self):
+        npx.set_np()
+        assert npx.is_np_array() and npx.is_np_shape()
+        npx.reset_np()
+        assert not npx.is_np_array()
+
+    def test_nn_extension_ops(self):
+        x = mnp.random.normal(size=(2, 8))
+        w = mnp.random.normal(size=(4, 8))
+        b = mnp.zeros((4,))
+        out = npx.fully_connected(x, w, b, num_hidden=4)
+        assert out.shape == (2, 4)
+        onp.testing.assert_allclose(
+            out.asnumpy(), x.asnumpy() @ w.asnumpy().T + b.asnumpy(),
+            rtol=2e-5, atol=2e-5)
+        sm = npx.softmax(out)
+        onp.testing.assert_allclose(sm.asnumpy().sum(-1), 1.0, rtol=1e-5)
+        assert npx.relu(mnp.array([-1.0, 2.0])).asnumpy().tolist() \
+            == [0.0, 2.0]
+
+    def test_one_hot_pick(self):
+        idx = mnp.array([0, 2]).astype("int32")
+        oh = npx.one_hot(idx, 3)
+        onp.testing.assert_array_equal(
+            oh.asnumpy(), [[1, 0, 0], [0, 0, 1]])
+
+    def test_save_load(self, tmp_path):
+        path = str(tmp_path / "arrs")
+        npx.save(path, {"w": mnp.ones((2, 2))})
+        back = npx.load(path)
+        onp.testing.assert_array_equal(back["w"].asnumpy(),
+                                       onp.ones((2, 2)))

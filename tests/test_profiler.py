@@ -1,0 +1,105 @@
+"""Profiler tests (reference strategy: tests/python/unittest/test_profiler.py:
+start/stop, dump, parse the chrome trace, find named events)."""
+import json
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, profiler
+
+
+@pytest.fixture(autouse=True)
+def _clean_profiler():
+    yield
+    profiler.stop()
+
+
+class TestProfiler:
+    def test_op_events_in_chrome_trace(self, tmp_path):
+        fname = str(tmp_path / "profile.json")
+        profiler.set_config(filename=fname, aggregate_stats=True)
+        profiler.start()
+        a = nd.array(np.random.rand(32, 32).astype(np.float32))
+        b = nd.array(np.random.rand(32, 32).astype(np.float32))
+        c = nd.dot(a, b)
+        c = nd.relu(c)
+        c.wait_to_read()
+        profiler.stop()
+        path = profiler.dump()
+        with open(path) as f:
+            trace = json.load(f)
+        events = trace["traceEvents"]
+        names = {e["name"] for e in events}
+        assert "dot" in names
+        assert "relu" in names
+        ops = [e for e in events if e["name"] == "dot"]
+        assert all(e["ph"] == "X" and e["dur"] >= 0 and "ts" in e
+                   for e in ops)
+        # aggregate summary written alongside
+        with open(path + ".summary.txt") as f:
+            summary = f.read()
+        assert "dot" in summary and "Calls" in summary
+
+    def test_user_scope_and_step_events(self, tmp_path):
+        profiler.set_config(filename=str(tmp_path / "p.json"))
+        profiler.start()
+        with profiler.scope("train_step"):
+            x = nd.ones((8, 8))
+            (x * 2).wait_to_read()
+        profiler.stop()
+        trace = json.loads(profiler.dumps())
+        names = [e["name"] for e in trace["traceEvents"]]
+        assert "train_step" in names
+
+    def test_pause_resume(self, tmp_path):
+        profiler.set_config(filename=str(tmp_path / "p.json"))
+        profiler.start()
+        profiler.pause()
+        nd.ones((4,)).wait_to_read()
+        profiler.resume()
+        x = nd.zeros((4,))
+        nd.exp(x).wait_to_read()
+        profiler.stop()
+        trace = json.loads(profiler.dumps())
+        names = [e["name"] for e in trace["traceEvents"]]
+        assert "exp" in names
+        assert "_ones" not in names   # recorded nothing while paused
+
+    def test_counter_and_marker(self, tmp_path):
+        profiler.set_config(filename=str(tmp_path / "p.json"))
+        profiler.start()
+        c = profiler.Counter(name="samples")
+        c.set_value(10)
+        c.increment(5)
+        m = profiler.Marker(name="epoch_end")
+        m.mark()
+        profiler.stop()
+        trace = json.loads(profiler.dumps())
+        counters = [e for e in trace["traceEvents"] if e["ph"] == "C"]
+        assert counters and counters[-1]["args"]["samples"] == 15
+        assert any(e["ph"] == "i" and e["name"] == "epoch_end"
+                   for e in trace["traceEvents"])
+
+    def test_set_state_and_errors(self):
+        profiler.set_state("run")
+        with pytest.raises(mx.MXNetError):
+            profiler.set_config(filename="x.json")  # while running
+        profiler.set_state("stop")
+        with pytest.raises(mx.MXNetError):
+            profiler.set_state("bogus")
+        with pytest.raises(mx.MXNetError):
+            profiler.set_config(not_an_option=1)
+
+    def test_executor_spans(self, tmp_path):
+        from mxnet_tpu import sym
+        x = sym.var("x")
+        y = sym.exp(x) * 2.0
+        ex = y.simple_bind(mx.cpu(), x=(4, 4))
+        profiler.set_config(filename=str(tmp_path / "p.json"))
+        profiler.start()
+        ex.forward()
+        profiler.stop()
+        trace = json.loads(profiler.dumps())
+        assert any(e["name"] == "Executor::forward"
+                   for e in trace["traceEvents"])
