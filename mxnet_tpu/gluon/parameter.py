@@ -64,6 +64,11 @@ class Parameter:
         self.init = init
         self.allow_deferred_init = allow_deferred_init
         self._differentiable = differentiable
+        self._stype = stype
+        # 'row_sparse': Trainer compresses this param's gradient to
+        # RowSparse before the optimizer, enabling lazy row updates
+        # (reference: Parameter grad_stype for sparse embeddings)
+        self._grad_stype = grad_stype
         # per-context storage, keyed by Context
         self._data: "OrderedDict[Context, NDArray]" = OrderedDict()
         self._grad: "OrderedDict[Context, NDArray]" = OrderedDict()

@@ -167,10 +167,16 @@ class Trainer:
         for i, p in enumerate(self._params):
             if p.grad_req == "null":
                 continue
+            sparse_grad = getattr(p, "_grad_stype", "default") == \
+                "row_sparse"
             for j, (w, g) in enumerate(zip(p.list_data(), p.list_grad())):
                 if j not in self._dev_updaters:
                     self._dev_updaters[j] = opt.get_updater(self._optimizer)
                 self._optimizer._set_current_context(j)
+                if sparse_grad:
+                    # compress to stored-rows form: the optimizer then
+                    # touches only rows this batch actually used
+                    g = g.tostype("row_sparse")
                 self._dev_updaters[j](i, g, w)
         self._optimizer._set_current_context(0)
 

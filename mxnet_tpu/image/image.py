@@ -162,6 +162,8 @@ def imdecode(buf, flag=1, to_rgb=True, **kwargs) -> NDArray:
             img = img[:, :, None]
     else:
         img = _png_decode(data)
+        if img.shape[2] == 2:           # gray+alpha: drop alpha
+            img = img[:, :, :1]
         if flag and img.shape[2] == 1:
             img = np.repeat(img, 3, axis=2)
         elif flag and img.shape[2] == 4:

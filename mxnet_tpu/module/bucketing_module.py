@@ -97,7 +97,9 @@ class BucketingModule(BaseModule):
         self._curr_bucket_key = self._default_bucket_key
         self.binded = True
         if preserved is not None:
-            self.set_params(*preserved, allow_missing=True)
+            # same warn-and-reinit contract as Module.bind(force_rebind)
+            module._restore_preserved(preserved)
+            self.params_initialized = True
 
     def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
         """Bind (or reuse) the executor for bucket_key, sharing parameters

@@ -118,25 +118,7 @@ class Module(BaseModule):
                               args_grad=None, grad_req=reqs, aux_states=aux)
         self.binded = True
         if preserved is not None:
-            arg_params, aux_params = preserved
-            # a rebind that changes a *parameter* shape cannot reuse the
-            # trained value; keep the fresh buffer and say so
-            def _compat(params, bound):
-                out = {}
-                for n, v in params.items():
-                    if n in bound and tuple(bound[n].shape) == \
-                            tuple(v.shape):
-                        out[n] = v
-                    else:
-                        self.logger.warning(
-                            "bind(force_rebind): parameter %r changed "
-                            "shape; re-initialized", n)
-                return out
-            self.init_params(
-                initializer=None,
-                arg_params=_compat(arg_params, self._exec.arg_dict),
-                aux_params=_compat(aux_params, self._exec.aux_dict),
-                allow_missing=True, force_init=True, allow_extra=True)
+            self._restore_preserved(preserved)
         elif shared_module is not None and shared_module.params_initialized:
             self.params_initialized = True
         elif self._preloaded is not None:
@@ -144,6 +126,37 @@ class Module(BaseModule):
             arg_params, aux_params = self._preloaded
             self.init_params(arg_params=arg_params, aux_params=aux_params,
                              allow_extra=True)
+
+    def _restore_preserved(self, preserved):
+        """Restore trained values after a force_rebind.  A rebind that
+        changes a *parameter* shape cannot reuse the trained value: those
+        params are freshly re-initialized (module default initializer)
+        with a warning."""
+        arg_params, aux_params = preserved
+        mismatched = []
+
+        def _compat(params, bound):
+            out = {}
+            for n, v in params.items():
+                if n in bound and tuple(bound[n].shape) == tuple(v.shape):
+                    out[n] = v
+                elif n in bound:
+                    mismatched.append(n)
+            return out
+
+        self.init_params(
+            initializer=None,
+            arg_params=_compat(arg_params, self._exec.arg_dict),
+            aux_params=_compat(aux_params, self._exec.aux_dict),
+            allow_missing=True, force_init=True, allow_extra=True)
+        if mismatched:
+            self.logger.warning(
+                "bind(force_rebind): parameters %s changed shape; "
+                "re-initialized with the default initializer", mismatched)
+            default_init = init_mod.Uniform(0.01)
+            for n in mismatched:
+                arr = self._exec.arg_dict.get(n) or self._exec.aux_dict[n]
+                default_init(InitDesc(n), arr)
 
     # ---------------------------------------------------------------- params
     _DEFAULT_INIT = object()  # distinguish "not given" from explicit None

@@ -162,6 +162,10 @@ def load(fname):
 # random namespace: mx.nd.random.uniform etc.
 from .. import random as random  # noqa: E402
 
+# sparse namespace: mx.nd.sparse.csr_matrix etc.
+from . import sparse  # noqa: E402
+from .sparse import CSRNDArray, RowSparseNDArray  # noqa: E402
+
 _reexport()
 
 # NumPy-ish aliases the reference exposes at nd level

@@ -530,6 +530,9 @@ class NDArray:
                         constant_value=constant_value)
 
     def tostype(self, stype):
-        if stype != "default":
-            raise MXNetError("only 'default' storage implemented")
-        return self
+        """Convert storage type (reference: NDArray.tostype); 'csr' and
+        'row_sparse' live in ndarray/sparse.py."""
+        if stype == "default":
+            return self
+        from . import sparse as _sparse
+        return _sparse.tostype(self, stype)

@@ -1,0 +1,373 @@
+"""Sparse NDArray: CSR and RowSparse storage types.
+
+Reference: ``python/mxnet/ndarray/sparse.py`` over ``kCSRStorage`` /
+``kRowSparseStorage`` (``include/mxnet/ndarray.h`` — SURVEY.md 2.1 NDArray
+row).  The reference uses sparse for (a) sparse input matrices (CSR dot)
+and (b) sparse gradients (row_sparse Embedding grads + lazy optimizer row
+updates).
+
+TPU-native redesign: XLA has no native sparse tensors — the MXU wants
+dense tiles — so sparse here is a *layout over dense device buffers*
+(data/indices[/indptr] jax arrays) whose ops compile to XLA gather /
+scatter-add / segment-sum, which is exactly how sparse workloads map to
+TPU efficiently.  Any op without a sparse implementation transparently
+falls back to the dense path by materializing (the reference's "storage
+fallback" mechanism, src/executor/infer_graph_attr_pass.cc) — correctness
+first, with the dense cost visible in the profiler rather than a crash.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .ndarray import NDArray, _resolve_dtype
+
+__all__ = ["BaseSparseNDArray", "CSRNDArray", "RowSparseNDArray",
+           "csr_matrix", "row_sparse_array", "zeros", "empty", "array",
+           "retain", "dot", "add", "elemwise_add", "tostype"]
+
+
+class BaseSparseNDArray(NDArray):
+    """Common machinery: components + lazy dense materialization."""
+
+    __slots__ = ("_sparse_shape", "_sparse_dtype", "_dense_cache",
+                 "_components")
+
+    def __init__(self, components: dict, shape, dtype):
+        # Deliberately NOT calling NDArray.__init__: there is no dense
+        # buffer yet.  Engine/autograd fields are set up manually.
+        from ..engine import Var, engine
+        self._components = {k: (v._data if isinstance(v, NDArray)
+                                else jnp.asarray(v))
+                            for k, v in components.items()}
+        self._sparse_shape = tuple(int(s) for s in shape)
+        self._sparse_dtype = _resolve_dtype(dtype) or \
+            self._components["data"].dtype
+        self._dense_cache = None
+        self._ctx = None
+        self._var = Var()
+        self._grad = None
+        self._grad_req = "null"
+        self._autograd_node = None
+        engine().track(self)
+
+    # -- the dense fallback hook -------------------------------------------
+    @property
+    def _data(self):
+        """Dense materialization (storage fallback).  Dense-only ops read
+        this transparently; the conversion is one XLA scatter."""
+        if self._dense_cache is None:
+            self._dense_cache = self._to_dense_jax()
+        return self._dense_cache
+
+    @_data.setter
+    def _data(self, value):  # pragma: no cover - guard
+        raise MXNetError(
+            f"cannot assign a dense buffer into a {self.stype} array; "
+            f"convert with tostype('default') first")
+
+    def _set_data(self, new_data):
+        raise MXNetError(
+            f"in-place write on a {self.stype} array is not supported; "
+            f"convert with tostype('default') first")
+
+    @property
+    def shape(self):
+        return self._sparse_shape
+
+    @property
+    def dtype(self):
+        return np.dtype(self._sparse_dtype) \
+            if self._sparse_dtype != jnp.bfloat16 else self._sparse_dtype
+
+    @property
+    def size(self):
+        n = 1
+        for s in self._sparse_shape:
+            n *= s
+        return n
+
+    @property
+    def ndim(self):
+        return len(self._sparse_shape)
+
+    @property
+    def data(self) -> NDArray:
+        """The non-zero values array (reference: CSRNDArray.data)."""
+        return NDArray(self._components["data"])
+
+    @property
+    def indices(self) -> NDArray:
+        return NDArray(self._components["indices"])
+
+    def asnumpy(self):
+        return np.asarray(self._data)
+
+    def todense(self) -> NDArray:
+        return NDArray(self._to_dense_jax())
+
+    def tostype(self, stype: str):
+        if stype == self.stype:
+            return self
+        if stype == "default":
+            return self.todense()
+        return _from_dense(self.todense(), stype)
+
+    def astype(self, dtype, copy=True):
+        comp = dict(self._components)
+        comp["data"] = comp["data"].astype(_resolve_dtype(dtype))
+        return type(self)._from_components(comp, self._sparse_shape)
+
+    def copy(self):
+        return type(self)._from_components(dict(self._components),
+                                           self._sparse_shape)
+
+    def copyto(self, other):
+        raise MXNetError("copyto on sparse arrays is not supported; "
+                         "use tostype/todense")
+
+    def wait_to_read(self):
+        self._var.check()
+        for v in self._components.values():
+            jax.block_until_ready(v)
+
+    def __repr__(self):
+        return (f"<{type(self).__name__} {self.shape} "
+                f"{self.dtype} nnz-storage={self._components['data'].shape}>")
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix (reference: CSRNDArray)."""
+
+    __slots__ = ()
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def indptr(self) -> NDArray:
+        return NDArray(self._components["indptr"])
+
+    @classmethod
+    def _from_components(cls, comp, shape):
+        return cls(comp, shape, comp["data"].dtype)
+
+    def _to_dense_jax(self):
+        data = self._components["data"]
+        indices = self._components["indices"].astype(jnp.int32)
+        indptr = self._components["indptr"].astype(jnp.int32)
+        nnz = data.shape[0]
+        rows, cols = self._sparse_shape
+        # row id per stored element from indptr: one searchsorted, no loop
+        row_ids = jnp.searchsorted(indptr, jnp.arange(nnz), side="right") - 1
+        out = jnp.zeros((rows, cols), data.dtype)
+        return out.at[row_ids, indices].add(data)
+
+    def __getitem__(self, key):
+        if isinstance(key, int):
+            key = slice(key, key + 1)
+        if not isinstance(key, slice) or key.step not in (None, 1):
+            raise MXNetError("CSR supports only contiguous row slicing")
+        start, stop, _ = key.indices(self._sparse_shape[0])
+        indptr = self._components["indptr"].astype(jnp.int32)
+        s, e = int(indptr[start]), int(indptr[stop])
+        comp = {"data": self._components["data"][s:e],
+                "indices": self._components["indices"][s:e],
+                "indptr": indptr[start:stop + 1] - s}
+        return CSRNDArray(comp, (stop - start, self._sparse_shape[1]),
+                          comp["data"].dtype)
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """First-dim-sparse tensor: (indices, rows) (reference:
+    RowSparseNDArray) — the gradient type for embedding-style lookups."""
+
+    __slots__ = ()
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @classmethod
+    def _from_components(cls, comp, shape):
+        return cls(comp, shape, comp["data"].dtype)
+
+    def _to_dense_jax(self):
+        data = self._components["data"]
+        indices = self._components["indices"].astype(jnp.int32)
+        out = jnp.zeros(self._sparse_shape, data.dtype)
+        return out.at[indices].add(data)
+
+    def retain(self, indices):
+        """Keep only the given rows (reference: sparse.retain)."""
+        keep = jnp.asarray(indices, jnp.int32)
+        mine = self._components["indices"].astype(jnp.int32)
+        mask = jnp.isin(mine, keep)
+        sel = np.flatnonzero(np.asarray(mask))
+        comp = {"data": self._components["data"][sel],
+                "indices": mine[sel]}
+        return RowSparseNDArray(comp, self._sparse_shape,
+                                comp["data"].dtype)
+
+
+# ---------------------------------------------------------------------------
+# creation
+# ---------------------------------------------------------------------------
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None) -> CSRNDArray:
+    """csr_matrix((data, indices, indptr), shape=(M, N)) or from a dense
+    array/NDArray (reference: mx.nd.sparse.csr_matrix)."""
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        data = jnp.asarray(data, _resolve_dtype(dtype))
+        comp = {"data": data,
+                "indices": jnp.asarray(indices, jnp.int32),
+                "indptr": jnp.asarray(indptr, jnp.int32)}
+        if shape is None:
+            raise MXNetError("csr_matrix: shape required with components")
+        return CSRNDArray(comp, shape, data.dtype)
+    dense = arg1.asnumpy() if isinstance(arg1, NDArray) else np.asarray(arg1)
+    if dense.ndim != 2:
+        raise MXNetError("csr_matrix: dense input must be 2-D")
+    mask = dense != 0
+    indptr = np.concatenate([[0], mask.sum(axis=1).cumsum()])
+    rows, cols = np.nonzero(mask)
+    comp = {"data": jnp.asarray(dense[rows, cols], _resolve_dtype(dtype)),
+            "indices": jnp.asarray(cols, jnp.int32),
+            "indptr": jnp.asarray(indptr, jnp.int32)}
+    return CSRNDArray(comp, dense.shape, comp["data"].dtype)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None) \
+        -> RowSparseNDArray:
+    """row_sparse_array((data, indices), shape=...) or from dense
+    (reference: mx.nd.sparse.row_sparse_array)."""
+    if isinstance(arg1, tuple) and len(arg1) == 2 and not \
+            isinstance(arg1[0], int):
+        data, indices = arg1
+        data = jnp.asarray(data, _resolve_dtype(dtype))
+        if shape is None:
+            raise MXNetError("row_sparse_array: shape required")
+        return RowSparseNDArray({"data": data,
+                                 "indices": jnp.asarray(indices,
+                                                        jnp.int32)},
+                                shape, data.dtype)
+    if isinstance(arg1, NDArray):
+        # device path: compute the row mask on device and transfer only
+        # the boolean mask (O(rows) bits), then gather rows on device —
+        # never the full dense tensor (Trainer calls this per step for
+        # sparse_grad params)
+        d = arg1._data
+        mask = jnp.any(d.reshape(d.shape[0], -1) != 0, axis=1)
+        nz_rows = np.flatnonzero(np.asarray(mask))
+        comp = {"data": d[nz_rows].astype(_resolve_dtype(dtype)
+                                          or d.dtype),
+                "indices": jnp.asarray(nz_rows, jnp.int32)}
+        return RowSparseNDArray(comp, d.shape, comp["data"].dtype)
+    dense = np.asarray(arg1)
+    nz_rows = np.flatnonzero(
+        (dense.reshape(dense.shape[0], -1) != 0).any(axis=1))
+    comp = {"data": jnp.asarray(dense[nz_rows], _resolve_dtype(dtype)),
+            "indices": jnp.asarray(nz_rows, jnp.int32)}
+    return RowSparseNDArray(comp, dense.shape, comp["data"].dtype)
+
+
+def zeros(stype, shape, ctx=None, dtype="float32"):
+    """reference: mx.nd.sparse.zeros."""
+    dtype = _resolve_dtype(dtype)
+    if stype == "csr":
+        return CSRNDArray({"data": jnp.zeros((0,), dtype),
+                           "indices": jnp.zeros((0,), jnp.int32),
+                           "indptr": jnp.zeros((shape[0] + 1,), jnp.int32)},
+                          shape, dtype)
+    if stype == "row_sparse":
+        return RowSparseNDArray(
+            {"data": jnp.zeros((0,) + tuple(shape[1:]), dtype),
+             "indices": jnp.zeros((0,), jnp.int32)}, shape, dtype)
+    if stype == "default":
+        from . import zeros as dense_zeros
+        return dense_zeros(shape, ctx=ctx, dtype=dtype)
+    raise MXNetError(f"unknown stype {stype!r}")
+
+
+empty = zeros
+
+
+def array(source, ctx=None, dtype=None):
+    """Sparse-preserving nd.sparse.array (reference)."""
+    if isinstance(source, BaseSparseNDArray):
+        return source.copy()
+    raise MXNetError("sparse.array expects a sparse input; use "
+                     "csr_matrix/row_sparse_array to construct")
+
+
+def _from_dense(arr: NDArray, stype: str):
+    if stype == "csr":
+        return csr_matrix(arr)
+    if stype == "row_sparse":
+        return row_sparse_array(arr)
+    raise MXNetError(f"unknown stype {stype!r}")
+
+
+def tostype(arr, stype: str):
+    """Free-function stype conversion covering dense arrays too."""
+    if isinstance(arr, BaseSparseNDArray):
+        return arr.tostype(stype)
+    if stype == "default":
+        return arr
+    return _from_dense(arr, stype)
+
+
+# ---------------------------------------------------------------------------
+# sparse ops
+# ---------------------------------------------------------------------------
+
+def retain(data: RowSparseNDArray, indices):
+    if not isinstance(data, RowSparseNDArray):
+        raise MXNetError("retain expects a RowSparseNDArray")
+    idx = indices._data if isinstance(indices, NDArray) else indices
+    return data.retain(idx)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False) -> NDArray:
+    """dot(csr, dense) / dot(csr.T, dense) — the sparse kernel the
+    reference ships for libsvm-style input pipelines
+    (reference: src/operator/tensor/dot.cc sparse paths).
+    Lowers to one XLA gather + segment-sum / scatter-add."""
+    if not isinstance(lhs, CSRNDArray):
+        from . import dot as dense_dot
+        return dense_dot(lhs, rhs, transpose_a=transpose_a,
+                         transpose_b=transpose_b)
+    if transpose_b:
+        raise MXNetError("dot(csr, dense, transpose_b=True) unsupported")
+    data = lhs._components["data"]
+    col = lhs._components["indices"].astype(jnp.int32)
+    indptr = lhs._components["indptr"].astype(jnp.int32)
+    nnz = data.shape[0]
+    rows, cols = lhs.shape
+    dense = rhs._data
+    row_ids = jnp.searchsorted(indptr, jnp.arange(nnz), side="right") - 1
+    if not transpose_a:
+        # out[r] = Σ_j a[r,j] * dense[j] : gather rows of dense by column
+        # index, weight, segment-sum into output rows
+        contrib = data[:, None] * dense[col]          # (nnz, k)
+        out = jax.ops.segment_sum(contrib, row_ids, num_segments=rows)
+    else:
+        # out[c] = Σ_r a[r,c] * dense[r] : scatter-add by column index
+        contrib = data[:, None] * dense[row_ids]
+        out = jnp.zeros((cols, dense.shape[1]), data.dtype) \
+            .at[col].add(contrib)
+    return NDArray(out)
+
+
+def add(lhs, rhs) -> NDArray:
+    """sparse + sparse/dense → dense (fallback add, reference semantics
+    keep rsp+rsp sparse; dense result is the safe superset here)."""
+    return NDArray(lhs._data + rhs._data)
+
+
+elemwise_add = add

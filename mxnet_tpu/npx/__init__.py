@@ -158,7 +158,8 @@ def embedding(data, weight, input_dim=None, output_dim=None,
     if input_dim is None:
         input_dim, output_dim = weight.shape
     return _op("Embedding", data, weight, input_dim=input_dim,
-               output_dim=output_dim, dtype=dtype)
+               output_dim=output_dim, dtype=dtype,
+               sparse_grad=sparse_grad)
 
 
 def dropout(data, p=0.5, axes=(), mode="training"):

@@ -162,6 +162,15 @@ def _apply(opname, arrays, **kwargs):
     return out
 
 
+def _rsp_rows(grad):
+    """(row_indices, row_values) if grad is RowSparse, else None."""
+    from ..ndarray.sparse import RowSparseNDArray
+    if isinstance(grad, RowSparseNDArray):
+        return (grad._components["indices"].astype("int32"),
+                grad._components["data"])
+    return None
+
+
 @register
 class SGD(Optimizer):
     """SGD w/ momentum (reference: SGD → sgd_update/sgd_mom_update)."""
@@ -169,6 +178,7 @@ class SGD(Optimizer):
     def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
         super().__init__(**kwargs)
         self.momentum = momentum
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         if self.momentum == 0.0:
@@ -178,6 +188,25 @@ class SGD(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
+        rows = _rsp_rows(grad) if not isinstance(state, tuple) else None
+        if rows is not None and self.lazy_update:
+            # lazy row-sparse update: touch only stored rows (reference:
+            # sgd_update kRowSparseStorage path).  One XLA gather+scatter.
+            from ..ops.optimizer_ops import _prep_grad
+            idx, gvals = rows
+            w = weight._data
+            wr = w[idx]
+            g = _prep_grad(gvals.astype(w.dtype), self.rescale_grad,
+                           self.clip_gradient, wd, wr)
+            if state is None:
+                new_rows = wr - lr * g
+            else:
+                m = state._data
+                mr = self.momentum * m[idx] - lr * g
+                state._set_data(m.at[idx].set(mr))
+                new_rows = wr + mr
+            weight._set_data(w.at[idx].set(new_rows))
+            return
         kw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
                   clip_gradient=self.clip_gradient or -1.0)
         if isinstance(state, tuple):  # multi-precision
@@ -238,6 +267,7 @@ class Adam(Optimizer):
         self.beta1 = beta1
         self.beta2 = beta2
         self.epsilon = epsilon
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         return (nd.zeros(weight.shape, ctx=weight.context,
@@ -251,6 +281,25 @@ class Adam(Optimizer):
         lr = self._get_lr(index)
         lr *= math.sqrt(1. - self.beta2 ** t) / (1. - self.beta1 ** t)
         mean, var = state
+        rows = _rsp_rows(grad)
+        if rows is not None and self.lazy_update:
+            # lazy adam (reference: adam_update kRowSparseStorage): only
+            # stored rows advance their moments — matches reference
+            # semantics where untouched rows' m/v stay frozen
+            from ..ops.optimizer_ops import _prep_grad
+            idx, gvals = rows
+            w = weight._data
+            g = _prep_grad(gvals.astype(w.dtype), self.rescale_grad,
+                           self.clip_gradient, self._get_wd(index), w[idx])
+            import jax.numpy as jnp
+            m, v = mean._data, var._data
+            mr = self.beta1 * m[idx] + (1 - self.beta1) * g
+            vr = self.beta2 * v[idx] + (1 - self.beta2) * g * g
+            mean._set_data(m.at[idx].set(mr))
+            var._set_data(v.at[idx].set(vr))
+            new_rows = w[idx] - lr * mr / (jnp.sqrt(vr) + self.epsilon)
+            weight._set_data(w.at[idx].set(new_rows))
+            return
         new_w, new_m, new_v = _apply(
             "adam_update", [weight, grad, mean, var],
             lr=lr, beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,

@@ -1,0 +1,182 @@
+"""Sparse NDArray tests vs dense oracles
+(reference strategy: tests/python/unittest/test_sparse_ndarray.py,
+test_sparse_operator.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import sparse
+
+
+def _rand_csr_dense(m=8, n=6, density=0.3, seed=0):
+    rng = np.random.RandomState(seed)
+    dense = rng.randn(m, n).astype(np.float32)
+    dense[rng.rand(m, n) > density] = 0.0
+    return dense
+
+
+class TestCSR:
+    def test_from_dense_roundtrip(self):
+        dense = _rand_csr_dense()
+        csr = sparse.csr_matrix(dense)
+        assert csr.stype == "csr"
+        np.testing.assert_allclose(csr.asnumpy(), dense)
+        back = csr.tostype("default")
+        assert back.stype == "default"
+        np.testing.assert_allclose(back.asnumpy(), dense)
+
+    def test_from_components(self):
+        # [[1,0,2],[0,0,3]]
+        csr = sparse.csr_matrix(([1., 2., 3.], [0, 2, 2], [0, 2, 3]),
+                                shape=(2, 3))
+        np.testing.assert_allclose(csr.asnumpy(),
+                                   [[1, 0, 2], [0, 0, 3]])
+        np.testing.assert_array_equal(csr.indptr.asnumpy(), [0, 2, 3])
+
+    def test_dot_vs_dense(self):
+        a = _rand_csr_dense(10, 7, seed=1)
+        b = np.random.RandomState(2).randn(7, 4).astype(np.float32)
+        csr = sparse.csr_matrix(a)
+        out = sparse.dot(csr, nd.array(b))
+        np.testing.assert_allclose(out.asnumpy(), a @ b, rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_dot_transpose_a(self):
+        a = _rand_csr_dense(10, 7, seed=3)
+        b = np.random.RandomState(4).randn(10, 5).astype(np.float32)
+        out = sparse.dot(sparse.csr_matrix(a), nd.array(b),
+                         transpose_a=True)
+        np.testing.assert_allclose(out.asnumpy(), a.T @ b, rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_row_slice(self):
+        dense = _rand_csr_dense(8, 5, seed=5)
+        csr = sparse.csr_matrix(dense)
+        sl = csr[2:6]
+        assert sl.stype == "csr"
+        np.testing.assert_allclose(sl.asnumpy(), dense[2:6])
+
+    def test_dense_op_fallback(self):
+        """Ops without sparse kernels densify transparently."""
+        dense = _rand_csr_dense()
+        csr = sparse.csr_matrix(dense)
+        out = nd.relu(csr)
+        np.testing.assert_allclose(out.asnumpy(), np.maximum(dense, 0))
+
+    def test_zeros(self):
+        z = sparse.zeros("csr", (3, 4))
+        np.testing.assert_array_equal(z.asnumpy(), np.zeros((3, 4)))
+
+
+class TestRowSparse:
+    def test_roundtrip_and_retain(self):
+        dense = np.zeros((6, 3), np.float32)
+        dense[1] = 1.0
+        dense[4] = 2.0
+        rsp = sparse.row_sparse_array(dense)
+        assert rsp.stype == "row_sparse"
+        np.testing.assert_array_equal(rsp.indices.asnumpy(), [1, 4])
+        np.testing.assert_allclose(rsp.asnumpy(), dense)
+        kept = sparse.retain(rsp, nd.array([4.0]))
+        np.testing.assert_array_equal(kept.indices.asnumpy(), [4])
+        np.testing.assert_allclose(kept.asnumpy()[4], dense[4])
+        np.testing.assert_allclose(kept.asnumpy()[1], 0.0)
+
+    def test_from_components(self):
+        rsp = sparse.row_sparse_array(
+            (np.ones((2, 3), np.float32), [0, 5]), shape=(7, 3))
+        out = rsp.asnumpy()
+        np.testing.assert_allclose(out[0], 1.0)
+        np.testing.assert_allclose(out[5], 1.0)
+        assert out.sum() == 6.0
+
+    def test_dense_tostype(self):
+        dense = nd.array(np.eye(4, dtype=np.float32))
+        rsp = dense.tostype("row_sparse")
+        assert rsp.stype == "row_sparse"
+        csr = dense.tostype("csr")
+        assert csr.stype == "csr"
+        np.testing.assert_allclose(rsp.asnumpy(), np.eye(4))
+        np.testing.assert_allclose(csr.asnumpy(), np.eye(4))
+
+
+class TestSparseOptimizer:
+    def _grad(self, shape, rows, seed=0):
+        g = np.zeros(shape, np.float32)
+        g[rows] = np.random.RandomState(seed).randn(
+            len(rows), shape[1]).astype(np.float32)
+        return g
+
+    def test_sgd_lazy_matches_dense_on_touched_rows(self):
+        shape, rows = (10, 4), [2, 7]
+        w0 = np.random.RandomState(1).randn(*shape).astype(np.float32)
+        gd = self._grad(shape, rows)
+        # dense reference update
+        opt_d = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9)
+        wd_ = nd.array(w0)
+        sd = opt_d.create_state(0, wd_)
+        opt_d.update(0, wd_, nd.array(gd), sd)
+        # lazy sparse update
+        opt_s = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9)
+        ws = nd.array(w0)
+        ss = opt_s.create_state(0, ws)
+        opt_s.update(0, ws, sparse.row_sparse_array(gd), ss)
+        np.testing.assert_allclose(ws.asnumpy(), wd_.asnumpy(), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_sgd_lazy_untouched_rows_frozen(self):
+        shape, rows = (10, 4), [0, 3]
+        w0 = np.random.RandomState(2).randn(*shape).astype(np.float32)
+        opt = mx.optimizer.create("sgd", learning_rate=0.5, momentum=0.9,
+                                  wd=0.1)
+        w = nd.array(w0)
+        s = opt.create_state(0, w)
+        opt.update(0, w, sparse.row_sparse_array(self._grad(shape, rows)),
+                   s)
+        out = w.asnumpy()
+        untouched = [i for i in range(10) if i not in rows]
+        # untouched rows see NO update (not even weight decay) — the lazy
+        # contract
+        np.testing.assert_array_equal(out[untouched], w0[untouched])
+        assert np.abs(out[rows] - w0[rows]).max() > 0
+
+    def test_adam_lazy_converges(self):
+        """Sparse embedding-style regression with lazy adam."""
+        vocab, dim = 50, 8
+        rng = np.random.RandomState(0)
+        true_emb = rng.randn(vocab, dim).astype(np.float32)
+        opt = mx.optimizer.create("adam", learning_rate=0.05)
+        w = nd.array(np.zeros((vocab, dim), np.float32))
+        state = opt.create_state(0, w)
+        for step in range(800):
+            idx = rng.randint(0, vocab, size=8)
+            uniq = np.unique(idx)
+            grad_rows = w.asnumpy()[uniq] - true_emb[uniq]
+            rsp = sparse.row_sparse_array((grad_rows, uniq),
+                                          shape=(vocab, dim))
+            opt.update(0, w, rsp, state)
+        err = np.abs(w.asnumpy() - true_emb).mean()
+        assert err < 0.03, err
+
+
+class TestSparseEmbeddingTraining:
+    def test_gluon_embedding_sparse_grad(self):
+        from mxnet_tpu import gluon, autograd
+        mx.random.seed(0)
+        net = gluon.nn.Embedding(20, 4, sparse_grad=True)
+        net.initialize(mx.init.Normal(0.1))
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 1.0, "momentum": 0.0})
+        w_before = None
+        x = nd.array(np.array([1, 5, 5], np.float32))
+        with autograd.record():
+            out = net(x)
+            loss = out.sum()
+        loss.backward()
+        w_before = net.weight.data().asnumpy().copy()
+        trainer.step(1)
+        w_after = net.weight.data().asnumpy()
+        changed = np.abs(w_after - w_before).sum(axis=1) > 0
+        assert changed[1] and changed[5]
+        assert not changed[0] and not changed[19]
