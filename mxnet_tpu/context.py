@@ -100,23 +100,28 @@ class Context:
 
 
 def _accel_devices():
-    return [d for d in jax.devices() if d.platform != "cpu"]
+    # local (addressable) accelerators only: device counts must agree
+    # with what Context can actually address in a multi-process job
+    return [d for d in jax.local_devices() if d.platform != "cpu"]
 
 
 def _devices_for(device_type: str):
+    # Contexts address THIS process's devices: under jax.distributed each
+    # process may only touch its local (addressable) devices — global
+    # jax.devices() entries from other hosts cannot back an NDArray.
     if device_type in ("cpu", "cpu_pinned", "cpu_shared"):
         try:
-            return jax.devices("cpu")
+            return jax.local_devices(backend="cpu")
         except RuntimeError:
             # cpu platform not initialised alongside an accelerator; fall
             # back to whatever the default platform is.
-            return jax.devices()
+            return jax.local_devices()
     accel = _accel_devices()
     if accel:
         return accel
     # No accelerator present: cpu devices stand in (e.g. the 8-device
     # virtual CPU mesh used by the test suite).
-    return jax.devices()
+    return jax.local_devices()
 
 
 def cpu(device_id: int = 0) -> Context:

@@ -384,13 +384,11 @@ class CSVIter(NDArrayIter):
                  label_shape=None, batch_size=1, round_batch=True,
                  num_parts=1, part_index=0, data_name="data",
                  label_name="softmax_label"):
-        data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32,
-                          ndmin=2)
+        data = _load_csv(data_csv)
         n = data.shape[0]
         data = data.reshape((n,) + tuple(data_shape))
         if label_csv is not None:
-            label = np.loadtxt(label_csv, delimiter=",", dtype=np.float32,
-                               ndmin=2)
+            label = _load_csv(label_csv)
             if label_shape is not None:
                 label = label.reshape((n,) + tuple(label_shape))
             else:
@@ -402,6 +400,15 @@ class CSVIter(NDArrayIter):
                          last_batch_handle="pad" if round_batch
                          else "discard",
                          data_name=data_name, label_name=label_name)
+
+
+def _load_csv(path):
+    """Numeric CSV → float32 (rows, cols); C++ parser when available
+    (reference: iter_csv.cc), numpy fallback."""
+    from ..lib import nativelib
+    if nativelib.available():
+        return nativelib.csv_load(path)
+    return np.loadtxt(path, delimiter=",", dtype=np.float32, ndmin=2)
 
 
 def _read_idx_file(path):
@@ -494,21 +501,29 @@ class ImageRecordIter(DataIter):
             path_imgidx or path_imgrec + ".idx", path_imgrec, "r") \
             if (path_imgidx or os.path.exists(path_imgrec + ".idx")) \
             else None
+        self._native = None
         if self._rec is not None and self._rec.keys:
             keys = list(self._rec.keys)
         else:
-            # no index: scan once recording offsets
+            # no index: scan once recording offsets.  The C++ scanner
+            # (lib/nativelib) walks frames without copying payloads;
+            # python fallback reads them all.
             self._rec = None
-            self._offsets = []
-            reader = recordio.MXRecordIO(path_imgrec, "r")
-            while True:
-                pos = reader.tell()
-                if reader.read() is None:
-                    break
-                self._offsets.append(pos)
-            reader.close()
+            from ..lib import nativelib
+            if nativelib.available():
+                self._native = nativelib.NativeRecordReader(path_imgrec)
+                self._offsets = self._native.index().tolist()
+            else:
+                self._offsets = []
+                reader = recordio.MXRecordIO(path_imgrec, "r")
+                while True:
+                    pos = reader.tell()
+                    if reader.read() is None:
+                        break
+                    self._offsets.append(pos)
+                reader.close()
+                self._plain_reader = recordio.MXRecordIO(path_imgrec, "r")
             keys = list(range(len(self._offsets)))
-            self._plain_reader = recordio.MXRecordIO(path_imgrec, "r")
         s, e = _shard_range(len(keys), num_parts, part_index)
         self._keys = keys[s:e]
         self._order = list(range(len(self._keys)))
@@ -591,6 +606,8 @@ class ImageRecordIter(DataIter):
     def _read_record(self, key):
         if self._rec is not None:
             return self._rec.read_idx(key)
+        if self._native is not None:
+            return self._native.read_at(self._offsets[key])
         self._plain_reader._f.seek(self._offsets[key])
         return self._plain_reader.read()
 

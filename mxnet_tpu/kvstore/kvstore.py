@@ -386,6 +386,59 @@ class XLA(KVStore):
 KVStoreBase.register_alias("nccl", XLA)
 
 
+@KVStoreBase.register
+class DistSync(KVStore):
+    """Multi-process synchronous tier (reference: KVStoreDist dist_sync).
+
+    The reference runs a parameter-server control plane over DCN; here the
+    process group is bootstrapped by ``parallel.dist.initialize`` (env
+    protocol from tools/launch.py) and a push reduces first locally across
+    this process's device copies, then across processes.  Rank/num_workers
+    mirror the reference worker identity API.
+    """
+
+    _TYPE = "dist_sync"
+
+    def __init__(self):
+        super().__init__()
+        from ..parallel import dist
+        self._dist = dist
+        dist.initialize()   # no-op when standalone / already joined
+
+    def init(self, key, value):
+        # rank 0's value is authoritative (reference: KVStoreDist —
+        # server stores rank-0 init), else workers whose initial weights
+        # differ would train on divergent parameters forever
+        super().init(key, value)
+        if self._dist.is_initialized():
+            for k, _vals in _normalize(key, value):
+                stored = self._store[k]
+                stored._set_data(
+                    self._dist.broadcast_host(stored, root=0)._data)
+
+    @property
+    def rank(self):
+        return self._dist.rank() if self._dist.is_initialized() else 0
+
+    @property
+    def num_workers(self):
+        return self._dist.size() if self._dist.is_initialized() else 1
+
+    def _reduce(self, k, vals):
+        # intra-process reduce (device copies) ...
+        dev = cpu(0).jax_device()
+        acc = jax.device_put(vals[0]._data, dev)
+        for v in vals[1:]:
+            acc = acc + jax.device_put(v._data, dev)
+        # ... then inter-process reduce over the group
+        return self._dist.allreduce_host(NDArray(acc, ctx=cpu(0)))
+
+
+KVStoreBase.register_alias("dist_sync", DistSync)
+KVStoreBase.register_alias("dist", DistSync)
+KVStoreBase.register_alias("dist_device_sync", DistSync)
+
+
 def create(name="local") -> KVStore:
     """Factory (reference: kvstore.create / KVStoreBase registry)."""
     if not isinstance(name, str):

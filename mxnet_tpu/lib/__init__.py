@@ -1,0 +1,4 @@
+"""Native (C++) runtime components, bound via ctypes (see nativelib.py)."""
+from . import nativelib
+
+__all__ = ["nativelib"]

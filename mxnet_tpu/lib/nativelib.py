@@ -1,0 +1,187 @@
+"""ctypes binding + on-demand build of the native IO library.
+
+Reference: the reference links dmlc-core/src/recordio.cc and the C++
+iterator tier into libmxnet.so at build time (SURVEY.md §2.1).  Here the
+library is a single translation unit compiled on first use with the
+toolchain in the image (g++ -O3 -shared) and cached next to the sources;
+every caller keeps a pure-Python fallback, so a missing compiler degrades
+performance, never correctness.  ``mx.runtime.Features()["NATIVE_IO"]``
+reports which path is active.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "src", "nativelib.cc")
+_SO = os.path.join(_DIR, "libmxnet_tpu_native.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", _SO, _SRC]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, timeout=120)
+        return proc.returncode == 0 and os.path.exists(_SO)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("MXNET_TPU_DISABLE_NATIVE"):
+            return None
+        stale = (not os.path.exists(_SO) or
+                 os.path.getmtime(_SO) < os.path.getmtime(_SRC))
+        if stale and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        if lib.mxnative_abi_version() != 1:
+            return None
+        lib.mxrec_open.restype = ctypes.c_void_p
+        lib.mxrec_open.argtypes = [ctypes.c_char_p]
+        lib.mxrec_close.argtypes = [ctypes.c_void_p]
+        lib.mxrec_index.restype = ctypes.c_int64
+        lib.mxrec_index.argtypes = [ctypes.c_void_p,
+                                    ctypes.POINTER(ctypes.c_int64),
+                                    ctypes.c_int64]
+        lib.mxrec_read_at.restype = ctypes.c_int64
+        lib.mxrec_read_at.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                      ctypes.c_char_p, ctypes.c_int64]
+        lib.mxrec_create.restype = ctypes.c_void_p
+        lib.mxrec_create.argtypes = [ctypes.c_char_p]
+        lib.mxrec_write.restype = ctypes.c_int64
+        lib.mxrec_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_int64]
+        lib.mxcsv_shape.restype = ctypes.c_int64
+        lib.mxcsv_shape.argtypes = [ctypes.c_char_p,
+                                    ctypes.POINTER(ctypes.c_int64)]
+        lib.mxcsv_parse.restype = ctypes.c_int64
+        lib.mxcsv_parse.argtypes = [
+            ctypes.c_char_p,
+            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+            ctypes.c_int64]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+# ---------------------------------------------------------------------------
+# high-level wrappers (all raise RuntimeError when the lib is unavailable;
+# callers gate on available())
+# ---------------------------------------------------------------------------
+
+class NativeRecordReader:
+    """Random-access record reader over the C++ scanner."""
+
+    def __init__(self, path: str):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = lib.mxrec_open(path.encode())
+        if not self._h:
+            raise OSError(f"cannot open {path!r}")
+
+    def close(self):
+        if self._h:
+            self._lib.mxrec_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def index(self) -> np.ndarray:
+        """Byte offsets of every logical record (the .idx-less scan)."""
+        count = self._lib.mxrec_index(self._h, None, 0)
+        if count < 0:
+            raise IOError("corrupt record file")
+        offsets = np.zeros(count, np.int64)
+        got = self._lib.mxrec_index(
+            self._h,
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), count)
+        if got != count:
+            raise IOError("record file changed during scan")
+        return offsets
+
+    def read_at(self, offset: int) -> bytes:
+        need = self._lib.mxrec_read_at(self._h, offset, None, 0)
+        if need < 0:
+            raise IOError(f"corrupt record at offset {offset}")
+        buf = ctypes.create_string_buffer(need)
+        got = self._lib.mxrec_read_at(self._h, offset, buf, need)
+        if got != need:
+            raise IOError(f"short read at offset {offset}")
+        return buf.raw
+
+
+class NativeRecordWriter:
+    def __init__(self, path: str):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = lib.mxrec_create(path.encode())
+        if not self._h:
+            raise OSError(f"cannot create {path!r}")
+
+    def write(self, payload: bytes) -> int:
+        n = self._lib.mxrec_write(self._h, payload, len(payload))
+        if n < 0:
+            raise IOError("record write failed")
+        return n
+
+    def close(self):
+        if self._h:
+            self._lib.mxrec_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def csv_load(path: str) -> np.ndarray:
+    """Parse a numeric CSV into a (rows, cols) float32 array."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    n_vals = ctypes.c_int64()
+    rows = lib.mxcsv_shape(path.encode(), ctypes.byref(n_vals))
+    if rows < 0:
+        raise OSError(f"cannot open {path!r}")
+    out = np.empty(n_vals.value, np.float32)
+    got = lib.mxcsv_parse(path.encode(), out, n_vals.value)
+    if got == -3:
+        raise ValueError(
+            f"non-numeric field in {path!r} (header line?) — "
+            f"CSVIter expects numeric-only files")
+    if got != n_vals.value:
+        raise IOError(f"csv parse mismatch in {path!r}")
+    if rows and n_vals.value % rows:
+        raise IOError(f"ragged csv {path!r}")
+    return out.reshape(rows, n_vals.value // rows) if rows else \
+        out.reshape(0, 0)

@@ -1,0 +1,257 @@
+// Native IO runtime for mxnet_tpu.
+//
+// Reference: dmlc-core's C++ RecordIO (include/dmlc/recordio.h,
+// src/recordio.cc) and the C++ iterator tier (src/io/iter_csv.cc) —
+// SURVEY.md §2.1 dmlc-core + Data iterators rows.  The TPU build keeps
+// compute on XLA, but the host-side input path (record scanning, framed
+// reads, CSV tokenizing) is byte-churning work Python does slowly; this
+// library is that tier, exposed over a plain C ABI consumed via ctypes
+// (mxnet_tpu/lib/nativelib.py), with the pure-Python implementation as
+// the always-available fallback.
+//
+// Format (byte-compatible with mxnet_tpu/recordio.py and dmlc):
+//   [magic:u32 LE][lrec:u32 LE][payload][pad to 4B]
+//   lrec = cflag<<29 | len ; multipart cflags 1/2/3 re-join with the
+//   magic word (payloads containing the magic are split on write).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <cstdlib>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+constexpr uint32_t kLenMask = (1u << 29) - 1;
+
+struct Reader {
+  FILE* f = nullptr;
+  int64_t size = 0;
+};
+
+inline int64_t pad4(int64_t n) { return (4 - n % 4) % 4; }
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------- reader
+void* mxrec_open(const char* path) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return nullptr;
+  auto* r = new Reader();
+  r->f = f;
+  std::fseek(f, 0, SEEK_END);
+  r->size = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  return r;
+}
+
+void mxrec_close(void* h) {
+  if (!h) return;
+  auto* r = static_cast<Reader*>(h);
+  if (r->f) std::fclose(r->f);
+  delete r;
+}
+
+// Scan the file, writing the byte offset of each *logical* record
+// (multipart = one record) into `offsets` (capacity `cap`; pass cap=0 to
+// count only).  Returns the record count, or -1 on a framing error.
+int64_t mxrec_index(void* h, int64_t* offsets, int64_t cap) {
+  auto* r = static_cast<Reader*>(h);
+  std::fseek(r->f, 0, SEEK_SET);
+  int64_t pos = 0, count = 0;
+  while (pos + 8 <= r->size) {
+    int64_t record_start = pos;
+    bool logical_start = true;
+    // walk the (possibly multipart) frame chain
+    while (true) {
+      uint32_t head[2];
+      if (std::fseek(r->f, pos, SEEK_SET) != 0) return -1;
+      if (std::fread(head, 4, 2, r->f) != 2) return count;  // EOF
+      if (head[0] != kMagic) return -1;
+      uint32_t cflag = head[1] >> 29;
+      int64_t len = head[1] & kLenMask;
+      pos += 8 + len + pad4(len);
+      if (logical_start && cflag != 0 && cflag != 1) return -1;
+      logical_start = false;
+      if (cflag == 0 || cflag == 3) break;
+    }
+    if (offsets && count < cap) offsets[count] = record_start;
+    ++count;
+  }
+  return count;
+}
+
+// Read the logical record at `offset`, re-joining multipart frames with
+// the magic word.  Returns payload length; if it exceeds `cap` nothing is
+// written and the required size is returned (call again with a bigger
+// buffer).  Returns -1 on framing errors.
+int64_t mxrec_read_at(void* h, int64_t offset, char* buf, int64_t cap) {
+  auto* r = static_cast<Reader*>(h);
+  int64_t pos = offset, total = 0;
+  bool measuring_done = false;
+  // first pass: measure; second: copy (single pass when it fits)
+  std::vector<std::pair<int64_t, int64_t>> spans;  // (file_pos, len)
+  while (true) {
+    uint32_t head[2];
+    if (std::fseek(r->f, pos, SEEK_SET) != 0) return -1;
+    if (std::fread(head, 4, 2, r->f) != 2) return -1;
+    if (head[0] != kMagic) return -1;
+    uint32_t cflag = head[1] >> 29;
+    int64_t len = head[1] & kLenMask;
+    if (!spans.empty()) total += 4;  // joining magic
+    spans.emplace_back(pos + 8, len);
+    total += len;
+    pos += 8 + len + pad4(len);
+    if (cflag == 0 || cflag == 3) break;
+  }
+  if (total > cap || !buf) return total;
+  char* out = buf;
+  for (size_t i = 0; i < spans.size(); ++i) {
+    if (i > 0) {
+      std::memcpy(out, &kMagic, 4);
+      out += 4;
+    }
+    std::fseek(r->f, spans[i].first, SEEK_SET);
+    if (std::fread(out, 1, spans[i].second, r->f) !=
+        static_cast<size_t>(spans[i].second))
+      return -1;
+    out += spans[i].second;
+  }
+  (void)measuring_done;
+  return total;
+}
+
+// ---------------------------------------------------------------- writer
+void* mxrec_create(const char* path) {
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return nullptr;
+  auto* r = new Reader();
+  r->f = f;
+  return r;
+}
+
+// Write one logical record, splitting embedded magic words into multipart
+// frames exactly like dmlc::RecordIOWriter.  Returns bytes written, -1 on
+// IO error.
+int64_t mxrec_write(void* h, const char* data, int64_t len) {
+  auto* r = static_cast<Reader*>(h);
+  // find split points at embedded magics
+  std::vector<std::pair<const char*, int64_t>> parts;
+  const char* p = data;
+  const char* end = data + len;
+  const char* part_start = p;
+  while (p + 4 <= end) {
+    uint32_t w;
+    std::memcpy(&w, p, 4);
+    if (w == kMagic) {
+      parts.emplace_back(part_start, p - part_start);
+      p += 4;
+      part_start = p;
+    } else {
+      ++p;
+    }
+  }
+  parts.emplace_back(part_start, end - part_start);
+  int64_t written = 0;
+  const size_t n = parts.size();
+  for (size_t i = 0; i < n; ++i) {
+    uint32_t cflag = 0;
+    if (n > 1) cflag = (i == 0) ? 1 : (i == n - 1 ? 3 : 2);
+    int64_t plen = parts[i].second;
+    uint32_t lrec = (cflag << 29) | static_cast<uint32_t>(plen);
+    if (std::fwrite(&kMagic, 4, 1, r->f) != 1) return -1;
+    if (std::fwrite(&lrec, 4, 1, r->f) != 1) return -1;
+    if (plen && std::fwrite(parts[i].first, 1, plen, r->f) !=
+                    static_cast<size_t>(plen))
+      return -1;
+    static const char zeros[4] = {0, 0, 0, 0};
+    int64_t pad = pad4(plen);
+    if (pad && std::fwrite(zeros, 1, pad, r->f) !=
+                   static_cast<size_t>(pad))
+      return -1;
+    written += 8 + plen + pad;
+  }
+  return written;
+}
+
+// ------------------------------------------------------------------- csv
+// Count values and rows of a comma/newline-separated float file.
+// Returns rows; *n_vals gets the total value count; -1 on open failure.
+int64_t mxcsv_shape(const char* path, int64_t* n_vals) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  int64_t rows = 0, vals = 0;
+  bool in_field = false, line_had_data = false;
+  int c;
+  char bufc[1 << 16];
+  size_t got;
+  while ((got = std::fread(bufc, 1, sizeof bufc, f)) > 0) {
+    for (size_t i = 0; i < got; ++i) {
+      c = bufc[i];
+      if (c == ',' || c == '\n') {
+        if (in_field) ++vals;
+        in_field = false;
+        if (c == '\n') {
+          if (line_had_data) ++rows;
+          line_had_data = false;
+        }
+      } else if (c != '\r' && c != ' ' && c != '\t') {
+        in_field = true;
+        line_had_data = true;
+      }
+    }
+  }
+  if (in_field) ++vals;
+  if (line_had_data) ++rows;
+  std::fclose(f);
+  *n_vals = vals;
+  return rows;
+}
+
+// Parse floats into `out` (capacity cap).  Returns values parsed, -1 on
+// open failure, -2 on overflow, -3 on a non-numeric field (e.g. a CSV
+// header) — callers must fail loudly, matching np.loadtxt's ValueError.
+int64_t mxcsv_parse(const char* path, float* out, int64_t cap) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  // stream with a field buffer: fields never exceed 64 chars for floats
+  char field[64];
+  int flen = 0;
+  int64_t n = 0;
+  char bufc[1 << 16];
+  size_t got;
+  int err = 0;
+  auto flush = [&]() -> bool {
+    if (flen == 0) return true;
+    field[flen] = 0;
+    if (n >= cap) { err = -2; return false; }
+    char* endp = nullptr;
+    float v = std::strtof(field, &endp);
+    // trailing spaces are fine; any other unconsumed char is not a float
+    while (endp && (*endp == ' ' || *endp == '\t')) ++endp;
+    if (endp == field || (endp && *endp != 0)) { err = -3; return false; }
+    out[n++] = v;
+    flen = 0;
+    return true;
+  };
+  while ((got = std::fread(bufc, 1, sizeof bufc, f)) > 0) {
+    for (size_t i = 0; i < got; ++i) {
+      char c = bufc[i];
+      if (c == ',' || c == '\n' || c == '\r') {
+        if (!flush()) { std::fclose(f); return err; }
+      } else if (flen < 63) {
+        field[flen++] = c;
+      }
+    }
+  }
+  bool ok = flush();
+  std::fclose(f);
+  return ok ? n : err;
+}
+
+int mxnative_abi_version() { return 1; }
+
+}  // extern "C"

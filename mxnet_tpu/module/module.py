@@ -153,9 +153,13 @@ class Module(BaseModule):
             self.logger.warning(
                 "bind(force_rebind): parameters %s changed shape; "
                 "re-initialized with the default initializer", mismatched)
+            # Initializer.__call__ name-dispatch sends aux names
+            # (moving_mean/moving_var/gamma/beta) to zeros/ones, so this
+            # is safe for aux statistics too
             default_init = init_mod.Uniform(0.01)
             for n in mismatched:
-                arr = self._exec.arg_dict.get(n) or self._exec.aux_dict[n]
+                arr = self._exec.arg_dict[n] if n in self._exec.arg_dict \
+                    else self._exec.aux_dict[n]
                 default_init(InitDesc(n), arr)
 
     # ---------------------------------------------------------------- params

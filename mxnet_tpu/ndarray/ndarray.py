@@ -114,9 +114,16 @@ class NDArray:
             return current_context()
         dev = self._data.device
         plat = getattr(dev, "platform", "cpu")
+        # index into the LOCAL device list: under jax.distributed, global
+        # device ids are offset per process (worker 1's first cpu device
+        # is id 2048) while Context numbering is per-process
         if plat == "cpu":
-            return Context("cpu", dev.id)
-        accel = [d for d in jax.devices() if d.platform != "cpu"]
+            try:
+                idx = jax.local_devices(backend="cpu").index(dev)
+            except (ValueError, RuntimeError):
+                idx = 0
+            return Context("cpu", idx)
+        accel = [d for d in jax.local_devices() if d.platform != "cpu"]
         try:
             idx = accel.index(dev)
         except ValueError:

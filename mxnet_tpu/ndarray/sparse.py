@@ -169,6 +169,12 @@ class CSRNDArray(BaseSparseNDArray):
 
     def __getitem__(self, key):
         if isinstance(key, int):
+            nrows = self._sparse_shape[0]
+            if key < 0:
+                key += nrows
+            if not 0 <= key < nrows:
+                raise MXNetError(f"row index {key} out of range "
+                                 f"for {self.shape}")
             key = slice(key, key + 1)
         if not isinstance(key, slice) or key.step not in (None, 1):
             raise MXNetError("CSR supports only contiguous row slicing")

@@ -1,0 +1,120 @@
+"""Native C++ IO library tests: build, byte-compat with the python
+RecordIO implementation, CSV parser, and the io-tier wiring."""
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+from mxnet_tpu.lib import nativelib
+
+pytestmark = pytest.mark.skipif(
+    not nativelib.available(),
+    reason="native toolchain unavailable (python fallback covers behavior)")
+
+_MAGIC = struct.pack("<I", 0xCED7230A)
+
+
+class TestNativeRecordIO:
+    def test_roundtrip_including_multipart(self, tmp_path):
+        path = str(tmp_path / "t.rec")
+        payloads = [b"hello", b"x" * 1000, _MAGIC + b"lead",
+                    b"a" + _MAGIC + b"b" + _MAGIC + b"c", b""]
+        w = nativelib.NativeRecordWriter(path)
+        for p in payloads:
+            w.write(p)
+        w.close()
+        r = nativelib.NativeRecordReader(path)
+        offs = r.index()
+        assert len(offs) == len(payloads)
+        assert [r.read_at(o) for o in offs] == payloads
+
+    def test_native_write_python_read(self, tmp_path):
+        path = str(tmp_path / "t.rec")
+        payloads = [b"one", _MAGIC * 3, b"two" + _MAGIC]
+        w = nativelib.NativeRecordWriter(path)
+        for p in payloads:
+            w.write(p)
+        w.close()
+        rd = recordio.MXRecordIO(path, "r")
+        got = []
+        while True:
+            s = rd.read()
+            if s is None:
+                break
+            got.append(s)
+        assert got == payloads
+
+    def test_python_write_native_read(self, tmp_path):
+        path = str(tmp_path / "t.rec")
+        payloads = [b"alpha", b"beta" + _MAGIC + b"gamma"]
+        wr = recordio.MXRecordIO(path, "w")
+        for p in payloads:
+            wr.write(p)
+        wr.close()
+        r = nativelib.NativeRecordReader(path)
+        assert [r.read_at(o) for o in r.index()] == payloads
+
+    def test_corrupt_file_detected(self, tmp_path):
+        path = str(tmp_path / "bad.rec")
+        with open(path, "wb") as f:
+            f.write(b"\x00" * 64)
+        r = nativelib.NativeRecordReader(path)
+        with pytest.raises(IOError):
+            r.index()
+
+
+class TestNativeCSV:
+    def test_parse_matches_numpy(self, tmp_path):
+        path = str(tmp_path / "d.csv")
+        rng = np.random.RandomState(0)
+        ref = rng.randn(20, 7).astype(np.float32)
+        np.savetxt(path, ref, delimiter=",", fmt="%.6g")
+        out = nativelib.csv_load(path)
+        ref2 = np.loadtxt(path, delimiter=",", dtype=np.float32, ndmin=2)
+        np.testing.assert_array_equal(out, ref2)
+
+    def test_csviter_uses_native(self, tmp_path):
+        from mxnet_tpu.io import CSVIter
+        path = str(tmp_path / "d.csv")
+        lpath = str(tmp_path / "l.csv")
+        data = np.arange(24, dtype=np.float32).reshape(6, 4)
+        np.savetxt(path, data, delimiter=",", fmt="%g")
+        np.savetxt(lpath, np.arange(6, dtype=np.float32), delimiter=",",
+                   fmt="%g")
+        it = CSVIter(path, (4,), label_csv=lpath, batch_size=3)
+        batch = next(it)
+        np.testing.assert_array_equal(batch.data[0].asnumpy(), data[:3])
+
+    def test_header_csv_raises(self, tmp_path):
+        path = str(tmp_path / "h.csv")
+        with open(path, "w") as f:
+            f.write("x,y,z\n1,2,3\n")
+        with pytest.raises(ValueError):
+            nativelib.csv_load(path)
+
+    def test_runtime_reports_native_io(self):
+        feats = mx.runtime.Features()
+        assert feats.is_enabled("NATIVE_IO")
+
+
+class TestImageRecordIterNativeScan:
+    def test_no_idx_scan_uses_native(self, tmp_path):
+        import cv2
+        from mxnet_tpu.io import ImageRecordIter
+        rec_path = str(tmp_path / "imgs.rec")
+        w = recordio.MXRecordIO(rec_path, "w")
+        rng = np.random.RandomState(0)
+        for i in range(10):
+            img = rng.randint(0, 255, (20, 20, 3)).astype(np.uint8)
+            header = recordio.IRHeader(0, float(i % 3), i, 0)
+            w.write(recordio.pack_img(header, img, img_fmt=".png"))
+        w.close()
+        it = ImageRecordIter(rec_path, (3, 16, 16), batch_size=5)
+        assert it._native is not None          # C++ scanner active
+        batch = it.next()
+        assert batch.data[0].shape == (5, 3, 16, 16)
+        labels = batch.label[0].asnumpy()
+        assert set(labels) <= {0.0, 1.0, 2.0}

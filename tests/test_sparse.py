@@ -56,6 +56,9 @@ class TestCSR:
         sl = csr[2:6]
         assert sl.stype == "csr"
         np.testing.assert_allclose(sl.asnumpy(), dense[2:6])
+        np.testing.assert_allclose(csr[-1].asnumpy(), dense[-1:])
+        with pytest.raises(mx.MXNetError):
+            csr[8]
 
     def test_dense_op_fallback(self):
         """Ops without sparse kernels densify transparently."""
