@@ -2,5 +2,6 @@
 (reference: ``python/mxnet/contrib/`` — SURVEY.md 2.2 contrib row).
 """
 from . import amp
+from . import quantization
 
-__all__ = ["amp"]
+__all__ = ["amp", "quantization"]

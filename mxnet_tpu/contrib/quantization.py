@@ -1,0 +1,501 @@
+"""INT8 post-training quantization frontend.
+
+Reference surface: ``python/mxnet/contrib/quantization.py`` —
+``quantize_model`` (symbolic graph pass), ``quantize_net`` (Gluon),
+naive min/max and KL-divergence ("entropy") calibration
+(``_get_optimal_threshold``, ``_LayerOutputCollector``) — SURVEY.md 2.2
+contrib row; op layer in ops/quantization.py.
+
+TPU-native notes: quantized compute runs int8×int8→int32 on the MXU
+(ops/quantization.py); the quantize/dequantize sandwich around each layer
+is elementwise jnp that XLA fuses away, so a quantized layer is a single
+fused kernel.  Only signed int8 is supported (uint8 buys nothing on TPU).
+"""
+from __future__ import annotations
+
+import fnmatch
+import logging
+from collections import OrderedDict
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["quantize_net", "quantize_model", "quantize_graph",
+           "CalibrationCollector", "calib_graph"]
+
+
+# ---------------------------------------------------------------------------
+# Calibration
+# ---------------------------------------------------------------------------
+
+def _get_optimal_threshold(hist, hist_edges, num_quantized_bins=255):
+    """KL-divergence threshold search (reference:
+    quantization.py _get_optimal_threshold / _smooth_distribution).
+
+    ``hist`` is a symmetric histogram of absolute activations around 0.
+    Returns the |threshold| minimizing KL(P || Q) between the clipped fp32
+    distribution P and its num_quantized_bins-level quantization Q.
+    """
+    num_bins = len(hist)
+    zero_bin = num_bins // 2
+    thresholds = []
+    divergences = []
+    # candidate thresholds: growing symmetric windows around the zero bin
+    for i in range(num_quantized_bins // 2 + 1, zero_bin + 1):
+        p_start, p_stop = zero_bin - i, zero_bin + i + 1
+        threshold = hist_edges[p_stop]
+        sliced = hist[p_start:p_stop].astype(np.float64)
+        p = sliced.copy()
+        # outliers are clipped into the boundary bins
+        p[0] += hist[:p_start].sum()
+        p[-1] += hist[p_stop:].sum()
+        is_nonzero = p != 0
+        # quantize the window into num_quantized_bins buckets
+        num_merged = len(sliced) // num_quantized_bins
+        q = np.zeros(len(p), np.float64)
+        for j in range(num_quantized_bins):
+            start = j * num_merged
+            stop = (j + 1) * num_merged if j != num_quantized_bins - 1 \
+                else len(sliced)
+            seg = sliced[start:stop]
+            nz = (seg != 0).sum()
+            if nz:
+                q[start:stop] = np.where(seg != 0, seg.sum() / nz, 0.0)
+        p /= max(p.sum(), 1e-12)
+        qs = q.sum()
+        if qs == 0:
+            continue
+        q /= qs
+        q[q == 0] = 1e-10
+        kl = float(np.sum(p[is_nonzero]
+                          * np.log(p[is_nonzero] / q[is_nonzero])))
+        thresholds.append(float(threshold))
+        divergences.append(kl)
+    if not thresholds:
+        return float(hist_edges[-1])
+    return thresholds[int(np.argmin(divergences))]
+
+
+class CalibrationCollector:
+    """Accumulates per-tensor calibration statistics across batches
+    (reference: _LayerOutputMinMaxCollector / _LayerHistogramCollector)."""
+
+    def __init__(self, mode="naive", num_bins=8001):
+        if mode not in ("naive", "entropy"):
+            raise MXNetError(f"calib_mode must be naive|entropy, got {mode}")
+        self.mode = mode
+        self.num_bins = num_bins
+        self.min_max = OrderedDict()        # name -> (min, max)
+        self.hists = OrderedDict()          # name -> (hist, edges)
+
+    def collect(self, name, arr):
+        a = np.asarray(arr, np.float32)
+        mn, mx = float(a.min()), float(a.max())
+        old = self.min_max.get(name)
+        if old is not None:
+            mn, mx = min(mn, old[0]), max(mx, old[1])
+        self.min_max[name] = (mn, mx)
+        if self.mode == "entropy":
+            amax = max(abs(mn), abs(mx), 1e-8)
+            prev = self.hists.get(name)
+            if prev is not None and prev[1][-1] >= amax:
+                hist, edges = np.histogram(a, bins=prev[1])
+                self.hists[name] = (prev[0] + hist, prev[1])
+            else:
+                edges = np.linspace(-amax, amax, self.num_bins + 1)
+                hist, _ = np.histogram(a, bins=edges)
+                if prev is not None:
+                    # re-bin the old histogram into the wider range
+                    centers = (prev[1][:-1] + prev[1][1:]) / 2
+                    rebin, _ = np.histogram(centers, bins=edges,
+                                            weights=prev[0])
+                    hist = hist + rebin.astype(hist.dtype)
+                self.hists[name] = (hist, edges)
+
+    def ranges(self):
+        """Final calibration ranges per collected tensor."""
+        out = OrderedDict()
+        for name, (mn, mx) in self.min_max.items():
+            if self.mode == "entropy":
+                hist, edges = self.hists[name]
+                t = _get_optimal_threshold(hist, edges)
+                out[name] = (-t, t)
+            else:
+                out[name] = (mn, mx)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Gluon path: quantize_net
+# ---------------------------------------------------------------------------
+
+def _quantize_param(p):
+    """Quantize one fp32 parameter offline → (int8 NDArray, min, max)."""
+    from .. import ndarray as nd
+    data = p.data() if hasattr(p, "data") else p
+    q, mn, mx = nd.quantize_v2(data.astype("float32"))
+    return q, mn, mx
+
+
+def _make_quantized_blocks():
+    """Defer gluon import to avoid a cycle at package import time."""
+    from ..gluon.block import HybridBlock
+
+    class QuantizedDense(HybridBlock):
+        """int8 replacement for nn.Dense built by quantize_net
+        (reference: the quantized_fully_connected subgraph)."""
+
+        def __init__(self, dense, calib_range, **kwargs):
+            super().__init__(**kwargs)
+            from .. import ndarray as nd
+            self._units = dense._units
+            self._flatten = dense._flatten
+            self._activation = dense._activation
+            self._calib = calib_range      # None = dynamic per-batch range
+            self._qweight, self._wmin, self._wmax = \
+                _quantize_param(dense.weight)
+            if dense.bias is not None:
+                self._qbias, self._bmin, self._bmax = \
+                    _quantize_param(dense.bias)
+            else:
+                self._qbias = None
+
+        def hybrid_forward(self, F, x):
+            from .. import ndarray as nd
+            if self._calib is not None:
+                qx, xmn, xmx = nd.quantize_v2(
+                    x, min_calib_range=self._calib[0],
+                    max_calib_range=self._calib[1])
+            else:
+                qx, xmn, xmx = nd.quantize_v2(x)
+            if self._qbias is not None:
+                out32, omn, omx = nd.quantized_fully_connected(
+                    qx, self._qweight, self._qbias, xmn, xmx,
+                    self._wmin, self._wmax, self._bmin, self._bmax,
+                    num_hidden=self._units, flatten=self._flatten)
+            else:
+                out32, omn, omx = nd.quantized_fully_connected(
+                    qx, self._qweight, None, xmn, xmx,
+                    self._wmin, self._wmax, None, None,
+                    num_hidden=self._units, flatten=self._flatten,
+                    no_bias=True)
+            out = nd.dequantize(out32, omn, omx)
+            if self._activation is not None:
+                out = nd.Activation(out, act_type=self._activation)
+            return out
+
+    class QuantizedConv(HybridBlock):
+        """int8 replacement for nn.Conv2D/Conv1D/Conv3D
+        (reference: the quantized_conv subgraph)."""
+
+        def __init__(self, conv, calib_range, **kwargs):
+            super().__init__(**kwargs)
+            self._kernel = conv._kernel
+            self._strides = conv._strides
+            self._padding = conv._padding
+            self._dilation = conv._dilation
+            self._groups = conv._groups
+            self._channels = conv._channels
+            self._activation = conv._activation
+            self._calib = calib_range
+            self._qweight, self._wmin, self._wmax = \
+                _quantize_param(conv.weight)
+            if conv.bias is not None:
+                self._qbias, self._bmin, self._bmax = \
+                    _quantize_param(conv.bias)
+            else:
+                self._qbias = None
+
+        def hybrid_forward(self, F, x):
+            from .. import ndarray as nd
+            if self._calib is not None:
+                qx, xmn, xmx = nd.quantize_v2(
+                    x, min_calib_range=self._calib[0],
+                    max_calib_range=self._calib[1])
+            else:
+                qx, xmn, xmx = nd.quantize_v2(x)
+            args = dict(kernel=self._kernel, stride=self._strides,
+                        dilate=self._dilation, pad=self._padding,
+                        num_filter=self._channels, num_group=self._groups)
+            if self._qbias is not None:
+                out32, omn, omx = nd.quantized_conv(
+                    qx, self._qweight, self._qbias, xmn, xmx,
+                    self._wmin, self._wmax, self._bmin, self._bmax, **args)
+            else:
+                out32, omn, omx = nd.quantized_conv(
+                    qx, self._qweight, None, xmn, xmx,
+                    self._wmin, self._wmax, None, None,
+                    no_bias=True, **args)
+            out = nd.dequantize(out32, omn, omx)
+            if self._activation is not None:
+                out = nd.Activation(out, act_type=self._activation)
+            return out
+
+    return QuantizedDense, QuantizedConv
+
+
+def _walk_candidates(block, exclude_layers, exclude_layers_match, prefix=""):
+    """Yield (parent, child_key, attr_name, layer, path) for every
+    quantizable layer (Dense / forward Conv)."""
+    from ..gluon import nn
+    for key, child in list(block._children.items()):
+        path = f"{prefix}{key}"
+        is_dense = isinstance(child, nn.Dense)
+        is_conv = isinstance(child, (nn.Conv1D, nn.Conv2D, nn.Conv3D))
+        if is_dense or is_conv:
+            name = child.name
+            if exclude_layers and name in exclude_layers:
+                continue
+            if exclude_layers_match and any(
+                    fnmatch.fnmatch(name, pat) or pat in name
+                    for pat in exclude_layers_match):
+                continue
+            attr = None
+            for k, v in block.__dict__.items():
+                if v is child:
+                    attr = k
+                    break
+            yield block, key, attr, child, path
+        else:
+            yield from _walk_candidates(child, exclude_layers,
+                                        exclude_layers_match, path + ".")
+
+
+def quantize_net(network, quantized_dtype="int8", quantize_mode="full",
+                 exclude_layers=None, exclude_layers_match=None,
+                 calib_data=None, data_shapes=None, calib_mode="none",
+                 num_calib_batches=None, ctx=None, logger=None):
+    """Quantize a Gluon network in place-of (reference: quantize_net).
+
+    calib_mode:
+      'none'    — dynamic: every batch computes its own input ranges.
+      'naive'   — min/max over ``calib_data`` batches.
+      'entropy' — KL-optimal thresholds over ``calib_data`` batches.
+    Returns the same network object with Dense/Conv children swapped for
+    int8 blocks; the original blocks' fp32 weights are quantized offline.
+    """
+    if quantized_dtype != "int8":
+        raise MXNetError("only int8 is supported (TPU has no uint8 path)")
+    logger = logger or logging.getLogger(__name__)
+    QuantizedDense, QuantizedConv = _make_quantized_blocks()
+    from ..gluon import nn
+
+    cands = list(_walk_candidates(network, exclude_layers,
+                                  exclude_layers_match))
+    if not cands:
+        raise MXNetError("quantize_net: no quantizable Dense/Conv layers "
+                         "found (or all excluded)")
+
+    calib_ranges = {}
+    if calib_mode in ("naive", "entropy"):
+        if calib_data is None:
+            raise MXNetError(f"calib_mode={calib_mode!r} needs calib_data")
+        collector = CalibrationCollector(mode=calib_mode)
+        handles = []
+        for _, _, _, layer, path in cands:
+            def mk(path):
+                def pre_hook(blk, args):
+                    collector.collect(path, args[0].asnumpy())
+                return pre_hook
+            layer._forward_pre_hooks.append(mk(path))
+            handles.append(layer)
+        try:
+            for i, batch in enumerate(calib_data):
+                if num_calib_batches is not None and i >= num_calib_batches:
+                    break
+                data = batch[0] if isinstance(batch, (list, tuple)) else batch
+                network(data)
+        finally:
+            for layer in handles:
+                layer._forward_pre_hooks.pop()
+        calib_ranges = collector.ranges()
+        logger.info("calibrated %d tensors (%s)", len(calib_ranges),
+                    calib_mode)
+    elif calib_mode != "none":
+        raise MXNetError(f"unknown calib_mode {calib_mode!r}")
+
+    n = 0
+    for parent, key, attr, layer, path in cands:
+        crange = calib_ranges.get(path)
+        if isinstance(layer, nn.Dense):
+            qblock = QuantizedDense(layer, crange)
+        else:
+            qblock = QuantizedConv(layer, crange)
+        parent._children[key] = qblock
+        if attr is not None:
+            parent.__dict__[attr] = qblock
+        n += 1
+    logger.info("quantized %d layers", n)
+    return network
+
+
+# ---------------------------------------------------------------------------
+# Symbolic path: quantize_model / quantize_graph
+# ---------------------------------------------------------------------------
+
+_QUANTIZABLE = {"FullyConnected": "_contrib_quantized_fully_connected",
+                "Convolution": "_contrib_quantized_conv"}
+
+
+def quantize_graph(sym, excluded_sym_names=(), calib_ranges=None):
+    """Rewrite a Symbol graph: each FullyConnected/Convolution becomes a
+    quantize→quantized-op→dequantize sandwich (reference: the C++
+    QuantizeGraph pass driven from quantize_model).
+
+    Returns (qsym, needed_param_transforms) where the latter maps
+    ``weight_name -> base_name`` for every weight/bias variable that
+    ``quantize_params`` must convert to int8 + range scalars.
+    """
+    from ..ops.registry import get_op
+    from ..symbol.symbol import Symbol, _SymNode, var
+
+    calib_ranges = calib_ranges or {}
+    excluded = set(excluded_sym_names)
+    mapping = {}                      # id(old node) -> new node
+    param_transforms = {}
+
+    def mapped(entry):
+        node, idx = entry
+        return (mapping[id(node)], idx)
+
+    for node in sym._topo():
+        if node.is_variable:
+            mapping[id(node)] = node
+            continue
+        new_inputs = [mapped(e) for e in node.inputs]
+        opname = node.op.name
+        if opname in _QUANTIZABLE and node.name not in excluded:
+            qop = get_op(_QUANTIZABLE[opname])
+            data_e = new_inputs[0]
+            weight_e = new_inputs[1]
+            no_bias = bool(node.kwargs.get("no_bias", False))
+            bias_e = None if no_bias or len(new_inputs) < 3 \
+                else new_inputs[2]
+            if not weight_e[0].is_variable or (
+                    bias_e is not None and not bias_e[0].is_variable):
+                # weight produced by another op — leave the node fp32
+                mapping[id(node)] = _SymNode(node.op, new_inputs,
+                                             dict(node.kwargs), node.name,
+                                             node.num_outputs)
+                continue
+            # offline-quantized weight/bias variables
+            wname = weight_e[0].name
+            param_transforms[wname] = wname
+            qw = var(wname + "_quantize")._outputs[0][0]
+            wmn = var(wname + "_min")._outputs[0][0]
+            wmx = var(wname + "_max")._outputs[0][0]
+            if bias_e is not None:
+                bname = bias_e[0].name
+                param_transforms[bname] = bname
+                qb = var(bname + "_quantize")._outputs[0][0]
+                bmn = var(bname + "_min")._outputs[0][0]
+                bmx = var(bname + "_max")._outputs[0][0]
+            # runtime-quantized data input
+            qkw = {}
+            crange = calib_ranges.get(node.name)
+            if crange is not None:
+                qkw = {"min_calib_range": float(crange[0]),
+                       "max_calib_range": float(crange[1])}
+            qdata = _SymNode(get_op("_contrib_quantize_v2"), [data_e], qkw,
+                             node.name + "_quantize", 3)
+            qinputs = [(qdata, 0),
+                       (qw, 0),
+                       (qb, 0) if bias_e is not None else (qdata, 0),
+                       (qdata, 1), (qdata, 2), (wmn, 0), (wmx, 0)]
+            qkwargs = dict(node.kwargs)
+            if bias_e is not None:
+                qinputs += [(bmn, 0), (bmx, 0)]
+            else:
+                qinputs += [(qdata, 1), (qdata, 2)]
+                qkwargs["no_bias"] = True
+            qnode = _SymNode(qop, qinputs, qkwargs,
+                             "quantized_" + node.name, 3)
+            deq = _SymNode(get_op("_contrib_dequantize"),
+                           [(qnode, 0), (qnode, 1), (qnode, 2)], {},
+                           node.name, 1)
+            mapping[id(node)] = deq
+        else:
+            mapping[id(node)] = _SymNode(node.op, new_inputs,
+                                         dict(node.kwargs), node.name,
+                                         node.num_outputs)
+    qsym = Symbol([mapped(e) for e in sym._outputs])
+    return qsym, param_transforms
+
+
+def quantize_params(qsym, arg_params):
+    """Produce the quantized arg dict for a rewritten graph (reference:
+    quantize_params): every ``X_quantize`` variable gets int8 data plus
+    ``X_min``/``X_max`` scalars; untouched fp32 params pass through."""
+    needed = set(qsym.list_arguments())
+    out = {}
+    for name, value in arg_params.items():
+        if name + "_quantize" in needed:
+            q, mn, mx = _quantize_param(value)
+            out[name + "_quantize"] = q
+            out[name + "_min"] = mn
+            out[name + "_max"] = mx
+        elif name in needed:
+            out[name] = value
+    return out
+
+
+def calib_graph(sym, arg_params, aux_params, calib_data, data_names=("data",),
+                calib_mode="naive", num_calib_batches=None):
+    """Collect per-quantizable-node input ranges by evaluating the fp32
+    graph's internals over calibration batches (reference: the
+    collect_layer_output step of quantize_model)."""
+    collector = CalibrationCollector(mode=calib_mode)
+    internals = sym.get_internals()
+    out_names = internals.list_outputs()
+    # which internal outputs feed quantizable nodes, keyed by consumer name
+    wanted = {}                      # internal output index -> node name
+    topo = sym._topo()
+    index_of = {}
+    k = 0
+    for n in topo:
+        for i in range(n.num_outputs):
+            index_of[(id(n), i)] = k
+            k += 1
+    for node in topo:
+        if not node.is_variable and node.op.name in _QUANTIZABLE:
+            src, si = node.inputs[0]
+            wanted[index_of[(id(src), si)]] = node.name
+    for bi, batch in enumerate(calib_data):
+        if num_calib_batches is not None and bi >= num_calib_batches:
+            break
+        if not isinstance(batch, (list, tuple)):
+            batch = (batch,)
+        feed = dict(arg_params)
+        feed.update(aux_params or {})
+        feed.update(dict(zip(data_names, batch)))
+        outs = internals.eval(**feed)
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        for idx, consumer in wanted.items():
+            collector.collect(consumer, outs[idx].asnumpy())
+    return collector.ranges()
+
+
+def quantize_model(sym, arg_params, aux_params=None, data_names=("data",),
+                   ctx=None, excluded_sym_names=None, calib_mode="none",
+                   calib_data=None, num_calib_examples=None,
+                   num_calib_batches=None, quantized_dtype="int8",
+                   logger=None):
+    """Quantize a symbolic model (reference: contrib.quantization
+    .quantize_model).  Returns (qsym, qarg_params, aux_params)."""
+    if quantized_dtype != "int8":
+        raise MXNetError("only int8 is supported (TPU has no uint8 path)")
+    aux_params = aux_params or {}
+    calib_ranges = None
+    if calib_mode in ("naive", "entropy"):
+        if calib_data is None:
+            raise MXNetError(f"calib_mode={calib_mode!r} needs calib_data")
+        calib_ranges = calib_graph(sym, arg_params, aux_params, calib_data,
+                                   data_names, calib_mode,
+                                   num_calib_batches)
+    elif calib_mode != "none":
+        raise MXNetError(f"unknown calib_mode {calib_mode!r}")
+    qsym, _ = quantize_graph(sym, excluded_sym_names or (), calib_ranges)
+    qargs = quantize_params(qsym, arg_params)
+    return qsym, qargs, aux_params

@@ -1,0 +1,230 @@
+"""INT8 quantization operators.
+
+Reference surface: ``src/operator/quantization/`` —
+``_contrib_quantize`` / ``_contrib_quantize_v2`` / ``_contrib_dequantize`` /
+``_contrib_requantize`` and the ``quantized_*`` compute ops
+(``quantized_fully_connected.cc``, ``quantized_conv.cc``,
+``quantized_pooling.cc``, ``quantized_flatten.cc``) — SURVEY.md 2.2
+contrib/quantization row.
+
+TPU-native redesign: the reference lowers these to cuDNN/oneDNN int8
+primitives; here the int8 GEMM/conv lower to ``lax.dot_general`` /
+``lax.conv_general_dilated`` with ``preferred_element_type=int32`` so XLA
+drives the MXU in its native 8-bit multiply / 32-bit accumulate mode.
+Quantize/dequantize are elementwise jnp that XLA fuses into the adjacent
+op, so a quantize→gemm→dequantize sandwich is one kernel, not three.
+
+Range convention (matches the reference's signed-int8 path): a tensor with
+calibration range [min_r, max_r] uses the symmetric scale
+``s = max(|min_r|, |max_r|) / 127`` and stores ``round(x / s)`` clipped to
+[-127, 127]; int32 accumulators carry range ±(2^31-1)·s_a·s_b.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+INT8_MAX = 127.0
+INT32_MAX = 2147483647.0
+
+
+def _sym_scale(min_r, max_r):
+    """Symmetric int8 scale for a calibration range.
+
+    A degenerate [0, 0] range (all-zero tensor — dead ReLU batch,
+    zero-init param) gets scale 1/127 instead of 0: quantized values are
+    still exactly 0, and downstream scale divisions (bias rescale,
+    dequantize) stay finite instead of producing NaN/inf.
+    """
+    amax = jnp.maximum(jnp.abs(min_r), jnp.abs(max_r))
+    return jnp.where(amax > 0, amax, 1.0) / INT8_MAX
+
+
+@register("_contrib_quantize", num_inputs=3, num_outputs=3,
+          differentiable=False, aliases=["quantize"])
+def quantize(data, min_range, max_range, *, out_type: str = "int8"):
+    """fp32 → int8 with an explicit calibration range (reference:
+    quantize.cc).  Returns (qdata, min_output, max_output)."""
+    if out_type != "int8":
+        raise ValueError("only signed int8 quantization is supported "
+                         "(uint8 has no MXU advantage on TPU)")
+    mn = jnp.asarray(min_range, jnp.float32).reshape(())
+    mx = jnp.asarray(max_range, jnp.float32).reshape(())
+    scale = _sym_scale(mn, mx)
+    q = jnp.clip(jnp.round(data / scale), -INT8_MAX, INT8_MAX)
+    amax = scale * INT8_MAX
+    return q.astype(jnp.int8), -amax, amax
+
+
+@register("_contrib_quantize_v2", num_outputs=3, differentiable=False,
+          aliases=["quantize_v2"])
+def quantize_v2(data, *, out_type: str = "int8", min_calib_range=None,
+                max_calib_range=None):
+    """fp32 → int8; range from calibration if given, else from the data
+    itself (reference: quantize_v2.cc)."""
+    if min_calib_range is not None and max_calib_range is not None:
+        mn = jnp.float32(min_calib_range)
+        mx = jnp.float32(max_calib_range)
+    else:
+        mn = jnp.min(data).astype(jnp.float32)
+        mx = jnp.max(data).astype(jnp.float32)
+    return quantize(data, mn, mx, out_type=out_type)
+
+
+@register("_contrib_dequantize", num_inputs=3, differentiable=False,
+          aliases=["dequantize"])
+def dequantize(qdata, min_range, max_range, *, out_type: str = "float32"):
+    """int8/int32 → fp32 (reference: dequantize.cc)."""
+    mn = jnp.asarray(min_range, jnp.float32).reshape(())
+    mx = jnp.asarray(max_range, jnp.float32).reshape(())
+    qmax = INT8_MAX if qdata.dtype == jnp.int8 else INT32_MAX
+    scale = jnp.maximum(jnp.abs(mn), jnp.abs(mx)) / qmax
+    return qdata.astype(jnp.float32) * scale
+
+
+@register("_contrib_requantize", num_inputs=3, num_outputs=3,
+          differentiable=False, aliases=["requantize"])
+def requantize(qdata, min_range, max_range, *, min_calib_range=None,
+               max_calib_range=None):
+    """int32 → int8, narrowing to the calibrated (or observed) output range
+    (reference: requantize.cc)."""
+    mn = jnp.asarray(min_range, jnp.float32).reshape(())
+    mx = jnp.asarray(max_range, jnp.float32).reshape(())
+    in_scale = jnp.maximum(jnp.abs(mn), jnp.abs(mx)) / INT32_MAX
+    real = qdata.astype(jnp.float32) * in_scale
+    if min_calib_range is not None and max_calib_range is not None:
+        omn = jnp.float32(min_calib_range)
+        omx = jnp.float32(max_calib_range)
+    else:
+        omn = jnp.min(real)
+        omx = jnp.max(real)
+    out_scale = _sym_scale(omn, omx)
+    q = jnp.clip(jnp.round(real / out_scale), -INT8_MAX, INT8_MAX)
+    amax = out_scale * INT8_MAX
+    return q.astype(jnp.int8), -amax, amax
+
+
+def _int32_range(min_a, max_a, min_b, max_b):
+    """Output range metadata for an int8×int8→int32 accumulation."""
+    s = _sym_scale(min_a, max_a) * _sym_scale(min_b, max_b)
+    amax = s * INT32_MAX
+    return -amax, amax
+
+
+def _rescale_bias(bias_q, min_bias, max_bias, out_scale):
+    """int8 bias → int32-accumulator units (reference: the FC kernel's
+    bias shift in quantized_fully_connected.cc)."""
+    s_b = _sym_scale(jnp.asarray(min_bias, jnp.float32),
+                     jnp.asarray(max_bias, jnp.float32))
+    return jnp.round(bias_q.astype(jnp.float32) * (s_b / out_scale)
+                     ).astype(jnp.int32)
+
+
+@register("_contrib_quantized_fully_connected", num_inputs=9, num_outputs=3,
+          differentiable=False, aliases=["quantized_fully_connected"])
+def quantized_fully_connected(data, weight, bias, min_data, max_data,
+                              min_weight, max_weight, min_bias, max_bias, *,
+                              num_hidden: int = 0, no_bias: bool = False,
+                              flatten: bool = True):
+    """int8 FC: int8×int8 → int32 on the MXU
+    (reference: quantized_fully_connected.cc).  Inputs follow the reference
+    9-tensor convention; returns (out_int32, min_out, max_out)."""
+    x = data.reshape(data.shape[0], -1) if flatten else data
+    out = lax.dot_general(x, weight,
+                          (((x.ndim - 1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    mn_d = jnp.asarray(min_data, jnp.float32).reshape(())
+    mx_d = jnp.asarray(max_data, jnp.float32).reshape(())
+    mn_w = jnp.asarray(min_weight, jnp.float32).reshape(())
+    mx_w = jnp.asarray(max_weight, jnp.float32).reshape(())
+    omn, omx = _int32_range(mn_d, mx_d, mn_w, mx_w)
+    if not no_bias and bias is not None:
+        out_scale = _sym_scale(mn_d, mx_d) * _sym_scale(mn_w, mx_w)
+        out = out + _rescale_bias(bias, min_bias, max_bias, out_scale)
+    return out, omn, omx
+
+
+@register("_contrib_quantized_conv", num_inputs=9, num_outputs=3,
+          differentiable=False, aliases=["quantized_conv"])
+def quantized_conv(data, weight, bias, min_data, max_data, min_weight,
+                   max_weight, min_bias, max_bias, *, kernel=(), stride=(),
+                   dilate=(), pad=(), num_filter: int = 0,
+                   num_group: int = 1, no_bias: bool = False,
+                   layout: str = "NCHW"):
+    """int8 conv: 8-bit multiply / 32-bit accumulate
+    (reference: quantized_conv.cc)."""
+    ndim = data.ndim - 2
+    stride = tuple(stride) or (1,) * ndim
+    dilate = tuple(dilate) or (1,) * ndim
+    pad = tuple(pad) or (0,) * ndim
+    out = lax.conv_general_dilated(
+        data, weight, window_strides=stride,
+        padding=[(p, p) for p in pad], rhs_dilation=dilate,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    mn_d = jnp.asarray(min_data, jnp.float32).reshape(())
+    mx_d = jnp.asarray(max_data, jnp.float32).reshape(())
+    mn_w = jnp.asarray(min_weight, jnp.float32).reshape(())
+    mx_w = jnp.asarray(max_weight, jnp.float32).reshape(())
+    omn, omx = _int32_range(mn_d, mx_d, mn_w, mx_w)
+    if not no_bias and bias is not None:
+        out_scale = _sym_scale(mn_d, mx_d) * _sym_scale(mn_w, mx_w)
+        b = _rescale_bias(bias, min_bias, max_bias, out_scale)
+        out = out + b.reshape((1, -1) + (1,) * ndim)
+    return out, omn, omx
+
+
+@register("_contrib_quantized_pooling", num_inputs=3, num_outputs=3,
+          differentiable=False, aliases=["quantized_pooling"])
+def quantized_pooling(data, min_data, max_data, *, kernel=(), stride=(),
+                      pad=(), pool_type: str = "max",
+                      global_pool: bool = False):
+    """Pooling straight on int8 — range is preserved
+    (reference: quantized_pooling.cc)."""
+    ndim = data.ndim - 2
+    if global_pool:
+        kernel = data.shape[2:]
+        stride = (1,) * ndim
+        pad = (0,) * ndim
+    stride = tuple(stride) or (1,) * ndim
+    pad = tuple(pad) or (0,) * ndim
+    dims = (1, 1) + tuple(kernel)
+    strides = (1, 1) + tuple(stride)
+    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    if pool_type == "max":
+        out = lax.reduce_window(data, jnp.iinfo(jnp.int8).min, lax.max,
+                                dims, strides, padding)
+    elif pool_type == "avg":
+        s = lax.reduce_window(data.astype(jnp.int32), 0, lax.add,
+                              dims, strides, padding)
+        n = 1
+        for k in kernel:
+            n *= int(k)
+        out = (s // n).astype(jnp.int8)
+    else:
+        raise ValueError(f"unsupported quantized pool_type {pool_type!r}")
+    return out, jnp.asarray(min_data, jnp.float32).reshape(()), \
+        jnp.asarray(max_data, jnp.float32).reshape(())
+
+
+@register("_contrib_quantized_flatten", num_inputs=3, num_outputs=3,
+          differentiable=False, aliases=["quantized_flatten"])
+def quantized_flatten(data, min_data, max_data):
+    """Flatten on int8 (reference: quantized_flatten.cc)."""
+    return (data.reshape(data.shape[0], -1),
+            jnp.asarray(min_data, jnp.float32).reshape(()),
+            jnp.asarray(max_data, jnp.float32).reshape(()))
+
+
+@register("_contrib_quantized_act", num_inputs=3, num_outputs=3,
+          differentiable=False, aliases=["quantized_act"])
+def quantized_act(data, min_data, max_data, *, act_type: str = "relu"):
+    """ReLU on int8: clamp at zero, range maps to [0, max]
+    (reference: quantized_activation.cc)."""
+    if act_type != "relu":
+        raise ValueError("only relu is supported on the int8 path")
+    mn = jnp.asarray(min_data, jnp.float32).reshape(())
+    mx = jnp.asarray(max_data, jnp.float32).reshape(())
+    return jnp.maximum(data, 0), jnp.zeros_like(mn), jnp.maximum(mx, 0.0)

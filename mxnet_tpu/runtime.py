@@ -39,6 +39,7 @@ def _detect() -> Dict[str, bool]:
         "SIGNAL_HANDLER": True,
         "PALLAS": _pallas_enabled(),
         "BF16": True,
+        "INT8_QUANTIZATION": True,   # ops/quantization.py int8 MXU path
         "NATIVE_IO": False,     # flipped true when the C++ recordio lib loads
     }
     try:

@@ -1,0 +1,167 @@
+"""INT8 quantization tests (reference test strategy:
+tests/python/quantization/test_quantization.py — SURVEY.md 4)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon
+from mxnet_tpu.contrib import quantization as qt
+from mxnet_tpu.gluon import nn
+
+
+def test_quantize_dequantize_roundtrip():
+    x = nd.random.uniform(-3, 3, shape=(16, 32))
+    q, mn, mxr = nd.quantize_v2(x)
+    assert str(q.dtype) == "int8"
+    back = nd.dequantize(q, mn, mxr)
+    scale = 3.0 / 127
+    assert np.abs(back.asnumpy() - x.asnumpy()).max() < scale * 1.01
+
+
+def test_quantize_with_calib_range_clips():
+    x = nd.array([[-10.0, -1.0, 0.0, 1.0, 10.0]])
+    q, mn, mxr = nd.quantize_v2(x, min_calib_range=-2.0, max_calib_range=2.0)
+    qa = q.asnumpy()
+    assert qa.min() == -127 and qa.max() == 127
+    assert float(mxr.asscalar()) == pytest.approx(2.0)
+
+
+def test_requantize_int32_to_int8():
+    x = nd.random.uniform(-1, 1, shape=(8, 8))
+    w = nd.random.uniform(-1, 1, shape=(4, 8))
+    qx, xmn, xmx = nd.quantize_v2(x)
+    qw, wmn, wmx = nd.quantize_v2(w)
+    out32, omn, omx = nd.quantized_fully_connected(
+        qx, qw, None, xmn, xmx, wmn, wmx, None, None,
+        num_hidden=4, no_bias=True)
+    q8, rmn, rmx = nd.requantize(out32, omn, omx)
+    assert str(q8.dtype) == "int8"
+    got = nd.dequantize(q8, rmn, rmx).asnumpy()
+    ref = x.asnumpy() @ w.asnumpy().T
+    assert np.abs(got - ref).max() < 0.05
+
+
+def test_quantized_conv_matches_fp32():
+    rng = np.random.RandomState(3)
+    x = nd.array(rng.uniform(-1, 1, (2, 3, 8, 8)).astype(np.float32))
+    w = nd.array(rng.uniform(-1, 1, (4, 3, 3, 3)).astype(np.float32))
+    b = nd.array(rng.uniform(-1, 1, (4,)).astype(np.float32))
+    qx, xmn, xmx = nd.quantize_v2(x)
+    qw, wmn, wmx = nd.quantize_v2(w)
+    qb, bmn, bmx = nd.quantize_v2(b)
+    out32, omn, omx = nd.quantized_conv(
+        qx, qw, qb, xmn, xmx, wmn, wmx, bmn, bmx,
+        kernel=(3, 3), pad=(1, 1), num_filter=4)
+    got = nd.dequantize(out32, omn, omx).asnumpy()
+    ref = nd.Convolution(x, w, b, kernel=(3, 3), pad=(1, 1),
+                         num_filter=4).asnumpy()
+    assert np.abs(got - ref).max() < 0.2
+    assert np.corrcoef(got.ravel(), ref.ravel())[0, 1] > 0.999
+
+
+def _make_net():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1, activation="relu"),
+            nn.MaxPool2D(2),
+            nn.Dense(32, activation="relu"),
+            nn.Dense(10))
+    net.initialize()
+    return net
+
+
+@pytest.mark.parametrize("calib_mode", ["none", "naive", "entropy"])
+def test_quantize_net_close_to_fp32(calib_mode):
+    mx.random.seed(0)
+    net = _make_net()
+    x = nd.random.uniform(-1, 1, shape=(4, 3, 16, 16))
+    ref = net(x).asnumpy()
+    calib = [x] if calib_mode != "none" else None
+    qnet = qt.quantize_net(net, calib_mode=calib_mode, calib_data=calib)
+    out = qnet(x).asnumpy()
+    assert out.shape == ref.shape
+    # int8 keeps ranking/structure: high correlation, modest abs error
+    assert np.corrcoef(out.ravel(), ref.ravel())[0, 1] > 0.99
+    assert np.abs(out - ref).max() < 0.25 * max(1.0, np.abs(ref).max())
+
+
+def test_quantize_net_excludes_and_hybridize():
+    net = _make_net()
+    x = nd.random.uniform(shape=(2, 3, 16, 16))
+    ref = net(x).asnumpy()
+    # exclude every Dense layer by match -> only the conv quantizes
+    qnet = qt.quantize_net(net, exclude_layers_match=["dense"])
+    from mxnet_tpu.gluon.nn import Dense
+    denses = [b for b in qnet._children.values() if isinstance(b, Dense)]
+    assert len(denses) == 2
+    qnet.hybridize()
+    out = qnet(x).asnumpy()
+    assert np.corrcoef(out.ravel(), ref.ravel())[0, 1] > 0.99
+    out2 = qnet(x).asnumpy()          # cached-op path reuse
+    assert np.allclose(out, out2)
+
+
+def test_entropy_threshold_ignores_outlier():
+    rng = np.random.RandomState(0)
+    data = np.concatenate([rng.normal(0, 1, 100000),
+                           [1000.0]]).astype(np.float32)
+    c = qt.CalibrationCollector(mode="entropy")
+    c.collect("t", data)
+    (mn, mxr), = c.ranges().values()
+    # KL calibration clips the single huge outlier; naive would keep 1000
+    assert mxr < 100.0
+    assert mn == -mxr
+
+
+def test_quantize_model_symbolic():
+    import mxnet_tpu.symbol as sym
+    data = sym.var("data")
+    w1 = sym.var("fc1_weight")
+    b1 = sym.var("fc1_bias")
+    fc1 = sym.FullyConnected(data, w1, b1, num_hidden=16, name="fc1")
+    act = sym.Activation(fc1, act_type="relu")
+    w2 = sym.var("fc2_weight")
+    b2 = sym.var("fc2_bias")
+    out = sym.FullyConnected(act, w2, b2, num_hidden=4, name="fc2")
+
+    rng = np.random.RandomState(0)
+    args = {"fc1_weight": nd.array(rng.randn(16, 8) * 0.3),
+            "fc1_bias": nd.array(rng.randn(16) * 0.1),
+            "fc2_weight": nd.array(rng.randn(4, 16) * 0.3),
+            "fc2_bias": nd.array(rng.randn(4) * 0.1)}
+    x = nd.array(rng.randn(8, 8).astype(np.float32))
+    ref = out.eval(data=x, **args)[0].asnumpy()
+
+    qsym, qargs, _ = qt.quantize_model(out, args, calib_mode="naive",
+                                       calib_data=[x])
+    qnames = qsym.list_arguments()
+    assert "fc1_weight_quantize" in qnames
+    assert str(qargs["fc1_weight_quantize"].dtype) == "int8"
+    got = qsym.eval(data=x, **qargs)[0].asnumpy()
+    assert np.corrcoef(got.ravel(), ref.ravel())[0, 1] > 0.99
+    assert np.abs(got - ref).max() < 0.25 * max(1.0, np.abs(ref).max())
+
+
+def test_quantize_model_excluded_layer_stays_fp32():
+    import mxnet_tpu.symbol as sym
+    data = sym.var("data")
+    w1 = sym.var("w1")
+    fc1 = sym.FullyConnected(data, w1, num_hidden=8, no_bias=True,
+                             name="fc1")
+    qsym, _ = qt.quantize_graph(fc1, excluded_sym_names=["fc1"])
+    assert "w1_quantize" not in qsym.list_arguments()
+    assert "w1" in qsym.list_arguments()
+
+
+def test_zero_range_all_zero_batch_keeps_bias():
+    # dead-ReLU batch: all-zero input must not NaN/zero-poison the layer
+    dense = nn.Dense(4, in_units=3)
+    net = nn.HybridSequential()
+    net.add(dense)
+    net.initialize()
+    dense.bias.set_data(nd.array([1.0, -2.0, 3.0, 0.5]))
+    x = nd.zeros((2, 3))
+    ref = net(x).asnumpy()
+    qnet = qt.quantize_net(net)
+    out = qnet(x).asnumpy()
+    assert np.isfinite(out).all()
+    assert np.abs(out - ref).max() < 0.05, (out, ref)
