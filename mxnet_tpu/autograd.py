@@ -32,6 +32,9 @@ class _AGState(threading.local):
     def __init__(self):
         self.recording = False
         self.training = False
+        # True while a retain_graph=True backward replays: cached-program
+        # backward (CachedOp) must then keep residual buffers (no donation)
+        self.retain = False
 
 
 _STATE = _AGState()
@@ -150,6 +153,12 @@ def _topo_order(root_nodes) -> List[TapeNode]:
     return order[::-1]  # producers last -> reverse gives consumers first
 
 
+def in_retain_backward() -> bool:
+    """True while a retain_graph=True backward pass is replaying
+    (thread-local; nested backwards restore the outer value)."""
+    return _STATE.retain
+
+
 def backward(heads, head_grads=None, retain_graph: bool = False,
              train_mode: bool = True):
     """Compute gradients of ``heads`` w.r.t. all arrays that were
@@ -194,6 +203,23 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
         _accumulate(node.out_grads, out_idx, g)
         root_nodes.append(node)
 
+    prev_retain = _STATE.retain
+    _STATE.retain = bool(retain_graph)
+    try:
+        _replay(root_nodes, leaf_acc, _leaf_contribute)
+    finally:
+        _STATE.retain = prev_retain
+
+    for arr, g in leaf_acc.values():
+        _write_grad(arr, g)
+
+    # Drop tape references on heads so memory frees (reference clears AGInfo)
+    if not retain_graph:
+        for h in heads:
+            h._autograd_node = None
+
+
+def _replay(root_nodes, leaf_acc, _leaf_contribute):
     for node in _topo_order(root_nodes):
         if all(g is None for g in node.out_grads):
             continue
@@ -215,16 +241,11 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
                 _accumulate(prod.out_grads, oidx, g)
             if arr._grad_req != "null" and arr._grad is not None:
                 _leaf_contribute(arr, g)
-        if not retain_graph:
-            node.out_grads = [None] * node.n_outputs
-
-    for arr, g in leaf_acc.values():
-        _write_grad(arr, g)
-
-    # Drop tape references on heads so memory frees (reference clears AGInfo)
-    if not retain_graph:
-        for h in heads:
-            h._autograd_node = None
+        # out_grads are per-PASS accumulators: always reset after replay.
+        # retain_graph keeps the tape (nodes + saved tensors) alive for a
+        # second backward — retaining stale cotangents would instead make
+        # every later pass re-add this pass's contributions (~3x grads).
+        node.out_grads = [None] * node.n_outputs
 
 
 def _node_out_avals(node: TapeNode):
@@ -251,10 +272,17 @@ def _write_grad(arr, g):
 def grad(heads, variables, head_grads=None, retain_graph=None,
          create_graph=False, train_mode=True):
     """Return gradients of heads w.r.t. variables without touching ``.grad``
-    buffers (reference: autograd.grad).  ``create_graph`` is accepted for
-    API parity; higher-order via the tape is not supported — use the
-    hybridized path (jax.grad composition) for that."""
+    buffers (reference: autograd.grad).  Higher-order gradients via the
+    tape are not supported — ``create_graph=True`` raises instead of
+    silently returning first-order results; compose ``jax.grad`` on the
+    hybridized path for higher-order."""
+    from .base import MXNetError
     from .ndarray import NDArray
+    if create_graph:
+        raise MXNetError(
+            "autograd.grad(create_graph=True): higher-order gradients are "
+            "not supported on the imperative tape; hybridize the block and "
+            "compose jax.grad/jax.vjp for higher-order derivatives")
     if isinstance(variables, NDArray):
         variables = [variables]
         single = True
@@ -334,21 +362,30 @@ class Function:
                     igrads = [igrads]
                 return [g._data if g is not None else None for g in igrads]
 
-            entries = []
-            for a in inputs:
-                prod = a._autograd_node
-                if prod is None:
-                    entries.append((None, 0, a))
-                else:
-                    entries.append((prod[0], prod[1], a))
-            node = TapeNode(fn=None, input_entries=entries,
-                            n_outputs=len(outs),
-                            name=type(self).__name__,
-                            custom_backward=custom_backward)
-            # fn=None means _node_out_avals can't eval_shape; stash avals.
-            avals = [jax.ShapeDtypeStruct(o.shape, o._data.dtype) for o in outs]
-            node.fn = lambda *xs: tuple(
-                jax.numpy.zeros(a.shape, a.dtype) for a in avals)
-            for i, o in enumerate(outs):
-                o._autograd_node = (node, i)
+            record_custom_node(inputs, outs, custom_backward,
+                               name=type(self).__name__)
         return outs[0] if single else outs
+
+
+def record_custom_node(inputs, outputs, custom_backward, name=""):
+    """Link a TapeNode with a caller-supplied backward onto the tape
+    (shared by autograd.Function and CachedOp's recorded dispatch).
+
+    ``custom_backward(out_grads, in_primals) -> per-input grads`` replaces
+    vjp replay; output avals are stashed so backward can synthesize zero
+    cotangents for unconsumed outputs without eval_shape-ing a real fn.
+    """
+    entries = []
+    for a in inputs:
+        prod = a._autograd_node
+        entries.append((None, 0, a) if prod is None
+                       else (prod[0], prod[1], a))
+    node = TapeNode(fn=None, input_entries=entries,
+                    n_outputs=len(outputs), name=name,
+                    custom_backward=custom_backward)
+    avals = [jax.ShapeDtypeStruct(o.shape, o._data.dtype) for o in outputs]
+    node.fn = lambda *xs: tuple(
+        jax.numpy.zeros(a.shape, a.dtype) for a in avals)
+    for i, o in enumerate(outputs):
+        o._autograd_node = (node, i)
+    return node

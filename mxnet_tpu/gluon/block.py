@@ -22,6 +22,7 @@ import threading
 from collections import OrderedDict
 
 import jax
+import jax.numpy as jnp
 
 from ..base import MXNetError
 from ..context import Context, current_context
@@ -356,18 +357,60 @@ class CachedOp:
         key = NDArray(mxrand.next_key())
         all_in = [key] + list(inputs) + param_arrays
         n_out = meta["n_flat_out"] + len(meta["aux_params"])
-        fn = jitted if n_out > 1 else meta["unwrap1"]
-        opdef = OpDef(f"cached_op_{self._block.name}", fn,
-                      len(all_in), n_out, True)
-        outs = invoke(opdef, all_in, {})
-        if n_out == 1:
-            outs = [outs]
+        recording = autograd.is_recording()
+        if recording:
+            outs = self._call_recorded(meta, all_in, n_out, ctx)
+        else:
+            fn = jitted if n_out > 1 else meta["unwrap1"]
+            opdef = OpDef(f"cached_op_{self._block.name}", fn,
+                          len(all_in), n_out, True)
+            outs = invoke(opdef, all_in, {})
+            if n_out == 1:
+                outs = [outs]
         flat_outputs = outs[:meta["n_flat_out"]]
         aux_values = outs[meta["n_flat_out"]:]
-        from .. import autograd as ag
         for p, v in zip(meta["aux_params"], aux_values):
             update_aux_state(p, v, ctx=None)
         return _unflatten(flat_outputs, meta["tree"])
+
+    def _call_recorded(self, meta, all_in, n_out, ctx):
+        """Training-mode dispatch: one forward program that also emits the
+        vjp residuals, so backward is one cached program with NO forward
+        recompute (reference: CachedOp caches fwd and bwd graphs and keeps
+        the saved-tensor buffers between them)."""
+        from .. import autograd
+        for a in all_in:
+            a._var.check()
+        raw = meta["fwd_rec"](*[a._data for a in all_in])
+        vis, res = raw[:n_out], raw[n_out:]
+        outs = [NDArray(o, ctx=all_in[1].context if len(all_in) > 1
+                        else None) for o in vis]
+        consumed = [False]
+
+        def custom_backward(out_grads, in_primals, _meta=meta, _res=res):
+            if consumed[0]:
+                raise MXNetError(
+                    "backward through this hybridized graph a second "
+                    "time: the saved buffers were freed after the first "
+                    "pass — call every earlier backward with "
+                    "retain_graph=True")
+            if autograd.in_retain_backward():
+                grads = _meta["bwd_res_retain"](_res, tuple(out_grads))
+            else:
+                consumed[0] = True        # donating replay frees residuals
+                grads = _meta["bwd_res"](_res, tuple(out_grads))
+            return (None,) + tuple(grads)
+
+        autograd.record_custom_node(all_in, outs, custom_backward,
+                                    name=f"cached_op_{self._block.name}")
+        from ..engine import engine, is_naive
+        eng = engine()
+        if is_naive():
+            for o in outs:
+                o.wait_to_read()
+        for o in outs:
+            eng.track(o)
+        return outs
 
     def _build(self, inputs, param_list, sig, ctx):
         global _N_CACHED_PROGRAMS
@@ -412,6 +455,59 @@ class CachedOp:
                        *[p.data(ctx)._data for p in params])
         jitted = jax.jit(pure)
         meta["unwrap1"] = lambda *arrays: jitted(*arrays)[0]
+
+        # Training path: forward and backward as one cached program pair
+        # sharing saved residuals (reference: CachedOp caches the fwd and
+        # bwd graphs; saved tensors live between them).  The vjp closure is
+        # flattened into plain arrays to cross the jit boundary; its static
+        # treedef is captured as a trace-time side effect.  Replaying
+        # backward through this program costs zero recompute and exactly
+        # one dispatch.
+        # What the training forward saves for backward is a memory/compute
+        # dial (reference: MXNET_BACKWARD_DO_MIRROR memory mirroring):
+        #   all            — save every intermediate (vjp default; hostile
+        #                    to HBM at BERT-large scale: fp32 attention
+        #                    probs alone are GBs)
+        #   dots (default) — save matmul/conv outputs, recompute elementwise
+        #                    (XLA refuses nothing the MXU already paid for)
+        #   dots_no_batch  — save only weight-side matmuls; activation
+        #                    matmuls (attention) recompute
+        #   none           — full rematerialization, minimal memory
+        from ..base import get_env
+        policy_name = get_env("MXNET_CACHED_OP_SAVE_POLICY", "dots")
+        policies = {
+            "all": None,
+            "dots": jax.checkpoint_policies.dots_saveable,
+            "dots_no_batch":
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            "none": jax.checkpoint_policies.nothing_saveable,
+        }
+        policy = policies.get(str(policy_name), policies["dots"])
+
+        @jax.jit
+        def fwd_rec(key, *arrays):
+            fn = lambda *arr: pure(key, *arr)      # noqa: E731
+            if policy is not None:
+                fn = jax.checkpoint(fn, policy=policy)
+            outs, vjp_fn = jax.vjp(fn, *arrays)
+            flat, tree = jax.tree_util.tree_flatten(vjp_fn)
+            meta["res_tree"] = tree
+            return tuple(outs) + tuple(flat)
+
+        def bwd_impl(res, cots):
+            vjp_fn = jax.tree_util.tree_unflatten(meta["res_tree"],
+                                                  list(res))
+            # key is closed over in fwd_rec's lambda: grads cover
+            # inputs+params only; _call_recorded prepends None for the key
+            return vjp_fn(tuple(cots))
+
+        meta["fwd_rec"] = fwd_rec
+        # residuals are dead after one replay: donating them lets XLA free
+        # each saved tensor as soon as its consuming bwd op runs (the
+        # reference frees saved tensors the same way).  retain_graph=True
+        # backward uses the non-donating twin so a second replay works.
+        meta["bwd_res"] = jax.jit(bwd_impl, donate_argnums=(0,))
+        meta["bwd_res_retain"] = jax.jit(bwd_impl)
         _N_CACHED_PROGRAMS += 1
         entry = (jitted, dict(meta))
         self._cache[sig] = entry

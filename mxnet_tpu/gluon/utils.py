@@ -39,25 +39,49 @@ def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
     return [s.as_in_context(c) for s, c in zip(slices, ctx_list)]
 
 
+def _clip_global_norm_impl(datas, max_norm):
+    import jax.numpy as jnp
+    total = jnp.sqrt(sum(jnp.sum(jnp.square(d.astype(jnp.float32)))
+                         for d in datas))
+    # rescale only a finite, over-threshold norm: a nan/inf norm must leave
+    # the arrays untouched (multiplying by nan would poison every gradient;
+    # the reference's `scale < 1.0` guard is likewise nan-false)
+    scale = jnp.where(jnp.isfinite(total) & (total > max_norm),
+                      max_norm / (total + 1e-8), 1.0)
+    return [(d * scale.astype(d.dtype)) for d in datas], total
+
+
+_clip_global_norm_jit = None
+
+
 def clip_global_norm(arrays, max_norm, check_isfinite=True):
     """Rescale arrays so the global L2 norm <= max_norm
-    (reference: utils.clip_global_norm)."""
+    (reference: utils.clip_global_norm).
+
+    One fused XLA program — norm, scale, and rescale all on device.  With
+    ``check_isfinite`` there is exactly one host sync (to inspect the norm)
+    and the float norm is returned; without it the call is fully async and
+    the norm comes back as a lazy NDArray, like the reference.
+    """
+    import jax
+    global _clip_global_norm_jit
     if not arrays:
         raise MXNetError("clip_global_norm: empty array list")
-    total = 0.0
-    for a in arrays:
-        n = a.norm().asscalar()
-        total += float(n) ** 2
-    total = total ** 0.5
-    if check_isfinite and not (total < float("inf")):
-        import warnings
-        warnings.warn("nan or inf found in gradients during "
-                      "clip_global_norm")
-    scale = max_norm / (total + 1e-8)
-    if scale < 1.0:
-        for a in arrays:
-            a._set_data((a * scale)._data)
-    return total
+    if _clip_global_norm_jit is None:
+        _clip_global_norm_jit = jax.jit(_clip_global_norm_impl,
+                                        static_argnums=(1,))
+    scaled, total = _clip_global_norm_jit([a._data for a in arrays],
+                                          float(max_norm))
+    for a, s in zip(arrays, scaled):
+        a._set_data(s)
+    if check_isfinite:
+        t = float(jax.device_get(total))
+        if not (t < float("inf")):
+            import warnings
+            warnings.warn("nan or inf found in gradients during "
+                          "clip_global_norm")
+        return t
+    return NDArray(total)
 
 
 def check_sha1(filename, sha1_hash):

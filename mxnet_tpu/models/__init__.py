@@ -7,14 +7,16 @@ in-tree here since they are baseline configs (BASELINE.md configs 3-5).
 from . import transformer_blocks
 from . import bert
 from . import transformer
-from .bert import (BERTEncoder, BERTModel, BERTForPretrain, BERTForQA,
+from .bert import (BERTEncoder, BERTModel, BERTForPretrain,
+                   BERTPretrainLoss, BERTForQA,
                    BERTClassifier, bert_12_768_12, bert_24_1024_16,
                    get_bert_model)
 from .transformer import (Transformer, TransformerEncoder,
                           TransformerDecoder, transformer_base,
                           transformer_big, SmoothedSoftmaxCELoss)
 
-__all__ = ["BERTEncoder", "BERTModel", "BERTForPretrain", "BERTForQA",
+__all__ = ["BERTEncoder", "BERTModel", "BERTForPretrain",
+           "BERTPretrainLoss", "BERTForQA",
            "BERTClassifier", "bert_12_768_12", "bert_24_1024_16",
            "get_bert_model", "Transformer", "TransformerEncoder",
            "TransformerDecoder", "transformer_base", "transformer_big",

@@ -161,6 +161,29 @@ class BERTForPretrain(HybridBlock):
         return mlm_scores, nsp_scores
 
 
+class BERTPretrainLoss(HybridBlock):
+    """MLM+NSP loss fused into the traced graph (GluonNLP's pretraining
+    script computes these losses eagerly; on TPU every eager op pays a
+    dispatch round trip, so the loss belongs inside the hybridized program
+    — one forward program, one backward program for the whole step).
+    """
+
+    def __init__(self, pretrain: "BERTForPretrain", **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.pretrain = pretrain
+
+    def hybrid_forward(self, F, inputs, token_types, valid_length,
+                       masked_positions, mlm_labels, nsp_labels):
+        mlm_scores, nsp_scores = self.pretrain(
+            inputs, token_types, valid_length, masked_positions)
+        mlm_lp = F.log_softmax(mlm_scores.astype("float32"), axis=-1)
+        nsp_lp = F.log_softmax(nsp_scores.astype("float32"), axis=-1)
+        mlm_loss = 0.0 - F.pick(mlm_lp, mlm_labels, axis=-1).mean()
+        nsp_loss = 0.0 - F.pick(nsp_lp, nsp_labels, axis=-1).mean()
+        return mlm_loss + nsp_loss
+
+
 def _gather_positions(F, seq, positions):
     """seq (B, L, C), positions (B, M) -> (B, M, C)."""
     B, L, C = seq.shape

@@ -632,7 +632,48 @@ def RNN(data, parameters, state, state_cell=None, *, state_size: int = 0,
 def Correlation(data1, data2, *, kernel_size: int = 1,
                 max_displacement: int = 1, stride1: int = 1, stride2: int = 1,
                 pad_size: int = 0, is_multiply: bool = True):
-    raise NotImplementedError("Correlation: not yet implemented")
+    """FlowNet cost volume (reference: src/operator/correlation.cc).
+
+    One output channel per displacement in the stride2 grid; each is a
+    channel-summed, kernel-window-summed patch product (or abs-difference),
+    normalized by kernel_size^2 * C.  The displacement grid is static, so
+    the whole volume lowers to a fused stack of shifted multiplies + a
+    reduce_window — no gather, MXU/VPU friendly.
+    """
+    N, C, H, W = data1.shape
+    kr = (kernel_size - 1) // 2
+    border = max_displacement + kr
+    pH, pW = H + 2 * pad_size, W + 2 * pad_size
+    if pH - 2 * border < 1 or pW - 2 * border < 1:
+        raise ValueError(
+            f"Correlation: displacement border {border} "
+            f"(max_displacement + kernel radius) leaves no valid output "
+            f"for padded input {pH}x{pW}; increase pad_size or shrink "
+            f"max_displacement/kernel_size")
+    top_h = int(-(-(pH - 2 * border) // stride1))
+    top_w = int(-(-(pW - 2 * border) // stride1))
+    grid_r = max_displacement // stride2
+    sumelems = float(kernel_size * kernel_size * C)
+    pad = ((0, 0), (0, 0), (pad_size, pad_size), (pad_size, pad_size))
+    p1 = jnp.pad(data1, pad)
+    p2 = jnp.pad(data2, pad)
+    start = border - kr
+    planes = []
+    for dy in range(-grid_r * stride2, grid_r * stride2 + 1, stride2):
+        for dx in range(-grid_r * stride2, grid_r * stride2 + 1, stride2):
+            shifted = jnp.roll(p2, (-dy, -dx), axis=(2, 3))
+            prod = p1 * shifted if is_multiply else jnp.abs(p1 - shifted)
+            s = prod.sum(axis=1)
+            if kernel_size > 1:
+                s = lax.reduce_window(s, 0.0, lax.add,
+                                      (1, kernel_size, kernel_size),
+                                      (1, 1, 1), "VALID")
+            sub = lax.slice(s, (0, start, start),
+                            (N, start + (top_h - 1) * stride1 + 1,
+                             start + (top_w - 1) * stride1 + 1),
+                            (1, stride1, stride1))
+            planes.append(sub / sumelems)
+    return jnp.stack(planes, axis=1)
 
 
 @register("GridGenerator")

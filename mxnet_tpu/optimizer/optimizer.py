@@ -145,6 +145,30 @@ class Optimizer:
         else:
             self.update(index, weight, grad, state)
 
+    # ------------------------------------------------------- fused whole-model
+    # step (reference: the multi-tensor ops multi_sgd_update /
+    # multi_mp_sgd_mom_update + Trainer MXNET_OPTIMIZER_AGGREGATION_SIZE).
+    # On TPU one dispatch per parameter is the eager path's dominant cost, so
+    # optimizers that can express their update as a pure per-param kernel
+    # opt into a single XLA program covering EVERY parameter: Trainer traces
+    # `_fused_one` over all (w, g, state) triples at once.  Step-varying
+    # hypers (t, lr, wd, rescale_grad) arrive as traced scalars so the
+    # program compiles once and never retraces.
+    fused = False
+
+    def _fused_key(self):
+        """Static hypers baked into the fused program (cache key part)."""
+        return (self.clip_gradient, self.multi_precision)
+
+    def _fused_one(self, w, g, state, t, lr, wd, rescale):
+        """Pure kernel: one param's update on raw jax arrays, built from
+        the same ops/optimizer_ops.py functions the per-param path runs
+        (one source of truth for the update math).  ``state`` mirrors
+        create_state(_multi_precision)'s structure with NDArrays replaced
+        by arrays.  Step-varying hypers arrive as traced scalars.
+        Returns (new_w, new_state)."""
+        raise NotImplementedError
+
     # --------------------------------------------------------- serialization
     def __getstate__(self):
         d = self.__dict__.copy()
@@ -234,6 +258,28 @@ class SGD(Optimizer):
     def update_multi_precision(self, index, weight, grad, state):
         self.update(index, weight, grad, state)
 
+    fused = True
+
+    def _fused_key(self):
+        return super()._fused_key() + (self.momentum,)
+
+    def _fused_one(self, w, g, state, t, lr, wd, rescale):
+        from ..ops import optimizer_ops as oo
+        clip = self.clip_gradient or -1.0
+        kw = dict(lr=lr, wd=wd, rescale_grad=rescale, clip_gradient=clip)
+        if isinstance(state, tuple):            # multi-precision (w32, mom)
+            w32, mom = state
+            if mom is None:
+                wn, w32n = oo.mp_sgd_update(w, g, w32, **kw)
+                return wn, (w32n, None)
+            wn, mn, w32n = oo.mp_sgd_mom_update(w, g, mom, w32,
+                                                momentum=self.momentum, **kw)
+            return wn, (w32n, mn)
+        if state is None:
+            return oo.sgd_update(w, g, **kw), None
+        wn, mn = oo.sgd_mom_update(w, g, state, momentum=self.momentum, **kw)
+        return wn, mn
+
 
 @register
 class NAG(Optimizer):
@@ -309,6 +355,31 @@ class Adam(Optimizer):
         mean._set_data(new_m._data)
         var._set_data(new_v._data)
 
+    fused = True
+
+    def _fused_key(self):
+        return super()._fused_key() + (self.beta1, self.beta2, self.epsilon)
+
+    def _fused_one(self, w, g, state, t, lr, wd, rescale):
+        import jax.numpy as jnp
+        from ..ops import optimizer_ops as oo
+        mp = (isinstance(state, tuple) and len(state) == 2
+              and isinstance(state[1], tuple))
+        if mp:
+            w32, (m, v) = state
+            weff, geff = w32, g.astype(jnp.float32)
+        else:
+            m, v = state
+            weff, geff = w, g
+        lr_t = lr * jnp.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+        wn, mn, vn = oo.adam_update(
+            weff, geff, m, v, lr=lr_t, beta1=self.beta1, beta2=self.beta2,
+            epsilon=self.epsilon, wd=wd, rescale_grad=rescale,
+            clip_gradient=self.clip_gradient or -1.0)
+        if mp:
+            return wn.astype(w.dtype), (wn, (mn, vn))
+        return wn, (mn, vn)
+
 
 @register
 class AdamW(Optimizer):
@@ -346,6 +417,33 @@ class AdamW(Optimizer):
         weight._set_data(new_w._data)
         mean._set_data(new_m._data)
         var._set_data(new_v._data)
+
+    fused = True
+
+    def _fused_key(self):
+        return super()._fused_key() + (self.beta1, self.beta2, self.epsilon)
+
+    def _fused_one(self, w, g, state, t, lr, wd, rescale):
+        import jax.numpy as jnp
+        from ..ops import optimizer_ops as oo
+        mp = (isinstance(state, tuple) and len(state) == 2
+              and isinstance(state[1], tuple))
+        if mp:
+            w32, (m, v) = state
+            weff, geff = w32, g.astype(jnp.float32)
+        else:
+            m, v = state
+            weff, geff = w, g
+        # decoupled decay scaled by lr only; bias correction on grad term
+        # (same lr=corr / eta=lr split the per-param path feeds the op)
+        corr = jnp.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+        wn, mn, vn = oo.adamw_update(
+            weff, geff, m, v, rescale, lr=corr, eta=lr, beta1=self.beta1,
+            beta2=self.beta2, epsilon=self.epsilon, wd=wd,
+            clip_gradient=self.clip_gradient or -1.0)
+        if mp:
+            return wn.astype(w.dtype), (wn, (mn, vn))
+        return wn, (mn, vn)
 
 
 @register

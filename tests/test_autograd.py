@@ -206,3 +206,41 @@ def test_rnn_op_grad_flows():
     loss.backward()
     g = params.grad.asnumpy()
     assert np.abs(g).sum() > 0
+
+
+def test_grad_create_graph_raises():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    with pytest.raises(mx.MXNetError):
+        autograd.grad(y, [x], create_graph=True)
+
+
+def test_retain_graph_second_backward_not_accumulated():
+    # retain_graph replay must NOT re-add the first pass's cotangents
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward(retain_graph=True)
+    g1 = x.grad.asnumpy().copy()
+    y.backward()                       # second replay over the kept tape
+    g2 = x.grad.asnumpy()
+    assert np.allclose(g1, [2.0, 4.0, 6.0])
+    assert np.allclose(g2, g1)         # grad_req=write: same value again
+
+
+def test_retain_graph_hybrid_block_second_backward():
+    from mxnet_tpu.gluon import nn
+    net = nn.Dense(3, in_units=4)
+    net.initialize()
+    net.hybridize(static_alloc=True)
+    x = nd.random.uniform(shape=(2, 4))
+    x.attach_grad()
+    with autograd.record():
+        y = net(x).sum()
+    y.backward(retain_graph=True)
+    g1 = x.grad.asnumpy().copy()
+    y.backward()                       # must not hit donated residuals
+    assert np.allclose(x.grad.asnumpy(), g1, rtol=1e-5)

@@ -264,3 +264,27 @@ def test_trainer_multi_device_state_not_double_stepped():
     trainer.step(1)
     t = trainer._updater.optimizer._index_update_count[0]
     assert t == 1, t
+
+
+def test_hybrid_second_backward_raises_clear_error():
+    net = nn.Dense(3, in_units=4)
+    net.initialize()
+    net.hybridize()
+    x = nd.random.uniform(shape=(2, 4))
+    x.attach_grad()
+    with autograd.record():
+        h = net(x)
+        y1 = h.sum()
+        y2 = (h * 2).sum()
+    y1.backward(retain_graph=True)
+    g1 = x.grad.asnumpy().copy()
+    y2.backward()                       # retained residuals: fine
+    assert np.allclose(x.grad.asnumpy(), 2 * g1, rtol=1e-5)
+    # fresh pass WITHOUT retain: second replay must raise clearly
+    with autograd.record():
+        h = net(x)
+        y1 = h.sum()
+        y2 = (h * 2).sum()
+    y1.backward()
+    with pytest.raises(mx.MXNetError, match="retain_graph"):
+        y2.backward()

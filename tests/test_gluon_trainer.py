@@ -129,3 +129,105 @@ def test_split_and_load():
     assert parts[0].shape == (3, 2)
     got = np.concatenate([p.asnumpy() for p in parts])
     assert np.allclose(got, data.asnumpy())
+
+
+def test_clip_global_norm_async():
+    arrays = [nd.ones((2, 2)) * 3, nd.ones((3,)) * 4]
+    total = gluon.utils.clip_global_norm(arrays, 1.0, check_isfinite=False)
+    assert isinstance(total, nd.NDArray)
+    assert float(total.asscalar()) > 1.0
+    new_total = sum(float(a.norm().asscalar()) ** 2
+                    for a in arrays) ** 0.5
+    assert new_total < 1.01
+    # below the threshold: arrays unchanged
+    small = [nd.ones((2,)) * 0.1]
+    gluon.utils.clip_global_norm(small, 10.0)
+    assert np.allclose(small[0].asnumpy(), 0.1)
+
+
+@pytest.mark.parametrize("optname,kw", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-3}),
+    ("adam", {"learning_rate": 0.01, "wd": 1e-3}),
+    ("adamw", {"learning_rate": 0.01, "wd": 0.01}),
+])
+def test_fused_update_matches_unfused(optname, kw):
+    def build():
+        net = nn.Sequential()
+        net.add(nn.Dense(16, in_units=8), nn.Dense(4, in_units=16))
+        net.initialize(mx.init.Xavier(rnd_type="gaussian"))
+        return net
+
+    mx.random.seed(42)
+    net_a = build()
+    mx.random.seed(42)
+    net_b = build()
+    tr_a = gluon.Trainer(net_a.collect_params(), optname, dict(kw))
+    tr_b = gluon.Trainer(net_b.collect_params(), optname, dict(kw))
+    tr_b._optimizer.fused = False          # force per-param reference path
+    assert tr_a._fused_eligible()
+
+    x = nd.random.uniform(shape=(8, 8))
+    y = nd.random.uniform(shape=(8, 4))
+    for step in range(4):
+        for net, tr in ((net_a, tr_a), (net_b, tr_b)):
+            with autograd.record():
+                l = ((net(x) - y) ** 2).mean()
+            l.backward()
+            tr.step(1)
+    # zip in insertion order: global name-prefix counters (dense9_ vs
+    # dense10_) sort differently lexically, so sorted() can misalign
+    for (na, pa), (nb, pb) in zip(
+            net_a.collect_params().items(),
+            net_b.collect_params().items()):
+        assert np.allclose(pa.data().asnumpy(), pb.data().asnumpy(),
+                           rtol=1e-5, atol=1e-6), (optname, na)
+    # one compiled program, reused across the 4 steps
+    assert len(tr_a._fused_progs) == 1
+
+
+def test_fused_update_multi_precision_bf16():
+    net = nn.Sequential()
+    net.add(nn.Dense(16, in_units=8), nn.Dense(4, in_units=16))
+    net.initialize()
+    net.cast("bfloat16")
+    tr = gluon.Trainer(net.collect_params(), "adamw",
+                       {"learning_rate": 0.05, "multi_precision": True})
+    assert tr._fused_eligible()
+    x = nd.random.uniform(shape=(8, 8)).astype("bfloat16")
+    y = nd.ones((8, 4)).astype("bfloat16")
+    losses = []
+    for _ in range(20):
+        with autograd.record():
+            l = ((net(x) - y) ** 2).mean()
+        l.backward()
+        tr.step(1)
+        losses.append(float(l.asscalar()))
+    assert losses[-1] < losses[0] * 0.5
+    # fp32 master weights survive in the updater state
+    st = tr._updater.states[0]
+    assert isinstance(st, tuple) and str(st[0].dtype) == "float32"
+
+
+def test_fused_update_ineligible_falls_back():
+    net = nn.Sequential()
+    net.add(nn.Dense(4, in_units=8))
+    net.initialize()
+    for p in net.collect_params().values():
+        p.grad_req = "add"
+    tr = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 0.1})
+    assert not tr._fused_eligible()
+    x = nd.random.uniform(shape=(2, 8))
+    with autograd.record():
+        net(x).sum().backward()
+    tr.step(1)          # per-param path still works
+
+
+def test_clip_global_norm_nan_preserves_arrays():
+    a = nd.array([1.0, np.nan])
+    b = nd.array([2.0, 3.0])
+    with pytest.warns(UserWarning):
+        total = gluon.utils.clip_global_norm([a, b], 1.0)
+    assert not (total < float("inf"))
+    got = a.asnumpy()
+    assert got[0] == 1.0 and np.isnan(got[1])      # untouched, not poisoned
+    assert np.allclose(b.asnumpy(), [2.0, 3.0])

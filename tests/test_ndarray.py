@@ -219,3 +219,52 @@ def test_random_ops():
     mx.random.seed(7)
     x2 = nd.random.uniform(shape=(5,)).asnumpy()
     assert np.allclose(x1, x2)
+
+
+def _correlation_oracle(d1, d2, kernel_size, max_displacement,
+                        stride1, stride2, pad_size, is_multiply):
+    """Naive numpy reference for the Correlation cost volume."""
+    N, C, H, W = d1.shape
+    kr = (kernel_size - 1) // 2
+    border = max_displacement + kr
+    pH, pW = H + 2 * pad_size, W + 2 * pad_size
+    top_h = max(1, -(-(pH - 2 * border) // stride1))
+    top_w = max(1, -(-(pW - 2 * border) // stride1))
+    gr = max_displacement // stride2
+    gw = 2 * gr + 1
+    p1 = np.zeros((N, C, pH, pW), d1.dtype)
+    p2 = np.zeros((N, C, pH, pW), d1.dtype)
+    p1[:, :, pad_size:pad_size + H, pad_size:pad_size + W] = d1
+    p2[:, :, pad_size:pad_size + H, pad_size:pad_size + W] = d2
+    out = np.zeros((N, gw * gw, top_h, top_w), np.float32)
+    sumelems = kernel_size * kernel_size * C
+    for oy in range(gw):
+        for ox in range(gw):
+            dy, dx = (oy - gr) * stride2, (ox - gr) * stride2
+            for y in range(top_h):
+                for x in range(top_w):
+                    y1, x1 = y * stride1 + border, x * stride1 + border
+                    a = p1[:, :, y1 - kr:y1 + kr + 1, x1 - kr:x1 + kr + 1]
+                    b = p2[:, :, y1 + dy - kr:y1 + dy + kr + 1,
+                           x1 + dx - kr:x1 + dx + kr + 1]
+                    v = a * b if is_multiply else np.abs(a - b)
+                    out[:, oy * gw + ox, y, x] = v.sum((1, 2, 3)) / sumelems
+    return out
+
+
+def test_correlation_vs_oracle():
+    rng = np.random.RandomState(0)
+    for kwargs in [
+        dict(kernel_size=1, max_displacement=2, stride1=1, stride2=1,
+             pad_size=2, is_multiply=True),
+        dict(kernel_size=3, max_displacement=2, stride1=2, stride2=2,
+             pad_size=3, is_multiply=True),
+        dict(kernel_size=1, max_displacement=1, stride1=1, stride2=1,
+             pad_size=1, is_multiply=False),
+    ]:
+        d1 = rng.randn(2, 3, 8, 8).astype(np.float32)
+        d2 = rng.randn(2, 3, 8, 8).astype(np.float32)
+        got = nd.Correlation(nd.array(d1), nd.array(d2), **kwargs).asnumpy()
+        want = _correlation_oracle(d1, d2, **kwargs)
+        assert got.shape == want.shape, (got.shape, want.shape, kwargs)
+        assert np.allclose(got, want, rtol=1e-4, atol=1e-5), kwargs
