@@ -16,6 +16,7 @@ differentiates the traced program with ``jax.grad`` (see gluon/block.py).
 from __future__ import annotations
 
 import threading
+from collections import OrderedDict
 from typing import Callable, List, Optional, Sequence
 
 import jax
@@ -108,16 +109,21 @@ class TapeNode:
     """
 
     __slots__ = ("fn", "input_entries", "n_outputs", "out_grads", "name",
-                 "_pending", "custom_backward")
+                 "_pending", "custom_backward", "key")
 
     def __init__(self, fn: Callable, input_entries, n_outputs: int,
-                 name: str = "", custom_backward: Optional[Callable] = None):
+                 name: str = "", custom_backward: Optional[Callable] = None,
+                 key=None):
         self.fn = fn
         self.input_entries = input_entries
         self.n_outputs = n_outputs
         self.out_grads: List = [None] * n_outputs
         self.name = name
         self.custom_backward = custom_backward
+        # (op_name, kwargs_signature) when fn's computation is fully
+        # determined by it — lets the bulk backward cache compiled replay
+        # programs across tapes (engine bulk-exec).  None = not bulkable.
+        self.key = key
         self._pending = 0
 
 
@@ -206,7 +212,18 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
     prev_retain = _STATE.retain
     _STATE.retain = bool(retain_graph)
     try:
-        _replay(root_nodes, leaf_acc, _leaf_contribute)
+        # replay under the requested mode: mode-dependent ops (Dropout,
+        # BatchNorm) recorded in train mode must re-linearize their
+        # training branch, not the identity/predict branch (reference:
+        # MXAutogradBackwardEx train_mode argument)
+        with pause(train_mode=train_mode):
+            done = False
+            try:
+                done = _try_bulk_replay(root_nodes, _leaf_contribute)
+            except Exception:       # noqa: BLE001 — any trace/compile
+                done = False        # failure falls back to per-node replay
+            if not done:
+                _replay(root_nodes, leaf_acc, _leaf_contribute)
     finally:
         _STATE.retain = prev_retain
 
@@ -217,6 +234,145 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
     if not retain_graph:
         for h in heads:
             h._autograd_node = None
+
+
+# Compiled whole-tape backward programs keyed by tape signature
+# (engine bulk-exec mode; see _try_bulk_replay).  Bounded FIFO: variable
+# shapes (ragged batches, bucketed lengths) would otherwise pin one
+# compiled program per distinct signature forever.  A signature whose
+# program failed to compile/run maps to None (negative cache) so the
+# expensive failure isn't retried every backward.
+_BULK_BWD_CACHE = OrderedDict()
+_BULK_BWD_CACHE_CAP = 64
+
+
+def _try_bulk_replay(root_nodes, _leaf_contribute):
+    """Replay the WHOLE tape backward as one cached XLA program
+    (reference: engine bulk-exec mode, MXNET_EXEC_BULK_EXEC_TRAIN —
+    there it batches engine ops into segments; here the entire eager
+    backward becomes a single dispatch instead of 2+ per op).
+
+    Only fn-based nodes whose computation is determined by their
+    ``key`` participate; custom-backward nodes (Function, CachedOp) and
+    RNG/const-closure ops fall back to per-node replay.  The compiled
+    program is cached on the tape's structural signature (op keys,
+    topology, shapes), so steady-state training loops hit the cache.
+    Returns True when the tape was handled.
+    """
+    from .engine import engine as _eng
+    if _eng().bulk_size <= 1:
+        return False
+    nodes = _topo_order(root_nodes)
+    if len(nodes) < 2:
+        return False
+    for n in nodes:
+        if n.custom_backward is not None or n.key is None:
+            return False
+    # RNG ops participate with their per-step key as a program input
+    # (never baked into the cached program)
+    rng_keys = [getattr(n.fn, "_rng_key", None) for n in nodes]
+    node_pos = {id(n): i for i, n in enumerate(nodes)}
+    arrs, arr_pos = [], {}
+    sig_nodes = []
+    for n in nodes:
+        ents = []
+        for prod, oidx, arr in n.input_entries:
+            k = id(arr)
+            if k not in arr_pos:
+                arr_pos[k] = len(arrs)
+                arrs.append(arr)
+            ents.append((node_pos[id(prod)] if prod is not None else -1,
+                         oidx, arr_pos[k],
+                         arr._grad_req != "null" and arr._grad is not None))
+        sig_nodes.append((n.key, n.n_outputs, tuple(ents),
+                          tuple(g is not None for g in n.out_grads)))
+    # is_training() is baked into the traced program (Dropout/BatchNorm
+    # branch on it at trace time), so the effective mode is part of the key
+    sig = (tuple(sig_nodes),
+           tuple((tuple(a.shape), str(a._data.dtype)) for a in arrs),
+           is_training())
+    init = [g for n in nodes for g in n.out_grads if g is not None]
+
+    if sig in _BULK_BWD_CACHE and _BULK_BWD_CACHE[sig] is None:
+        return False                     # negative-cached failing program
+    cached = _BULK_BWD_CACHE.get(sig)
+    if cached is None:
+        from .random import trace_key_scope
+        fns = []
+        for n in nodes:
+            base = getattr(n.fn, "_rng_base", None)
+            if base is None:
+                fns.append(n.fn)
+            else:
+                def fn_k(k, *a, _f=base):
+                    with trace_key_scope(k):
+                        return _f(*a)
+                fns.append(fn_k)
+        avals = [_node_out_avals(n) for n in nodes]
+        has_rng = [rk is not None for rk in rng_keys]
+        leaf_positions = sorted({e[2] for s in sig_nodes
+                                 for e in s[2] if e[3]})
+
+        def prog_fn(arr_datas, init_gs, keys):
+            store = [[None] * s[1] for s in sig_nodes]
+            it = iter(init_gs)
+            for i, s in enumerate(sig_nodes):
+                for j, has in enumerate(s[3]):
+                    if has:
+                        store[i][j] = next(it)
+            kit = iter(keys)
+            node_keys = [next(kit) if h else None for h in has_rng]
+            leaf_g = {}
+            for i, (key, n_out, ents, _m) in enumerate(sig_nodes):
+                if all(g is None for g in store[i]):
+                    continue
+                cots = [g if g is not None
+                        else jax.numpy.zeros(av.shape, av.dtype)
+                        for g, av in zip(store[i], avals[i])]
+                primals = [arr_datas[e[2]] for e in ents]
+                if has_rng[i]:
+                    primals = [node_keys[i]] + primals
+                _, vjp_fn = jax.vjp(fns[i], *primals)
+                in_grads = vjp_fn(tuple(cots) if n_out > 1 else cots[0])
+                if has_rng[i]:
+                    in_grads = in_grads[1:]       # drop key cotangent
+                for (p, oidx, apos, is_leaf), g in zip(ents, in_grads):
+                    if g is None or \
+                            getattr(g, "dtype", None) == jax.dtypes.float0:
+                        continue
+                    if p >= 0:
+                        _accumulate(store[p], oidx, g)
+                    if is_leaf:
+                        if apos in leaf_g:
+                            leaf_g[apos] = leaf_g[apos] + g
+                        else:
+                            leaf_g[apos] = g
+            return [leaf_g.get(p) for p in leaf_positions]
+
+        cached = (jax.jit(prog_fn), leaf_positions)
+        _BULK_BWD_CACHE[sig] = cached
+        while len(_BULK_BWD_CACHE) > _BULK_BWD_CACHE_CAP:
+            _BULK_BWD_CACHE.popitem(last=False)
+
+    jitted, leaf_positions = cached
+    try:
+        outs = jitted([a._data for a in arrs], init,
+                      [rk for rk in rng_keys if rk is not None])
+    except Exception:
+        # trace/compile/run failure: blacklist this signature so every
+        # later backward doesn't re-pay the failing compile, and warn once
+        _BULK_BWD_CACHE[sig] = None
+        import logging
+        logging.getLogger(__name__).warning(
+            "bulk backward program failed for a %d-node tape; falling "
+            "back to per-node replay for this tape shape", len(nodes))
+        return False
+    for pos, g in zip(leaf_positions, outs):
+        if g is not None:
+            _leaf_contribute(arrs[pos], g)
+    for n in nodes:
+        n.out_grads = [None] * n.n_outputs
+    return True
 
 
 def _replay(root_nodes, leaf_acc, _leaf_contribute):

@@ -159,8 +159,15 @@ declare_env("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice",
             "Execution engine: 'NaiveEngine' forces synchronous op execution "
             "(debug/bisection mode); default is async (XLA/PJRT async dispatch).")
 declare_env("MXNET_SEED", None, "Global RNG seed fixed at import if set.")
-declare_env("MXNET_EXEC_BULK_EXEC_INFERENCE", 1,
-            "Allow bulking consecutive eager ops (jit fusion of op segments).")
+declare_env("MXNET_EXEC_BULK_EXEC_TRAIN", "1",
+            "Bulk-exec mode: compile the whole eager backward tape into one "
+            "cached XLA program (autograd bulk replay). Set 0 to disable.")
+declare_env("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", 15,
+            "engine.bulk_size default when bulk-exec is on; bulk backward "
+            "runs when bulk_size > 1.")
+declare_env("MXNET_CACHED_OP_SAVE_POLICY", "dots",
+            "What the hybridized training forward saves for backward: "
+            "all | dots | dots_no_batch | none (memory/recompute dial).")
 declare_env("MXNET_KVSTORE_BIGARRAY_BOUND", 1000000,
             "Arrays above this many elements get their own allreduce bucket.")
 declare_env("MXNET_PROFILER_AUTOSTART", 0, "Start profiler at import.")

@@ -64,7 +64,14 @@ class Engine:
         # id-keyed so NDArray.__eq__ (an elementwise op, reference
         # semantics) is never invoked by container bookkeeping
         self._live = weakref.WeakValueDictionary()
-        self._bulk_size = int(get_env("MXNET_EXEC_BULK_EXEC_INFERENCE", 1))
+        # bulk-exec on by default like the reference
+        # (MXNET_EXEC_BULK_EXEC_TRAIN=1, segment cap 15); =0 disables —
+        # autograd's bulk backward replay consults bulk_size > 1
+        if str(get_env("MXNET_EXEC_BULK_EXEC_TRAIN", "1")) == "0":
+            self._bulk_size = 1
+        else:
+            self._bulk_size = int(
+                get_env("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", 15))
         self._lock = threading.Lock()
 
     @classmethod

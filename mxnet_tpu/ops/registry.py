@@ -155,6 +155,26 @@ def invoke(opdef: OpDef, inputs, kwargs: Dict[str, Any], out=None):
     else:
         fn = opdef.fn
 
+    if opdef.mutates_rng:
+        # draw the op's key NOW and pin it into the closure: backward's
+        # vjp replay (and any re-execution) must see the SAME randomness
+        # as the forward (reference: resource randomness is drawn once per
+        # op), and a replay-time next_key() inside a vjp trace would leak
+        # a tracer into the global stream
+        from .. import random as mxrand
+        _fixed_key = mxrand.next_key()
+        _base_rng_fn = fn
+
+        def fn(*args, _k=_fixed_key, _f=_base_rng_fn):
+            with mxrand.trace_key_scope(_k):
+                return _f(*args)
+
+        # bulk backward re-parametrizes the key as a program INPUT so the
+        # compiled replay can be cached across steps (each step's key
+        # varies; the program must not bake one in)
+        fn._rng_base = _base_rng_fn
+        fn._rng_key = _fixed_key
+
     from .. import profiler as _prof
     t0 = _prof._now_us() if _prof._ACTIVE else None
     try:
@@ -184,6 +204,18 @@ def invoke(opdef: OpDef, inputs, kwargs: Dict[str, Any], out=None):
 
     if record:
         nd_inputs = [a for a in inputs if isinstance(a, NDArray)]
+        # a (name, kwargs) signature fully determines the computation when
+        # every positional input is an NDArray — the bulk backward keys
+        # compiled replay programs on it (None = closed-over constants,
+        # not bulkable)
+        key = None
+        if len(nd_inputs) == len(inputs):
+            try:
+                key = (opdef.name, tuple(sorted(kwargs.items())))
+                hash(key)
+            except TypeError:
+                key = (opdef.name, tuple(sorted(
+                    (k, repr(v)) for k, v in kwargs.items())))
         # fn must close over non-NDArray positional inputs as constants
         if len(nd_inputs) != len(inputs):
             idxs = [i for i, a in enumerate(inputs) if isinstance(a, NDArray)]
@@ -202,7 +234,8 @@ def invoke(opdef: OpDef, inputs, kwargs: Dict[str, Any], out=None):
             entries.append((None, 0, a) if prod is None
                            else (prod[0], prod[1], a))
         node = autograd.TapeNode(fn=fn, input_entries=entries,
-                                 n_outputs=len(outs), name=opdef.name)
+                                 n_outputs=len(outs), name=opdef.name,
+                                 key=key)
         for i, o in enumerate(outs):
             o._autograd_node = (node, i)
 

@@ -244,3 +244,66 @@ def test_retain_graph_hybrid_block_second_backward():
     g1 = x.grad.asnumpy().copy()
     y.backward()                       # must not hit donated residuals
     assert np.allclose(x.grad.asnumpy(), g1, rtol=1e-5)
+
+
+def test_bulk_backward_matches_per_node():
+    from mxnet_tpu import engine as eng
+    from mxnet_tpu.autograd import _BULK_BWD_CACHE
+    mx.random.seed(3)
+    x = nd.random.uniform(shape=(4, 6))
+    w = nd.random.uniform(shape=(6, 3))
+    x.attach_grad()
+    w.attach_grad()
+
+    def step():
+        with autograd.record():
+            h = nd.relu(nd.dot(x, w) - 0.1)
+            l = (h * h).sum()
+        l.backward()
+        return x.grad.asnumpy().copy(), w.grad.asnumpy().copy()
+
+    before = len(_BULK_BWD_CACHE)
+    gx_b, gw_b = step()
+    assert len(_BULK_BWD_CACHE) > before          # bulk path engaged
+    gx_b2, _ = step()                             # cache hit, same result
+    assert np.allclose(gx_b, gx_b2)
+    old = eng.set_bulk_size(1)                    # force per-node replay
+    try:
+        gx_p, gw_p = step()
+    finally:
+        eng.set_bulk_size(old)
+    assert np.allclose(gx_b, gx_p, rtol=1e-5, atol=1e-6)
+    assert np.allclose(gw_b, gw_p, rtol=1e-5, atol=1e-6)
+
+
+def test_eager_dropout_backward_mask_matches_forward():
+    mx.random.seed(0)
+    x = nd.ones((4000,))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Dropout(x, p=0.5, mode="always")
+    y.backward()
+    yv, g = y.asnumpy(), x.grad.asnumpy()
+    assert ((yv != 0) == (g != 0)).all()          # same mask both ways
+    assert np.allclose(g[g != 0], 2.0)            # 1/(1-p) scaling
+
+
+def test_bulk_backward_with_dropout_engages_and_varies():
+    from mxnet_tpu.autograd import _BULK_BWD_CACHE
+    mx.random.seed(5)
+    x = nd.ones((512,))
+    x.attach_grad()
+    before = len(_BULK_BWD_CACHE)
+    grads = []
+    for _ in range(2):
+        with autograd.record():
+            y = nd.Dropout(x * 2.0, p=0.5, mode="always") + 0.0
+            (y * y).sum().backward()
+        grads.append(x.grad.asnumpy().copy())
+    assert len(_BULK_BWD_CACHE) > before          # rng node didn't block bulk
+    # per-step keys are program inputs: masks (hence grads) differ
+    assert not np.allclose(grads[0], grads[1])
+    # grad consistent with its own forward mask: kept entries give
+    # dl/dx = 2y * dy/dx = (2*4x)*(2/(1-p)) = 32 at x=1, dropped give 0
+    vals = np.unique(np.round(grads[1], 4))
+    assert set(vals).issubset({0.0, 32.0}), vals
