@@ -1,0 +1,203 @@
+"""Per-operator performance harness.
+
+Reference surface: ``benchmark/opperf/opperf.py`` — time individual
+operators over representative shapes to localize regressions.  Timing
+rule on TPU: async dispatch means wall-time must bracket a
+``jax.device_get`` sync (block_until_ready is a no-op over some remote
+backends), and the first call is excluded as compile time.
+
+CLI:
+  python benchmark/opperf.py                 # default op set
+  python benchmark/opperf.py --ops dot,relu  --runs 50
+  python benchmark/opperf.py --categories nn,reduce
+
+One JSON line per op:
+  {"op": "dot", "shape": "...", "avg_ms": .., "p50_ms": .., "compile_ms": ..}
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _shapes(large):
+    b = 4 if not large else 32
+    return {
+        "elemwise": [(b, 1024, 1024)],
+        "broadcast": [((b, 1024, 1024), (1, 1024, 1))],
+        "reduce": [(b, 1024, 1024)],
+        "gemm": [((1024, 1024), (1024, 1024))],
+        "conv": [(b, 64, 56, 56)],
+        "nn": [(b, 1024)],
+        "optimizer": [(1024, 1024)],
+    }
+
+
+def _op_specs(large=False):
+    """op name -> (category, build_args_fn) where build_args_fn(nd, rng)
+    returns (args, kwargs)."""
+    S = _shapes(large)
+
+    def t(shape):
+        def mk(nd, rng):
+            return ([nd.array(rng.rand(*shape).astype(np.float32))], {})
+        return mk
+
+    def t2(shapes):
+        def mk(nd, rng):
+            return ([nd.array(rng.rand(*s).astype(np.float32))
+                     for s in shapes], {})
+        return mk
+
+    e = S["elemwise"][0]
+    bl, br = S["broadcast"][0]
+    g = S["gemm"][0]
+    c = S["conv"][0]
+    n = S["nn"][0]
+    o = S["optimizer"][0]
+    specs = {
+        # elemwise / broadcast (VPU + HBM bandwidth bound)
+        "relu": ("elemwise", t(e)),
+        "sigmoid": ("elemwise", t(e)),
+        "exp": ("elemwise", t(e)),
+        "sqrt": ("elemwise", t(e)),
+        "elemwise_add": ("elemwise", t2([e, e])),
+        "elemwise_mul": ("elemwise", t2([e, e])),
+        "broadcast_add": ("broadcast", t2([bl, br])),
+        "broadcast_mul": ("broadcast", t2([bl, br])),
+        # reductions
+        "sum": ("reduce", t(S["reduce"][0])),
+        "mean": ("reduce", t(S["reduce"][0])),
+        "max": ("reduce", t(S["reduce"][0])),
+        "argmax": ("reduce", lambda nd, rng: (
+            [nd.array(rng.rand(*S["reduce"][0]).astype(np.float32))],
+            {"axis": -1})),
+        # MXU
+        "dot": ("gemm", t2([g[0], g[1]])),
+        "batch_dot": ("gemm", lambda nd, rng: (
+            [nd.array(rng.rand(8, 512, 512).astype(np.float32)),
+             nd.array(rng.rand(8, 512, 512).astype(np.float32))], {})),
+        "FullyConnected": ("nn", lambda nd, rng: (
+            [nd.array(rng.rand(*n).astype(np.float32)),
+             nd.array(rng.rand(4096, n[1]).astype(np.float32)),
+             nd.array(rng.rand(4096).astype(np.float32))],
+            {"num_hidden": 4096})),
+        "Convolution": ("conv", lambda nd, rng: (
+            [nd.array(rng.rand(*c).astype(np.float32)),
+             nd.array(rng.rand(128, c[1], 3, 3).astype(np.float32)),
+             nd.array(rng.rand(128).astype(np.float32))],
+            {"kernel": (3, 3), "pad": (1, 1), "num_filter": 128})),
+        "Pooling": ("conv", lambda nd, rng: (
+            [nd.array(rng.rand(*c).astype(np.float32))],
+            {"kernel": (2, 2), "stride": (2, 2), "pool_type": "max"})),
+        "softmax": ("nn", lambda nd, rng: (
+            [nd.array(rng.rand(*n).astype(np.float32))], {"axis": -1})),
+        "LayerNorm": ("nn", lambda nd, rng: (
+            [nd.array(rng.rand(*n).astype(np.float32)),
+             nd.array(np.ones(n[1], np.float32)),
+             nd.array(np.zeros(n[1], np.float32))], {})),
+        # optimizer updates
+        "sgd_mom_update": ("optimizer", lambda nd, rng: (
+            [nd.array(rng.rand(*o).astype(np.float32)) for _ in range(3)],
+            {"lr": 0.1})),
+        "adam_update": ("optimizer", lambda nd, rng: (
+            [nd.array(rng.rand(*o).astype(np.float32)) for _ in range(4)],
+            {"lr": 0.001})),
+        # int8 MXU path
+        "quantized_fully_connected": ("nn", lambda nd, rng: (
+            lambda q=nd.quantize_v2(
+                nd.array(rng.rand(*n).astype(np.float32))),
+                w=nd.quantize_v2(
+                    nd.array(rng.rand(4096, n[1]).astype(np.float32))):
+            ([q[0], w[0], None, q[1], q[2], w[1], w[2], None, None],
+             {"num_hidden": 4096, "no_bias": True}))()),
+    }
+    return specs
+
+
+def time_op(name, build, warmup=2, runs=10):
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    rng = np.random.RandomState(0)
+    args, kwargs = build(nd, rng)
+    fn = getattr(nd, name)
+
+    import jax.numpy as jnp
+
+    def once(reps=1):
+        # reps async dispatches then ONE 1-element sync: amortizes the
+        # dispatch/sync round-trip latency (dominant over a remote TPU
+        # tunnel) and avoids timing the full-output host transfer
+        for _ in range(reps):
+            out = fn(*args, **kwargs)
+            if isinstance(out, (list, tuple)):
+                out = out[0]
+        jax.device_get(jnp.ravel(out._data)[:1])
+
+    t0 = time.perf_counter()
+    once()
+    compile_ms = (time.perf_counter() - t0) * 1e3
+    reps = 10
+    for _ in range(warmup):
+        once(reps)
+    samples = []
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        once(reps)
+        samples.append((time.perf_counter() - t0) * 1e3 / reps)
+    shape = "x".join(str(s) for s in args[0].shape) if args else ""
+    return {"op": name, "shape": shape,
+            "avg_ms": round(float(np.mean(samples)), 4),
+            "p50_ms": round(float(np.median(samples)), 4),
+            "min_ms": round(float(np.min(samples)), 4),
+            "compile_ms": round(compile_ms, 2)}
+
+
+def run_performance_test(ops=None, categories=None, warmup=2, runs=10,
+                         large=False):
+    """Programmatic entry (reference: opperf.run_performance_test)."""
+    specs = _op_specs(large)
+    results = []
+    for name, (cat, build) in specs.items():
+        if ops and name not in ops:
+            continue
+        if categories and cat not in categories:
+            continue
+        try:
+            results.append(time_op(name, build, warmup, runs))
+        except Exception as e:                        # noqa: BLE001
+            results.append({"op": name, "error": str(e)[:120]})
+    return results
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ops", default=None,
+                    help="comma-separated op names (default: all)")
+    ap.add_argument("--categories", default=None,
+                    help="comma-separated: elemwise,broadcast,reduce,"
+                         "gemm,conv,nn,optimizer")
+    ap.add_argument("--runs", type=int, default=10)
+    ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--large", action="store_true",
+                    help="TPU-scale shapes (default: CI-friendly)")
+    args = ap.parse_args()
+    ops = set(args.ops.split(",")) if args.ops else None
+    cats = set(args.categories.split(",")) if args.categories else None
+    for row in run_performance_test(ops, cats, args.warmup, args.runs,
+                                    args.large):
+        print(json.dumps(row))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""Multi-process data-parallel training via the launcher (reference:
+example/distributed_training + tools/launch.py local mode).
+
+  python tools/launch.py -n 2 python examples/distributed_train.py
+
+Each process computes gradients on its shard of the batch; the 'dist'
+kvstore (jax.distributed + XLA collectives) averages them.
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import mxnet_tpu as mx                                    # noqa: E402
+from mxnet_tpu import autograd, gluon, nd                 # noqa: E402
+from mxnet_tpu.gluon import nn                            # noqa: E402
+from mxnet_tpu.parallel import dist                       # noqa: E402
+
+
+def main():
+    dist.initialize()                  # reads the launcher's env handshake
+    rank, world = dist.rank(), dist.size()
+    print(f"[{rank}/{world}] up")
+
+    mx.random.seed(7)                  # same init on every worker
+    net = nn.Sequential()
+    net.add(nn.Dense(64, activation="relu", in_units=32),
+            nn.Dense(8, in_units=64))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05}, kvstore="dist")
+
+    rng = np.random.RandomState(0)     # same data stream, sharded by rank
+    X = rng.randn(256, 32).astype(np.float32)
+    Y = X @ rng.randn(32, 8).astype(np.float32)
+    shard = slice(rank * 256 // world, (rank + 1) * 256 // world)
+    xs, ys = nd.array(X[shard]), nd.array(Y[shard])
+
+    for epoch in range(20):
+        with autograd.record():
+            loss = ((net(xs) - ys) ** 2).mean()
+        loss.backward()
+        # grads are already per-sample means; the dist kvstore SUMS across
+        # workers, so rescale by world size to average
+        trainer.step(world)
+        if rank == 0 and epoch % 5 == 0:
+            print(f"epoch {epoch}: loss {float(loss.asscalar()):.5f}")
+    print(f"[{rank}] final loss {float(loss.asscalar()):.5f}")
+
+
+if __name__ == "__main__":
+    main()

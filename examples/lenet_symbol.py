@@ -1,0 +1,54 @@
+"""Train a symbolic-API MLP with the legacy Module interface (reference:
+example/image-classification/train_mnist.py symbolic path).
+
+  python examples/lenet_symbol.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import mxnet_tpu as mx                                    # noqa: E402
+import mxnet_tpu.symbol as sym                            # noqa: E402
+from mxnet_tpu import io, nd                              # noqa: E402
+from mxnet_tpu.module import Module                       # noqa: E402
+
+
+def build_symbol():
+    data = sym.var("data")
+    h = sym.FullyConnected(data, sym.var("fc1_weight"), sym.var("fc1_bias"),
+                           num_hidden=128, name="fc1")
+    h = sym.Activation(h, act_type="relu")
+    h = sym.FullyConnected(h, sym.var("fc2_weight"), sym.var("fc2_bias"),
+                           num_hidden=10, name="fc2")
+    return sym.SoftmaxOutput(h, sym.var("softmax_label"), name="softmax")
+
+
+def main():
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    # synthetic 10-class problem: linearly separable clusters
+    n = 2048
+    centers = rng.randn(10, 64).astype(np.float32) * 3
+    labels = rng.randint(0, 10, n)
+    data = centers[labels] + rng.randn(n, 64).astype(np.float32)
+
+    train_iter = io.NDArrayIter(data={"data": nd.array(data)},
+                                label={"softmax_label": nd.array(
+                                    labels.astype(np.float32))},
+                                batch_size=128, shuffle=True)
+
+    mod = Module(build_symbol(), data_names=("data",),
+                 label_names=("softmax_label",))
+    mod.fit(train_iter, num_epoch=5, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            eval_metric="acc")
+    score = mod.score(train_iter, mx.metric.Accuracy())
+    print("final accuracy:", score)
+
+
+if __name__ == "__main__":
+    main()

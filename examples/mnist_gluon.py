@@ -1,0 +1,72 @@
+"""Train LeNet on MNIST with the Gluon API (reference:
+example/gluon/mnist/mnist.py).
+
+Runs anywhere; on a machine without the MNIST files the dataset serves a
+synthetic fallback (gluon.data.vision.MNIST(...).synthetic is True).
+
+  python examples/mnist_gluon.py --epochs 2
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import mxnet_tpu as mx                                    # noqa: E402
+from mxnet_tpu import autograd, gluon, nd                 # noqa: E402
+from mxnet_tpu.gluon import nn                            # noqa: E402
+
+
+def build_net():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(20, 5, activation="relu"), nn.MaxPool2D(2, 2),
+            nn.Conv2D(50, 5, activation="relu"), nn.MaxPool2D(2, 2),
+            nn.Dense(500, activation="relu"), nn.Dense(10))
+    return net
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    args = ap.parse_args()
+
+    mx.random.seed(42)
+    train = gluon.data.vision.MNIST(train=True)
+    loader = gluon.data.DataLoader(train, batch_size=args.batch_size,
+                                   shuffle=True)
+    if train.synthetic:
+        print("note: no local MNIST files; training on the synthetic set")
+
+    net = build_net()
+    net.initialize(mx.init.Xavier())
+    net.hybridize(static_alloc=True)
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+
+    for epoch in range(args.epochs):
+        metric.reset()
+        tic = time.time()
+        for i, (x, y) in enumerate(loader):
+            x = x.astype("float32").transpose((0, 3, 1, 2)) / 255.0
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(x.shape[0])
+            metric.update(y, out)
+        name, acc = metric.get()
+        print(f"epoch {epoch}: {name}={acc:.4f} "
+              f"({time.time() - tic:.1f}s)")
+
+    net.save_parameters("lenet.params")
+    print("saved lenet.params")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,58 @@
+"""Post-training INT8 quantization of a Gluon network (reference:
+example/quantization/imagenet_gen_qsym_onedn.py — here the int8 compute
+runs on the MXU's 8-bit multiply / 32-bit accumulate path).
+
+  python examples/quantize_int8.py
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax                                                # noqa: E402
+import mxnet_tpu as mx                                    # noqa: E402
+from mxnet_tpu import nd                                  # noqa: E402
+from mxnet_tpu.contrib import quantization as qt          # noqa: E402
+from mxnet_tpu.gluon import nn                            # noqa: E402
+
+
+def main():
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(32, 3, padding=1, activation="relu"),
+            nn.MaxPool2D(2),
+            nn.Conv2D(64, 3, padding=1, activation="relu"),
+            nn.MaxPool2D(2),
+            nn.Dense(128, activation="relu"),
+            nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+
+    x = nd.random.uniform(-1, 1, shape=(8, 3, 32, 32))
+    ref = net(x)
+
+    # KL-divergence ("entropy") calibration over representative batches
+    calib = [nd.random.uniform(-1, 1, shape=(8, 3, 32, 32))
+             for _ in range(4)]
+    qnet = qt.quantize_net(net, calib_mode="entropy", calib_data=calib)
+    qnet.hybridize(static_alloc=True)
+
+    out = qnet(x)
+    err = np.abs(out.asnumpy() - ref.asnumpy()).max()
+    corr = np.corrcoef(out.asnumpy().ravel(), ref.asnumpy().ravel())[0, 1]
+    print(f"int8 vs fp32: max abs err {err:.4f}, correlation {corr:.5f}")
+
+    for tag, m in (("fp32", net), ("int8", qnet)):
+        jax.device_get(m(x)[0]._data)
+        t0 = time.perf_counter()
+        for _ in range(10):
+            out = m(x)
+        jax.device_get(out[0]._data)
+        print(f"{tag}: {(time.perf_counter() - t0) / 10 * 1e3:.2f} ms/batch")
+
+
+if __name__ == "__main__":
+    main()

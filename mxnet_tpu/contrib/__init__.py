@@ -3,5 +3,6 @@
 """
 from . import amp
 from . import quantization
+from . import onnx
 
-__all__ = ["amp", "quantization"]
+__all__ = ["amp", "quantization", "onnx"]

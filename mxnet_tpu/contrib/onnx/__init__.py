@@ -1,0 +1,13 @@
+"""ONNX interop (reference: ``python/mxnet/contrib/onnx`` — mx2onnx
+export + onnx2mx import).  The protobuf wire format is implemented
+in-tree (``_proto.py``) because the ``onnx`` pip package is not part of
+this build; files produced here follow onnx.proto3 IR v8 / opset 13 and
+are readable by the real onnx tooling for the supported op subset.
+"""
+from .mx2onnx import export_model
+from .onnx2mx import import_model
+
+# reference alias: mx.contrib.onnx.onnx_net / get_model naming
+import_to_gluon = None          # gluon import arrives via SymbolBlock
+
+__all__ = ["export_model", "import_model"]

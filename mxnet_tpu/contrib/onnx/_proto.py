@@ -1,0 +1,309 @@
+"""Minimal protobuf wire-format codec for the ONNX message subset.
+
+Reference surface: ``python/mxnet/contrib/onnx`` depends on the ``onnx``
+pip package for ModelProto serialization; that package is not available in
+this build, so the wire format (proto3) is implemented directly — varint /
+64-bit / length-delimited / 32-bit field encodings over a declarative
+schema of the ONNX messages we emit and read (onnx/onnx.proto3, IR v8).
+
+Messages are plain dicts; repeated fields are lists.  The decoder accepts
+both packed and unpacked repeated scalars, skips unknown fields, and is
+therefore compatible with files produced by the real onnx library for the
+message subset used here.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Tuple
+
+# --------------------------------------------------------------------------
+# ONNX enums
+# --------------------------------------------------------------------------
+
+# TensorProto.DataType
+FLOAT, UINT8, INT8, UINT16, INT16, INT32, INT64 = 1, 2, 3, 4, 5, 6, 7
+STRING, BOOL, FLOAT16, DOUBLE, UINT32, UINT64 = 8, 9, 10, 11, 12, 13
+BFLOAT16 = 16
+
+NP_TO_ONNX = {"float32": FLOAT, "float64": DOUBLE, "float16": FLOAT16,
+              "int8": INT8, "uint8": UINT8, "int32": INT32, "int64": INT64,
+              "bool": BOOL, "bfloat16": BFLOAT16}
+ONNX_TO_NP = {v: k for k, v in NP_TO_ONNX.items()}
+
+# AttributeProto.AttributeType
+A_FLOAT, A_INT, A_STRING, A_TENSOR = 1, 2, 3, 4
+A_FLOATS, A_INTS, A_STRINGS = 6, 7, 8
+
+# --------------------------------------------------------------------------
+# Schemas: field name -> (field_number, kind)
+# kind: 'int' varint | 'float' 32-bit | 'str' | 'bytes' | 'msg:<Name>'
+#       prefix 'rep_' marks repeated fields
+# --------------------------------------------------------------------------
+
+SCHEMAS: Dict[str, Dict[str, Tuple[int, str]]] = {
+    "ModelProto": {
+        "ir_version": (1, "int"),
+        "producer_name": (2, "str"),
+        "producer_version": (3, "str"),
+        "domain": (4, "str"),
+        "model_version": (5, "int"),
+        "doc_string": (6, "str"),
+        "graph": (7, "msg:GraphProto"),
+        "opset_import": (8, "rep_msg:OperatorSetIdProto"),
+    },
+    "OperatorSetIdProto": {
+        "domain": (1, "str"),
+        "version": (2, "int"),
+    },
+    "GraphProto": {
+        "node": (1, "rep_msg:NodeProto"),
+        "name": (2, "str"),
+        "initializer": (5, "rep_msg:TensorProto"),
+        "doc_string": (10, "str"),
+        "input": (11, "rep_msg:ValueInfoProto"),
+        "output": (12, "rep_msg:ValueInfoProto"),
+        "value_info": (13, "rep_msg:ValueInfoProto"),
+    },
+    "NodeProto": {
+        "input": (1, "rep_str"),
+        "output": (2, "rep_str"),
+        "name": (3, "str"),
+        "op_type": (4, "str"),
+        "attribute": (5, "rep_msg:AttributeProto"),
+        "doc_string": (6, "str"),
+        "domain": (7, "str"),
+    },
+    "AttributeProto": {
+        "name": (1, "str"),
+        "f": (2, "float"),
+        "i": (3, "int"),
+        "s": (4, "bytes"),
+        "t": (5, "msg:TensorProto"),
+        "floats": (7, "rep_float"),
+        "ints": (8, "rep_int"),
+        "strings": (9, "rep_bytes"),
+        "type": (20, "int"),
+    },
+    "TensorProto": {
+        "dims": (1, "rep_int"),
+        "data_type": (2, "int"),
+        "float_data": (4, "rep_float"),
+        "int32_data": (5, "rep_int"),
+        "int64_data": (7, "rep_int"),
+        "name": (8, "str"),
+        "raw_data": (9, "bytes"),
+    },
+    "ValueInfoProto": {
+        "name": (1, "str"),
+        "type": (2, "msg:TypeProto"),
+        "doc_string": (3, "str"),
+    },
+    "TypeProto": {
+        "tensor_type": (1, "msg:TensorTypeProto"),
+    },
+    "TensorTypeProto": {          # TypeProto.Tensor
+        "elem_type": (1, "int"),
+        "shape": (2, "msg:TensorShapeProto"),
+    },
+    "TensorShapeProto": {
+        "dim": (1, "rep_msg:Dimension"),
+    },
+    "Dimension": {                # TensorShapeProto.Dimension
+        "dim_value": (1, "int"),
+        "dim_param": (2, "str"),
+    },
+}
+
+_WIRE_VARINT, _WIRE_64, _WIRE_LEN, _WIRE_32 = 0, 1, 2, 5
+
+
+# --------------------------------------------------------------------------
+# Encoding
+# --------------------------------------------------------------------------
+
+def _varint(value: int) -> bytes:
+    if value < 0:
+        value += 1 << 64                      # two's complement, 10 bytes
+    out = bytearray()
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _enc_scalar(field: int, kind: str, v) -> bytes:
+    if kind == "int":
+        return _tag(field, _WIRE_VARINT) + _varint(int(v))
+    if kind == "float":
+        return _tag(field, _WIRE_32) + struct.pack("<f", float(v))
+    if kind == "str":
+        b = v.encode("utf-8") if isinstance(v, str) else bytes(v)
+        return _tag(field, _WIRE_LEN) + _varint(len(b)) + b
+    if kind == "bytes":
+        b = bytes(v)
+        return _tag(field, _WIRE_LEN) + _varint(len(b)) + b
+    raise ValueError(f"unknown scalar kind {kind!r}")
+
+
+def encode(msg_name: str, obj: dict) -> bytes:
+    schema = SCHEMAS[msg_name]
+    out = bytearray()
+    for fname, value in obj.items():
+        if value is None:
+            continue
+        if fname not in schema:
+            raise KeyError(f"{msg_name} has no field {fname!r}")
+        field, kind = schema[fname]
+        if kind.startswith("rep_msg:"):
+            sub = kind.split(":", 1)[1]
+            for item in value:
+                body = encode(sub, item)
+                out += _tag(field, _WIRE_LEN) + _varint(len(body)) + body
+        elif kind.startswith("msg:"):
+            sub = kind.split(":", 1)[1]
+            body = encode(sub, value)
+            out += _tag(field, _WIRE_LEN) + _varint(len(body)) + body
+        elif kind == "rep_int":                # packed
+            body = b"".join(_varint(int(x)) for x in value)
+            out += _tag(field, _WIRE_LEN) + _varint(len(body)) + body
+        elif kind == "rep_float":              # packed
+            body = b"".join(struct.pack("<f", float(x)) for x in value)
+            out += _tag(field, _WIRE_LEN) + _varint(len(body)) + body
+        elif kind in ("rep_str", "rep_bytes"):
+            for item in value:
+                out += _enc_scalar(field, kind[4:], item)
+        else:
+            out += _enc_scalar(field, kind, value)
+    return bytes(out)
+
+
+# --------------------------------------------------------------------------
+# Decoding
+# --------------------------------------------------------------------------
+
+def _read_varint(data: bytes, pos: int) -> Tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+    if result >= 1 << 63:                     # negative int64
+        result -= 1 << 64
+    return result, pos
+
+
+def decode(msg_name: str, data: bytes) -> dict:
+    schema = SCHEMAS[msg_name]
+    by_num = {num: (fname, kind) for fname, (num, kind) in schema.items()}
+    obj: dict = {}
+    pos = 0
+    n = len(data)
+    while pos < n:
+        tag, pos = _read_varint(data, pos)
+        field, wire = tag >> 3, tag & 7
+        if wire == _WIRE_VARINT:
+            value, pos = _read_varint(data, pos)
+            raw = ("varint", value)
+        elif wire == _WIRE_64:
+            raw = ("f64", struct.unpack_from("<d", data, pos)[0])
+            pos += 8
+        elif wire == _WIRE_LEN:
+            ln, pos = _read_varint(data, pos)
+            raw = ("len", bytes(data[pos:pos + ln]))
+            pos += ln
+        elif wire == _WIRE_32:
+            raw = ("f32", struct.unpack_from("<f", data, pos)[0])
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        if field not in by_num:
+            continue                           # unknown field: skip
+        fname, kind = by_num[field]
+        _store(obj, fname, kind, raw)
+    return obj
+
+
+def _store(obj, fname, kind, raw):
+    wire_kind, value = raw
+    if kind.startswith("rep_msg:"):
+        obj.setdefault(fname, []).append(decode(kind.split(":", 1)[1], value))
+    elif kind.startswith("msg:"):
+        obj[fname] = decode(kind.split(":", 1)[1], value)
+    elif kind == "rep_int":
+        lst = obj.setdefault(fname, [])
+        if wire_kind == "len":                 # packed
+            pos = 0
+            while pos < len(value):
+                v, pos = _read_varint(value, pos)
+                lst.append(v)
+        else:
+            lst.append(value)
+    elif kind == "rep_float":
+        lst = obj.setdefault(fname, [])
+        if wire_kind == "len":                 # packed
+            lst.extend(struct.unpack(f"<{len(value) // 4}f", value))
+        else:
+            lst.append(value)
+    elif kind == "rep_str":
+        obj.setdefault(fname, []).append(value.decode("utf-8"))
+    elif kind == "rep_bytes":
+        obj.setdefault(fname, []).append(value)
+    elif kind == "int":
+        obj[fname] = value
+    elif kind == "float":
+        obj[fname] = value
+    elif kind == "str":
+        obj[fname] = value.decode("utf-8")
+    elif kind == "bytes":
+        obj[fname] = value
+    else:
+        raise ValueError(f"unknown kind {kind!r}")
+
+
+# --------------------------------------------------------------------------
+# Tensor helpers
+# --------------------------------------------------------------------------
+
+def tensor_from_numpy(name: str, arr) -> dict:
+    import numpy as np
+    a = np.ascontiguousarray(arr)
+    dt = NP_TO_ONNX.get(str(a.dtype))
+    if dt is None:
+        a = a.astype(np.float32)
+        dt = FLOAT
+    return {"name": name, "dims": list(a.shape), "data_type": dt,
+            "raw_data": a.tobytes()}
+
+
+def tensor_to_numpy(t: dict):
+    import numpy as np
+    dims = t.get("dims", [])
+    dt = t.get("data_type", FLOAT)
+    np_dtype = ONNX_TO_NP.get(dt, "float32")
+    if "raw_data" in t and t["raw_data"]:
+        if np_dtype == "bfloat16":
+            import jax.numpy as jnp
+            return np.asarray(
+                jnp.asarray(
+                    np.frombuffer(t["raw_data"], np.uint16).reshape(dims)
+                ).view(jnp.bfloat16))
+        return np.frombuffer(t["raw_data"], np_dtype).reshape(dims).copy()
+    if t.get("float_data"):
+        return np.asarray(t["float_data"], np.float32).reshape(dims)
+    if t.get("int64_data"):
+        return np.asarray(t["int64_data"], np.int64).reshape(dims)
+    if t.get("int32_data"):
+        return np.asarray(t["int32_data"], np_dtype if "int" in np_dtype
+                          else np.int32).reshape(dims)
+    return np.zeros(dims, np_dtype)

@@ -1,0 +1,337 @@
+"""Symbol graph → ONNX ModelProto export.
+
+Reference surface: ``python/mxnet/contrib/onnx/mx2onnx/export_model.py``
+(op converter registry + ``export_model``).  Serialization rides the
+self-contained codec in ``_proto.py`` instead of the onnx pip package.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+from . import _proto as P
+
+OPSET_VERSION = 13
+_CONVERTERS = {}
+
+
+def register_converter(*op_names):
+    def deco(fn):
+        for n in op_names:
+            _CONVERTERS[n] = fn
+        return fn
+    return deco
+
+
+class _Ctx:
+    """Per-export state: extra initializers and generated nodes."""
+
+    def __init__(self, params):
+        self.params = params
+        self.extra_inits = []
+        self.counter = [0]
+
+    def const(self, value, dtype, hint):
+        name = f"_const_{hint}_{self.counter[0]}"
+        self.counter[0] += 1
+        self.extra_inits.append(
+            P.tensor_from_numpy(name, np.asarray(value, dtype)))
+        return name
+
+
+def _node(op_type, inputs, outputs, name, **attrs):
+    a = []
+    for k, v in attrs.items():
+        if v is None:
+            continue
+        if isinstance(v, float):
+            a.append({"name": k, "type": P.A_FLOAT, "f": v})
+        elif isinstance(v, bool) or isinstance(v, int):
+            a.append({"name": k, "type": P.A_INT, "i": int(v)})
+        elif isinstance(v, str):
+            a.append({"name": k, "type": P.A_STRING, "s": v.encode()})
+        elif isinstance(v, (list, tuple)):
+            if v and isinstance(v[0], float):
+                a.append({"name": k, "type": P.A_FLOATS,
+                          "floats": [float(x) for x in v]})
+            else:
+                a.append({"name": k, "type": P.A_INTS,
+                          "ints": [int(x) for x in v]})
+        else:
+            raise MXNetError(f"unsupported attr {k}={v!r}")
+    return {"op_type": op_type, "input": list(inputs),
+            "output": list(outputs), "name": name, "attribute": a}
+
+
+# --------------------------------------------------------------------------
+# Converters: (ctx, node_name, kwargs, input_names, out_name) -> [NodeProto]
+# --------------------------------------------------------------------------
+
+@register_converter("FullyConnected")
+def _fc(ctx, name, kw, ins, out):
+    nodes = []
+    data = ins[0]
+    if kw.get("flatten", True):
+        nodes.append(_node("Flatten", [data], [name + "_flat"],
+                           name + "_flat", axis=1))
+        data = name + "_flat"
+    gemm_in = [data, ins[1]] + (ins[2:3] if not kw.get("no_bias") else [])
+    nodes.append(_node("Gemm", gemm_in, [out], name,
+                       alpha=1.0, beta=1.0, transA=0, transB=1))
+    return nodes
+
+
+@register_converter("Convolution")
+def _conv(ctx, name, kw, ins, out):
+    kernel = list(kw.get("kernel", ()))
+    nd = len(kernel)
+    stride = list(kw.get("stride", ())) or [1] * nd
+    dilate = list(kw.get("dilate", ())) or [1] * nd
+    pad = list(kw.get("pad", ())) or [0] * nd
+    return [_node("Conv", list(ins), [out], name, kernel_shape=kernel,
+                  strides=stride, dilations=dilate, pads=pad + pad,
+                  group=int(kw.get("num_group", 1)))]
+
+
+@register_converter("Pooling")
+def _pool(ctx, name, kw, ins, out):
+    ptype = kw.get("pool_type", "max")
+    if ptype not in ("max", "avg"):
+        raise MXNetError(f"onnx export: unsupported pool_type {ptype!r}")
+    if kw.get("pooling_convention", "valid") != "valid":
+        raise MXNetError("onnx export: pooling_convention='full' (ceil "
+                         "semantics) has no converter")
+    if kw.get("global_pool"):
+        op = "GlobalMaxPool" if ptype == "max" else "GlobalAveragePool"
+        return [_node(op, list(ins), [out], name)]
+    kernel = list(kw.get("kernel", ()))
+    nd = len(kernel)
+    stride = list(kw.get("stride", ())) or [1] * nd
+    pad = list(kw.get("pad", ())) or [0] * nd
+    op = "MaxPool" if ptype == "max" else "AveragePool"
+    attrs = dict(kernel_shape=kernel, strides=stride, pads=pad + pad)
+    if ptype == "avg":
+        attrs["count_include_pad"] = int(kw.get("count_include_pad", True))
+    return [_node(op, list(ins), [out], name, **attrs)]
+
+
+_ACT = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+        "softrelu": "Softplus", "softsign": "Softsign"}
+
+
+@register_converter("Activation")
+def _act(ctx, name, kw, ins, out):
+    act = kw.get("act_type", "relu")
+    if act not in _ACT:
+        raise MXNetError(f"onnx export: unsupported act_type {act!r}")
+    return [_node(_ACT[act], list(ins), [out], name)]
+
+
+@register_converter("relu")
+def _relu(ctx, name, kw, ins, out):
+    return [_node("Relu", list(ins), [out], name)]
+
+
+@register_converter("sigmoid")
+def _sigmoid(ctx, name, kw, ins, out):
+    return [_node("Sigmoid", list(ins), [out], name)]
+
+
+@register_converter("tanh")
+def _tanh(ctx, name, kw, ins, out):
+    return [_node("Tanh", list(ins), [out], name)]
+
+
+@register_converter("BatchNorm")
+def _bn(ctx, name, kw, ins, out):
+    ins = list(ins)
+    if kw.get("fix_gamma", True):
+        # fix_gamma forces scale=1 at compute time (ops/nn.py BatchNorm);
+        # the exported graph must bake that in, not the stored gamma values
+        gamma = ctx.params.get(ins[1])
+        size = (int(np.prod(gamma.shape)) if gamma is not None else None)
+        if size is None:
+            raise MXNetError(
+                f"onnx export: BatchNorm {name!r} has fix_gamma=True but "
+                f"gamma {ins[1]!r} is not a bound param")
+        ins[1] = ctx.const(np.ones(size, np.float32), np.float32,
+                           "fixed_gamma")
+    return [_node("BatchNormalization", ins, [out], name,
+                  epsilon=float(kw.get("eps", 1e-3)),
+                  momentum=float(kw.get("momentum", 0.9)))]
+
+
+@register_converter("LayerNorm")
+def _ln(ctx, name, kw, ins, out):
+    return [_node("LayerNormalization", list(ins), [out], name,
+                  axis=int(kw.get("axis", -1)),
+                  epsilon=float(kw.get("eps", 1e-5)))]
+
+
+@register_converter("Flatten")
+def _flatten(ctx, name, kw, ins, out):
+    return [_node("Flatten", list(ins), [out], name, axis=1)]
+
+
+@register_converter("reshape", "Reshape")
+def _reshape(ctx, name, kw, ins, out):
+    shape = list(kw.get("shape", ()))
+    if any(s in (-2, -3, -4) for s in shape):
+        raise MXNetError("onnx export: reshape special codes -2/-3/-4 have "
+                         "no ONNX equivalent")
+    # MXNet's 0 = copy-dim matches ONNX Reshape's 0 (allowzero=0 default)
+    sname = ctx.const(shape, np.int64, "shape")
+    return [_node("Reshape", [ins[0], sname], [out], name)]
+
+
+@register_converter("concat")
+def _concat(ctx, name, kw, ins, out):
+    return [_node("Concat", list(ins), [out], name,
+                  axis=int(kw.get("dim", 1)))]
+
+
+@register_converter("Dropout")
+def _dropout(ctx, name, kw, ins, out):
+    rname = ctx.const(float(kw.get("p", 0.5)), np.float32, "ratio")
+    return [_node("Dropout", [ins[0], rname], [out], name)]
+
+
+@register_converter("softmax")
+def _softmax(ctx, name, kw, ins, out):
+    return [_node("Softmax", list(ins), [out], name,
+                  axis=int(kw.get("axis", -1)))]
+
+
+@register_converter("log_softmax")
+def _log_softmax(ctx, name, kw, ins, out):
+    return [_node("LogSoftmax", list(ins), [out], name,
+                  axis=int(kw.get("axis", -1)))]
+
+
+@register_converter("transpose")
+def _transpose(ctx, name, kw, ins, out):
+    axes = list(kw.get("axes", ()))
+    return [_node("Transpose", list(ins), [out], name,
+                  perm=axes or None)]
+
+
+@register_converter("Embedding")
+def _embedding(ctx, name, kw, ins, out):
+    # mx: (indices, weight) -> onnx Gather(weight, indices)
+    return [_node("Gather", [ins[1], ins[0]], [out], name, axis=0)]
+
+
+_BINOP = {"elemwise_add": "Add", "broadcast_add": "Add",
+          "elemwise_sub": "Sub", "broadcast_sub": "Sub",
+          "elemwise_mul": "Mul", "broadcast_mul": "Mul",
+          "elemwise_div": "Div", "broadcast_div": "Div",
+          "dot": "MatMul"}
+
+for _mx, _ox in _BINOP.items():
+    def _mk(_ox):
+        def cv(ctx, name, kw, ins, out):
+            return [_node(_ox, list(ins), [out], name)]
+        return cv
+    register_converter(_mx)(_mk(_ox))
+
+_SCALAR_OP = {"_plus_scalar": "Add", "_minus_scalar": "Sub",
+              "_mul_scalar": "Mul", "_div_scalar": "Div"}
+
+for _mx, _ox in _SCALAR_OP.items():
+    def _mks(_ox):
+        def cv(ctx, name, kw, ins, out):
+            s = ctx.const(float(kw.get("scalar", 0.0)), np.float32, "scalar")
+            return [_node(_ox, [ins[0], s], [out], name)]
+        return cv
+    register_converter(_mx)(_mks(_ox))
+
+
+# --------------------------------------------------------------------------
+# export_model
+# --------------------------------------------------------------------------
+
+def _out_name(node, idx, n_outputs):
+    return node.name if n_outputs == 1 else f"{node.name}_out{idx}"
+
+
+def export_model(sym, params, input_shapes=None, input_dtypes="float32",
+                 onnx_file_path="model.onnx", verbose=False):
+    """Serialize a Symbol + params to an ONNX file (reference:
+    onnx_mxnet.export_model).  Returns the file path."""
+    params = {k.split(":", 1)[-1]: v for k, v in (params or {}).items()}
+    arg_names = set(sym.list_inputs())
+    data_inputs = [n for n in sym.list_inputs() if n not in params]
+    if isinstance(input_shapes, dict):
+        shape_map = dict(input_shapes)
+    else:
+        shape_map = dict(zip(data_inputs, input_shapes or []))
+    if isinstance(input_dtypes, str):
+        dtype_map = {n: input_dtypes for n in data_inputs}
+    else:
+        dtype_map = dict(zip(data_inputs, input_dtypes))
+
+    ctx = _Ctx(params)
+    nodes, inits, graph_inputs = [], [], []
+    for name in data_inputs:
+        shape = shape_map.get(name)
+        dims = [{"dim_value": int(s)} for s in (shape or ())]
+        graph_inputs.append({
+            "name": name,
+            "type": {"tensor_type": {
+                "elem_type": P.NP_TO_ONNX.get(
+                    str(dtype_map.get(name, "float32")), P.FLOAT),
+                "shape": {"dim": dims}}}})
+    for name in sorted(p for p in arg_names if p in params):
+        arr = params[name]
+        inits.append(P.tensor_from_numpy(
+            name, arr.asnumpy() if hasattr(arr, "asnumpy") else arr))
+
+    out_names = []
+    for node in sym._topo():
+        if node.is_variable:
+            if node.name not in params and node.name not in set(data_inputs):
+                raise MXNetError(
+                    f"onnx export: free variable {node.name!r} has no "
+                    f"shape/param binding")
+            continue
+        opname = node.op.name
+        conv = _CONVERTERS.get(opname)
+        if conv is None:
+            for alias in node.op.aliases:
+                conv = _CONVERTERS.get(alias)
+                if conv is not None:
+                    break
+        if conv is None:
+            raise MXNetError(
+                f"onnx export: no converter for operator {opname!r}")
+        ins = [_out_name(src, i, src.num_outputs) if not src.is_variable
+               else src.name for src, i in node.inputs]
+        out = _out_name(node, 0, node.num_outputs)
+        nodes.extend(conv(ctx, node.name, dict(node.kwargs), ins, out))
+
+    for n, i in sym._outputs:
+        out_names.append(_out_name(n, i, n.num_outputs) if not n.is_variable
+                         else n.name)
+    graph = {
+        "node": nodes,
+        "name": "mxnet_tpu_graph",
+        "initializer": inits + ctx.extra_inits,
+        "input": graph_inputs,
+        "output": [{"name": o, "type": {"tensor_type": {
+            "elem_type": P.FLOAT, "shape": {"dim": []}}}}
+            for o in out_names],
+    }
+    model = {
+        "ir_version": 8,
+        "producer_name": "mxnet_tpu",
+        "producer_version": "2.0",
+        "opset_import": [{"domain": "", "version": OPSET_VERSION}],
+        "graph": graph,
+    }
+    blob = P.encode("ModelProto", model)
+    with open(onnx_file_path, "wb") as f:
+        f.write(blob)
+    if verbose:
+        print(f"exported {len(nodes)} nodes, {len(inits)} params "
+              f"-> {onnx_file_path}")
+    return onnx_file_path

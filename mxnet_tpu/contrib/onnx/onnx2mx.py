@@ -1,0 +1,265 @@
+"""ONNX ModelProto → Symbol graph import.
+
+Reference surface: ``python/mxnet/contrib/onnx/onnx2mx/import_model.py``
+(``import_model`` returning ``(sym, arg_params, aux_params)``).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+from . import _proto as P
+
+_IMPORTERS = {}
+
+
+def register_importer(*op_types):
+    def deco(fn):
+        for t in op_types:
+            _IMPORTERS[t] = fn
+        return fn
+    return deco
+
+
+def _attrs(node):
+    out = {}
+    for a in node.get("attribute", []):
+        t = a.get("type")
+        if t == P.A_FLOAT:
+            out[a["name"]] = a.get("f", 0.0)
+        elif t == P.A_INT:
+            out[a["name"]] = a.get("i", 0)
+        elif t == P.A_STRING:
+            out[a["name"]] = a.get("s", b"").decode()
+        elif t == P.A_FLOATS:
+            out[a["name"]] = list(a.get("floats", []))
+        elif t == P.A_INTS:
+            out[a["name"]] = list(a.get("ints", []))
+        elif t == P.A_TENSOR:
+            out[a["name"]] = P.tensor_to_numpy(a["t"])
+    return out
+
+
+# Importer signature: (sym_mod, inputs, attrs, consts, name) -> Symbol
+# ``consts`` maps input name -> numpy value for initializer-backed inputs.
+
+@register_importer("Gemm")
+def _gemm(sym, ins, at, consts, name):
+    if at.get("transA"):
+        raise MXNetError("onnx import: Gemm transA unsupported")
+    alpha = float(at.get("alpha", 1.0))
+    beta = float(at.get("beta", 1.0))
+    data, weight = ins[0], ins[1]
+    if not at.get("transB", 0):
+        weight = sym.transpose(weight)
+    if alpha == 1.0 and beta == 1.0:
+        args = [data, weight] + (list(ins[2:3]) if len(ins) > 2 else [])
+        return sym.FullyConnected(*args, num_hidden=0,
+                                  no_bias=len(ins) < 3, flatten=False,
+                                  name=name)
+    out = sym.FullyConnected(data, weight, num_hidden=0, no_bias=True,
+                             flatten=False, name=name + "_mm")
+    if alpha != 1.0:
+        out = out * alpha
+    if len(ins) > 2 and beta != 0.0:
+        bias = ins[2] if beta == 1.0 else ins[2] * beta
+        out = sym.broadcast_add(out, bias, name=name)
+    return out
+
+
+@register_importer("Conv")
+def _conv(sym, ins, at, consts, name):
+    kernel = tuple(at.get("kernel_shape", ()))
+    nd = len(kernel)
+    pads = at.get("pads", [0] * (2 * nd))
+    if pads[:nd] != pads[nd:]:
+        raise MXNetError("onnx import: asymmetric Conv pads unsupported")
+    return sym.Convolution(*ins, kernel=kernel,
+                           stride=tuple(at.get("strides", ())) or (1,) * nd,
+                           dilate=tuple(at.get("dilations", ())) or
+                           (1,) * nd,
+                           pad=tuple(pads[:nd]),
+                           num_filter=0,
+                           num_group=int(at.get("group", 1)),
+                           no_bias=len(ins) < 3, name=name)
+
+
+@register_importer("MaxPool", "AveragePool")
+def _pool(sym, ins, at, consts, name):
+    kernel = tuple(at.get("kernel_shape", ()))
+    nd = len(kernel)
+    pads = at.get("pads", [0] * (2 * nd))
+    if pads[:nd] != pads[nd:]:
+        raise MXNetError("onnx import: asymmetric pool pads unsupported")
+    kw = {}
+    if at["_ptype"] == "avg":
+        # ONNX default count_include_pad=0; MXNet default is True
+        kw["count_include_pad"] = bool(at.get("count_include_pad", 0))
+    return sym.Pooling(ins[0], kernel=kernel, pool_type=at["_ptype"],
+                       stride=tuple(at.get("strides", ())) or (1,) * nd,
+                       pad=tuple(pads[:nd]), name=name, **kw)
+
+
+@register_importer("GlobalMaxPool", "GlobalAveragePool")
+def _gpool(sym, ins, at, consts, name):
+    return sym.Pooling(ins[0], global_pool=True, pool_type=at["_ptype"],
+                       kernel=(), name=name)
+
+
+@register_importer("BatchNormalization")
+def _bn(sym, ins, at, consts, name):
+    return sym.BatchNorm(*ins, eps=float(at.get("epsilon", 1e-5)),
+                         momentum=float(at.get("momentum", 0.9)),
+                         fix_gamma=False, use_global_stats=True, name=name)
+
+
+@register_importer("LayerNormalization")
+def _ln(sym, ins, at, consts, name):
+    return sym.LayerNorm(*ins, axis=int(at.get("axis", -1)),
+                         eps=float(at.get("epsilon", 1e-5)), name=name)
+
+
+@register_importer("Relu")
+def _relu(sym, ins, at, consts, name):
+    return sym.Activation(ins[0], act_type="relu", name=name)
+
+
+@register_importer("Sigmoid")
+def _sig(sym, ins, at, consts, name):
+    return sym.Activation(ins[0], act_type="sigmoid", name=name)
+
+
+@register_importer("Tanh")
+def _tanh(sym, ins, at, consts, name):
+    return sym.Activation(ins[0], act_type="tanh", name=name)
+
+
+@register_importer("Softplus")
+def _softplus(sym, ins, at, consts, name):
+    return sym.Activation(ins[0], act_type="softrelu", name=name)
+
+
+@register_importer("Flatten")
+def _flat(sym, ins, at, consts, name):
+    return sym.Flatten(ins[0], name=name)
+
+
+@register_importer("Reshape")
+def _reshape(sym, ins, at, consts, name):
+    shape = consts.get("__in1__")
+    if shape is None:
+        raise MXNetError("onnx import: dynamic Reshape shape unsupported")
+    return sym.reshape(ins[0], shape=tuple(int(s) for s in shape),
+                       name=name)
+
+
+@register_importer("Concat")
+def _concat(sym, ins, at, consts, name):
+    return sym.concat(*ins, dim=int(at.get("axis", 1)), name=name)
+
+
+@register_importer("Dropout")
+def _dropout(sym, ins, at, consts, name):
+    ratio = at.get("ratio")
+    if ratio is None:
+        r = consts.get("__in1__")
+        ratio = float(r) if r is not None else 0.5
+    return sym.Dropout(ins[0], p=float(ratio), name=name)
+
+
+@register_importer("Softmax")
+def _softmax(sym, ins, at, consts, name):
+    return sym.softmax(ins[0], axis=int(at.get("axis", -1)), name=name)
+
+
+@register_importer("LogSoftmax")
+def _logsoftmax(sym, ins, at, consts, name):
+    return sym.log_softmax(ins[0], axis=int(at.get("axis", -1)), name=name)
+
+
+@register_importer("Transpose")
+def _transpose(sym, ins, at, consts, name):
+    return sym.transpose(ins[0], axes=tuple(at.get("perm", ())), name=name)
+
+
+@register_importer("Gather")
+def _gather(sym, ins, at, consts, name):
+    return sym.take(ins[0], ins[1], axis=int(at.get("axis", 0)), name=name)
+
+
+@register_importer("MatMul")
+def _matmul(sym, ins, at, consts, name):
+    return sym.dot(ins[0], ins[1], name=name)
+
+
+for _ox, _mx in (("Add", "broadcast_add"), ("Sub", "broadcast_sub"),
+                 ("Mul", "broadcast_mul"), ("Div", "broadcast_div")):
+    def _mkbin(_mx):
+        def imp(sym, ins, at, consts, name):
+            return getattr(sym, _mx)(ins[0], ins[1], name=name)
+        return imp
+    register_importer(_ox)(_mkbin(_mx))
+
+
+def import_model(model_file):
+    """Load an ONNX file → (sym, arg_params, aux_params) (reference:
+    onnx_mxnet.import_model)."""
+    import mxnet_tpu.symbol as sym_mod
+    from ... import nd
+
+    with open(model_file, "rb") as f:
+        model = P.decode("ModelProto", f.read())
+    graph = model.get("graph", {})
+    inits = {t["name"]: P.tensor_to_numpy(t)
+             for t in graph.get("initializer", [])}
+    tensors = {}                               # onnx name -> Symbol
+    for vi in graph.get("input", []):
+        if vi["name"] not in inits:
+            tensors[vi["name"]] = sym_mod.var(vi["name"])
+
+    arg_params, aux_params = {}, {}
+    used_const = set()
+
+    def as_sym(onnx_name):
+        if onnx_name in tensors:
+            return tensors[onnx_name]
+        if onnx_name in inits:
+            arg_params[onnx_name] = nd.array(
+                np.ascontiguousarray(inits[onnx_name]))
+            tensors[onnx_name] = sym_mod.var(onnx_name)
+            used_const.add(onnx_name)
+            return tensors[onnx_name]
+        raise MXNetError(f"onnx import: undefined tensor {onnx_name!r}")
+
+    for node in graph.get("node", []):
+        op = node["op_type"]
+        imp = _IMPORTERS.get(op)
+        if imp is None:
+            raise MXNetError(f"onnx import: no importer for {op!r}")
+        at = _attrs(node)
+        at["_op_type"] = op
+        if "Pool" in op:
+            at["_ptype"] = "max" if "Max" in op else "avg"
+        raw_ins = node.get("input", [])
+        consts = {}
+        for i, n in enumerate(raw_ins):
+            if n in inits:
+                consts[f"__in{i}__"] = inits[n]
+        # shape/ratio style const inputs are consumed as attrs, not args
+        if op in ("Reshape", "Dropout") and len(raw_ins) > 1:
+            ins = [as_sym(raw_ins[0])]
+        else:
+            ins = [as_sym(n) for n in raw_ins]
+        name = node.get("name") or f"{op.lower()}_{len(tensors)}"
+        out_sym = imp(sym_mod, ins, at, consts, name)
+        outs = node.get("output", [])
+        if op == "BatchNormalization" and len(raw_ins) >= 5:
+            for aux_in in raw_ins[3:5]:
+                if aux_in in arg_params:
+                    aux_params[aux_in] = arg_params.pop(aux_in)
+        for i, o in enumerate(outs):
+            tensors[o] = out_sym[i] if len(outs) > 1 else out_sym
+
+    out_syms = [tensors[o["name"]] for o in graph.get("output", [])]
+    final = out_syms[0] if len(out_syms) == 1 else sym_mod.Group(out_syms)
+    return final, arg_params, aux_params

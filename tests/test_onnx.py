@@ -1,0 +1,234 @@
+"""ONNX export/import tests (reference strategy:
+tests/python-pytest/onnx/ — round-trip through serialized ModelProto)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+import mxnet_tpu.symbol as sym
+from mxnet_tpu.contrib import onnx as onnx_mxnet
+from mxnet_tpu.contrib.onnx import _proto as P
+
+
+def test_proto_codec_roundtrip():
+    model = {
+        "ir_version": 8,
+        "producer_name": "mxnet_tpu",
+        "opset_import": [{"domain": "", "version": 13}],
+        "graph": {
+            "name": "g",
+            "node": [{"op_type": "Relu", "input": ["x"], "output": ["y"],
+                      "name": "relu0",
+                      "attribute": [{"name": "axis", "type": P.A_INT,
+                                     "i": -1},
+                                    {"name": "perm", "type": P.A_INTS,
+                                     "ints": [1, 0]},
+                                    {"name": "eps", "type": P.A_FLOAT,
+                                     "f": 0.5}]}],
+            "initializer": [P.tensor_from_numpy(
+                "w", np.arange(6, dtype=np.float32).reshape(2, 3))],
+            "input": [{"name": "x", "type": {"tensor_type": {
+                "elem_type": P.FLOAT,
+                "shape": {"dim": [{"dim_value": 2}, {"dim_value": 3}]}}}}],
+            "output": [{"name": "y", "type": {"tensor_type": {
+                "elem_type": P.FLOAT, "shape": {"dim": []}}}}],
+        },
+    }
+    blob = P.encode("ModelProto", model)
+    back = P.decode("ModelProto", blob)
+    assert back["ir_version"] == 8
+    assert back["graph"]["node"][0]["op_type"] == "Relu"
+    at = {a["name"]: a for a in back["graph"]["node"][0]["attribute"]}
+    assert at["axis"]["i"] == -1
+    assert at["perm"]["ints"] == [1, 0]
+    assert at["eps"]["f"] == pytest.approx(0.5)
+    w = P.tensor_to_numpy(back["graph"]["initializer"][0])
+    assert np.allclose(w, np.arange(6).reshape(2, 3))
+    dims = back["graph"]["input"][0]["type"]["tensor_type"]["shape"]["dim"]
+    assert [d["dim_value"] for d in dims] == [2, 3]
+
+
+def _mlp():
+    data = sym.var("data")
+    w1, b1 = sym.var("fc1_weight"), sym.var("fc1_bias")
+    h = sym.FullyConnected(data, w1, b1, num_hidden=16, name="fc1")
+    h = sym.Activation(h, act_type="relu", name="relu1")
+    w2, b2 = sym.var("fc2_weight"), sym.var("fc2_bias")
+    out = sym.FullyConnected(h, w2, b2, num_hidden=4, name="fc2")
+    out = sym.softmax(out, axis=-1, name="prob")
+    rng = np.random.RandomState(0)
+    params = {"fc1_weight": nd.array(rng.randn(16, 8) * 0.3),
+              "fc1_bias": nd.array(rng.randn(16) * 0.1),
+              "fc2_weight": nd.array(rng.randn(4, 16) * 0.3),
+              "fc2_bias": nd.array(rng.randn(4) * 0.1)}
+    return out, params
+
+
+def test_onnx_roundtrip_mlp(tmp_path):
+    out, params = _mlp()
+    rng = np.random.RandomState(1)
+    x = nd.array(rng.randn(8, 8).astype(np.float32))
+    ref = out.eval(data=x, **params)[0].asnumpy()
+
+    path = str(tmp_path / "mlp.onnx")
+    onnx_mxnet.export_model(out, params, input_shapes=[(8, 8)],
+                            onnx_file_path=path)
+    sym2, args2, aux2 = onnx_mxnet.import_model(path)
+    assert not aux2
+    got = sym2.eval(data=x, **args2)[0].asnumpy()
+    assert got.shape == ref.shape
+    assert np.allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_onnx_roundtrip_cnn(tmp_path):
+    data = sym.var("data")
+    w = sym.var("conv_weight")
+    b = sym.var("conv_bias")
+    h = sym.Convolution(data, w, b, kernel=(3, 3), pad=(1, 1), stride=(1, 1),
+                        num_filter=6, name="conv0")
+    h = sym.Activation(h, act_type="relu", name="act0")
+    h = sym.Pooling(h, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                    name="pool0")
+    h = sym.Flatten(h, name="flat0")
+    wf, bf = sym.var("fc_weight"), sym.var("fc_bias")
+    out = sym.FullyConnected(h, wf, bf, num_hidden=3, name="fc0")
+
+    rng = np.random.RandomState(2)
+    params = {"conv_weight": nd.array(rng.randn(6, 3, 3, 3) * 0.2),
+              "conv_bias": nd.array(rng.randn(6) * 0.1),
+              "fc_weight": nd.array(rng.randn(3, 6 * 4 * 4) * 0.1),
+              "fc_bias": nd.array(rng.randn(3) * 0.1)}
+    x = nd.array(rng.randn(2, 3, 8, 8).astype(np.float32))
+    ref = out.eval(data=x, **params)[0].asnumpy()
+
+    path = str(tmp_path / "cnn.onnx")
+    onnx_mxnet.export_model(out, params, input_shapes=[(2, 3, 8, 8)],
+                            onnx_file_path=path)
+    sym2, args2, _ = onnx_mxnet.import_model(path)
+    got = sym2.eval(data=x, **args2)[0].asnumpy()
+    assert np.allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_roundtrip_elemwise_scalar(tmp_path):
+    data = sym.var("data")
+    out = sym.transpose((data * 2.0 + 1.0), axes=(1, 0), name="t0")
+    x = nd.array(np.arange(6).reshape(2, 3).astype(np.float32))
+    ref = out.eval(data=x)[0].asnumpy()
+    path = str(tmp_path / "ew.onnx")
+    onnx_mxnet.export_model(out, {}, input_shapes=[(2, 3)],
+                            onnx_file_path=path)
+    sym2, args2, _ = onnx_mxnet.import_model(path)
+    got = sym2.eval(data=x, **args2)[0].asnumpy()
+    assert np.allclose(got, ref)
+
+
+def test_onnx_export_unsupported_op_raises(tmp_path):
+    data = sym.var("data")
+    out = sym.Correlation(data, data)
+    with pytest.raises(mx.MXNetError, match="no converter"):
+        onnx_mxnet.export_model(out, {}, input_shapes=[(1, 1, 8, 8)],
+                                onnx_file_path=str(tmp_path / "x.onnx"))
+
+
+def test_onnx_batchnorm_aux_split(tmp_path):
+    data = sym.var("data")
+    g, b = sym.var("bn_gamma"), sym.var("bn_beta")
+    mm, mv = sym.var("bn_moving_mean"), sym.var("bn_moving_var")
+    out = sym.BatchNorm(data, g, b, mm, mv, fix_gamma=False,
+                        use_global_stats=True, name="bn0")
+    rng = np.random.RandomState(3)
+    params = {"bn_gamma": nd.array(rng.rand(4) + 0.5),
+              "bn_beta": nd.array(rng.randn(4)),
+              "bn_moving_mean": nd.array(rng.randn(4) * 0.1),
+              "bn_moving_var": nd.array(rng.rand(4) + 0.5)}
+    x = nd.array(rng.randn(2, 4, 3, 3).astype(np.float32))
+    ref = out.eval(data=x, **params)[0].asnumpy()
+    path = str(tmp_path / "bn.onnx")
+    onnx_mxnet.export_model(out, params, input_shapes=[(2, 4, 3, 3)],
+                            onnx_file_path=path)
+    sym2, args2, aux2 = onnx_mxnet.import_model(path)
+    assert set(aux2) == {"bn_moving_mean", "bn_moving_var"}
+    got = sym2.eval(data=x, **args2, **aux2)[0].asnumpy()
+    assert np.allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_avgpool_count_include_pad_roundtrip(tmp_path):
+    data = sym.var("data")
+    out = sym.Pooling(data, kernel=(2, 2), stride=(2, 2), pad=(1, 1),
+                      pool_type="avg", count_include_pad=False, name="p0")
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.rand(1, 2, 6, 6).astype(np.float32))
+    ref = out.eval(data=x)[0].asnumpy()
+    path = str(tmp_path / "ap.onnx")
+    onnx_mxnet.export_model(out, {}, input_shapes=[(1, 2, 6, 6)],
+                            onnx_file_path=path)
+    sym2, args2, _ = onnx_mxnet.import_model(path)
+    got = sym2.eval(data=x, **args2)[0].asnumpy()
+    assert np.allclose(got, ref, rtol=1e-5), np.abs(got - ref).max()
+
+
+def test_onnx_batchnorm_fix_gamma_exports_ones(tmp_path):
+    data = sym.var("data")
+    g, b = sym.var("g"), sym.var("b")
+    mm, mv = sym.var("mm"), sym.var("mv")
+    out = sym.BatchNorm(data, g, b, mm, mv, use_global_stats=True,
+                        name="bn0")                   # fix_gamma default True
+    rng = np.random.RandomState(1)
+    params = {"g": nd.array(rng.rand(3) + 2.0),       # non-unit gamma
+              "b": nd.array(rng.randn(3)),
+              "mm": nd.array(rng.randn(3) * 0.1),
+              "mv": nd.array(rng.rand(3) + 0.5)}
+    x = nd.array(rng.randn(2, 3, 4, 4).astype(np.float32))
+    ref = out.eval(data=x, **params)[0].asnumpy()
+    path = str(tmp_path / "bnfg.onnx")
+    onnx_mxnet.export_model(out, params, input_shapes=[(2, 3, 4, 4)],
+                            onnx_file_path=path)
+    sym2, args2, aux2 = onnx_mxnet.import_model(path)
+    got = sym2.eval(data=x, **args2, **aux2)[0].asnumpy()
+    assert np.allclose(got, ref, rtol=1e-4, atol=1e-5), \
+        np.abs(got - ref).max()
+
+
+def test_onnx_pooling_full_convention_raises(tmp_path):
+    data = sym.var("data")
+    out = sym.Pooling(data, kernel=(3, 3), stride=(2, 2),
+                      pooling_convention="full")
+    with pytest.raises(mx.MXNetError, match="pooling_convention"):
+        onnx_mxnet.export_model(out, {}, input_shapes=[(1, 1, 6, 6)],
+                                onnx_file_path=str(tmp_path / "x.onnx"))
+
+
+def test_onnx_gemm_alpha_beta_import(tmp_path):
+    # hand-build a Gemm with non-default scaling, as an external exporter
+    # would, and check the importer honors alpha/beta
+    rng = np.random.RandomState(2)
+    w = rng.randn(4, 8).astype(np.float32)
+    c = rng.randn(4).astype(np.float32)
+    model = {
+        "ir_version": 8, "producer_name": "external",
+        "opset_import": [{"domain": "", "version": 13}],
+        "graph": {
+            "name": "g",
+            "node": [{"op_type": "Gemm", "input": ["x", "w", "c"],
+                      "output": ["y"], "name": "gemm0",
+                      "attribute": [
+                          {"name": "alpha", "type": P.A_FLOAT, "f": 0.5},
+                          {"name": "beta", "type": P.A_FLOAT, "f": 2.0},
+                          {"name": "transB", "type": P.A_INT, "i": 1}]}],
+            "initializer": [P.tensor_from_numpy("w", w),
+                            P.tensor_from_numpy("c", c)],
+            "input": [{"name": "x", "type": {"tensor_type": {
+                "elem_type": P.FLOAT,
+                "shape": {"dim": [{"dim_value": 2}, {"dim_value": 8}]}}}}],
+            "output": [{"name": "y", "type": {"tensor_type": {
+                "elem_type": P.FLOAT, "shape": {"dim": []}}}}],
+        },
+    }
+    path = str(tmp_path / "gemm.onnx")
+    with open(path, "wb") as f:
+        f.write(P.encode("ModelProto", model))
+    sym2, args2, _ = onnx_mxnet.import_model(path)
+    x = rng.randn(2, 8).astype(np.float32)
+    got = sym2.eval(x=nd.array(x), **args2)[0].asnumpy()
+    want = 0.5 * (x @ w.T) + 2.0 * c
+    assert np.allclose(got, want, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,24 @@
+"""opperf harness test (reference: benchmark/opperf self-test)."""
+import sys
+import os
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from benchmark.opperf import run_performance_test
+
+
+def test_opperf_runs_and_reports():
+    rows = run_performance_test(ops={"relu", "dot", "adam_update"},
+                                warmup=1, runs=2)
+    assert len(rows) == 3
+    for row in rows:
+        assert "error" not in row, row
+        assert row["avg_ms"] > 0
+        assert row["compile_ms"] > 0
+        assert row["shape"]
+
+
+def test_opperf_category_filter():
+    rows = run_performance_test(categories={"gemm"}, warmup=0, runs=1)
+    assert {r["op"] for r in rows} == {"dot", "batch_dot"}
