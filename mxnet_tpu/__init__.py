@@ -29,6 +29,8 @@ from . import symbol
 from . import symbol as sym
 from .ndarray import NDArray
 from .symbol import Symbol
+from . import attribute
+from .attribute import AttrScope
 
 
 def waitall():
@@ -42,7 +44,7 @@ import importlib as _importlib
 for _mod in ("initializer", "optimizer", "metric", "gluon", "io", "kvstore",
              "recordio", "callback", "profiler", "util", "runtime",
              "test_utils", "executor", "module", "image", "contrib",
-             "parallel", "models", "np", "npx", "lr_scheduler"):
+             "parallel", "models", "np", "npx", "lr_scheduler", "operator"):
     try:
         globals()[_mod] = _importlib.import_module(f".{_mod}", __name__)
     except ModuleNotFoundError as _e:

@@ -1,0 +1,59 @@
+"""Symbol attribute scoping.
+
+Reference surface: ``python/mxnet/attribute.py`` — ``mx.AttrScope``
+attaches string attributes (notably ``ctx_group`` for the manual model
+parallelism of §2.4 P7 and ``__layout__`` hints) to every symbol created
+inside the scope; ``Bind(group2ctx=...)`` then places subgraphs.
+
+TPU-native: device placement of subgraphs is superseded by GSPMD
+sharding — one logical memory space, XLA decides placement from sharding
+annotations.  The scope machinery is kept at full fidelity (attributes
+flow into the graph, serialize through Symbol JSON, and are queryable),
+and ``ctx_group``/``group2ctx`` are accepted everywhere the reference
+accepts them so model-parallel example code runs unchanged; the groups
+act as sharding hints rather than hard device pins.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+__all__ = ["AttrScope", "current_attrs"]
+
+
+class _ScopeState(threading.local):
+    def __init__(self):
+        self.stack = []
+
+
+_STATE = _ScopeState()
+
+
+class AttrScope:
+    """``with mx.AttrScope(ctx_group='dev1'):`` — every symbol created in
+    the scope carries the attributes (reference: mx.AttrScope)."""
+
+    def __init__(self, **attrs):
+        self._attrs = {k: str(v) for k, v in attrs.items()}
+
+    def __enter__(self):
+        merged = dict(_STATE.stack[-1]) if _STATE.stack else {}
+        merged.update(self._attrs)
+        _STATE.stack.append(merged)
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.stack.pop()
+        return False
+
+    @classmethod
+    def get(cls, attrs: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+        """Merge current scope attrs with explicit ones (explicit win)."""
+        out = dict(_STATE.stack[-1]) if _STATE.stack else {}
+        if attrs:
+            out.update({k: str(v) for k, v in attrs.items()})
+        return out
+
+
+def current_attrs() -> Dict[str, str]:
+    return dict(_STATE.stack[-1]) if _STATE.stack else {}

@@ -4,5 +4,6 @@
 from . import amp
 from . import quantization
 from . import onnx
+from . import text
 
-__all__ = ["amp", "quantization", "onnx"]
+__all__ = ["amp", "quantization", "onnx", "text"]

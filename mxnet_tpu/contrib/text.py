@@ -1,0 +1,173 @@
+"""Text utilities: vocabulary + token embeddings.
+
+Reference surface: ``python/mxnet/contrib/text/`` —
+``vocab.Vocabulary``, ``embedding.TokenEmbedding``/``CustomEmbedding``,
+``utils.count_tokens_from_str``.  Pretrained-embedding downloads
+(GloVe/fastText) need egress this build doesn't have; the file-backed
+``CustomEmbedding`` covers the same API with local vectors.
+"""
+from __future__ import annotations
+
+import re
+from collections import Counter
+from typing import List, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["count_tokens_from_str", "Vocabulary", "CustomEmbedding",
+           "get_pretrained_file_names"]
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """Token frequency Counter from raw text (reference:
+    text.utils.count_tokens_from_str)."""
+    source_str = re.sub(
+        f"[{re.escape(token_delim)}{re.escape(seq_delim)}]+", " ",
+        source_str)
+    if to_lower:
+        source_str = source_str.lower()
+    counter = counter_to_update if counter_to_update is not None \
+        else Counter()
+    counter.update(t for t in source_str.split(" ") if t)
+    return counter
+
+
+class Vocabulary:
+    """Indexed vocabulary with reserved tokens (reference:
+    text.vocab.Vocabulary)."""
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq < 1:
+            raise MXNetError("min_freq must be >= 1")
+        reserved_tokens = list(reserved_tokens or [])
+        if unknown_token in reserved_tokens:
+            raise MXNetError("unknown_token must not be reserved")
+        if len(set(reserved_tokens)) != len(reserved_tokens):
+            raise MXNetError("reserved_tokens must be unique")
+        self._unknown_token = unknown_token
+        self._reserved_tokens = reserved_tokens
+        self._idx_to_token = [unknown_token] + reserved_tokens
+        if counter is not None:
+            pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+            if most_freq_count is not None:
+                pairs = pairs[:most_freq_count]
+            for tok, freq in pairs:
+                if freq >= min_freq and tok not in self._idx_to_token[
+                        :1 + len(reserved_tokens)]:
+                    self._idx_to_token.append(tok)
+        self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def idx_to_token(self) -> List[str]:
+        return list(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return dict(self._token_to_idx)
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return list(self._reserved_tokens)
+
+    def to_indices(self, tokens):
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        out = [self._token_to_idx.get(t, 0) for t in toks]
+        return out[0] if single else out
+
+    def to_tokens(self, indices):
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        out = []
+        for i in idxs:
+            if not 0 <= i < len(self._idx_to_token):
+                raise MXNetError(f"token index {i} out of range")
+            out.append(self._idx_to_token[i])
+        return out[0] if single else out
+
+
+class CustomEmbedding:
+    """Token embedding loaded from a local vector file: one line per
+    token, ``token v1 v2 ... vD`` (reference: text.embedding
+    .CustomEmbedding)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ",
+                 encoding="utf8", vocabulary: Optional[Vocabulary] = None,
+                 init_unknown_vec=None):
+        tokens, vecs = [], []
+        with open(pretrained_file_path, encoding=encoding) as f:
+            for line in f:
+                parts = line.rstrip().split(elem_delim)
+                if len(parts) < 2:
+                    continue
+                tokens.append(parts[0])
+                vecs.append(np.asarray([float(x) for x in parts[1:]],
+                                       np.float32))
+        if not tokens:
+            raise MXNetError(f"no vectors found in {pretrained_file_path}")
+        dim = len(vecs[0])
+        for t, v in zip(tokens, vecs):
+            if len(v) != dim:
+                raise MXNetError(
+                    f"inconsistent vector length for token {t!r}")
+        self._vec_len = dim
+        file_map = dict(zip(tokens, vecs))
+        if vocabulary is None:
+            vocabulary = Vocabulary(Counter(tokens))
+        self._vocab = vocabulary
+        unk = (init_unknown_vec or (lambda d: np.zeros(d, np.float32)))(dim)
+        table = [np.asarray(unk, np.float32)]
+        for tok in vocabulary.idx_to_token[1:]:
+            table.append(file_map.get(tok, np.asarray(unk, np.float32)))
+        self._idx_to_vec = np.stack(table)
+
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        from .. import ndarray as nd
+        return nd.array(self._idx_to_vec)
+
+    def __len__(self):
+        return len(self._vocab)
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        from .. import ndarray as nd
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        t2i = self._vocab._token_to_idx
+        idxs = []
+        for t in toks:
+            i = t2i.get(t)
+            if i is None and lower_case_backup:
+                i = t2i.get(t.lower())
+            idxs.append(0 if i is None else i)
+        out = self._idx_to_vec[idxs]
+        return nd.array(out[0] if single else out)
+
+    def to_indices(self, tokens):
+        return self._vocab.to_indices(tokens)
+
+    def to_tokens(self, indices):
+        return self._vocab.to_tokens(indices)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Reference: text.embedding.get_pretrained_file_names — the download
+    catalog needs network egress this build doesn't have."""
+    raise MXNetError(
+        "pretrained embedding downloads are unavailable (no network "
+        "egress); load local vectors with contrib.text.CustomEmbedding")

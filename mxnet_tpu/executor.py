@@ -42,9 +42,14 @@ class Executor:
     """
 
     def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
-                 aux_states=None):
+                 aux_states=None, group2ctx=None):
         self._symbol = symbol
         self._ctx = ctx
+        # reference manual model parallelism (AttrScope ctx_group +
+        # Bind(group2ctx)): accepted for source compatibility; placement
+        # is superseded by GSPMD sharding over one logical memory space,
+        # so groups are retained as metadata, not device pins
+        self._group2ctx = dict(group2ctx or {})
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
         self.arg_dict: Dict[str, NDArray] = _as_dict(args, arg_names, "args")

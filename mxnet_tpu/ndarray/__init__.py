@@ -151,12 +151,30 @@ def save(fname, data):
 
 
 def load(fname):
+    from ..compat import is_dmlc_params, load_params_dmlc
+    if is_dmlc_params(fname):
+        # legacy upstream .params container (migration shim)
+        return load_params_dmlc(fname)
     with _np.load(fname, allow_pickle=False) as z:
         fmt = str(z["__mx_format__"]) if "__mx_format__" in z else "dict"
         if fmt == "list":
             n = len([k for k in z.files if k.startswith("__arr_")])
             return [array(z[f"__arr_{i}"]) for i in range(n)]
         return {k: array(z[k]) for k in z.files if k != "__mx_format__"}
+
+
+def from_dlpack(ext):
+    """Wrap an external DLPack tensor/capsule as an NDArray (reference:
+    mx.nd.from_dlpack).  Zero-copy for host buffers; accepts any object
+    with ``__dlpack__`` (torch/numpy tensors) or a raw capsule."""
+    import jax.numpy as jnp
+    return NDArray(jnp.from_dlpack(ext))
+
+
+def from_numpy(arr, zero_copy=True):
+    """Reference: mx.nd.from_numpy — host-array import (the backing
+    buffer is copied to the device; zero_copy is best-effort)."""
+    return array(arr)
 
 
 # random namespace: mx.nd.random.uniform etc.

@@ -163,6 +163,25 @@ class NDArray:
         self.wait_to_read()
         return np.asarray(self._data)
 
+    # DLPack interop (reference: NDArray DLPack methods over
+    # include/mxnet/tensor_blob.h DLTensor).  Zero-copy where the backing
+    # PJRT buffer is host/GPU memory; arrays are immutable here, so the
+    # "for_write" variant shares the read contract and mutation of the
+    # exported view is undefined (the reference's write capsule mutates
+    # in place — not expressible over immutable XLA buffers).
+    def to_dlpack_for_read(self):
+        self.wait_to_read()
+        return self._data.__dlpack__()
+
+    to_dlpack_for_write = to_dlpack_for_read
+
+    def __dlpack__(self, *args, **kwargs):
+        self.wait_to_read()
+        return self._data.__dlpack__(*args, **kwargs)
+
+    def __dlpack_device__(self):
+        return self._data.__dlpack_device__()
+
     def asscalar(self):
         if self.size != 1:
             raise MXNetError("The current array is not a scalar")

@@ -1,0 +1,304 @@
+"""Python custom operators.
+
+Reference surface: ``python/mxnet/operator.py`` + the C++ trampoline
+``src/operator/custom/custom.cc`` — ``CustomOp`` (forward/backward in
+python over NDArrays), ``CustomOpProp`` (shape/type inference + operator
+factory), ``mx.operator.register``, invoked as
+``mx.nd.Custom(*args, op_type="name")`` / ``mx.sym.Custom(...)``.
+
+TPU-native redesign: the reference trampolines from the C++ engine back
+into python on a dedicated thread.  Here the python body runs through
+``jax.pure_callback`` with a ``jax.custom_vjp`` wired to the user's
+``backward`` — which means Custom ops work not only eagerly but also
+inside ``hybridize()``/``jit`` traces (the callback escapes to host mid-
+program), something the reference's CachedOp never supported for
+CustomOp.  The host round trip makes Custom ops slow by construction —
+the docstring contract mirrors the reference: use them for research
+glue, not hot-path kernels.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Type
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered"]
+
+
+class CustomOp:
+    """Base class for custom operator implementations (reference:
+    mx.operator.CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise MXNetError(
+            f"{type(self).__name__}.backward not implemented; gradients "
+            f"through this Custom op are unavailable")
+
+    def assign(self, dst, req, src):
+        """Write ``src`` into ``dst`` honoring the grad req (reference:
+        CustomOp.assign)."""
+        if req in ("null", 0):
+            return
+        if req in ("add", 3):
+            dst += src
+        else:                              # write / inplace
+            dst[:] = src
+
+
+class CustomOpProp:
+    """Shape/type inference + factory (reference: mx.operator.CustomOpProp).
+
+    Subclasses override list_arguments/list_outputs/infer_shape/
+    infer_type/create_operator.
+    """
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = bool(need_top_grad)
+        self.kwargs: Dict[str, str] = {}
+
+    def list_arguments(self) -> List[str]:
+        return ["data"]
+
+    def list_outputs(self) -> List[str]:
+        return ["output"]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def create_operator(self, ctx, in_shapes, in_dtypes) -> CustomOp:
+        raise NotImplementedError
+
+
+_CUSTOM_REGISTRY: Dict[str, Type[CustomOpProp]] = {}
+
+
+def register(reg_name):
+    """Register a CustomOpProp subclass under ``op_type`` (reference:
+    mx.operator.register)."""
+
+    def deco(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register expects a CustomOpProp subclass")
+        _CUSTOM_REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return deco
+
+
+def get_all_registered():
+    return dict(_CUSTOM_REGISTRY)
+
+
+def _make_prop(op_type, kwargs):
+    cls = _CUSTOM_REGISTRY.get(op_type)
+    if cls is None:
+        raise MXNetError(
+            f"Custom op_type {op_type!r} is not registered "
+            f"(known: {sorted(_CUSTOM_REGISTRY)})")
+    # the reference passes ctor kwargs as strings through the C ABI
+    prop = cls(**{k: str(v) for k, v in kwargs.items()})
+    prop.kwargs = dict(kwargs)
+    return prop
+
+
+class _Plan:
+    """Resolved shapes/dtypes + operator instance for one Custom call."""
+
+    def __init__(self, op_type, kwargs, in_shapes, in_dtypes):
+        import jax
+        self.prop = _make_prop(op_type, kwargs)
+        if self.prop.list_auxiliary_states():
+            raise MXNetError("Custom ops with auxiliary states are not "
+                             "supported on the TPU build")
+        self.n_in = len(self.prop.list_arguments())
+        if len(in_shapes) != self.n_in:
+            raise MXNetError(
+                f"Custom[{op_type}] expects {self.n_in} inputs "
+                f"({self.prop.list_arguments()}), got {len(in_shapes)}")
+        self.in_shapes = in_shapes
+        self.in_dtypes = in_dtypes
+        _, out_shapes, _ = self.prop.infer_shape(in_shapes)
+        _, out_dtypes, _ = self.prop.infer_type(in_dtypes)
+        self.out_specs = [jax.ShapeDtypeStruct(tuple(s), np.dtype(d))
+                          for s, d in zip(out_shapes, out_dtypes)]
+        self.in_specs = [jax.ShapeDtypeStruct(tuple(s), np.dtype(d))
+                         for s, d in zip(in_shapes, in_dtypes)]
+        self.op = self.prop.create_operator(None, in_shapes, in_dtypes)
+
+    def fwd_host(self, *arrays):
+        import jax.numpy as jnp
+        from . import autograd
+        from .ndarray import NDArray
+        ins = [NDArray(jnp.asarray(np.asarray(a))) for a in arrays]
+        outs = [NDArray(jnp.zeros(s.shape, s.dtype))
+                for s in self.out_specs]
+        self.op.forward(autograd.is_training(), ["write"] * len(outs),
+                        ins, outs, [])
+        return tuple(np.asarray(o._data, dtype=sp.dtype)
+                     for o, sp in zip(outs, self.out_specs))
+
+    def bwd_host(self, *arrays):
+        import jax.numpy as jnp
+        from .ndarray import NDArray
+        n_out = len(self.out_specs)
+        ograds = [NDArray(jnp.asarray(np.asarray(a)))
+                  for a in arrays[:n_out]]
+        rest = arrays[n_out:]
+        ins = [NDArray(jnp.asarray(np.asarray(a)))
+               for a in rest[:self.n_in]]
+        outs = [NDArray(jnp.asarray(np.asarray(a)))
+                for a in rest[self.n_in:]]
+        igrads = [NDArray(jnp.zeros(s.shape, s.dtype))
+                  for s in self.in_specs]
+        self.op.backward(["write"] * self.n_in, ograds, ins, outs,
+                         igrads, [])
+        return tuple(np.asarray(g._data, dtype=s.dtype)
+                     for g, s in zip(igrads, self.in_specs))
+
+
+def _custom_traced(inputs, op_type, kwargs):
+    """Traced (hybridize/jit) body: pure-callback forward with a
+    custom_vjp backward.  Needs a callback-capable backend (CPU mesh is;
+    some remote-dispatch TPU backends are not — eager Custom always
+    works because it bypasses tracing entirely)."""
+    import jax
+    import jax.numpy as jnp
+
+    plan = _Plan(op_type, kwargs,
+                 [list(a.shape) for a in inputs],
+                 [str(a.dtype) for a in inputs])
+
+    if not any(isinstance(a, jax.core.Tracer) for a in inputs):
+        # concrete arrays (Symbol.eval interpret path): run the
+        # trampoline directly — callback machinery may be unsupported
+        # on the backend and is unnecessary without a trace
+        outs = tuple(jnp.asarray(o) for o in plan.fwd_host(*inputs))
+        return outs if len(plan.out_specs) > 1 else outs[0]
+
+    @jax.custom_vjp
+    def run(*arrays):
+        return jax.pure_callback(plan.fwd_host, tuple(plan.out_specs),
+                                 *arrays)
+
+    def run_fwd(*arrays):
+        outs = jax.pure_callback(plan.fwd_host, tuple(plan.out_specs),
+                                 *arrays)
+        return outs, (arrays, outs)
+
+    def run_bwd(res, cots):
+        arrays, outs = res
+        if not isinstance(cots, tuple):
+            cots = (cots,)
+        grads = jax.pure_callback(plan.bwd_host, tuple(plan.in_specs),
+                                  *cots, *arrays, *outs)
+        return tuple(grads)
+
+    run.defvjp(run_fwd, run_bwd)
+    result = run(*inputs)
+    return result if len(plan.out_specs) > 1 else result[0]
+
+
+def _custom_eager(nd_inputs, op_type, kwargs):
+    """Eager path: direct python trampoline, no jax tracing anywhere —
+    the tape node gets a host-side custom backward (reference:
+    custom.cc pushes the python callbacks onto the engine)."""
+    import jax.numpy as jnp
+    from . import autograd
+    from .ndarray import NDArray
+
+    plan = _Plan(op_type, kwargs,
+                 [list(a.shape) for a in nd_inputs],
+                 [str(a._data.dtype) for a in nd_inputs])
+    raw_outs = plan.fwd_host(*[a._data for a in nd_inputs])
+    outs = [NDArray(jnp.asarray(o)) for o in raw_outs]
+
+    if autograd.is_recording():
+        def custom_backward(out_grads, in_primals, _plan=plan,
+                            _raw_outs=raw_outs):
+            grads = _plan.bwd_host(*out_grads, *in_primals, *_raw_outs)
+            return tuple(jnp.asarray(g) for g in grads)
+
+        autograd.record_custom_node(nd_inputs, outs, custom_backward,
+                                    name=f"Custom[{op_type}]")
+    from .engine import engine, is_naive
+    eng = engine()
+    if is_naive():
+        for o in outs:
+            o.wait_to_read()
+    for o in outs:
+        eng.track(o)
+    return outs[0] if len(outs) == 1 else outs
+
+
+def _register_custom_op():
+    """Hook the 'Custom' operator into the shared registry so it is
+    reachable as mx.nd.Custom / mx.sym.Custom (reference: custom.cc
+    NNVM registration)."""
+    from .ops.registry import register as reg_op
+
+    def n_outputs(kwargs):
+        try:
+            prop = _make_prop(kwargs.get("op_type", ""),
+                              {k: v for k, v in kwargs.items()
+                               if k != "op_type"})
+            return len(prop.list_outputs())
+        except MXNetError:
+            return 1
+
+    @reg_op("Custom", num_inputs=None, num_outputs=n_outputs)
+    def Custom(*data, op_type: str = "", **kwargs):
+        # reached with raw arrays only under a trace (hybridize / the
+        # symbolic executor's jit); the NDArray frontend below routes
+        # eager calls around invoke entirely
+        return _custom_traced(list(data), op_type, kwargs)
+
+    # this module imports after the nd/sym namespaces generated their
+    # frontends, so attach Custom's frontend explicitly.  The nd frontend
+    # dispatches eager NDArray calls to the python trampoline (no jax
+    # trace -> works on every backend); Symbols go through the registry.
+    from .ops.registry import get_op, make_frontend
+    from . import ndarray as nd_mod
+    from . import symbol as sym_mod
+    from .symbol import Symbol
+    sym_frontend = make_frontend(get_op("Custom"))
+
+    def frontend(*args, op_type: str = "", out=None, **kwargs):
+        import jax
+        from .ops.registry import invoke
+        if args and isinstance(args[0], (list, tuple)):
+            args = tuple(args[0]) + tuple(args[1:])
+        if args and isinstance(args[0], Symbol):
+            return sym_frontend(*args, op_type=op_type, **kwargs)
+        if any(isinstance(a._data, jax.core.Tracer) for a in args):
+            # inside a hybridize/jit trace: take the pure_callback path
+            return invoke(get_op("Custom"), list(args),
+                          {"op_type": op_type, **kwargs}, out=out)
+        res = _custom_eager(list(args), op_type, kwargs)
+        if out is not None:
+            dsts = [out] if not isinstance(out, (list, tuple)) else list(out)
+            srcs = [res] if not isinstance(res, (list, tuple)) else list(res)
+            for d, s in zip(dsts, srcs):
+                d._set_data(s._data)
+                d._autograd_node = s._autograd_node
+            return out
+        return res
+
+    for mod in (nd_mod, nd_mod.op, sym_mod, sym_mod.op):
+        setattr(mod, "Custom", sym_frontend if mod in (sym_mod, sym_mod.op)
+                else frontend)
+    return Custom
+
+
+_register_custom_op()

@@ -50,7 +50,8 @@ class OpDef:
     """
 
     __slots__ = ("name", "fn", "num_inputs", "num_outputs", "differentiable",
-                 "params", "doc", "aliases", "mutates_rng", "aux_update")
+                 "params", "doc", "aliases", "mutates_rng", "aux_update",
+                 "open_schema")
 
     def __init__(self, name: str, fn: Callable, num_inputs, num_outputs,
                  differentiable: bool, mutates_rng: bool = False):
@@ -72,6 +73,10 @@ class OpDef:
             k: p for k, p in sig.parameters.items()
             if p.kind == inspect.Parameter.KEYWORD_ONLY
         }
+        # ops with **kwargs (Custom: user-defined ctor args pass through
+        # the string-kv ABI like the reference) accept arbitrary keys
+        self.open_schema = any(p.kind == inspect.Parameter.VAR_KEYWORD
+                               for p in sig.parameters.values())
         self.doc = inspect.getdoc(fn) or f"Operator {name}."
 
     def n_outputs(self, kwargs) -> int:
@@ -80,6 +85,8 @@ class OpDef:
         return self.num_outputs
 
     def validate_kwargs(self, kwargs: Dict[str, Any]):
+        if self.open_schema:
+            return
         for k in kwargs:
             if k not in self.params:
                 raise MXNetError(

@@ -241,9 +241,10 @@ class Symbol:
 
     # bind/simple_bind live in executor.py (imported lazily to avoid cycle)
     def bind(self, ctx, args, args_grad=None, grad_req="write", aux_states=None,
-             **kwargs):
+             group2ctx=None, **kwargs):
         from ..executor import Executor
-        return Executor(self, ctx, args, args_grad, grad_req, aux_states)
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states,
+                        group2ctx=group2ctx)
 
     def simple_bind(self, ctx, grad_req="write", type_dict=None, **shapes):
         from ..executor import Executor
@@ -266,13 +267,19 @@ class Symbol:
         idx = {id(n): i for i, n in enumerate(nodes)}
         jnodes = []
         for n in nodes:
-            jnodes.append({
+            jn = {
                 "op": "null" if n.is_variable else n.op.name,
                 "name": n.name,
                 "attrs": {k: json.dumps(v) if not isinstance(v, str) else v
                           for k, v in n.kwargs.items()},
                 "inputs": [[idx[id(src)], i, 0] for src, i in n.inputs],
-            })
+            }
+            if n.attrs:
+                # user/scope attributes (ctx_group, __shape__, ...) live
+                # beside op params so AttrScope metadata survives
+                # save/load_json (reference keeps both in nnvm attrs)
+                jn["user_attrs"] = dict(n.attrs)
+            jnodes.append(jn)
         heads = [[idx[id(n)], i, 0] for n, i in self._outputs]
         return json.dumps({"nodes": jnodes, "heads": heads,
                            "mxnet_tpu_version": 1}, indent=2)
@@ -352,6 +359,10 @@ def invoke_symbolic(opdef, args, kwargs) -> Symbol:
                 f"got {type(a)}")
     nout = opdef.n_outputs(kwargs)
     node = _SymNode(opdef, inputs, kwargs, name, nout)
+    from ..attribute import current_attrs
+    scope = current_attrs()
+    if scope:
+        node.attrs.update(scope)
     return Symbol([(node, i) for i in range(nout)])
 
 
@@ -359,6 +370,10 @@ def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
         init=None, stype=None, **kwargs) -> Symbol:
     """Create a variable symbol (reference: mx.sym.var / Variable)."""
     node = _SymNode(None, [], {}, name)
+    from ..attribute import current_attrs
+    node.attrs.update(current_attrs())
+    if attr:
+        node.attrs.update({k: str(v) for k, v in attr.items()})
     if shape is not None:
         node.attrs["__shape__"] = str(tuple(shape))
     if dtype is not None:
@@ -407,6 +422,8 @@ def load_json(json_str: str) -> Symbol:
             inputs = [(nodes[i], oi) for i, oi, _ in jn["inputs"]]
             node = _SymNode(opdef, inputs, kwargs, jn["name"],
                             opdef.n_outputs(kwargs))
+        if jn.get("user_attrs"):
+            node.attrs.update(jn["user_attrs"])
         nodes.append(node)
     heads = [(nodes[i], oi) for i, oi, _ in data["heads"]]
     return Symbol(heads)

@@ -268,3 +268,24 @@ def test_correlation_vs_oracle():
         want = _correlation_oracle(d1, d2, **kwargs)
         assert got.shape == want.shape, (got.shape, want.shape, kwargs)
         assert np.allclose(got, want, rtol=1e-4, atol=1e-5), kwargs
+
+
+def test_legacy_params_format_roundtrip(tmp_path):
+    from mxnet_tpu import compat
+    arrays = {"fc1_weight": nd.random.uniform(shape=(8, 4)),
+              "fc1_bias": nd.array(np.arange(8, dtype=np.float32)),
+              "count": nd.array(np.array([3], dtype=np.int32)
+                                ).astype("int32"),
+              "scalar": nd.array(np.float32(7.5).reshape(()))}
+    path = str(tmp_path / "model-0000.params")
+    compat.save_params_dmlc(path, arrays)
+    # magic detected and routed by plain nd.load
+    back = nd.load(path)
+    assert set(back) == set(arrays)
+    for k in arrays:
+        assert str(back[k].dtype) == str(arrays[k].dtype), k
+        assert np.allclose(back[k].asnumpy(), arrays[k].asnumpy()), k
+    # header is the documented dmlc list magic
+    import struct
+    with open(path, "rb") as f:
+        assert struct.unpack("<Q", f.read(8))[0] == 0x112

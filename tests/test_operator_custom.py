@@ -1,0 +1,148 @@
+"""CustomOp trampoline + DLPack + AttrScope tests (reference:
+tests/python/unittest/test_operator.py::test_custom_op,
+test_ndarray.py dlpack cases, test_attr.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+
+class _Sigmoid(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        y = 1.0 / (1.0 + nd.exp(-in_data[0]))
+        self.assign(out_data[0], req[0], y)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0]
+        self.assign(in_grad[0], req[0], out_grad[0] * y * (1 - y))
+
+
+@mx.operator.register("t_sigmoid")
+class _SigmoidProp(mx.operator.CustomOpProp):
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return _Sigmoid()
+
+
+@mx.operator.register("t_twoout")
+class _TwoOutProp(mx.operator.CustomOpProp):
+    def list_outputs(self):
+        return ["sum", "diff"]
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        class TwoOut(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                self.assign(out_data[0], req[0], in_data[0] + in_data[1])
+                self.assign(out_data[1], req[1], in_data[0] - in_data[1])
+        return TwoOut()
+
+    def list_arguments(self):
+        return ["a", "b"]
+
+
+def test_custom_op_eager_forward_backward():
+    x = nd.array([[-1.0, 0.0, 2.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="t_sigmoid")
+        y.sum().backward()
+    ref = 1 / (1 + np.exp(-x.asnumpy()))
+    assert np.allclose(y.asnumpy(), ref, rtol=1e-5)
+    assert np.allclose(x.grad.asnumpy(), ref * (1 - ref), rtol=1e-5)
+
+
+def test_custom_op_symbolic_and_multi_output():
+    import mxnet_tpu.symbol as sym
+    s = sym.Custom(sym.var("a"), sym.var("b"), op_type="t_twoout")
+    a, b = nd.array([3.0, 1.0]), nd.array([1.0, 4.0])
+    outs = s.eval(a=a, b=b)
+    assert np.allclose(outs[0].asnumpy(), [4.0, 5.0])
+    assert np.allclose(outs[1].asnumpy(), [2.0, -3.0])
+
+
+def test_custom_op_hybridized():
+    class Blk(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            return F.Custom(x, op_type="t_sigmoid") * 2.0
+
+    b = Blk()
+    b.hybridize()
+    x = nd.array([[0.5, -0.5]])
+    x.attach_grad()
+    with autograd.record():
+        out = b(x)
+        out.sum().backward()
+    r = 1 / (1 + np.exp(-x.asnumpy()))
+    assert np.allclose(out.asnumpy(), 2 * r, rtol=1e-5)
+    assert np.allclose(x.grad.asnumpy(), 2 * r * (1 - r), rtol=1e-5)
+
+
+def test_custom_op_unknown_type_raises():
+    with pytest.raises(mx.MXNetError, match="not registered"):
+        nd.Custom(nd.ones((2,)), op_type="nope_never_registered")
+
+
+def test_dlpack_torch_roundtrip():
+    torch = pytest.importorskip("torch")
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    t = torch.utils.dlpack.from_dlpack(x)        # NDArray __dlpack__
+    assert t.shape == (2, 2)
+    assert np.allclose(t.numpy(), x.asnumpy())
+    back = nd.from_dlpack(torch.arange(4.0) + 1)
+    assert isinstance(back, nd.NDArray)
+    assert np.allclose(back.asnumpy(), [1, 2, 3, 4])
+    cap = x.to_dlpack_for_read()
+    t2 = torch.utils.dlpack.from_dlpack(cap)
+    assert np.allclose(t2.numpy(), x.asnumpy())
+
+
+def test_attr_scope_ctx_group():
+    import mxnet_tpu.symbol as sym
+    with mx.AttrScope(ctx_group="dev1", custom="yes"):
+        a = sym.var("a")
+        with mx.AttrScope(ctx_group="dev2"):
+            b = sym.var("b")
+        c = sym.relu(a)
+    d = sym.relu(c)
+    assert a.attr("ctx_group") == "dev1"
+    assert a.attr("custom") == "yes"
+    assert b.attr("ctx_group") == "dev2"      # inner scope overrides
+    assert b.attr("custom") == "yes"          # outer attrs inherited
+    assert c.attr("ctx_group") == "dev1"
+    assert d.attr("ctx_group") is None        # outside any scope
+
+    # group2ctx accepted by bind (placement is GSPMD's job; API parity)
+    out = sym.FullyConnected(b, sym.var("w"), num_hidden=4, no_bias=True)
+    exe = out.bind(mx.cpu(), {"b": nd.ones((2, 3)),
+                              "w": nd.ones((4, 3))},
+                   group2ctx={"dev1": mx.cpu(0), "dev2": mx.cpu(0)})
+    got = exe.forward()
+    assert got[0].shape == (2, 4)
+
+
+def test_attr_scope_survives_json_roundtrip():
+    import mxnet_tpu.symbol as sym
+    with mx.AttrScope(ctx_group="dev1"):
+        r = sym.relu(sym.var("a"))
+    back = sym.load_json(r.tojson())
+    assert back.attr("ctx_group") == "dev1"
+    assert back.get_internals()["a_output"].attr("ctx_group") == "dev1"
+
+
+def test_custom_op_out_kwarg_multi_output():
+    a, b = nd.array([3.0, 1.0]), nd.array([1.0, 4.0])
+    o1, o2 = nd.zeros((2,)), nd.zeros((2,))
+    nd.Custom(a, b, op_type="t_twoout", out=[o1, o2])
+    assert np.allclose(o1.asnumpy(), [4.0, 5.0])
+    assert np.allclose(o2.asnumpy(), [2.0, -3.0])
+    # list-then-positional input spelling keeps ALL inputs
+    outs = nd.Custom([a], b, op_type="t_twoout")
+    assert np.allclose(outs[0].asnumpy(), [4.0, 5.0])
+
+
+def test_nd_load_accepts_file_object(tmp_path):
+    p = tmp_path / "x.npz"
+    nd.save(str(p), {"w": nd.ones((2, 2))})
+    with open(p, "rb") as f:
+        back = nd.load(f)
+    assert np.allclose(back["w"].asnumpy(), 1.0)

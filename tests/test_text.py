@@ -1,0 +1,43 @@
+"""contrib.text tests (reference: tests/python/unittest/test_contrib_text.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import text
+
+
+def test_count_tokens_and_vocab():
+    c = text.count_tokens_from_str("a b b c c c\nd d d d", to_lower=True)
+    assert c["c"] == 3 and c["b"] == 2
+    v = text.Vocabulary(c, min_freq=2, reserved_tokens=["<pad>"])
+    assert v.unknown_token == "<unk>"
+    assert v.idx_to_token[0] == "<unk>" and v.idx_to_token[1] == "<pad>"
+    # freq order: d(4), c(3), b(2); 'a'(1) dropped by min_freq
+    assert v.idx_to_token[2:] == ["d", "c", "b"]
+    assert v.to_indices(["d", "zzz"]) == [2, 0]
+    assert v.to_tokens([2, 0]) == ["d", "<unk>"]
+    assert len(v) == 5
+
+
+def test_custom_embedding(tmp_path):
+    p = tmp_path / "vecs.txt"
+    p.write_text("hello 1.0 2.0 3.0\nworld 4.0 5.0 6.0\n")
+    emb = text.CustomEmbedding(str(p))
+    assert emb.vec_len == 3
+    got = emb.get_vecs_by_tokens(["world", "hello", "missing"])
+    assert np.allclose(got.asnumpy(),
+                       [[4, 5, 6], [1, 2, 3], [0, 0, 0]])
+    single = emb.get_vecs_by_tokens("hello")
+    assert np.allclose(single.asnumpy(), [1, 2, 3])
+    table = emb.idx_to_vec
+    assert table.shape == (len(emb), 3)
+
+
+def test_pretrained_names_raise():
+    with pytest.raises(mx.MXNetError, match="egress"):
+        text.get_pretrained_file_names("glove")
+
+
+def test_count_tokens_metachar_delims():
+    c = text.count_tokens_from_str("a^b^^c", token_delim="^", seq_delim="|")
+    assert c == {"a": 1, "b": 1, "c": 1}

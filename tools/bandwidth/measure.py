@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""kvstore allreduce bandwidth harness.
+
+Reference surface: ``tools/bandwidth/measure.py`` — time
+``kvstore.pushpull`` over a range of tensor sizes to localize comm
+regressions (bucketing thresholds, collective fusion).
+
+On one host this measures the 'xla' tier over the virtual device mesh:
+
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    PYTHONPATH=. python tools/bandwidth/measure.py --num-devices 8
+
+One JSON line per size:
+  {"bytes": N, "avg_ms": .., "algo_gbps": ..}
+(algorithmic bandwidth: 2*(n-1)/n * bytes / time, the allreduce rule)
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-devices", type=int, default=0,
+                    help="devices in the reduce group (default: all)")
+    ap.add_argument("--min-kb", type=int, default=4)
+    ap.add_argument("--max-mb", type=int, default=64)
+    ap.add_argument("--runs", type=int, default=5)
+    ap.add_argument("--kvstore", default="device")
+    args = ap.parse_args()
+
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    devs = jax.devices()
+    n = args.num_devices or len(devs)
+    if n > len(devs):
+        raise SystemExit(f"need {n} devices, have {len(devs)}")
+    kv = mx.kv.create(args.kvstore)
+    ctxs = [mx.Context(devs[i].platform, i) for i in range(n)]
+
+    size = args.min_kb * 1024 // 4
+    max_elems = args.max_mb * 1024 * 1024 // 4
+    key = 0
+    while size <= max_elems:
+        vals = [nd.ones((size,), ctx=c) for c in ctxs]
+        kv.init(str(key), vals[0])
+        out = [nd.empty((size,), ctx=c) for c in ctxs]
+        def sync():
+            # force EVERY device's chain: the pull half broadcasts the
+            # reduced value to all n devices, and that is part of the
+            # allreduce being measured
+            for o in out:
+                jax.device_get(o._data[:1])
+
+        for _ in range(2):                                    # warmup
+            kv.pushpull(str(key), vals, out=out)
+            sync()
+        t0 = time.perf_counter()
+        for _ in range(args.runs):
+            kv.pushpull(str(key), vals, out=out)
+        sync()
+        dt = (time.perf_counter() - t0) / args.runs
+        nbytes = size * 4
+        algo = 2 * (n - 1) / max(n, 1) * nbytes / dt / 1e9
+        print(json.dumps({"bytes": nbytes, "devices": n,
+                          "avg_ms": round(dt * 1e3, 3),
+                          "algo_gbps": round(algo, 3)}))
+        key += 1
+        size *= 4
+
+
+if __name__ == "__main__":
+    main()
