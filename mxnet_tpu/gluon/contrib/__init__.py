@@ -1,4 +1,6 @@
 """Gluon contrib (reference: python/mxnet/gluon/contrib/)."""
 from . import estimator
+from . import nn
+from . import rnn
 
-__all__ = ["estimator"]
+__all__ = ["estimator", "nn", "rnn"]

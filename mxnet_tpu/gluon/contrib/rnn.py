@@ -1,0 +1,149 @@
+"""Contrib recurrent cells.
+
+Reference surface: ``python/mxnet/gluon/contrib/rnn/`` —
+``VariationalDropoutCell`` (one dropout mask per sequence, Gal & Ghahramani)
+and ``Conv2DLSTMCell`` (convolutional state transitions, Shi et al.).
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ..rnn.rnn_cell import HybridRecurrentCell, ModifierCell
+
+__all__ = ["VariationalDropoutCell", "Conv2DLSTMCell"]
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Applies the SAME dropout mask at every time step (reference:
+    contrib.rnn.VariationalDropoutCell).  Masks are drawn once per
+    sequence (after reset()) from the framework RNG so they respect
+    mx.random.seed.
+
+    Imperative-only: the per-sequence mask is python-side state, which a
+    hybridized trace would either leak (tracer escape) or silently
+    re-randomize per step — calling this cell under hybridize raises
+    instead (the reference cell has the same cached-mask design and the
+    same limitation applies in spirit)."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0):
+        super().__init__(base_cell)
+        self._drop_inputs = drop_inputs
+        self._drop_states = drop_states
+        self._drop_outputs = drop_outputs
+        self._mask_in = None
+        self._mask_states = None
+        self._mask_out = None
+
+    def reset(self):
+        super().reset()
+        self._mask_in = None
+        self._mask_states = None
+        self._mask_out = None
+
+    @staticmethod
+    def _mask(F, p, like):
+        keep = F.random.uniform(0, 1, shape=like.shape) >= p
+        return keep.astype(like.dtype) / (1 - p)
+
+    def hybrid_forward(self, F, x, *states):
+        import jax
+        from ... import autograd
+        if isinstance(getattr(x, "_data", None), jax.core.Tracer):
+            raise MXNetError(
+                "VariationalDropoutCell cannot be hybridized: the "
+                "per-sequence dropout mask is python-side state that a "
+                "compiled trace would re-randomize per step; use the "
+                "cell imperatively")
+        training = autograd.is_training()
+        if training and self._drop_inputs:
+            if self._mask_in is None:
+                self._mask_in = self._mask(F, self._drop_inputs, x)
+            x = x * self._mask_in
+        if training and self._drop_states:
+            if self._mask_states is None:
+                self._mask_states = self._mask(F, self._drop_states,
+                                               states[0])
+            states = (states[0] * self._mask_states,) + tuple(states[1:])
+        out, nstates = self.base_cell(x, list(states))
+        if training and self._drop_outputs:
+            if self._mask_out is None:
+                self._mask_out = self._mask(F, self._drop_outputs, out)
+            out = out * self._mask_out
+        return out, nstates
+
+    def _alias(self):
+        return "vardrop"
+
+
+class Conv2DLSTMCell(HybridRecurrentCell):
+    """Convolutional LSTM over NCHW inputs (reference:
+    contrib.rnn.Conv2DLSTMCell): gates computed by conv of input and
+    hidden state; states are feature maps."""
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                 h2h_kernel, i2h_pad=0, **kwargs):
+        super().__init__(**kwargs)
+        c_in, h, w = input_shape
+        self._hidden_channels = hidden_channels
+        k_i = i2h_kernel if isinstance(i2h_kernel, tuple) \
+            else (i2h_kernel, i2h_kernel)
+        k_h = h2h_kernel if isinstance(h2h_kernel, tuple) \
+            else (h2h_kernel, h2h_kernel)
+        if any(k % 2 == 0 for k in k_h):
+            raise MXNetError("h2h_kernel must be odd (same-size state)")
+        pad_i = i2h_pad if isinstance(i2h_pad, tuple) else (i2h_pad,
+                                                            i2h_pad)
+        # the state's spatial size is the i2h conv's OUTPUT size
+        # (reference: _ConvRNNCell computes state_shape from the conv
+        # arithmetic); the h2h conv is same-size over that
+        state_h = h + 2 * pad_i[0] - k_i[0] + 1
+        state_w = w + 2 * pad_i[1] - k_i[1] + 1
+        if state_h < 1 or state_w < 1:
+            raise MXNetError(
+                f"Conv2DLSTMCell: i2h kernel {k_i} with pad {pad_i} "
+                f"leaves no output for input {h}x{w}")
+        self._state_shape = (hidden_channels, state_h, state_w)
+        self._i2h_kernel, self._h2h_kernel = k_i, k_h
+        self._i2h_pad = pad_i
+        self._h2h_pad = (k_h[0] // 2, k_h[1] // 2)
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(4 * hidden_channels, c_in) + k_i,
+                allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight",
+                shape=(4 * hidden_channels, hidden_channels) + k_h,
+                allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(4 * hidden_channels,), init="zeros",
+                allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(4 * hidden_channels,), init="zeros",
+                allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size,) + self._state_shape,
+                 "__layout__": "NCHW"},
+                {"shape": (batch_size,) + self._state_shape,
+                 "__layout__": "NCHW"}]
+
+    def _alias(self):
+        return "conv_lstm"
+
+    def hybrid_forward(self, F, x, h, c, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h = F.Convolution(x, i2h_weight, i2h_bias,
+                            kernel=self._i2h_kernel, pad=self._i2h_pad,
+                            num_filter=4 * self._hidden_channels)
+        h2h = F.Convolution(h, h2h_weight, h2h_bias,
+                            kernel=self._h2h_kernel, pad=self._h2h_pad,
+                            num_filter=4 * self._hidden_channels)
+        gates = i2h + h2h
+        slices = F.split(gates, num_outputs=4, axis=1)
+        i = F.sigmoid(slices[0])
+        f = F.sigmoid(slices[1])
+        g = F.tanh(slices[2])
+        o = F.sigmoid(slices[3])
+        c_new = f * c + i * g
+        h_new = o * F.tanh(c_new)
+        return h_new, [h_new, c_new]

@@ -13,12 +13,14 @@ from .. import nn
 from ..block import HybridBlock
 
 __all__ = ["get_model", "ResNetV1", "ResNetV2", "VGG", "AlexNet",
-           "MobileNet", "MobileNetV2", "SqueezeNet",
+           "MobileNet", "MobileNetV2", "SqueezeNet", "DenseNet",
+           "Inception3",
            "resnet18_v1", "resnet34_v1", "resnet50_v1", "resnet101_v1",
            "resnet152_v1", "resnet18_v2", "resnet34_v2", "resnet50_v2",
            "resnet101_v2", "resnet152_v2", "vgg11", "vgg13", "vgg16",
            "vgg19", "alexnet", "mobilenet1_0", "mobilenet0_5",
-           "mobilenet_v2_1_0", "squeezenet1_0"]
+           "mobilenet_v2_1_0", "squeezenet1_0", "densenet121",
+           "densenet161", "densenet169", "densenet201", "inception_v3"]
 
 
 # ---------------------------------------------------------------- ResNet V1
@@ -518,6 +520,195 @@ def squeezenet1_0(**kw):
     return SqueezeNet("1.0", **kw)
 
 
+# ---------------------------------------------------------------- DenseNet
+class _DenseLayer(HybridBlock):
+    """BN→ReLU→1x1→BN→ReLU→3x3, output concatenated onto the input
+    (reference: model_zoo/vision/densenet.py _make_dense_layer)."""
+
+    def __init__(self, growth_rate, bn_size, dropout, **kwargs):
+        super().__init__(**kwargs)
+        self.body = nn.HybridSequential(prefix="")
+        self.body.add(nn.BatchNorm(), nn.Activation("relu"),
+                      nn.Conv2D(bn_size * growth_rate, 1, use_bias=False),
+                      nn.BatchNorm(), nn.Activation("relu"),
+                      nn.Conv2D(growth_rate, 3, padding=1, use_bias=False))
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def hybrid_forward(self, F, x):
+        out = self.body(x)
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return F.concat(x, out, dim=1)
+
+
+def _transition(channels):
+    out = nn.HybridSequential(prefix="")
+    out.add(nn.BatchNorm(), nn.Activation("relu"),
+            nn.Conv2D(channels, 1, use_bias=False), nn.AvgPool2D(2, 2))
+    return out
+
+
+_DENSENET_SPEC = {121: (64, 32, [6, 12, 24, 16]),
+                  161: (96, 48, [6, 12, 36, 24]),
+                  169: (64, 32, [6, 12, 32, 32]),
+                  201: (64, 32, [6, 12, 48, 32])}
+
+
+class DenseNet(HybridBlock):
+    """DenseNet-BC (reference: model_zoo/vision/densenet.py)."""
+
+    def __init__(self, num_init_features, growth_rate, block_config,
+                 bn_size=4, dropout=0, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.features = nn.HybridSequential(prefix="")
+            self.features.add(
+                nn.Conv2D(num_init_features, 7, 2, 3, use_bias=False),
+                nn.BatchNorm(), nn.Activation("relu"),
+                nn.MaxPool2D(3, 2, 1))
+            channels = num_init_features
+            for i, n_layers in enumerate(block_config):
+                for _ in range(n_layers):
+                    self.features.add(_DenseLayer(growth_rate, bn_size,
+                                                  dropout))
+                    channels += growth_rate
+                if i != len(block_config) - 1:
+                    channels //= 2
+                    self.features.add(_transition(channels))
+            self.features.add(nn.BatchNorm(), nn.Activation("relu"),
+                              nn.GlobalAvgPool2D(), nn.Flatten())
+            self.output = nn.Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
+
+def _densenet(num_layers, **kw):
+    if kw.pop("pretrained", False):
+        raise MXNetError("pretrained weights unavailable (no egress)")
+    init_f, growth, cfg = _DENSENET_SPEC[num_layers]
+    return DenseNet(init_f, growth, cfg, **kw)
+
+
+def densenet121(**kw):
+    return _densenet(121, **kw)
+
+
+def densenet161(**kw):
+    return _densenet(161, **kw)
+
+
+def densenet169(**kw):
+    return _densenet(169, **kw)
+
+
+def densenet201(**kw):
+    return _densenet(201, **kw)
+
+
+# ------------------------------------------------------------ Inception V3
+def _inc_conv(channels, kernel, stride=1, padding=0):
+    out = nn.HybridSequential(prefix="")
+    out.add(nn.Conv2D(channels, kernel, stride, padding, use_bias=False),
+            nn.BatchNorm(epsilon=0.001), nn.Activation("relu"))
+    return out
+
+
+def _IncBranches(branches):
+    """Parallel branches concatenated on channels (the reference
+    inception.py builds exactly this from contrib HybridConcurrent)."""
+    from ..contrib.nn import HybridConcurrent
+    out = HybridConcurrent(axis=1)
+    out.add(*branches)
+    return out
+
+
+def _seq(*blocks):
+    out = nn.HybridSequential(prefix="")
+    out.add(*blocks)
+    return out
+
+
+def _inc_a(pool_features):
+    return _IncBranches([
+        _inc_conv(64, 1),
+        _seq(_inc_conv(48, 1), _inc_conv(64, 5, padding=2)),
+        _seq(_inc_conv(64, 1), _inc_conv(96, 3, padding=1),
+             _inc_conv(96, 3, padding=1)),
+        _seq(nn.AvgPool2D(3, 1, 1), _inc_conv(pool_features, 1))])
+
+
+def _inc_b():
+    return _IncBranches([
+        _inc_conv(384, 3, 2),
+        _seq(_inc_conv(64, 1), _inc_conv(96, 3, padding=1),
+             _inc_conv(96, 3, 2)),
+        nn.MaxPool2D(3, 2)])
+
+
+def _inc_c(c7):
+    return _IncBranches([
+        _inc_conv(192, 1),
+        _seq(_inc_conv(c7, 1), _inc_conv(c7, (1, 7), padding=(0, 3)),
+             _inc_conv(192, (7, 1), padding=(3, 0))),
+        _seq(_inc_conv(c7, 1), _inc_conv(c7, (7, 1), padding=(3, 0)),
+             _inc_conv(c7, (1, 7), padding=(0, 3)),
+             _inc_conv(c7, (7, 1), padding=(3, 0)),
+             _inc_conv(192, (1, 7), padding=(0, 3))),
+        _seq(nn.AvgPool2D(3, 1, 1), _inc_conv(192, 1))])
+
+
+def _inc_d():
+    return _IncBranches([
+        _seq(_inc_conv(192, 1), _inc_conv(320, 3, 2)),
+        _seq(_inc_conv(192, 1), _inc_conv(192, (1, 7), padding=(0, 3)),
+             _inc_conv(192, (7, 1), padding=(3, 0)), _inc_conv(192, 3, 2)),
+        nn.MaxPool2D(3, 2)])
+
+
+def _inc_e():
+    return _IncBranches([
+        _inc_conv(320, 1),
+        _seq(_inc_conv(384, 1),
+             _IncBranches([_inc_conv(384, (1, 3), padding=(0, 1)),
+                           _inc_conv(384, (3, 1), padding=(1, 0))])),
+        _seq(_inc_conv(448, 1), _inc_conv(384, 3, padding=1),
+             _IncBranches([_inc_conv(384, (1, 3), padding=(0, 1)),
+                           _inc_conv(384, (3, 1), padding=(1, 0))])),
+        _seq(nn.AvgPool2D(3, 1, 1), _inc_conv(192, 1))])
+
+
+class Inception3(HybridBlock):
+    """Inception V3, 299x299 input (reference:
+    model_zoo/vision/inception.py)."""
+
+    def __init__(self, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.features = nn.HybridSequential(prefix="")
+            self.features.add(
+                _inc_conv(32, 3, 2), _inc_conv(32, 3), _inc_conv(64, 3,
+                                                                 padding=1),
+                nn.MaxPool2D(3, 2),
+                _inc_conv(80, 1), _inc_conv(192, 3), nn.MaxPool2D(3, 2),
+                _inc_a(32), _inc_a(64), _inc_a(64),
+                _inc_b(),
+                _inc_c(128), _inc_c(160), _inc_c(160), _inc_c(192),
+                _inc_d(),
+                _inc_e(), _inc_e(),
+                nn.AvgPool2D(8), nn.Dropout(0.5))
+            self.output = nn.Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
+
+def inception_v3(pretrained=False, **kw):
+    if pretrained:
+        raise MXNetError("pretrained weights unavailable (no egress)")
+    return Inception3(**kw)
+
+
 _MODELS = {
     "resnet18_v1": resnet18_v1, "resnet34_v1": resnet34_v1,
     "resnet50_v1": resnet50_v1, "resnet101_v1": resnet101_v1,
@@ -530,6 +721,9 @@ _MODELS = {
     "mobilenet1.0": mobilenet1_0, "mobilenet0.5": mobilenet0_5,
     "mobilenetv2_1.0": mobilenet_v2_1_0,
     "squeezenet1.0": squeezenet1_0,
+    "densenet121": densenet121, "densenet161": densenet161,
+    "densenet169": densenet169, "densenet201": densenet201,
+    "inceptionv3": inception_v3,
 }
 
 

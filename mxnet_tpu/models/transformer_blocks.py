@@ -70,6 +70,14 @@ class MultiHeadSelfAttention(HybridBlock):
     dense path.  The flash path has no attention-prob dropout (the score
     matrix never materializes); dropout is applied to the attention
     output instead.
+
+    When to flip it (measured, BERT-large on one v5e chip): at L<=512
+    XLA's fused dense attention wins on step time — keep the default.
+    The flash path's value is MEMORY: at L=2048 the dense path cannot
+    train at all (O(L^2) fp32 scores OOM a 16GB chip even at batch 1)
+    while flash trains fine — use_flash=True is for long sequences,
+    optionally combined with ring-attention context parallelism
+    (parallel/ring_attention.py) beyond a single chip's length budget.
     """
 
     def __init__(self, units, num_heads, dropout=0.0, use_flash=False,

@@ -1,0 +1,122 @@
+"""gluon.contrib nn/rnn tests (reference:
+tests/python/unittest/test_gluon_contrib.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn, rnn
+from mxnet_tpu.gluon.contrib import nn as cnn
+from mxnet_tpu.gluon.contrib import rnn as crnn
+
+
+def test_concurrent_and_identity():
+    con = cnn.HybridConcurrent(axis=1)
+    con.add(nn.Dense(3), cnn.Identity(), nn.Dense(2))
+    con.initialize()
+    x = nd.random.uniform(shape=(2, 4))
+    out = con(x)
+    assert out.shape == (2, 3 + 4 + 2)
+    con.hybridize()
+    assert np.allclose(con(x).asnumpy(), out.asnumpy(), rtol=1e-5)
+
+
+def test_pixelshuffle2d():
+    x = nd.arange(2 * 8 * 3 * 3).reshape((2, 8, 3, 3))
+    ps = cnn.PixelShuffle2D(2)
+    y = ps(x)
+    assert y.shape == (2, 2, 6, 6)
+    # depth-to-space invariant: every input value appears exactly once
+    assert np.allclose(np.sort(y.asnumpy().ravel()),
+                       np.sort(x.asnumpy().ravel()))
+    ps_rect = cnn.PixelShuffle2D((1, 2))
+    y2 = ps_rect(x)
+    assert y2.shape == (2, 4, 3, 6)
+
+
+def test_sync_batchnorm_matches_batchnorm():
+    mx.random.seed(0)
+    x = nd.random.uniform(shape=(4, 3, 5, 5))
+    a = cnn.SyncBatchNorm(num_devices=8)
+    b = nn.BatchNorm()
+    a.initialize()
+    b.initialize()
+    with autograd.record():
+        ya = a(x)
+    with autograd.record():
+        yb = b(x)
+    assert np.allclose(ya.asnumpy(), yb.asnumpy(), rtol=1e-5)
+
+
+def test_sparse_embedding_trains_only_touched_rows():
+    mx.random.seed(0)
+    se = cnn.SparseEmbedding(20, 4)
+    se.initialize(mx.init.Normal(0.1))
+    tr = gluon.Trainer(se.collect_params(), "sgd", {"learning_rate": 1.0})
+    x = nd.array([2, 7, 7], dtype="int32")
+    with autograd.record():
+        se(x).sum().backward()
+    before = se.weight.data().asnumpy().copy()
+    tr.step(1)
+    after = se.weight.data().asnumpy()
+    changed = np.abs(after - before).sum(axis=1) > 0
+    assert changed[2] and changed[7]
+    assert not changed[0] and not changed[19]
+
+
+def test_variational_dropout_same_mask_across_steps():
+    mx.random.seed(3)
+    base = rnn.LSTMCell(4, input_size=6)
+    vd = crnn.VariationalDropoutCell(base, drop_inputs=0.5)
+    vd.initialize()
+    vd.reset()
+    x = nd.ones((2, 6))
+    with autograd.record():
+        _, s = vd(x, vd.begin_state(batch_size=2))
+        mask1 = vd._mask_in.asnumpy().copy()
+        vd(x, s)
+        mask2 = vd._mask_in.asnumpy()
+    assert np.allclose(mask1, mask2)          # same mask within sequence
+    vd.reset()
+    with autograd.record():
+        vd(x, vd.begin_state(batch_size=2))
+    assert not np.allclose(vd._mask_in.asnumpy(), mask1)  # new sequence
+
+
+def test_conv2d_lstm_cell_unroll():
+    mx.random.seed(0)
+    cell = crnn.Conv2DLSTMCell((3, 6, 6), 4, 3, 3, i2h_pad=1)
+    cell.initialize()
+    xs = [nd.random.uniform(shape=(2, 3, 6, 6)) for _ in range(3)]
+    outs, states = cell.unroll(3, xs, layout="TNC", merge_outputs=False)
+    assert len(outs) == 3
+    assert outs[-1].shape == (2, 4, 6, 6)
+    assert states[0].shape == (2, 4, 6, 6)
+    # gradients flow end to end
+    for p in cell.collect_params().values():
+        p.grad_req = "write"
+    with autograd.record():
+        outs, _ = cell.unroll(3, xs, layout="TNC", merge_outputs=False)
+        outs[-1].sum().backward()
+    g = cell.i2h_weight.grad().asnumpy()
+    assert np.abs(g).sum() > 0
+
+
+def test_conv2d_lstm_default_pad_geometry():
+    cell = crnn.Conv2DLSTMCell((3, 6, 6), 4, 3, 3)      # i2h_pad=0
+    cell.initialize()
+    out, st = cell(nd.random.uniform(shape=(2, 3, 6, 6)),
+                   cell.begin_state(batch_size=2))
+    assert out.shape == (2, 4, 4, 4)                    # conv output size
+
+
+def test_variational_dropout_hybridize_raises():
+    base = rnn.LSTMCell(4, input_size=6)
+    vd = crnn.VariationalDropoutCell(base, drop_inputs=0.5)
+    vd.initialize()
+    vd.hybridize()
+    vd.reset()
+    x = nd.ones((2, 6))
+    with pytest.raises(mx.MXNetError, match="hybridiz"):
+        with autograd.record():
+            vd(x, vd.begin_state(batch_size=2))

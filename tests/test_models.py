@@ -128,9 +128,31 @@ def test_label_smoothing_loss_value():
 
 @pytest.mark.parametrize("name", ["resnet18_v1", "resnet18_v2",
                                   "mobilenet_v2_1.0".replace("_v2_", "v2_"),
-                                  "squeezenet1.0"])
+                                  "squeezenet1.0", "densenet121"])
 def test_model_zoo_forward(name):
     net = gluon.model_zoo.get_model(name, classes=10)
     net.initialize()
     out = net(nd.random.uniform(shape=(1, 3, 64, 64)))
     assert out.shape == (1, 10)
+
+
+def test_model_zoo_inception_forward():
+    net = gluon.model_zoo.get_model("inceptionv3", classes=7)
+    net.initialize()
+    out = net(nd.random.uniform(shape=(1, 3, 299, 299)))
+    assert out.shape == (1, 7)
+
+
+def test_model_zoo_densenet_trains():
+    net = gluon.model_zoo.get_model("densenet121", classes=4)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    x = nd.random.uniform(shape=(2, 3, 64, 64))
+    y = nd.array([0, 3], dtype="int32")
+    lf = gluon.loss.SoftmaxCrossEntropyLoss()
+    from mxnet_tpu import autograd
+    with autograd.record():
+        l = lf(net(x), y)
+    l.backward()
+    tr.step(2)
+    assert np.isfinite(float(l.mean().asscalar()))
