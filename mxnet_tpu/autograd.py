@@ -428,17 +428,20 @@ def _write_grad(arr, g):
 def grad(heads, variables, head_grads=None, retain_graph=None,
          create_graph=False, train_mode=True):
     """Return gradients of heads w.r.t. variables without touching ``.grad``
-    buffers (reference: autograd.grad).  Higher-order gradients via the
-    tape are not supported — ``create_graph=True`` raises instead of
-    silently returning first-order results; compose ``jax.grad`` on the
-    hybridized path for higher-order."""
+    buffers (reference: autograd.grad).
+
+    ``create_graph=True`` returns gradients that are themselves recorded
+    on the tape, so they can be differentiated again (higher-order
+    gradients / gradient penalties).  The tape reachable from ``heads``
+    is functionalized into one pure JAX function and the whole gradient
+    computation becomes a single fn-based tape node — differentiable to
+    arbitrary order by construction (each extra order adds one more
+    ``jax.vjp`` composition)."""
     from .base import MXNetError
     from .ndarray import NDArray
     if create_graph:
-        raise MXNetError(
-            "autograd.grad(create_graph=True): higher-order gradients are "
-            "not supported on the imperative tape; hybridize the block and "
-            "compose jax.grad/jax.vjp for higher-order derivatives")
+        return _grad_create_graph(heads, variables, head_grads,
+                                  train_mode=train_mode)
     if isinstance(variables, NDArray):
         variables = [variables]
         single = True
@@ -448,14 +451,152 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
     for v in variables:
         v.attach_grad()
     try:
-        backward(heads, head_grads,
-                 retain_graph=bool(retain_graph) or create_graph,
+        backward(heads, head_grads, retain_graph=bool(retain_graph),
                  train_mode=train_mode)
         outs = [v.grad.copy() for v in variables]
     finally:
         for v, (g, req) in zip(variables, saved):
             v._grad, v._grad_req = g, req
     return outs[0] if single else outs
+
+
+def _grad_create_graph(heads, variables, head_grads=None, train_mode=True):
+    """Differentiable gradients: functionalize the tape and record the
+    gradient computation as one new fn-based tape node.
+
+    Reference: ``autograd.grad(create_graph=True)`` (upstream supports
+    second-order for a subset of ops via FGradient-of-FGradient; here the
+    replayed function is pure JAX, so any order works).  The tape is left
+    intact (as with ``retain_graph=True``), letting the returned grads
+    compose with the original graph — e.g. WGAN-GP style penalties.
+
+    Limitations (raise loudly): ``variables`` must be leaf arrays, and the
+    reachable tape may not contain host-side custom-backward nodes
+    (autograd.Function, CustomOp, recorded CachedOp dispatch) — those are
+    opaque to re-linearization.
+    """
+    from .ndarray import NDArray
+
+    single = isinstance(variables, NDArray)
+    if single:
+        variables = [variables]
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif not isinstance(head_grads, (list, tuple)):
+        head_grads = [head_grads]
+
+    for v in variables:
+        if v._autograd_node is not None:
+            raise MXNetError(
+                "grad(create_graph=True): variables must be leaf arrays "
+                "(computed inside record() -> differentiate w.r.t. its "
+                "leaf inputs instead)")
+    for hg in head_grads:
+        if hg is not None and hg._autograd_node is not None:
+            raise MXNetError(
+                "grad(create_graph=True): head_grads recorded on the tape "
+                "are treated as constants of the gradient node, which "
+                "would silently drop their own gradient paths — pass "
+                "detached head_grads (e.g. hg.copy() outside record())")
+
+    head_entries = []
+    root_nodes = []
+    for h in heads:
+        info = h._autograd_node
+        if info is None:
+            # head IS a leaf (d head / d head = ones): replay reads its
+            # value straight from the input slot
+            head_entries.append((None, h))
+            continue
+        head_entries.append(info)
+        root_nodes.append(info[0])
+
+    # producers-first order for forward replay
+    nodes = _topo_order(root_nodes)[::-1]
+    for n in nodes:
+        if n.custom_backward is not None:
+            raise MXNetError(
+                f"grad(create_graph=True): tape contains a host-side "
+                f"custom-backward node ({n.name or 'Function'}) that "
+                f"cannot be re-linearized for higher-order gradients")
+
+    # Inputs of the functionalized tape: the (deduplicated) variables
+    # first, then every other distinct leaf array (entries with no
+    # producer node, plus any head that is itself a leaf).  Duplicate
+    # variables must collapse to ONE input slot — the replay reads values
+    # by array identity, and a stale duplicate slot would never be read,
+    # zeroing its cotangent.
+    uniq_vars, var_slot, _seen = [], [], {}
+    for v in variables:
+        if id(v) not in _seen:
+            _seen[id(v)] = len(uniq_vars)
+            uniq_vars.append(v)
+        var_slot.append(_seen[id(v)])
+    all_inputs = list(uniq_vars)
+    pos = {id(a): i for i, a in enumerate(all_inputs)}
+    for n in nodes:
+        for prod, _oidx, arr in n.input_entries:
+            if prod is None and id(arr) not in pos:
+                pos[id(arr)] = len(all_inputs)
+                all_inputs.append(arr)
+    for ent in head_entries:
+        if ent[0] is None and id(ent[1]) not in pos:
+            pos[id(ent[1])] = len(all_inputs)
+            all_inputs.append(ent[1])
+
+    node_idx = {id(n): i for i, n in enumerate(nodes)}
+    # cotangents for the heads (constants of the gradient node)
+    cots = [jax.numpy.ones_like(h._data) if hg is None else hg._data
+            for h, hg in zip(heads, head_grads)]
+
+    def _replay_forward(datas):
+        store = [None] * len(nodes)
+        for i, n in enumerate(nodes):
+            ins = []
+            for prod, oidx, arr in n.input_entries:
+                if prod is None:
+                    ins.append(datas[pos[id(arr)]])
+                else:
+                    ins.append(store[node_idx[id(prod)]][oidx])
+            o = n.fn(*ins)
+            store[i] = tuple(o) if isinstance(o, (tuple, list)) else (o,)
+        return tuple(datas[pos[id(ent[1])]] if ent[0] is None
+                     else store[node_idx[id(ent[0])]][ent[1]]
+                     for ent in head_entries)
+
+    n_vars = len(uniq_vars)
+
+    def grad_fn(*datas):
+        # Bake the recorded effective mode into the function: mode-
+        # dependent ops (Dropout, BatchNorm) read the thread-local at
+        # trace time, and this fn is re-traced whenever the grad node is
+        # differentiated again — possibly under a different ambient mode.
+        with _RecordScope(False, train_mode):
+            _, vjp_fn = jax.vjp(lambda *ds: _replay_forward(ds), *datas)
+            in_grads = vjp_fn(tuple(cots))
+        out = tuple(
+            g if getattr(g, "dtype", None) != jax.dtypes.float0
+            else jax.numpy.zeros_like(d)
+            for g, d in zip(in_grads[:n_vars], datas[:n_vars]))
+        # tape convention: single-output node fns return a bare array
+        return out[0] if n_vars == 1 else out
+
+    with pause():
+        raw_grads = grad_fn(*[a._data for a in all_inputs])
+    if n_vars == 1:
+        raw_grads = (raw_grads,)
+    outs = [NDArray(g) for g in raw_grads]
+
+    # record the gradient computation itself so the grads differentiate
+    entries = [(None, 0, a) for a in all_inputs]
+    gnode = TapeNode(fn=grad_fn, input_entries=entries,
+                     n_outputs=len(outs), name="grad")
+    for i, o in enumerate(outs):
+        o._autograd_node = (gnode, i)
+    results = [outs[s] for s in var_slot]
+    return results[0] if single else results
 
 
 def mark_variables(variables, gradients, grad_reqs="write"):

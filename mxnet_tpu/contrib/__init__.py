@@ -5,5 +5,6 @@ from . import amp
 from . import quantization
 from . import onnx
 from . import text
+from . import tensorboard
 
-__all__ = ["amp", "quantization", "onnx", "text"]
+__all__ = ["amp", "quantization", "onnx", "text", "tensorboard"]

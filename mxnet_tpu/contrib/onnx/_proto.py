@@ -1,4 +1,8 @@
-"""Minimal protobuf wire-format codec for the ONNX message subset.
+"""Minimal protobuf wire-format codec (schema-driven, write+read).
+
+Primary consumer is the ONNX message subset below; ``contrib.tensorboard``
+registers the TF ``Event``/``Summary`` schemas into the same registry and
+reuses the codec for event files.
 
 Reference surface: ``python/mxnet/contrib/onnx`` depends on the ``onnx``
 pip package for ModelProto serialization; that package is not available in
@@ -144,6 +148,8 @@ def _enc_scalar(field: int, kind: str, v) -> bytes:
         return _tag(field, _WIRE_VARINT) + _varint(int(v))
     if kind == "float":
         return _tag(field, _WIRE_32) + struct.pack("<f", float(v))
+    if kind == "double":
+        return _tag(field, _WIRE_64) + struct.pack("<d", float(v))
     if kind == "str":
         b = v.encode("utf-8") if isinstance(v, str) else bytes(v)
         return _tag(field, _WIRE_LEN) + _varint(len(b)) + b
@@ -176,6 +182,9 @@ def encode(msg_name: str, obj: dict) -> bytes:
             out += _tag(field, _WIRE_LEN) + _varint(len(body)) + body
         elif kind == "rep_float":              # packed
             body = b"".join(struct.pack("<f", float(x)) for x in value)
+            out += _tag(field, _WIRE_LEN) + _varint(len(body)) + body
+        elif kind == "rep_double":             # packed
+            body = b"".join(struct.pack("<d", float(x)) for x in value)
             out += _tag(field, _WIRE_LEN) + _varint(len(body)) + body
         elif kind in ("rep_str", "rep_bytes"):
             for item in value:
@@ -255,13 +264,19 @@ def _store(obj, fname, kind, raw):
             lst.extend(struct.unpack(f"<{len(value) // 4}f", value))
         else:
             lst.append(value)
+    elif kind == "rep_double":
+        lst = obj.setdefault(fname, [])
+        if wire_kind == "len":                 # packed
+            lst.extend(struct.unpack(f"<{len(value) // 8}d", value))
+        else:
+            lst.append(value)
     elif kind == "rep_str":
         obj.setdefault(fname, []).append(value.decode("utf-8"))
     elif kind == "rep_bytes":
         obj.setdefault(fname, []).append(value)
     elif kind == "int":
         obj[fname] = value
-    elif kind == "float":
+    elif kind in ("float", "double"):
         obj[fname] = value
     elif kind == "str":
         obj[fname] = value.decode("utf-8")

@@ -1,0 +1,338 @@
+"""TensorBoard event-file logging (mxboard equivalent).
+
+Reference surface: upstream MXNet delegates TensorBoard logging to the
+external ``mxboard`` package (``python/mxnet/contrib/tensorboard.py`` is
+a thin ``LogMetricsCallback``) — SURVEY.md §5.5 "TensorBoard via external
+mxboard (event-file writer); not in-repo".  This build has no egress, so
+the writer is self-contained: TFRecord framing (length + masked CRC32C)
+around hand-schemed ``Event``/``Summary`` protobufs, encoded with the
+shared wire codec from ``contrib.onnx._proto`` — no tensorflow /
+tensorboard / protoc dependency.  Files are readable by any stock
+TensorBoard.
+
+API mirrors mxboard's ``SummaryWriter``:
+
+    with SummaryWriter(logdir="./logs") as sw:
+        sw.add_scalar("loss", 0.5, global_step=1)
+        sw.add_histogram("weights", nd_or_np_array, global_step=1)
+        sw.add_image("sample", hwc_uint8_array, global_step=1)
+        sw.add_text("note", "hello", global_step=1)
+
+plus the upstream in-repo ``LogMetricsCallback`` for ``Module.fit``-style
+batch-end callbacks.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import time
+from typing import Optional
+
+import numpy as np
+
+from .onnx._proto import SCHEMAS, decode, encode
+
+__all__ = ["SummaryWriter", "LogMetricsCallback", "read_events"]
+
+# TF event.proto / summary.proto field numbers (stable public wire
+# contract).  Names are prefixed TF* where they would collide with the
+# ONNX messages sharing the codec's schema registry.
+SCHEMAS.update({
+    "Event": {
+        "wall_time": (1, "double"),
+        "step": (2, "int"),
+        "file_version": (3, "str"),
+        "summary": (5, "msg:Summary"),
+    },
+    "Summary": {
+        "value": (1, "rep_msg:SummaryValue"),
+    },
+    "SummaryValue": {
+        "tag": (1, "str"),
+        "simple_value": (2, "float"),
+        "image": (4, "msg:SummaryImage"),
+        "histo": (5, "msg:HistogramProto"),
+        "tensor": (8, "msg:TFTensorProto"),
+        "metadata": (9, "msg:SummaryMetadata"),
+    },
+    "SummaryImage": {
+        "height": (1, "int"),
+        "width": (2, "int"),
+        "colorspace": (3, "int"),
+        "encoded_image_string": (4, "bytes"),
+    },
+    "HistogramProto": {
+        "min": (1, "double"),
+        "max": (2, "double"),
+        "num": (3, "double"),
+        "sum": (4, "double"),
+        "sum_squares": (5, "double"),
+        "bucket_limit": (6, "rep_double"),
+        "bucket": (7, "rep_double"),
+    },
+    "SummaryMetadata": {
+        "plugin_data": (1, "msg:PluginData"),
+        "display_name": (2, "str"),
+    },
+    "PluginData": {
+        "plugin_name": (1, "str"),
+        "content": (2, "bytes"),
+    },
+    "TFTensorProto": {
+        "dtype": (1, "int"),           # DataType enum; DT_STRING = 7
+        "tensor_shape": (2, "msg:TFTensorShapeProto"),
+        "string_val": (8, "rep_bytes"),
+    },
+    "TFTensorShapeProto": {
+        "dim": (2, "rep_msg:TFTensorShapeDim"),
+    },
+    "TFTensorShapeDim": {
+        "size": (1, "int"),
+        "name": (2, "str"),
+    },
+})
+
+_DT_STRING = 7
+
+
+# --------------------------------------------------------------------------
+# CRC32C (Castagnoli) — table-driven; TFRecord framing masks it.
+# --------------------------------------------------------------------------
+
+def _make_crc32c_table():
+    poly = 0x82F63B78  # reflected Castagnoli polynomial
+    table = []
+    for n in range(256):
+        crc = n
+        for _ in range(8):
+            crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+        table.append(crc)
+    return table
+
+
+_CRC_TABLE = _make_crc32c_table()
+
+
+def _crc32c(data: bytes, crc: int = 0) -> int:
+    crc ^= 0xFFFFFFFF
+    for b in data:
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# --------------------------------------------------------------------------
+# Summary builders (dict messages for the shared codec)
+# --------------------------------------------------------------------------
+
+def _histogram_msg(values: np.ndarray, bins: int = 30) -> dict:
+    v = np.asarray(values, np.float64).ravel()
+    if v.size == 0:
+        v = np.zeros((1,), np.float64)
+    counts, edges = np.histogram(v, bins=bins)
+    return {"min": float(v.min()), "max": float(v.max()),
+            "num": float(v.size), "sum": float(v.sum()),
+            "sum_squares": float((v * v).sum()),
+            "bucket_limit": list(edges[1:]),
+            "bucket": [float(c) for c in counts]}
+
+
+def _image_msg(img: np.ndarray) -> dict:
+    from ..image.image import imencode
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if arr.dtype != np.uint8:
+        a = arr.astype(np.float64)
+        lo, hi = a.min(), a.max()
+        if hi > lo:
+            a = (a - lo) / (hi - lo)
+        arr = (np.clip(a, 0.0, 1.0) * 255.0 + 0.5).astype(np.uint8)
+    h, w, c = arr.shape
+    return {"height": h, "width": w, "colorspace": c,
+            "encoded_image_string": imencode(arr, ".png")}
+
+
+def _text_msg(text: str) -> dict:
+    # the "text" plugin reads a rank-1 DT_STRING tensor
+    return {"tensor": {"dtype": _DT_STRING,
+                       "tensor_shape": {"dim": [{"size": 1}]},
+                       "string_val": [text.encode("utf-8")]},
+            "metadata": {"plugin_data": {"plugin_name": "text"}}}
+
+
+def _event(values=None, step: Optional[int] = None,
+           file_version: Optional[str] = None) -> bytes:
+    ev = {"wall_time": time.time()}
+    if step is not None:
+        ev["step"] = int(step)
+    if file_version is not None:
+        ev["file_version"] = file_version
+    if values:
+        ev["summary"] = {"value": values}
+    return encode("Event", ev)
+
+
+# --------------------------------------------------------------------------
+# Writer
+# --------------------------------------------------------------------------
+
+_WRITER_SEQ = [0]
+
+
+class SummaryWriter:
+    """Writes TensorBoard event files (mxboard.SummaryWriter surface)."""
+
+    def __init__(self, logdir, flush_secs=120, filename_suffix=""):
+        self._logdir = str(logdir)
+        os.makedirs(self._logdir, exist_ok=True)
+        # pid + per-process counter keep two writers created in the same
+        # wall-clock second from clobbering each other's file
+        _WRITER_SEQ[0] += 1
+        fname = "events.out.tfevents.%010d.%s.%d.%d%s" % (
+            int(time.time()), socket.gethostname(), os.getpid(),
+            _WRITER_SEQ[0], filename_suffix)
+        self._path = os.path.join(self._logdir, fname)
+        self._file = open(self._path, "wb")
+        self._flush_secs = flush_secs
+        self._last_flush = time.time()
+        self._write_event(_event(file_version="brain.Event:2"))
+        self.flush()
+
+    # -- record framing ---------------------------------------------------
+    def _write_event(self, event: bytes):
+        if self._file is None:
+            raise ValueError("SummaryWriter is closed")
+        header = struct.pack("<Q", len(event))
+        self._file.write(header)
+        self._file.write(struct.pack("<I", _masked_crc(header)))
+        self._file.write(event)
+        self._file.write(struct.pack("<I", _masked_crc(event)))
+        if time.time() - self._last_flush >= self._flush_secs:
+            self.flush()
+
+    @staticmethod
+    def _to_numpy(values):
+        if hasattr(values, "asnumpy"):
+            return values.asnumpy()
+        return np.asarray(values)
+
+    # -- public API -------------------------------------------------------
+    def add_scalar(self, tag, value, global_step=None):
+        if hasattr(value, "asscalar"):
+            value = value.asscalar()
+        self._write_event(_event(
+            [{"tag": tag, "simple_value": float(value)}], step=global_step))
+
+    def add_histogram(self, tag, values, global_step=None, bins=30):
+        self._write_event(_event(
+            [{"tag": tag,
+              "histo": _histogram_msg(self._to_numpy(values), bins)}],
+            step=global_step))
+
+    def add_image(self, tag, image, global_step=None):
+        """`image`: HWC (or HW) uint8 / float array or NDArray.  Float
+        images are min-max normalized (constant images clamp to [0,1])."""
+        self._write_event(_event(
+            [{"tag": tag, "image": _image_msg(self._to_numpy(image))}],
+            step=global_step))
+
+    def add_text(self, tag, text, global_step=None):
+        self._write_event(_event(
+            [dict(_text_msg(str(text)), tag=tag)], step=global_step))
+
+    def flush(self):
+        if self._file is not None:
+            self._file.flush()
+            self._last_flush = time.time()
+
+    def close(self):
+        if self._file is not None:
+            self.flush()
+            self._file.close()
+            self._file = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def get_logdir(self):
+        return self._logdir
+
+
+class LogMetricsCallback:
+    """Batch-end callback streaming `eval_metric` to TensorBoard
+    (reference: ``python/mxnet/contrib/tensorboard.LogMetricsCallback``).
+    """
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.summary_writer = SummaryWriter(logging_dir)
+        self._step = 0
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+            self.summary_writer.add_scalar(name, value, self._step)
+        self._step += 1
+
+
+# --------------------------------------------------------------------------
+# Reader (round-trip verification + offline inspection without TB).
+# --------------------------------------------------------------------------
+
+def read_events(path):
+    """Parse an event file back into dicts (verifies CRCs).
+
+    Returns a list of ``{"wall_time", "step", "file_version", "values"}``
+    where ``values`` maps tag → scalar float / ``{"histo": ...}`` /
+    ``{"image": (h, w, c, png_bytes)}`` / ``{"text": str}``.
+    """
+    events = []
+    with open(path, "rb") as f:
+        data = f.read()
+    pos = 0
+    while pos < len(data):
+        header = data[pos:pos + 8]
+        (length,) = struct.unpack("<Q", header)
+        (hcrc,) = struct.unpack("<I", data[pos + 8:pos + 12])
+        if hcrc != _masked_crc(header):
+            raise ValueError("event file corrupt: bad header crc")
+        payload = data[pos + 12:pos + 12 + length]
+        (pcrc,) = struct.unpack("<I",
+                                data[pos + 12 + length:pos + 16 + length])
+        if pcrc != _masked_crc(payload):
+            raise ValueError("event file corrupt: bad payload crc")
+        pos += 16 + length
+
+        raw = decode("Event", payload)
+        ev = {"wall_time": raw.get("wall_time"), "step": raw.get("step"),
+              "file_version": raw.get("file_version"), "values": {}}
+        for val in raw.get("summary", {}).get("value", []):
+            tag = val.get("tag")
+            if tag is None:
+                continue
+            if "simple_value" in val:
+                ev["values"][tag] = val["simple_value"]
+            elif "histo" in val:
+                ev["values"][tag] = {"histo": val["histo"]}
+            elif "image" in val:
+                im = val["image"]
+                ev["values"][tag] = {"image": (
+                    im.get("height"), im.get("width"), im.get("colorspace"),
+                    im.get("encoded_image_string", b""))}
+            elif "tensor" in val:
+                sv = val["tensor"].get("string_val", [b""])
+                ev["values"][tag] = {"text": sv[0].decode("utf-8")}
+        events.append(ev)
+    return events

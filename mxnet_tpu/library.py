@@ -1,0 +1,258 @@
+"""Dynamic custom-operator library loading.
+
+Reference surface: ``mx.library.load`` / ``MXLoadLib``
+(``python/mxnet/library.py`` + ``src/initialize.cc`` dynamic custom-op
+lib loading, backed by the ``lib_api.h`` plugin ABI in
+``src/lib_api.h``) — SURVEY.md §2.1 Initialization row.  Upstream lets
+users ship compiled operator libraries (.so) that register new ops into
+the runtime without rebuilding MXNet.
+
+TPU-native redesign: compute stays on XLA, so a plugin op is a *host*
+kernel — exactly the role of the reference's CPU-only ``lib_api.h``
+libraries.  A plugin .so exports a small C ABI (below); ``load()`` binds
+it with ctypes and registers each exported op as a ``CustomOpProp``, so
+plugin ops get the full Custom machinery: eager NDArray calls, autograd
+(when the lib exports a backward), and ``hybridize()``/``jit`` via
+``jax.pure_callback`` — reachable as ``mx.nd.Custom(x, op_type=name)``
+and as generated ``mx.nd.<name>`` frontends.
+
+Plugin C ABI (version 1, float32, single-output):
+
+.. code-block:: c
+
+    int         mxlib_abi_version(void);            // must return 1
+    int         mxlib_num_ops(void);
+    const char* mxlib_op_name(int op);
+    int         mxlib_op_num_inputs(int op);
+    int         mxlib_op_has_backward(int op);
+    // out_shape has room for 8 dims; return out ndim, or -1 on error
+    int  mxlib_op_infer_shape(int op, int n_in, const int64_t* shapes,
+                              const int* ndims, int64_t* out_shape);
+    // flat float32 buffers; shapes as in infer_shape; 0 = ok
+    int  mxlib_op_forward(int op, int n_in, const float** ins,
+                          const int64_t* shapes, const int* ndims,
+                          float* out, const int64_t* out_shape,
+                          int out_ndim);
+    // in_grads[i] has input i's shape; 0 = ok
+    int  mxlib_op_backward(int op, int n_in, const float* out_grad,
+                           const float** ins, const int64_t* shapes,
+                           const int* ndims, float** in_grads);
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Dict, List
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["load", "loaded_libraries"]
+
+_LOADED: Dict[str, "_PluginLib"] = {}
+
+_MAX_DIMS = 8
+
+
+class _PluginLib:
+    """ctypes binding of one plugin .so."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.cdll = ctypes.CDLL(path)
+        c = self.cdll
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        i32p = ctypes.POINTER(ctypes.c_int)
+        fpp = ctypes.POINTER(ctypes.POINTER(ctypes.c_float))
+        fp = ctypes.POINTER(ctypes.c_float)
+
+        try:
+            c.mxlib_abi_version.restype = ctypes.c_int
+            abi = c.mxlib_abi_version()
+        except AttributeError:
+            raise MXNetError(
+                f"{path} is not an mxnet_tpu op library "
+                f"(missing mxlib_abi_version)")
+        if abi != 1:
+            raise MXNetError(
+                f"{path}: plugin ABI version {abi} unsupported (want 1)")
+
+        c.mxlib_num_ops.restype = ctypes.c_int
+        c.mxlib_op_name.restype = ctypes.c_char_p
+        c.mxlib_op_name.argtypes = [ctypes.c_int]
+        c.mxlib_op_num_inputs.restype = ctypes.c_int
+        c.mxlib_op_num_inputs.argtypes = [ctypes.c_int]
+        c.mxlib_op_has_backward.restype = ctypes.c_int
+        c.mxlib_op_has_backward.argtypes = [ctypes.c_int]
+        c.mxlib_op_infer_shape.restype = ctypes.c_int
+        c.mxlib_op_infer_shape.argtypes = [
+            ctypes.c_int, ctypes.c_int, i64p, i32p, i64p]
+        c.mxlib_op_forward.restype = ctypes.c_int
+        c.mxlib_op_forward.argtypes = [
+            ctypes.c_int, ctypes.c_int, fpp, i64p, i32p, fp, i64p,
+            ctypes.c_int]
+        c.mxlib_op_backward.restype = ctypes.c_int
+        c.mxlib_op_backward.argtypes = [
+            ctypes.c_int, ctypes.c_int, fp, fpp, i64p, i32p, fpp]
+
+        self.op_names: List[str] = []
+        for i in range(c.mxlib_num_ops()):
+            self.op_names.append(c.mxlib_op_name(i).decode("utf-8"))
+
+    # -- marshalling ------------------------------------------------------
+    @staticmethod
+    def _pack_shapes(shapes):
+        flat = []
+        ndims = []
+        for s in shapes:
+            if len(s) > _MAX_DIMS:
+                raise MXNetError(f"plugin ops support <= {_MAX_DIMS} dims, "
+                                 f"got shape {tuple(s)}")
+            flat.extend(int(d) for d in s)
+            ndims.append(len(s))
+        c_flat = (ctypes.c_int64 * max(1, len(flat)))(*flat)
+        c_ndims = (ctypes.c_int * max(1, len(ndims)))(*ndims)
+        return c_flat, c_ndims
+
+    def infer_shape(self, op_idx, in_shapes):
+        c_flat, c_ndims = self._pack_shapes(in_shapes)
+        out_shape = (ctypes.c_int64 * _MAX_DIMS)()
+        ndim = self.cdll.mxlib_op_infer_shape(
+            op_idx, len(in_shapes), c_flat, c_ndims, out_shape)
+        if ndim < 0:
+            raise MXNetError(
+                f"{self.op_names[op_idx]}: infer_shape failed for "
+                f"{[tuple(s) for s in in_shapes]}")
+        return [int(out_shape[i]) for i in range(ndim)]
+
+    def forward(self, op_idx, arrays, out_shape):
+        arrays = [np.ascontiguousarray(a, np.float32) for a in arrays]
+        c_flat, c_ndims = self._pack_shapes([a.shape for a in arrays])
+        ins = (ctypes.POINTER(ctypes.c_float) * len(arrays))(
+            *[a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+              for a in arrays])
+        out = np.zeros(out_shape, np.float32)
+        c_oshape = (ctypes.c_int64 * max(1, len(out_shape)))(
+            *[int(d) for d in out_shape])
+        rc = self.cdll.mxlib_op_forward(
+            op_idx, len(arrays), ins, c_flat, c_ndims,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            c_oshape, len(out_shape))
+        if rc != 0:
+            raise MXNetError(
+                f"{self.op_names[op_idx]}: forward failed (rc={rc})")
+        return out
+
+    def backward(self, op_idx, out_grad, arrays):
+        arrays = [np.ascontiguousarray(a, np.float32) for a in arrays]
+        out_grad = np.ascontiguousarray(out_grad, np.float32)
+        c_flat, c_ndims = self._pack_shapes([a.shape for a in arrays])
+        ins = (ctypes.POINTER(ctypes.c_float) * len(arrays))(
+            *[a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+              for a in arrays])
+        grads = [np.zeros(a.shape, np.float32) for a in arrays]
+        gptrs = (ctypes.POINTER(ctypes.c_float) * len(arrays))(
+            *[g.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+              for g in grads])
+        rc = self.cdll.mxlib_op_backward(
+            op_idx, len(arrays),
+            out_grad.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ins, c_flat, c_ndims, gptrs)
+        if rc != 0:
+            raise MXNetError(
+                f"{self.op_names[op_idx]}: backward failed (rc={rc})")
+        return grads
+
+
+def _make_prop_class(lib: _PluginLib, op_idx: int, name: str):
+    """Build a CustomOpProp subclass delegating to the plugin kernels."""
+    from . import operator as op_mod
+
+    n_in = lib.cdll.mxlib_op_num_inputs(op_idx)
+    has_bwd = bool(lib.cdll.mxlib_op_has_backward(op_idx))
+
+    class _PluginOp(op_mod.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            ins = [d.asnumpy() for d in in_data]
+            out = lib.forward(op_idx, ins, out_data[0].shape)
+            self.assign(out_data[0], req[0], out)
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            if not has_bwd:
+                raise MXNetError(
+                    f"plugin op {name!r} exports no backward")
+            grads = lib.backward(op_idx, out_grad[0].asnumpy(),
+                                 [d.asnumpy() for d in in_data])
+            for dst, r, g in zip(in_grad, req, grads):
+                self.assign(dst, r, g)
+
+    class _PluginProp(op_mod.CustomOpProp):
+        def __init__(self, **kwargs):
+            super().__init__(need_top_grad=True)
+
+        def list_arguments(self):
+            return [f"data{i}" for i in range(n_in)] if n_in != 1 \
+                else ["data"]
+
+        def list_outputs(self):
+            return ["output"]
+
+        def infer_shape(self, in_shape):
+            out = lib.infer_shape(op_idx, in_shape)
+            return in_shape, [out], []
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            return _PluginOp()
+
+    _PluginProp.__name__ = f"PluginProp_{name}"
+    return _PluginProp
+
+
+def _attach_frontend(name: str):
+    """Expose the plugin op as mx.nd.<name>(...) like MXLoadLib does."""
+    from . import ndarray as nd_mod
+    from . import symbol as sym_mod
+
+    def frontend(*data, **kwargs):
+        return nd_mod.Custom(*data, op_type=name, **kwargs)
+
+    def sym_frontend(*data, **kwargs):
+        return sym_mod.Custom(*data, op_type=name, **kwargs)
+
+    frontend.__name__ = name
+    frontend.__doc__ = f"Plugin operator {name!r} (loaded via " \
+                       f"mx.library.load)."
+    for mod, fn in ((nd_mod, frontend), (nd_mod.op, frontend),
+                    (sym_mod, sym_frontend), (sym_mod.op, sym_frontend)):
+        setattr(mod, name, fn)
+
+
+def load(path, verbose=True):
+    """Load an operator library (reference: ``mx.library.load`` →
+    ``MXLoadLib``).  Registers every exported op; returns the list of
+    op names registered."""
+    from . import operator as op_mod
+
+    path = os.path.abspath(path)
+    if not os.path.exists(path):
+        raise MXNetError(f"library not found: {path}")
+    if path in _LOADED:
+        return list(_LOADED[path].op_names)
+
+    lib = _PluginLib(path)
+    for idx, name in enumerate(lib.op_names):
+        prop_cls = _make_prop_class(lib, idx, name)
+        op_mod.register(name)(prop_cls)
+        _attach_frontend(name)
+        if verbose:
+            import logging
+            logging.getLogger("mxnet_tpu").info(
+                "library.load: registered op %r from %s", name, path)
+    _LOADED[path] = lib
+    return list(lib.op_names)
+
+
+def loaded_libraries():
+    """Map of loaded library path → op-name list."""
+    return {p: list(l.op_names) for p, l in _LOADED.items()}

@@ -208,11 +208,160 @@ def test_rnn_op_grad_flows():
     assert np.abs(g).sum() > 0
 
 
-def test_grad_create_graph_raises():
+def test_grad_create_graph_second_order():
+    # d/dx x^3 = 3x^2 ; d2/dx2 = 6x
+    x = nd.array([1.0, 2.0, -3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x * x).sum()
+        gx = autograd.grad(y, [x], create_graph=True)[0]
+        gsum = gx.sum()
+    gsum.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 6 * x.asnumpy(),
+                               rtol=1e-5)
+
+
+def test_grad_create_graph_third_order():
+    # f = x^4: f' = 4x^3, f'' = 12x^2, f''' = 24x
+    x = nd.array([0.5, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 4).sum()
+        g1 = autograd.grad(y, [x], create_graph=True)[0]
+        g2 = autograd.grad(g1.sum(), [x], create_graph=True)[0]
+        g3sum = g2.sum()
+    g3sum.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 24 * x.asnumpy(),
+                               rtol=1e-5)
+
+
+def test_grad_create_graph_sin():
+    # d2/dx2 sin(x) = -sin(x)
+    x = nd.array([0.3, 1.2, -0.7])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.sin(x).sum()
+        gx = autograd.grad(y, [x], create_graph=True)[0]
+        gsum = gx.sum()
+    gsum.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), -np.sin(x.asnumpy()),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_grad_create_graph_gradient_penalty():
+    # WGAN-GP-style: loss = f(x) + |df/dx|^2 trains through the penalty
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    w = nd.array([[0.5], [0.25]])
+    w.attach_grad()
+    x.attach_grad()
+    with autograd.record():
+        y = nd.dot(x, w).sum()
+        gx = autograd.grad(y, [x], create_graph=True)[0]
+        penalty = (gx * gx).sum()
+        loss = y + penalty
+    loss.backward()
+    # dy/dx = w broadcast over rows; penalty = 2 * (w0^2 + w1^2)
+    # dloss/dw = x.sum(0) + 4*w
+    expect = x.asnumpy().sum(0)[:, None] + 4 * w.asnumpy()
+    np.testing.assert_allclose(w.grad.asnumpy(), expect, rtol=1e-5)
+
+
+def test_grad_create_graph_mixed_partials():
+    # f = x^2 * y ; d/dy (df/dx) = 2x
+    x = nd.array([1.5, -2.0])
+    y = nd.array([2.0, 3.0])
+    x.attach_grad()
+    y.attach_grad()
+    with autograd.record():
+        f = (x * x * y).sum()
+        gx = autograd.grad(f, [x], create_graph=True)[0]
+        gsum = gx.sum()
+    gsum.backward()
+    np.testing.assert_allclose(y.grad.asnumpy(), 2 * x.asnumpy(),
+                               rtol=1e-5)
+
+
+def test_grad_create_graph_leaf_head():
+    # head that IS a leaf variable: d head / d head = ones
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    g = autograd.grad(x, [x], create_graph=True)
+    np.testing.assert_allclose(g[0].asnumpy(), [1.0, 1.0])
+
+
+def test_grad_create_graph_dropout_train_mode():
+    # mode-dependent ops must re-linearize their recorded (train) branch,
+    # matching what backward() computes — not the identity predict branch
+    mx.random.seed(7)
+    x = nd.ones((64,))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Dropout(x, p=0.5).sum()
+    gx = autograd.grad(y, [x], create_graph=True)[0]
+    y.backward()
+    np.testing.assert_allclose(gx.asnumpy(), x.grad.asnumpy())
+    # the train branch scales kept units by 1/(1-p)=2: grads are {0, 2}
+    vals = set(np.unique(gx.asnumpy()))
+    assert vals <= {0.0, 2.0} and 2.0 in vals
+
+
+def test_grad_create_graph_duplicate_variables():
+    # both occurrences of a duplicated variable get the full gradient,
+    # matching the create_graph=False path
     x = nd.array([1.0, 2.0])
     x.attach_grad()
     with autograd.record():
         y = (x * x).sum()
+    g = autograd.grad(y, [x, x], create_graph=True)
+    np.testing.assert_allclose(g[0].asnumpy(), [2.0, 4.0])
+    np.testing.assert_allclose(g[1].asnumpy(), [2.0, 4.0])
+
+
+def test_grad_create_graph_leaf_head_no_attach():
+    # leaf head without attach_grad works, same as create_graph=False
+    x = nd.array([1.0, 2.0])
+    g = autograd.grad(x, [x], create_graph=True)
+    np.testing.assert_allclose(g[0].asnumpy(), [1.0, 1.0])
+
+
+def test_grad_create_graph_recorded_head_grads_raise():
+    # recorded head_grads would silently become constants: raise loudly
+    x = nd.array([1.0, 2.0])
+    w = nd.array([3.0, 4.0])
+    x.attach_grad()
+    w.attach_grad()
+    with autograd.record():
+        y = x * w
+        hg = w * 2
+    with pytest.raises(mx.MXNetError):
+        autograd.grad(y, [x], head_grads=hg, create_graph=True)
+
+
+def test_grad_create_graph_nonleaf_variable_raises():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        z = x * 2
+        y = (z * z).sum()
+    with pytest.raises(mx.MXNetError):
+        autograd.grad(y, [z], create_graph=True)
+
+
+def test_grad_create_graph_custom_function_raises():
+    class Square(autograd.Function):
+        def forward(self, x):
+            self.save_for_backward(x)
+            return x * x
+
+        def backward(self, dy):
+            (x,) = self.saved_tensors
+            return 2 * x * dy
+
+    sq = Square()
+    x = nd.array([1.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = sq(x).sum()
     with pytest.raises(mx.MXNetError):
         autograd.grad(y, [x], create_graph=True)
 

@@ -1,0 +1,105 @@
+"""contrib.tensorboard: event-file writer round-trips through the reader
+(which verifies TFRecord masked-CRC framing byte-for-byte)."""
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import nd
+from mxnet_tpu.contrib.tensorboard import (SummaryWriter, read_events,
+                                           _crc32c)
+
+
+def _events_file(logdir):
+    files = glob.glob(os.path.join(str(logdir), "events.out.tfevents.*"))
+    assert len(files) == 1
+    return files[0]
+
+
+def test_crc32c_known_vectors():
+    # RFC 3720 / kernel test vectors for CRC32C (Castagnoli)
+    assert _crc32c(b"") == 0
+    assert _crc32c(b"123456789") == 0xE3069283
+    assert _crc32c(b"\x00" * 32) == 0x8A9136AA
+
+
+def test_scalar_roundtrip(tmp_path):
+    with SummaryWriter(logdir=tmp_path) as sw:
+        for step in range(5):
+            sw.add_scalar("train/loss", 1.0 / (step + 1), global_step=step)
+    events = read_events(_events_file(tmp_path))
+    assert events[0]["file_version"] == "brain.Event:2"
+    scalars = [(e["step"], e["values"]["train/loss"])
+               for e in events if "train/loss" in e["values"]]
+    assert len(scalars) == 5
+    for step, value in scalars:
+        assert value == pytest.approx(1.0 / (step + 1), rel=1e-6)
+
+
+def test_scalar_accepts_ndarray(tmp_path):
+    with SummaryWriter(logdir=tmp_path) as sw:
+        sw.add_scalar("x", nd.array([3.5]).reshape(()), global_step=0)
+    events = read_events(_events_file(tmp_path))
+    assert events[-1]["values"]["x"] == pytest.approx(3.5)
+
+
+def test_histogram_roundtrip(tmp_path):
+    rng = np.random.RandomState(0)
+    data = rng.randn(1000)
+    with SummaryWriter(logdir=tmp_path) as sw:
+        sw.add_histogram("w", data, global_step=7, bins=20)
+    ev = read_events(_events_file(tmp_path))[-1]
+    histo = ev["values"]["w"]["histo"]
+    assert ev["step"] == 7
+    assert histo["num"] == pytest.approx(1000)
+    assert histo["min"] == pytest.approx(data.min())
+    assert histo["max"] == pytest.approx(data.max())
+    assert histo["sum"] == pytest.approx(data.sum(), rel=1e-6)
+    assert sum(histo["bucket"]) == pytest.approx(1000)
+    assert len(histo["bucket_limit"]) == len(histo["bucket"]) == 20
+
+
+def test_image_roundtrip(tmp_path):
+    from mxnet_tpu.image.image import imdecode
+    img = (np.arange(8 * 6 * 3) % 256).reshape(8, 6, 3).astype(np.uint8)
+    with SummaryWriter(logdir=tmp_path) as sw:
+        sw.add_image("pic", img, global_step=1)
+    ev = read_events(_events_file(tmp_path))[-1]
+    h, w, c, png = ev["values"]["pic"]["image"]
+    assert (h, w, c) == (8, 6, 3)
+    decoded = imdecode(png)  # default to_rgb=True: PNG payload is RGB
+    np.testing.assert_array_equal(np.asarray(decoded.asnumpy()), img)
+
+
+def test_image_constant_float_clamps(tmp_path):
+    from mxnet_tpu.image.image import imdecode
+    # constant out-of-range float image must clamp, not wrap modulo 256
+    img = np.full((4, 4), 2.0, np.float64)
+    with SummaryWriter(logdir=tmp_path) as sw:
+        sw.add_image("c", img)
+    ev = read_events(_events_file(tmp_path))[-1]
+    h, w, c, png = ev["values"]["c"]["image"]
+    decoded = np.asarray(imdecode(png, flag=0).asnumpy())
+    assert decoded.min() == decoded.max() == 255
+
+
+def test_text_roundtrip(tmp_path):
+    with SummaryWriter(logdir=tmp_path) as sw:
+        sw.add_text("note", "hello tpu", global_step=2)
+    ev = read_events(_events_file(tmp_path))[-1]
+    assert ev["values"]["note"]["text"] == "hello tpu"
+
+
+def test_log_metrics_callback(tmp_path):
+    from types import SimpleNamespace
+    from mxnet_tpu import metric
+    from mxnet_tpu.contrib.tensorboard import LogMetricsCallback
+
+    m = metric.Accuracy()
+    m.update([nd.array([0, 1])], [nd.array([[0.9, 0.1], [0.2, 0.8]])])
+    cb = LogMetricsCallback(str(tmp_path), prefix="train")
+    cb(SimpleNamespace(eval_metric=m))
+    cb.summary_writer.close()
+    ev = read_events(_events_file(tmp_path))[-1]
+    assert ev["values"]["train-accuracy"] == pytest.approx(1.0)
