@@ -583,8 +583,51 @@ def _grad_create_graph(heads, variables, head_grads=None, train_mode=True):
         # tape convention: single-output node fns return a bare array
         return out[0] if n_vars == 1 else out
 
+    # Structural signature of the functionalized tape.  When every inner
+    # node is itself key-determined (no RNG closures) and the head
+    # cotangents are the default ones, grad_fn's computation is fully
+    # determined by this signature — so (a) the eager call below can run
+    # a cached jitted program instead of re-tracing the nested vjp every
+    # call, and (b) the recorded node gets a bulk key, letting a later
+    # backward over it compile the WHOLE outer tape as one program
+    # (engine bulk-exec; see _try_bulk_replay).  Steady-state loops that
+    # re-build the same-shaped tape each step (e.g. WGAN-GP) then pay
+    # zero retrace cost.
+    gkey = None
+    if all(n.key is not None and
+           getattr(n.fn, "_rng_base", None) is None for n in nodes) \
+            and all(hg is None for hg in head_grads):
+        sig_nodes = tuple(
+            (n.key, n.n_outputs,
+             tuple((node_idx[id(prod)] if prod is not None else -1,
+                    oidx, pos[id(arr)] if prod is None else -1)
+                   for prod, oidx, arr in n.input_entries))
+            for n in nodes)
+        sig_heads = tuple((-1, pos[id(ent[1])]) if ent[0] is None
+                          else (node_idx[id(ent[0])], ent[1])
+                          for ent in head_entries)
+        sig_shapes = tuple((tuple(a.shape), str(a._data.dtype))
+                           for a in all_inputs)
+        gkey = ("__grad__", sig_nodes, sig_heads, sig_shapes, n_vars,
+                bool(train_mode))
+
     with pause():
-        raw_grads = grad_fn(*[a._data for a in all_inputs])
+        runner = grad_fn
+        if gkey is not None:
+            cached = _GRAD_FN_CACHE.get(gkey)
+            if cached is None:
+                # AOT-compile so the cache holds ONLY the executable —
+                # caching jit(grad_fn) itself would pin every tape
+                # intermediate through the closure for the cache's
+                # lifetime (gigabytes on large-model loops)
+                avals = [jax.ShapeDtypeStruct(a.shape, a._data.dtype)
+                         for a in all_inputs]
+                cached = jax.jit(grad_fn).lower(*avals).compile()
+                _GRAD_FN_CACHE[gkey] = cached
+                while len(_GRAD_FN_CACHE) > _GRAD_FN_CACHE_CAP:
+                    _GRAD_FN_CACHE.popitem(last=False)
+            runner = cached
+        raw_grads = runner(*[a._data for a in all_inputs])
     if n_vars == 1:
         raw_grads = (raw_grads,)
     outs = [NDArray(g) for g in raw_grads]
@@ -592,11 +635,17 @@ def _grad_create_graph(heads, variables, head_grads=None, train_mode=True):
     # record the gradient computation itself so the grads differentiate
     entries = [(None, 0, a) for a in all_inputs]
     gnode = TapeNode(fn=grad_fn, input_entries=entries,
-                     n_outputs=len(outs), name="grad")
+                     n_outputs=len(outs), name="grad", key=gkey)
     for i, o in enumerate(outs):
         o._autograd_node = (gnode, i)
     results = [outs[s] for s in var_slot]
     return results[0] if single else results
+
+
+# Compiled grad_fn programs for create_graph tapes, keyed by structural
+# signature (bounded FIFO, same rationale as _BULK_BWD_CACHE).
+_GRAD_FN_CACHE = OrderedDict()
+_GRAD_FN_CACHE_CAP = 64
 
 
 def mark_variables(variables, gradients, grad_reqs="write"):

@@ -625,6 +625,32 @@ class HybridBlock(Block):
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError
 
+    def optimize_for(self, x, *args, backend=None, **backend_opts):
+        """Trace this block to a Symbol graph, run the registered
+        subgraph-backend pass over it, and return a ``SymbolBlock``
+        sharing this block's parameters (reference:
+        HybridBlock.optimize_for).
+
+        Upstream rewrites the cached graph in place; here the compiled
+        path is an XLA trace (which already fuses), so the pass runs on
+        the exported Symbol DAG and the optimized graph comes back as a
+        new block — same parameters, rewritten topology.
+        """
+        from .. import symbol as sym_mod
+        if backend is None:
+            raise MXNetError("optimize_for requires backend=<name>")
+        n_in = 1 + len(args)
+        data_syms = [sym_mod.var("data")] if n_in == 1 else \
+            [sym_mod.var(f"data{i}") for i in range(n_in)]
+        out = self(*data_syms)
+        if isinstance(out, (list, tuple)):
+            out = sym_mod.Group(list(out))
+        opt = out.optimize_for(backend, **backend_opts)
+        blk = SymbolBlock(opt, data_syms, params=self.collect_params())
+        # example data validates the rewritten graph end-to-end
+        blk(x, *args)
+        return blk
+
     # ------------------------------------------------------------ export
     def export(self, path, epoch=0):
         """Serialize to symbol-json + params (reference: HybridBlock.export).
@@ -660,7 +686,14 @@ class SymbolBlock(HybridBlock):
         self._in_names = [s.name for s in inputs]
         in_set = set(self._in_names)
         for arg in outputs.list_arguments():
-            if arg not in in_set:
+            if arg in in_set:
+                continue
+            # graph argument names are raw Parameter names; adopt a
+            # matching shared parameter directly rather than minting a
+            # fresh (prefixless) one through get()'s prefixed lookup
+            if params is not None and arg in params:
+                self._params._params[arg] = params[arg]
+            else:
                 self._params.get(arg, shape=None, allow_deferred_init=True)
 
     @staticmethod

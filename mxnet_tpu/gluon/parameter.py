@@ -258,9 +258,9 @@ class Parameter:
     def var(self):
         """Symbol variable for this parameter (reference: Parameter.var)."""
         if self._var is None:
-            from ..symbol import Symbol
-            self._var = Symbol.var(self.name, shape=self.shape,
-                                   dtype=self.dtype)
+            from .. import symbol as sym_mod
+            self._var = sym_mod.var(self.name, shape=self.shape,
+                                    dtype=self.dtype)
         return self._var
 
     # npz-friendly export used by save_parameters

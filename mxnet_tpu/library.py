@@ -209,10 +209,26 @@ def _make_prop_class(lib: _PluginLib, op_idx: int, name: str):
     return _PluginProp
 
 
-def _attach_frontend(name: str):
-    """Expose the plugin op as mx.nd.<name>(...) like MXLoadLib does."""
+def _attach_frontend(name: str) -> bool:
+    """Expose the plugin op as mx.nd.<name>(...) like MXLoadLib does.
+
+    A plugin op whose name collides with an existing nd/sym attribute
+    (e.g. a built-in operator) does NOT replace it — silently rerouting
+    ``nd.dot`` through a host-callback CustomOp would corrupt every
+    subsequent caller.  The op stays reachable as
+    ``nd.Custom(..., op_type=name)``; returns False on collision.
+    """
+    import logging
     from . import ndarray as nd_mod
     from . import symbol as sym_mod
+
+    if any(hasattr(m, name) for m in (nd_mod, nd_mod.op, sym_mod,
+                                      sym_mod.op)):
+        logging.getLogger("mxnet_tpu").warning(
+            "library.load: plugin op %r collides with an existing "
+            "operator; keeping the built-in — call it via "
+            "nd.Custom(..., op_type=%r)", name, name)
+        return False
 
     def frontend(*data, **kwargs):
         return nd_mod.Custom(*data, op_type=name, **kwargs)
@@ -226,6 +242,7 @@ def _attach_frontend(name: str):
     for mod, fn in ((nd_mod, frontend), (nd_mod.op, frontend),
                     (sym_mod, sym_frontend), (sym_mod.op, sym_frontend)):
         setattr(mod, name, fn)
+    return True
 
 
 def load(path, verbose=True):

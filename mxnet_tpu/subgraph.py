@@ -1,0 +1,139 @@
+"""Subgraph backends: registered graph-rewrite passes + ``optimize_for``.
+
+Reference surface: the subgraph API in ``src/operator/subgraph/``
+(``SubgraphProperty`` registry, ``MXSetSubgraphPropertyOpNames``) and its
+frontends ``Symbol.optimize_for(backend)`` / ``HybridBlock.optimize_for``
+— SURVEY.md §2.1 nnvm-passes row ("subgraph API, SubgraphProperty") and
+the oneDNN/TensorRT glue row.
+
+TPU-native redesign: upstream subgraph backends exist mostly to hand
+fused kernels to cuDNN/oneDNN/TensorRT; on this build XLA performs that
+fusion automatically, so the registry's built-in passes do the graph
+hygiene XLA cannot see — stripping train-only ops for inference
+(``"inference"``) — while the registry itself gives users the same
+extension point upstream had: register a property, rewrite the DAG.
+Passes operate on the pure-python ``Symbol`` DAG (``_SymNode``), so a
+custom property is a dozen lines instead of a C++ plugin.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from .base import MXNetError
+
+__all__ = ["SubgraphProperty", "register_backend", "get_backend",
+           "list_backends", "optimize_symbol", "rewrite_nodes"]
+
+_BACKENDS: Dict[str, "SubgraphProperty"] = {}
+
+
+class SubgraphProperty:
+    """One graph-rewrite backend (reference: SubgraphProperty).
+
+    Subclass and override :meth:`apply`, then register::
+
+        @register_backend("my_backend")
+        class MyProp(SubgraphProperty):
+            def apply(self, sym, **kwargs):
+                return rewrite_nodes(sym, my_node_fn)
+    """
+
+    name: str = ""
+
+    def apply(self, sym, **kwargs):
+        """Return the rewritten Symbol (must not mutate ``sym``)."""
+        raise NotImplementedError
+
+
+def register_backend(name: str):
+    """Register a SubgraphProperty class or factory under ``name``."""
+
+    def deco(cls):
+        prop = cls() if isinstance(cls, type) else cls
+        if not isinstance(prop, SubgraphProperty):
+            raise MXNetError("register_backend expects a SubgraphProperty")
+        prop.name = name
+        _BACKENDS[name] = prop
+        return cls
+
+    return deco
+
+
+def get_backend(name: str) -> SubgraphProperty:
+    if name not in _BACKENDS:
+        raise MXNetError(
+            f"unknown subgraph backend {name!r} "
+            f"(registered: {sorted(_BACKENDS)})")
+    return _BACKENDS[name]
+
+
+def list_backends():
+    return sorted(_BACKENDS)
+
+
+def optimize_symbol(sym, backend: str, **kwargs):
+    """Apply a registered backend pass to ``sym`` (Symbol.optimize_for)."""
+    return get_backend(backend).apply(sym, **kwargs)
+
+
+# --------------------------------------------------------------------------
+# Rewrite helper
+# --------------------------------------------------------------------------
+
+def rewrite_nodes(sym, node_fn: Callable):
+    """Rebuild the DAG applying ``node_fn`` to every op node.
+
+    ``node_fn(node, new_inputs) -> None | (node_ref, out_idx) | _SymNode``
+      * ``None``: keep the node (with rewritten inputs)
+      * ``(ref, idx)``: REPLACE the node's output 0 by that existing
+        entry (e.g. skip an identity by returning its input entry)
+      * a new ``_SymNode``: substitute it
+
+    Only single-output replacements are supported for elision; nodes with
+    ``num_outputs > 1`` are always kept (rewritten inputs only).
+    """
+    from .symbol.symbol import Symbol, _SymNode
+
+    memo = {}
+    for node in sym._topo():                   # producers first, iterative
+        if node.is_variable:
+            memo[id(node)] = {0: (node, 0)}
+            continue
+        new_inputs = [memo[id(n)][i] for n, i in node.inputs]
+        result = node_fn(node, new_inputs) if node.num_outputs == 1 \
+            else None
+        if result is None:
+            new = _SymNode(node.op, new_inputs, node.kwargs, node.name,
+                           node.num_outputs)
+            new.attrs = dict(node.attrs)
+            entry_map = {i: (new, i) for i in range(node.num_outputs)}
+        elif isinstance(result, tuple):
+            entry_map = {0: result}
+        else:
+            entry_map = {i: (result, i) for i in range(result.num_outputs)}
+        memo[id(node)] = entry_map
+
+    return Symbol([memo[id(n)][i] for n, i in sym._outputs])
+
+
+# --------------------------------------------------------------------------
+# Built-in backends
+# --------------------------------------------------------------------------
+
+@register_backend("inference")
+class _InferencePass(SubgraphProperty):
+    """Strip train-only ops for deployment graphs: Dropout becomes a
+    pass-through, ``identity``/zero-arg ``Cast``-to-same disappear
+    (reference: the quantization/TensorRT properties do the same strip
+    before handing subgraphs to the backend)."""
+
+    _DROP = {"Dropout", "identity", "BlockGrad", "stop_gradient"}
+
+    def apply(self, sym, **kwargs):
+        def node_fn(node, new_inputs):
+            opname = node.op.name if node.op is not None else ""
+            if opname in self._DROP and len(new_inputs) == 1:
+                return new_inputs[0]
+            return None
+
+        return rewrite_nodes(sym, node_fn)

@@ -261,6 +261,13 @@ class Symbol:
         return Executor(self, ctx, args, args_grad, grad_req, aux)
 
     # -- serialization ------------------------------------------------------
+    def optimize_for(self, backend, **kwargs):
+        """Apply a registered subgraph-backend pass and return the
+        rewritten Symbol (reference: Symbol.optimize_for over the
+        SubgraphProperty registry — src/operator/subgraph/)."""
+        from ..subgraph import optimize_symbol
+        return optimize_symbol(self, backend, **kwargs)
+
     def tojson(self) -> str:
         """nnvm-style JSON (reference: Symbol.tojson / nnvm SaveJSON)."""
         nodes = self._topo()

@@ -96,6 +96,23 @@ def test_load_non_plugin_raises(plugin_so):
         mx.library.load(path)
 
 
+def test_collision_keeps_builtin(tmp_path):
+    # a plugin op named like a built-in must NOT replace it
+    src = tmp_path / "collide.cc"
+    so = tmp_path / "libcollide.so"
+    src.write_text(PLUGIN_SRC.replace('"my_scale2"', '"dot"')
+                   .replace('"my_addsub"', '"my_addsub_c"'))
+    subprocess.run(["g++", "-O2", "-shared", "-fPIC", str(src),
+                    "-o", str(so)], check=True)
+    builtin_dot = nd.dot
+    mx.library.load(str(so))
+    assert nd.dot is builtin_dot          # untouched
+    # still reachable through the Custom dispatcher
+    x = nd.array(np.array([1.0, -2.0], np.float32))
+    np.testing.assert_allclose(
+        nd.Custom(x, op_type="dot").asnumpy(), 2 * x.asnumpy())
+
+
 def test_eager_forward(plugin_so):
     x = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
     y = nd.my_scale2(x)

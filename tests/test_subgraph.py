@@ -1,0 +1,111 @@
+"""Subgraph backend registry + optimize_for (Symbol and HybridBlock)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon
+from mxnet_tpu.subgraph import (SubgraphProperty, register_backend,
+                                list_backends, rewrite_nodes)
+
+
+def test_inference_pass_strips_dropout():
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("w")
+    h = mx.sym.dot(data, w)
+    h = mx.sym.Dropout(h, p=0.5)
+    out = mx.sym.relu(h)
+
+    opt = out.optimize_for("inference")
+    names_before = [n.op.name for n in out._topo() if n.op is not None]
+    names_after = [n.op.name for n in opt._topo() if n.op is not None]
+    assert "Dropout" in names_before
+    assert "Dropout" not in names_after
+
+    x = nd.array(np.random.RandomState(0).randn(4, 3).astype(np.float32))
+    wv = nd.array(np.random.RandomState(1).randn(3, 5).astype(np.float32))
+    ref = np.maximum(np.dot(x.asnumpy(), wv.asnumpy()), 0)
+    got = opt.eval(data=x, w=wv)
+    got = got[0] if isinstance(got, (list, tuple)) else got
+    np.testing.assert_allclose(got.asnumpy(), ref, rtol=1e-5)
+
+
+def test_unknown_backend_raises():
+    data = mx.sym.Variable("data")
+    with pytest.raises(mx.MXNetError):
+        (data + 1).optimize_for("no_such_backend")
+    assert "inference" in list_backends()
+
+
+def test_custom_backend_rewrite():
+    # swap relu -> sigmoid via a registered property
+    @register_backend("swap_relu_test")
+    class SwapRelu(SubgraphProperty):
+        def apply(self, sym, **kwargs):
+            from mxnet_tpu.symbol.symbol import _SymNode
+            from mxnet_tpu.ops.registry import get_op
+
+            def node_fn(node, new_inputs):
+                if node.op is not None and node.op.name == "relu":
+                    return _SymNode(get_op("sigmoid"), new_inputs, {},
+                                    node.name + "_sig")
+                return None
+
+            return rewrite_nodes(sym, node_fn)
+
+    data = mx.sym.Variable("data")
+    out = mx.sym.relu(data)
+    opt = out.optimize_for("swap_relu_test")
+    x = nd.array(np.array([-1.0, 0.0, 2.0], np.float32))
+    got = opt.eval(data=x)
+    got = got[0] if isinstance(got, (list, tuple)) else got
+    np.testing.assert_allclose(got.asnumpy(),
+                               1.0 / (1.0 + np.exp(-x.asnumpy())),
+                               rtol=1e-5)
+
+
+def test_hybrid_block_optimize_for():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, activation="relu"),
+            gluon.nn.Dropout(0.5),
+            gluon.nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    x = nd.array(np.random.RandomState(0).randn(2, 4).astype(np.float32))
+    y_ref = net(x)            # inference mode: Dropout is identity
+
+    blk = net.optimize_for(x, backend="inference")
+    y_opt = blk(x)
+    np.testing.assert_allclose(y_opt.asnumpy(), y_ref.asnumpy(),
+                               rtol=1e-5)
+
+    # the rewritten graph really lost its Dropout node
+    names = [n.op.name for n in blk._out_sym._topo() if n.op is not None]
+    assert "Dropout" not in names
+
+
+def test_hybrid_block_optimize_for_multi_input():
+    class TwoIn(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.fc = gluon.nn.Dense(4)
+
+        def hybrid_forward(self, F, a, b):
+            return self.fc(a) + self.fc(b)
+
+    net = TwoIn()
+    net.initialize(mx.init.Xavier())
+    a = nd.array(np.random.RandomState(0).randn(2, 3).astype(np.float32))
+    b = nd.array(np.random.RandomState(1).randn(2, 3).astype(np.float32))
+    ref = net(a, b)
+    blk = net.optimize_for(a, b, backend="inference")
+    np.testing.assert_allclose(blk(a, b).asnumpy(), ref.asnumpy(),
+                               rtol=1e-5)
+
+
+def test_optimize_for_requires_backend():
+    net = gluon.nn.Dense(2)
+    net.initialize()
+    x = nd.ones((1, 3))
+    net(x)
+    with pytest.raises(mx.MXNetError):
+        net.optimize_for(x)
