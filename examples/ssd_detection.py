@@ -1,0 +1,145 @@
+"""Single-shot detection (SSD) on a synthetic shapes dataset
+(reference: example/ssd — upstream trains VGG-SSD on VOC; no egress
+here, so the data is generated: one bright axis-aligned square per
+image, class = which half of the brightness range).
+
+Exercises the MultiBox op family end to end: MultiBoxPrior anchors →
+conv class/box predictors → MultiBoxTarget matching + offset encoding →
+SmoothL1 + softmax losses → MultiBoxDetection decode + NMS at eval.
+
+  python examples/ssd_detection.py --iters 150
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import mxnet_tpu as mx                                    # noqa: E402
+from mxnet_tpu import autograd, gluon, nd                 # noqa: E402
+from mxnet_tpu.gluon import nn                            # noqa: E402
+
+IMG = 32
+N_CLS = 2          # two foreground classes
+
+
+def synth_batch(rng, n):
+    """Images with one square; label rows [cls, xmin, ymin, xmax, ymax]."""
+    imgs = np.zeros((n, 1, IMG, IMG), np.float32)
+    labels = np.zeros((n, 1, 5), np.float32)
+    for i in range(n):
+        size = rng.randint(8, 16)
+        x0 = rng.randint(0, IMG - size)
+        y0 = rng.randint(0, IMG - size)
+        cls = rng.randint(0, N_CLS)
+        val = 0.4 if cls == 0 else 0.9
+        imgs[i, 0, y0:y0 + size, x0:x0 + size] = val
+        labels[i, 0] = [cls, x0 / IMG, y0 / IMG,
+                        (x0 + size) / IMG, (y0 + size) / IMG]
+    return nd.array(imgs), nd.array(labels)
+
+
+class TinySSD(gluon.HybridBlock):
+    """One backbone + one 8x8 prediction scale (K anchors per cell)."""
+
+    SIZES = (0.3, 0.45)
+    RATIOS = (1.0, 2.0, 0.5)
+    K = len(SIZES) + len(RATIOS) - 1
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.backbone = nn.HybridSequential()
+            self.backbone.add(
+                nn.Conv2D(16, 3, padding=1, activation="relu"),
+                nn.MaxPool2D(2, 2),                       # 16x16
+                nn.Conv2D(32, 3, padding=1, activation="relu"),
+                nn.MaxPool2D(2, 2),                       # 8x8
+                nn.Conv2D(64, 3, padding=1, activation="relu"))
+            self.cls_head = nn.Conv2D(self.K * (N_CLS + 1), 3, padding=1)
+            self.box_head = nn.Conv2D(self.K * 4, 3, padding=1)
+
+    def hybrid_forward(self, F, x):
+        feat = self.backbone(x)                           # (B, 64, 8, 8)
+        anchors = F.MultiBoxPrior(feat, sizes=self.SIZES,
+                                  ratios=self.RATIOS)
+        cls = self.cls_head(feat)                         # (B, K*(C+1), 8, 8)
+        box = self.box_head(feat)
+        B = cls.shape[0]
+        # (B, C+1, N) layout expected by MultiBoxTarget/Detection
+        cls = cls.transpose((0, 2, 3, 1)).reshape(
+            (B, -1, N_CLS + 1)).transpose((0, 2, 1))
+        box = box.transpose((0, 2, 3, 1)).reshape((B, -1))
+        return anchors, cls, box
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=150)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--lr", type=float, default=5e-3)
+    args = ap.parse_args()
+
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    net = TinySSD()
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for it in range(args.iters):
+        imgs, labels = synth_batch(rng, args.batch_size)
+        with autograd.record():
+            anchors, cls_pred, box_pred = net(imgs)
+            with autograd.pause():
+                box_t, box_m, cls_t = nd.MultiBoxTarget(
+                    anchors, labels, cls_pred,
+                    negative_mining_ratio=3.0)
+            cls_l = ce(cls_pred.transpose((0, 2, 1)).reshape(
+                (-1, N_CLS + 1)), cls_t.reshape((-1,)))
+            # ignore_label -1 rows get zero weight
+            w = (cls_t.reshape((-1,)) >= 0)
+            cls_l = (cls_l * w).sum() / w.sum()
+            box_l = (nd.smooth_l1(box_pred - box_t) * box_m).sum() \
+                / box_m.sum().clip(1.0, None)
+            loss = cls_l + box_l
+        loss.backward()
+        trainer.step(args.batch_size)
+        if it % 25 == 0 or it == args.iters - 1:
+            print(f"iter {it}: loss {float(loss.asscalar()):.4f} "
+                  f"(cls {float(cls_l.asscalar()):.4f} "
+                  f"box {float(box_l.asscalar()):.4f})")
+
+    # ---- evaluate: mean IoU of the top detection vs ground truth
+    imgs, labels = synth_batch(rng, 64)
+    anchors, cls_pred, box_pred = net(imgs)
+    cls_prob = nd.softmax(cls_pred, axis=1)
+    dets = nd.MultiBoxDetection(cls_prob, box_pred, anchors,
+                                nms_threshold=0.45).asnumpy()
+    gts = labels.asnumpy()
+    ious, cls_hits = [], []
+    for i in range(dets.shape[0]):
+        top = dets[i, 0]                                  # best-scoring box
+        gt = gts[i, 0]
+        bx = top[2:]
+        gx = gt[1:]
+        ix = max(0.0, min(bx[2], gx[2]) - max(bx[0], gx[0]))
+        iy = max(0.0, min(bx[3], gx[3]) - max(bx[1], gx[1]))
+        inter = ix * iy
+        union = ((bx[2] - bx[0]) * (bx[3] - bx[1]) +
+                 (gx[2] - gx[0]) * (gx[3] - gx[1]) - inter)
+        ious.append(inter / max(union, 1e-9))
+        cls_hits.append(float(top[0] == gt[0]))
+    miou = float(np.mean(ious))
+    acc = float(np.mean(cls_hits))
+    print(f"eval: mean IoU {miou:.3f}, class accuracy {acc:.2f}")
+    assert miou > 0.4, f"detector did not localize (mIoU {miou:.3f})"
+    print("done: detector localizes the synthetic objects")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,252 @@
+"""Object-detection operators: the SSD MultiBox family.
+
+Reference: ``src/operator/contrib/multibox_prior.cc`` /
+``multibox_target.cc`` / ``multibox_detection.cc`` (SURVEY.md §2.1
+operator-library contrib subtree; consumed by ``example/ssd``).
+
+TPU-native redesign: the reference runs per-box scalar loops on
+CPU/GPU threads; here every stage is expressed as dense, statically
+shaped array math — IoU matrices as one broadcast op, bipartite gt
+matching as a masked argmax sweep over the (small) gt count, and NMS as
+a ``lax.fori_loop`` of suppress-the-max rounds — so the whole pipeline
+compiles into a handful of fused XLA kernels and works under ``jit``.
+
+Layout contracts (match the reference):
+  anchors   : (1, N, 4) corner-format [xmin, ymin, xmax, ymax], normalized
+  labels    : (B, M, 5) rows [cls, xmin, ymin, xmax, ymax]; cls < 0 pads
+  cls_pred  : (B, num_cls+1, N) — class 0 is background
+  loc_pred  : (B, N*4) center-format offsets scaled by ``variances``
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .registry import register
+
+__all__ = ["MultiBoxPrior", "MultiBoxTarget", "MultiBoxDetection"]
+
+
+def _corner_to_center(boxes):
+    """[xmin,ymin,xmax,ymax] -> (cx, cy, w, h) along last axis."""
+    xmin, ymin, xmax, ymax = jnp.split(boxes, 4, axis=-1)
+    w = xmax - xmin
+    h = ymax - ymin
+    return xmin + w / 2, ymin + h / 2, w, h
+
+
+def _iou_matrix(a, b):
+    """IoU between corner boxes a (N,4) and b (M,4) -> (N, M)."""
+    ax0, ay0, ax1, ay1 = [a[:, i, None] for i in range(4)]
+    bx0, by0, bx1, by1 = [b[None, :, i] for i in range(4)]
+    ix = jnp.clip(jnp.minimum(ax1, bx1) - jnp.maximum(ax0, bx0), 0.0)
+    iy = jnp.clip(jnp.minimum(ay1, by1) - jnp.maximum(ay0, by0), 0.0)
+    inter = ix * iy
+    area_a = jnp.clip(ax1 - ax0, 0.0) * jnp.clip(ay1 - ay0, 0.0)
+    area_b = jnp.clip(bx1 - bx0, 0.0) * jnp.clip(by1 - by0, 0.0)
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-12)
+
+
+@register("_contrib_MultiBoxPrior", aliases=["MultiBoxPrior"],
+          differentiable=False)
+def MultiBoxPrior(data, *, sizes=(1.0,), ratios=(1.0,), clip=False,
+                  steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Anchor boxes for one feature map (reference: multibox_prior.cc).
+
+    ``data`` is (B, C, H, W); output (1, H*W*(S+R-1), 4) corner boxes:
+    per cell, one box per size plus one box per extra ratio at sizes[0]
+    — the reference's exact enumeration order.  Widths carry the
+    reference's ``H/W`` aspect factor so a ratio-1 box is square in
+    IMAGE space, not in normalized coordinates (multibox_prior.cc:
+    ``w = size * in_h / in_w / 2``).
+    """
+    sizes = tuple(float(s) for s in (sizes if isinstance(sizes, (tuple, list))
+                                     else (sizes,)))
+    ratios = tuple(float(r) for r in
+                   (ratios if isinstance(ratios, (tuple, list))
+                    else (ratios,)))
+    H, W = data.shape[2], data.shape[3]
+    step_y = 1.0 / H if steps[0] <= 0 else float(steps[0])
+    step_x = 1.0 / W if steps[1] <= 0 else float(steps[1])
+    cy = (jnp.arange(H, dtype=jnp.float32) + float(offsets[0])) * step_y
+    cx = (jnp.arange(W, dtype=jnp.float32) + float(offsets[1])) * step_x
+    cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")       # (H, W)
+
+    aspect = float(H) / float(W)
+    half = []
+    for s in sizes:
+        half.append((s * aspect / 2.0, s / 2.0))
+    for r in ratios[1:]:
+        rs = float(np.sqrt(r))
+        half.append((sizes[0] * aspect * rs / 2.0, sizes[0] / rs / 2.0))
+    hw = jnp.asarray([w for w, _ in half], jnp.float32)   # (K,)
+    hh = jnp.asarray([h for _, h in half], jnp.float32)
+
+    cxg = cxg[:, :, None]
+    cyg = cyg[:, :, None]
+    boxes = jnp.stack([cxg - hw, cyg - hh, cxg + hw, cyg + hh],
+                      axis=-1)                            # (H, W, K, 4)
+    out = boxes.reshape(1, -1, 4)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out.astype(jnp.float32)
+
+
+@register("_contrib_MultiBoxTarget", num_inputs=3, num_outputs=3,
+          aliases=["MultiBoxTarget"], differentiable=False)
+def MultiBoxTarget(anchor, label, cls_pred, *, overlap_threshold=0.5,
+                   ignore_label=-1.0, negative_mining_ratio=-1.0,
+                   negative_mining_thresh=0.5,
+                   minimum_negative_samples=0,
+                   variances=(0.1, 0.1, 0.2, 0.2)):
+    """Anchor-to-ground-truth matching + box-offset encoding
+    (reference: multibox_target.cc).
+
+    Returns [box_target (B, N*4), box_mask (B, N*4), cls_target (B, N)].
+    Matching: each gt claims its best anchor (bipartite sweep), then any
+    anchor with IoU > overlap_threshold joins its argmax gt.  With
+    ``negative_mining_ratio > 0`` only the hardest
+    ``ratio * num_pos`` negatives (lowest predicted background score
+    among those under ``negative_mining_thresh`` IoU) keep cls_target 0;
+    the rest become ``ignore_label``.
+    """
+    anchors = anchor.reshape(-1, 4)                       # (N, 4)
+    N = anchors.shape[0]
+    M = label.shape[1]
+    var = jnp.asarray(variances, jnp.float32)
+    acx, acy, aw, ah = _corner_to_center(anchors)
+
+    def one_sample(lab, cpred):
+        valid = lab[:, 0] >= 0                            # (M,)
+        gt = lab[:, 1:5]
+        iou = _iou_matrix(anchors, gt)                    # (N, M)
+        iou = jnp.where(valid[None, :], iou, -1.0)
+
+        # bipartite: each valid gt grabs its best anchor, sequentially
+        # masking claimed anchors (reference's greedy matching)
+        def bip_body(j, carry):
+            match, claimed = carry                        # (N,), (N,)
+            col = jnp.where(claimed, -1.0, iou[:, j])
+            best = jnp.argmax(col)
+            ok = valid[j] & (col[best] > 1e-12)
+            match = jnp.where(
+                ok, match.at[best].set(j), match)
+            claimed = jnp.where(
+                ok, claimed.at[best].set(True), claimed)
+            return match, claimed
+
+        match = jnp.full((N,), -1, jnp.int32)
+        claimed = jnp.zeros((N,), bool)
+        match, claimed = lax.fori_loop(0, M, bip_body, (match, claimed))
+
+        # threshold matching for the rest
+        best_gt = jnp.argmax(iou, axis=1).astype(jnp.int32)
+        best_iou = jnp.max(iou, axis=1)
+        match = jnp.where((match < 0) &
+                          (best_iou > overlap_threshold),
+                          best_gt, match)
+
+        matched = match >= 0
+        gt_cls = jnp.where(valid, lab[:, 0], 0.0)
+        safe_match = jnp.clip(match, 0, M - 1)
+        cls_t = jnp.where(matched, gt_cls[safe_match] + 1.0, 0.0)
+
+        # hard-negative mining on the background score of cls_pred
+        if negative_mining_ratio > 0:
+            num_pos = matched.sum()
+            max_neg = jnp.maximum(
+                (negative_mining_ratio * num_pos).astype(jnp.int32),
+                jnp.asarray(int(minimum_negative_samples), jnp.int32))
+            is_neg = (~matched) & (best_iou < negative_mining_thresh)
+            bg_score = cpred[0]                           # (N,)
+            order = jnp.argsort(jnp.where(is_neg, bg_score, jnp.inf))
+            rank = jnp.argsort(order)                     # rank per anchor
+            keep_neg = is_neg & (rank < max_neg)
+            cls_t = jnp.where(matched, cls_t,
+                              jnp.where(keep_neg, 0.0,
+                                        float(ignore_label)))
+
+        # encode offsets for matched anchors (center format, variances)
+        g = gt[safe_match]                                # (N, 4)
+        gcx, gcy, gw, gh = _corner_to_center(g)
+        eps = 1e-12
+        tx = (gcx - acx) / jnp.maximum(aw, eps) / var[0]
+        ty = (gcy - acy) / jnp.maximum(ah, eps) / var[1]
+        tw = jnp.log(jnp.maximum(gw / jnp.maximum(aw, eps), eps)) / var[2]
+        th = jnp.log(jnp.maximum(gh / jnp.maximum(ah, eps), eps)) / var[3]
+        t = jnp.concatenate([tx, ty, tw, th], axis=-1)    # (N, 4)
+        mask = jnp.where(matched[:, None], 1.0, 0.0)
+        return (t * mask).reshape(-1), \
+            jnp.broadcast_to(mask, (N, 4)).reshape(-1), cls_t
+
+    box_t, box_m, cls_t = jax.vmap(one_sample)(label, cls_pred)
+    return box_t, box_m, cls_t
+
+
+@register("_contrib_MultiBoxDetection", num_inputs=3,
+          aliases=["MultiBoxDetection"], differentiable=False)
+def MultiBoxDetection(cls_prob, loc_pred, anchor, *, clip=True,
+                      threshold=0.01, background_id=0, nms_threshold=0.5,
+                      force_suppress=False,
+                      variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Decode + per-class NMS (reference: multibox_detection.cc).
+
+    Output (B, N, 6): rows [cls_id, score, xmin, ymin, xmax, ymax];
+    suppressed / below-threshold rows have cls_id -1, sorted by score.
+    """
+    anchors = anchor.reshape(-1, 4)
+    N = anchors.shape[0]
+    var = jnp.asarray(variances, jnp.float32)
+    acx, acy, aw, ah = _corner_to_center(anchors)
+
+    def one_sample(cprob, lpred):
+        loc = lpred.reshape(N, 4)
+        cx = loc[:, 0:1] * var[0] * aw + acx
+        cy = loc[:, 1:2] * var[1] * ah + acy
+        w = jnp.exp(jnp.clip(loc[:, 2:3] * var[2], -10, 10)) * aw / 2
+        h = jnp.exp(jnp.clip(loc[:, 3:4] * var[3], -10, 10)) * ah / 2
+        boxes = jnp.concatenate([cx - w, cy - h, cx + w, cy + h], -1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+
+        # best foreground class per anchor
+        fg = jnp.concatenate(
+            [cprob[:background_id], cprob[background_id + 1:]], axis=0)
+        fg_ids = jnp.concatenate(
+            [jnp.arange(background_id),
+             jnp.arange(background_id + 1, cprob.shape[0])])
+        best = jnp.argmax(fg, axis=0)                     # (N,)
+        score = jnp.take_along_axis(fg, best[None, :], 0)[0]
+        cls_id = fg_ids[best].astype(jnp.float32) - \
+            jnp.where(fg_ids[best] > background_id, 1.0, 0.0)
+        keep = score > threshold
+        cls_id = jnp.where(keep, cls_id, -1.0)
+        score = jnp.where(keep, score, 0.0)
+
+        # sort by score descending; optional topk cutoff
+        order = jnp.argsort(-score)
+        cls_id = cls_id[order]
+        score = score[order]
+        boxes = boxes[order]
+        if nms_topk > 0:
+            idx = jnp.arange(N)
+            cls_id = jnp.where(idx < nms_topk, cls_id, -1.0)
+
+        iou = _iou_matrix(boxes, boxes)
+
+        def nms_body(i, alive):
+            # box i suppresses lower-scored overlapping boxes of its class
+            same_cls = (cls_id == cls_id[i]) | bool(force_suppress)
+            sup = (iou[i] > nms_threshold) & same_cls & \
+                (jnp.arange(N) > i) & alive[i] & (cls_id[i] >= 0)
+            return alive & ~sup
+
+        alive = jnp.ones((N,), bool)
+        alive = lax.fori_loop(0, N, nms_body, alive)
+        cls_id = jnp.where(alive, cls_id, -1.0)
+        return jnp.concatenate([cls_id[:, None], score[:, None], boxes],
+                               axis=-1)
+
+    return jax.vmap(one_sample)(cls_prob, loc_pred)

@@ -1,0 +1,161 @@
+"""SSD MultiBox detection ops: prior generation, target matching/encoding,
+decode + NMS (reference: tests/python/unittest/test_operator.py multibox
+cases)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_multibox_prior_shapes_and_values():
+    data = nd.zeros((1, 3, 2, 2))
+    anchors = nd.MultiBoxPrior(data, sizes=(0.5, 0.25), ratios=(1.0, 2.0))
+    # K = num_sizes + num_ratios - 1 = 3 boxes per cell, 2x2 cells
+    assert anchors.shape == (1, 2 * 2 * 3, 4)
+    a = anchors.asnumpy()[0]
+    # first cell center is ((0+.5)/2, (0+.5)/2) = (0.25, 0.25); first box
+    # is sizes[0]=0.5 at ratio 1: corners (0.25±0.25)
+    np.testing.assert_allclose(a[0], [0.0, 0.0, 0.5, 0.5], atol=1e-6)
+    # second box: size 0.25 -> (0.25±0.125)
+    np.testing.assert_allclose(a[1], [0.125, 0.125, 0.375, 0.375],
+                               atol=1e-6)
+    # third box: size 0.5 at ratio 2 -> w=0.5*sqrt2/2, h=0.5/sqrt2/2
+    w, h = 0.5 * np.sqrt(2) / 2, 0.5 / np.sqrt(2) / 2
+    np.testing.assert_allclose(a[2], [0.25 - w, 0.25 - h, 0.25 + w,
+                                      0.25 + h], atol=1e-6)
+
+
+def test_multibox_prior_nonsquare_aspect():
+    # reference: w carries the H/W factor so ratio-1 boxes are square in
+    # image space (multibox_prior.cc w = size * in_h / in_w / 2)
+    data = nd.zeros((1, 3, 2, 4))          # H=2, W=4
+    a = nd.MultiBoxPrior(data, sizes=(0.5,)).asnumpy()[0]
+    w = a[0, 2] - a[0, 0]
+    h = a[0, 3] - a[0, 1]
+    np.testing.assert_allclose(w, 0.5 * (2 / 4), atol=1e-6)
+    np.testing.assert_allclose(h, 0.5, atol=1e-6)
+
+
+def test_multibox_prior_clip():
+    data = nd.zeros((1, 3, 1, 1))
+    anchors = nd.MultiBoxPrior(data, sizes=(1.5,), clip=True)
+    a = anchors.asnumpy()
+    assert a.min() >= 0.0 and a.max() <= 1.0
+
+
+def test_multibox_target_matching_and_encoding():
+    # two anchors; one gt overlapping anchor 0 exactly
+    anchors = nd.array(np.array(
+        [[[0.0, 0.0, 0.5, 0.5], [0.5, 0.5, 1.0, 1.0]]], np.float32))
+    label = nd.array(np.array(
+        [[[1.0, 0.0, 0.0, 0.5, 0.5],
+          [-1.0, 0.0, 0.0, 0.0, 0.0]]], np.float32))    # one gt, one pad
+    cls_pred = nd.zeros((1, 3, 2))
+    box_t, box_m, cls_t = nd.MultiBoxTarget(anchors, label, cls_pred)
+    ct = cls_t.asnumpy()[0]
+    assert ct[0] == 2.0      # class 1 -> target 1+1 = 2
+    assert ct[1] == 0.0      # background
+    bm = box_m.asnumpy()[0].reshape(2, 4)
+    np.testing.assert_allclose(bm[0], 1.0)
+    np.testing.assert_allclose(bm[1], 0.0)
+    # perfect match: offsets are all zero
+    bt = box_t.asnumpy()[0].reshape(2, 4)
+    np.testing.assert_allclose(bt[0], 0.0, atol=1e-5)
+
+
+def test_multibox_target_offset_encoding_roundtrip():
+    # encode with MultiBoxTarget, decode with MultiBoxDetection: the
+    # decoded box must reproduce the ground truth
+    rng = np.random.RandomState(0)
+    anchors_np = np.array([[[0.1, 0.1, 0.6, 0.7]]], np.float32)
+    gt = np.array([[[0.0, 0.15, 0.05, 0.7, 0.8]]], np.float32)
+    anchors = nd.array(anchors_np)
+    label = nd.array(gt)
+    cls_pred = nd.zeros((1, 2, 1))
+    box_t, box_m, cls_t = nd.MultiBoxTarget(anchors, label, cls_pred)
+    assert cls_t.asnumpy()[0, 0] == 1.0
+
+    # feed the encoded offsets back through the decoder
+    cls_prob = nd.array(np.array([[[0.1], [0.9]]], np.float32))
+    out = nd.MultiBoxDetection(cls_prob, box_t, anchors,
+                               threshold=0.5, clip=False)
+    row = out.asnumpy()[0, 0]
+    assert row[0] == 0.0                 # class id (background excluded)
+    np.testing.assert_allclose(row[2:], gt[0, 0, 1:], atol=1e-5)
+
+
+def test_multibox_detection_nms():
+    # three anchors: two heavily overlapping (same class), one separate
+    anchors = nd.array(np.array(
+        [[[0.1, 0.1, 0.4, 0.4],
+          [0.12, 0.1, 0.42, 0.4],
+          [0.6, 0.6, 0.9, 0.9]]], np.float32))
+    # zero offsets: boxes decode to the anchors themselves
+    loc = nd.zeros((1, 12))
+    cls_prob = nd.array(np.array(
+        [[[0.1, 0.2, 0.1],          # background
+          [0.9, 0.8, 0.85]]], np.float32))
+    out = nd.MultiBoxDetection(cls_prob, loc, anchors,
+                               nms_threshold=0.5).asnumpy()[0]
+    kept = out[out[:, 0] >= 0]
+    # the weaker of the overlapping pair is suppressed
+    assert kept.shape[0] == 2
+    np.testing.assert_allclose(sorted(kept[:, 1]), [0.85, 0.9], atol=1e-6)
+
+
+def test_multibox_detection_threshold():
+    anchors = nd.array(np.array([[[0.1, 0.1, 0.4, 0.4]]], np.float32))
+    loc = nd.zeros((1, 4))
+    cls_prob = nd.array(np.array([[[0.99], [0.005]]], np.float32))
+    out = nd.MultiBoxDetection(cls_prob, loc, anchors,
+                               threshold=0.01).asnumpy()[0]
+    assert (out[:, 0] == -1).all()       # below threshold: all suppressed
+
+
+def test_multibox_target_negative_mining():
+    # 4 anchors, 1 matched; mining ratio 1 keeps only 1 hard negative
+    anchors = nd.array(np.array(
+        [[[0.0, 0.0, 0.5, 0.5], [0.5, 0.0, 1.0, 0.5],
+          [0.0, 0.5, 0.5, 1.0], [0.5, 0.5, 1.0, 1.0]]], np.float32))
+    label = nd.array(np.array(
+        [[[0.0, 0.0, 0.0, 0.5, 0.5]]], np.float32))
+    # background scores: anchor 1 is the "hardest" negative (lowest bg)
+    cls_pred = nd.array(np.array(
+        [[[0.9, 0.1, 0.8, 0.7], [0.1, 0.9, 0.2, 0.3]]], np.float32))
+    _, _, cls_t = nd.MultiBoxTarget(anchors, label, cls_pred,
+                                    negative_mining_ratio=1.0,
+                                    ignore_label=-1.0)
+    ct = cls_t.asnumpy()[0]
+    assert ct[0] == 1.0                  # the positive
+    assert (ct == 0.0).sum() == 1        # exactly one kept negative
+    assert ct[1] == 0.0                  # ...the hardest one
+    assert (ct == -1.0).sum() == 2       # the rest ignored
+
+
+def test_multibox_under_jit():
+    # the whole pipeline must compile (static shapes, no python branches)
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.detection import (MultiBoxPrior, MultiBoxTarget,
+                                         MultiBoxDetection)
+
+    @jax.jit
+    def pipeline(feat, label, cls_pred, cls_prob, loc):
+        anchors = MultiBoxPrior(feat, sizes=(0.4, 0.2), ratios=(1.0, 2.0))
+        bt, bm, ct = MultiBoxTarget(anchors, label, cls_pred)
+        det = MultiBoxDetection(cls_prob, loc, anchors)
+        return bt, bm, ct, det
+
+    rng = np.random.RandomState(1)
+    feat = jnp.zeros((2, 8, 4, 4))
+    N = 4 * 4 * 3
+    label = jnp.asarray(rng.rand(2, 3, 5).astype(np.float32))
+    label = label.at[:, :, 0].set(0.0)
+    cls_pred = jnp.asarray(rng.rand(2, 3, N).astype(np.float32))
+    cls_prob = jnp.asarray(rng.rand(2, 3, N).astype(np.float32))
+    loc = jnp.asarray(rng.randn(2, N * 4).astype(np.float32) * 0.1)
+    bt, bm, ct, det = pipeline(feat, label, cls_pred, cls_prob, loc)
+    assert bt.shape == (2, N * 4) and ct.shape == (2, N)
+    assert det.shape == (2, N, 6)
+    assert np.isfinite(np.asarray(det)).all()
