@@ -162,6 +162,34 @@ def main():
             import sys
             print(f"bench: hybrid path failed: {e!r}", file=sys.stderr)
 
+    # ---------------- gluon.contrib.FusedTrainStep: the user-facing API
+    # as ONE compiled program (fwd+bwd+optimizer, donated buffers).
+    # multi_precision=False: fp32 master + fp32 moments do not fit next
+    # to a BERT-large donation transition on a 16GB chip.
+    fused_mfu = None
+    if os.environ.get("BENCH_FUSED", "1") != "0":
+        try:
+            from mxnet_tpu import gluon
+            from mxnet_tpu.gluon.contrib import FusedTrainStep
+            model_u, head_u = build_pretrain()
+            if on_tpu:
+                head_u.cast("bfloat16")
+            step_u = models.BERTPretrainLoss(head_u)
+            tr_u = gluon.Trainer(head_u.collect_params(), "adamw",
+                                 {"learning_rate": 1e-4,
+                                  "multi_precision": False})
+            fused = FusedTrainStep(step_u, tr_u)
+            feats, labels = _mlm_batch(nd, rng, cfg["vocab_size"], B, L)
+            fdt = _time_steps(
+                jax, lambda: fused(*feats, *labels, batch_size=B)._data,
+                steps)
+            fused_mfu = _mfu(n_params, B, L, fdt, peak_tflops)
+            model_u = head_u = step_u = tr_u = fused = None  # noqa: F841
+            gc.collect()
+        except Exception as e:                       # noqa: BLE001
+            import sys
+            print(f"bench: fused-step path failed: {e!r}", file=sys.stderr)
+
     # ---------------- long-sequence Pallas flash-attention path at 512
     # (VERDICT r1: bench flash at seq >= 512 where O(L^2) hurts)
     flash_mfu = None
@@ -193,6 +221,8 @@ def main():
     if hybrid_mfu is not None:
         out["hybrid_mfu"] = round(hybrid_mfu, 4)
         out["hybrid_vs_sharded"] = round(hybrid_mfu / mfu, 4)
+    if fused_mfu is not None:
+        out["fused_step_mfu"] = round(fused_mfu, 4)
     if flash_mfu is not None:
         out["flash512_mfu"] = round(flash_mfu, 4)
         out["flash512_samples_per_sec"] = round(flash_samples, 2)

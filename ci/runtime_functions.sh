@@ -1,0 +1,56 @@
+#!/usr/bin/env bash
+# CI entry points (reference: ci/docker/runtime_functions.sh — SURVEY.md
+# §2.3 CI row).  Each function is one CI job; run as
+#   ci/runtime_functions.sh <function>
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+# The virtual 8-device CPU mesh: "real runtime, fake scale" (same env the
+# driver's multichip dry-run uses).
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}"
+
+unittest_cpu() {
+    python -m pytest tests/ -x -q
+}
+
+sanity_imports() {
+    # every public subpackage imports; runtime feature report prints
+    python -c "
+import mxnet_tpu as mx
+import mxnet_tpu.gluon, mxnet_tpu.kvstore, mxnet_tpu.io, mxnet_tpu.image
+import mxnet_tpu.module, mxnet_tpu.executor, mxnet_tpu.contrib
+import mxnet_tpu.parallel, mxnet_tpu.models, mxnet_tpu.np
+print(mx.runtime.Features())"
+}
+
+multichip_dryrun() {
+    python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('multichip ok')"
+}
+
+compile_entry() {
+    python -c "
+import __graft_entry__ as g, jax
+fn, args = g.entry()
+print(jax.jit(fn).lower(*args).compile() and 'entry compiles')"
+}
+
+native_build() {
+    # rebuild the C++ IO library and run its tests
+    g++ -O2 -shared -fPIC -o mxnet_tpu/lib/libmxnet_tpu_native.so \
+        mxnet_tpu/lib/src/nativelib.cc
+    python -m pytest tests/test_native.py -x -q
+}
+
+examples_smoke() {
+    python examples/mnist_gluon.py --epochs 1
+    python examples/word_language_model.py --epochs 1
+    python examples/ssd_detection.py --iters 40
+}
+
+bench_cpu() {
+    # tiny-config bench harness end-to-end (no TPU required)
+    BENCH_CHILD=1 BENCH_STEPS=2 python bench.py
+}
+
+"$@"

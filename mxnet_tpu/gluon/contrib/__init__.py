@@ -2,5 +2,7 @@
 from . import estimator
 from . import nn
 from . import rnn
+from .fused import FusedTrainStep
+from .moe import MoEFFN
 
-__all__ = ["estimator", "nn", "rnn"]
+__all__ = ["estimator", "nn", "rnn", "FusedTrainStep", "MoEFFN"]

@@ -1,0 +1,58 @@
+"""Mixture-of-Experts Gluon layer (expert-parallel on the ``ep`` mesh
+axis).
+
+New TPU-first capability — upstream MXNet has no MoE (SURVEY.md §2.4:
+EP absent; flagged as new capability).  Wraps ``ops/moe.py``'s
+GShard-style dense-routing op: parameters are named so
+``parallel.MEGATRON_RULES`` shards the expert dim over ``ep`` (the
+dispatch/combine einsums then lower to ICI all-to-alls under GSPMD).
+
+    layer = MoEFFN(units=512, hidden_size=2048, num_experts=8)
+    out, aux_loss = layer(x)          # add aux_weight*aux_loss to loss
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ..block import HybridBlock
+
+__all__ = ["MoEFFN"]
+
+
+class MoEFFN(HybridBlock):
+    """Switch/GShard top-1 MoE feed-forward block.
+
+    Inputs (..., units); returns (output (..., units), aux_loss ()).
+    Tokens routed past an expert's ``capacity_factor`` allowance are
+    dropped (carried by the caller's residual connection, per GShard).
+    """
+
+    def __init__(self, units, hidden_size, num_experts,
+                 capacity_factor=1.25, activation="gelu",
+                 weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        if num_experts < 1:
+            raise MXNetError("MoEFFN needs num_experts >= 1")
+        self._capacity_factor = float(capacity_factor)
+        self._activation = activation
+        with self.name_scope():
+            self.gate_weight = self.params.get(
+                "gate_weight", shape=(units, num_experts),
+                init=weight_initializer)
+            self.expert_w1 = self.params.get(
+                "expert_w1", shape=(num_experts, units, hidden_size),
+                init=weight_initializer)
+            self.expert_b1 = self.params.get(
+                "expert_b1", shape=(num_experts, hidden_size), init="zeros")
+            self.expert_w2 = self.params.get(
+                "expert_w2", shape=(num_experts, hidden_size, units),
+                init=weight_initializer)
+            self.expert_b2 = self.params.get(
+                "expert_b2", shape=(num_experts, units), init="zeros")
+
+    def hybrid_forward(self, F, x, gate_weight, expert_w1, expert_b1,
+                       expert_w2, expert_b2):
+        out, aux = F.moe_ffn(x, gate_weight, expert_w1, expert_b1,
+                             expert_w2, expert_b2,
+                             capacity_factor=self._capacity_factor,
+                             activation=self._activation)
+        return out, aux

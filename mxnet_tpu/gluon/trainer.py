@@ -18,6 +18,33 @@ from .. import optimizer as opt
 from ..ndarray import NDArray
 from .parameter import Parameter, ParameterDict
 
+
+# optimizer-state pytree helpers, shared with contrib.fused.FusedTrainStep
+def _state_raw(s):
+    if s is None:
+        return None
+    if isinstance(s, (tuple, list)):
+        return tuple(_state_raw(x) for x in s)
+    return s._data
+
+
+def _state_sig(s):
+    if s is None:
+        return None
+    if isinstance(s, (tuple, list)):
+        return tuple(_state_sig(x) for x in s)
+    return (tuple(s.shape), str(s.dtype))
+
+
+def _state_write_back(dst, new):
+    if dst is None:
+        return
+    if isinstance(dst, (tuple, list)):
+        for d, n in zip(dst, new):
+            _state_write_back(d, n)
+        return
+    dst._set_data(new)
+
 __all__ = ["Trainer"]
 
 
@@ -221,28 +248,8 @@ class Trainer:
                 upd.states[i] = o.create_state_multi_precision(i, p.data())
             o._update_count(i)
 
-        def as_raw(s):
-            if s is None:
-                return None
-            if isinstance(s, (tuple, list)):
-                return tuple(as_raw(x) for x in s)
-            return s._data
-
-        def state_sig(s):
-            if s is None:
-                return None
-            if isinstance(s, (tuple, list)):
-                return tuple(state_sig(x) for x in s)
-            return (tuple(s.shape), str(s.dtype))
-
-        def write_back(dst, new):
-            if dst is None:
-                return
-            if isinstance(dst, (tuple, list)):
-                for d, n in zip(dst, new):
-                    write_back(d, n)
-                return
-            dst._set_data(new)
+        as_raw, state_sig, write_back = (_state_raw, _state_sig,
+                                         _state_write_back)
 
         weights = [p.data()._data for _, p in items]
         grads = [p.grad()._data for _, p in items]

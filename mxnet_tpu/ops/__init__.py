@@ -12,6 +12,7 @@ from . import linalg      # noqa: F401  gemm/potrf/trsm
 from . import optimizer_ops  # noqa: F401  fused sgd/adam/lamb updates
 from . import contrib     # noqa: F401  transformer kernels, roialign, ...
 from . import detection   # noqa: F401  SSD MultiBox prior/target/detection
+from . import moe         # noqa: F401  MoE routing + expert FFN (GShard)
 from . import quantization  # noqa: F401  int8 quantize/dequantize/qgemm
 from . import pallas_kernels  # noqa: F401  flash attention (TPU/interpret)
 from .. import random as _random_ops  # noqa: F401  sampling ops

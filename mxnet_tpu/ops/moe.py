@@ -1,0 +1,99 @@
+"""Mixture-of-Experts operators (GShard-style dense routing).
+
+New TPU-first capability — the reference has no MoE (SURVEY.md §2.4:
+EP is ABSENT upstream; flagged as new capability for the pod-scale
+north star).  Design follows the GShard/Switch dispatch pattern the TPU
+ecosystem standardized on: routing is expressed as dense one-hot
+einsums over a fixed expert ``capacity`` (never ragged gathers), so the
+whole layer is a handful of MXU matmuls that XLA shards cleanly — with
+the expert dimension partitioned over the mesh's ``ep`` axis, the
+dispatch/combine einsums lower to all-to-alls on ICI.
+
+Ops:
+  ``moe_top1_dispatch`` — router: gate probs -> combine/dispatch tensors
+  ``moe_ffn``           — full MoE FFN block (router + expert MLPs)
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = ["moe_top1_dispatch", "moe_ffn"]
+
+
+def _top1_tensors(gates, capacity):
+    """gates (S, E) -> combine (S, E, C), dispatch bool (S, E, C),
+    aux_loss (Switch load-balancing loss)."""
+    S, E = gates.shape
+    expert = jnp.argmax(gates, axis=-1)                   # (S,)
+    onehot = jax.nn.one_hot(expert, E, dtype=gates.dtype)  # (S, E)
+    # position of each token within its expert's queue
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0        # (S, E)
+    keep = (pos >= 0) & (pos < capacity)
+    pos_cap = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
+    pos_onehot = jax.nn.one_hot(pos_cap, capacity,
+                                dtype=gates.dtype)        # (S, E, C)
+    dispatch = pos_onehot * keep.astype(gates.dtype)[..., None]
+    gate_val = jnp.sum(gates * onehot, axis=-1, keepdims=True)  # (S, 1)
+    combine = dispatch * gate_val[..., None]
+    # Switch-transformer aux loss: E * sum_e (frac_tokens_e * mean_gate_e)
+    frac = onehot.mean(axis=0)
+    mean_gate = gates.mean(axis=0)
+    aux = E * jnp.sum(frac * mean_gate)
+    return combine, dispatch, aux
+
+
+@register("_contrib_moe_top1_dispatch", num_outputs=3,
+          aliases=["moe_top1_dispatch"])
+def moe_top1_dispatch(gate_logits, *, capacity: int = 0,
+                      capacity_factor: float = 1.25):
+    """Top-1 (Switch) router. ``gate_logits``: (S, E).
+
+    Returns (combine (S,E,C), dispatch (S,E,C), aux_loss ()).  Tokens
+    beyond an expert's capacity are dropped (their combine weights are
+    zero — the residual connection carries them, as in GShard).
+    """
+    S, E = gate_logits.shape
+    cap = int(capacity) if capacity else \
+        max(1, int(capacity_factor * S / E))
+    gates = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    combine, dispatch, aux = _top1_tensors(gates, cap)
+    return (combine.astype(gate_logits.dtype),
+            dispatch.astype(gate_logits.dtype), aux)
+
+
+@register("_contrib_moe_ffn", num_inputs=6, num_outputs=2,
+          aliases=["moe_ffn"])
+def moe_ffn(x, wg, w1, b1, w2, b2, *, capacity_factor: float = 1.25,
+            activation: str = "gelu"):
+    """Full MoE FFN: route -> expert MLPs -> combine.
+
+    x (B, L, C) or (S, C); wg (C, E); w1 (E, C, H); b1 (E, H);
+    w2 (E, H, C); b2 (E, C).  Returns (out with x's shape, aux_loss ())
+    — add ``aux_weight * aux_loss`` to the training loss to balance
+    expert load (Switch-transformer recipe).
+    """
+    orig_shape = x.shape
+    C = orig_shape[-1]
+    xs = x.reshape(-1, C)                                 # (S, C)
+    S = xs.shape[0]
+    E = w1.shape[0]
+    cap = max(1, int(capacity_factor * S / E))
+
+    gates = jax.nn.softmax(
+        (xs.astype(jnp.float32) @ wg.astype(jnp.float32)), axis=-1)
+    combine, dispatch, aux = _top1_tensors(gates, cap)
+    combine = combine.astype(xs.dtype)
+    dispatch = dispatch.astype(xs.dtype)
+
+    expert_in = jnp.einsum("sec,sm->ecm", dispatch, xs)   # (E, cap, C)
+    h = jnp.einsum("ecm,emh->ech", expert_in, w1) + b1[:, None, :]
+    if activation == "relu":
+        h = jax.nn.relu(h)
+    else:
+        h = jax.nn.gelu(h)
+    expert_out = jnp.einsum("ech,ehm->ecm", h, w2) + b2[:, None, :]
+    out = jnp.einsum("sec,ecm->sm", combine, expert_out)  # (S, C)
+    return out.reshape(orig_shape), aux

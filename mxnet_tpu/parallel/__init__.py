@@ -5,7 +5,8 @@ SURVEY.md §5.8); the TPU-native design scales via ONE mechanism: shard
 annotations over a ``jax.sharding.Mesh`` compiled by GSPMD, with XLA
 inserting the ICI/DCN collectives.  This package supplies:
 
-- mesh construction (``make_mesh``) with named axes dp/tp/sp;
+- mesh construction (``make_mesh``) with named axes dp/tp/sp/ep (ep =
+  expert parallelism for MoE, ops/moe.py + gluon.contrib.MoEFFN);
 - ``functionalize``: trace a Gluon Block into a pure fn of
   (params, inputs) — the bridge from the imperative API to pjit;
 - sharding rules (regex -> PartitionSpec) with Megatron-style defaults

@@ -1,4 +1,4 @@
-"""Device-mesh construction (axes: dp / tp / sp)."""
+"""Device-mesh construction (axes: dp / tp / sp / ep)."""
 from __future__ import annotations
 
 import numpy as np
@@ -11,27 +11,29 @@ from ..base import MXNetError
 __all__ = ["make_mesh", "mesh_axis_size"]
 
 
-def make_mesh(dp=None, tp=1, sp=1, devices=None) -> Mesh:
-    """Build a Mesh with axes (dp, tp, sp).
+def make_mesh(dp=None, tp=1, sp=1, ep=1, devices=None) -> Mesh:
+    """Build a Mesh with axes (dp, tp, sp, ep).
 
-    ``dp=None`` absorbs the remaining devices.  On real hardware prefer
-    tp/sp on the innermost axes so their collectives ride ICI neighbors
-    (jax device order is torus-contiguous).
+    ``dp=None`` absorbs the remaining devices.  ``ep`` is the
+    expert-parallel axis (MoE experts sharded across it; unused axes of
+    size 1 cost nothing).  On real hardware prefer tp/sp on the
+    innermost axes so their collectives ride ICI neighbors (jax device
+    order is torus-contiguous).
     """
     if devices is None:
         devices = jax.devices()
     n = len(devices)
     if dp is None:
-        if n % (tp * sp):
-            raise MXNetError(f"{n} devices not divisible by tp*sp="
-                             f"{tp * sp}")
-        dp = n // (tp * sp)
-    if dp * tp * sp > n:
-        raise MXNetError(f"mesh {dp}x{tp}x{sp} needs {dp * tp * sp} "
-                         f"devices, only {n} available")
-    devices = devices[:dp * tp * sp]  # explicit dims may use a subset
-    arr = np.array(devices).reshape(dp, tp, sp)
-    return Mesh(arr, axis_names=("dp", "tp", "sp"))
+        if n % (tp * sp * ep):
+            raise MXNetError(f"{n} devices not divisible by tp*sp*ep="
+                             f"{tp * sp * ep}")
+        dp = n // (tp * sp * ep)
+    if dp * tp * sp * ep > n:
+        raise MXNetError(f"mesh {dp}x{tp}x{sp}x{ep} needs "
+                         f"{dp * tp * sp * ep} devices, only {n} available")
+    devices = devices[:dp * tp * sp * ep]  # explicit dims may use a subset
+    arr = np.array(devices).reshape(dp, tp, sp, ep)
+    return Mesh(arr, axis_names=("dp", "tp", "sp", "ep"))
 
 
 def mesh_axis_size(mesh: Mesh, axis: str) -> int:

@@ -43,7 +43,9 @@ class ShardingRules:
             if axis is None or i >= len(shape):
                 out.append(None)
                 continue
-            size = mesh.shape[axis] if isinstance(axis, str) else 1
+            # axes the mesh doesn't have (e.g. 'ep' on a 3-axis mesh)
+            # degrade to replication, same as non-dividing dims
+            size = mesh.shape.get(axis, 0) if isinstance(axis, str) else 1
             out.append(axis if size and shape[i] % size == 0 else None)
         return P(*out)
 
@@ -63,6 +65,12 @@ MEGATRON_RULES = ShardingRules([
     (r"(word_embed|tgt_embed|src_embed).*weight$", P(None, "tp")),
     (r"mlm_decoder_weight$", P("tp", None)),
     (r"mlm_decoder_bias$", P("tp")),
+    # MoE experts: dim 0 is the expert dim, sharded over the ep axis;
+    # the hidden dim additionally takes tp (GShard layout)
+    (r"expert_w1$", P("ep", None, "tp")),
+    (r"expert_b1$", P("ep", "tp")),
+    (r"expert_w2$", P("ep", "tp", None)),
+    (r"expert_b2$", P("ep", None)),
 ], default=P())
 
 

@@ -1,0 +1,158 @@
+"""gluon.contrib.FusedTrainStep: one-program training step must match the
+record/backward/step recipe numerically, keep aux states updating, and
+respect LR changes mid-training."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.contrib import FusedTrainStep
+
+
+def _make_pair(seed, with_bn=False, optimizer="adam",
+               opt_args=None):
+    """Two identical (net, trainer) pairs with shared init."""
+    opt_args = dict(opt_args or {"learning_rate": 1e-2})
+    nets = []
+    for _ in range(2):
+        mx.random.seed(seed)
+        net = nn.HybridSequential()
+        # explicit in_units: init draws happen eagerly under the seed, so
+        # both copies start from identical weights
+        net.add(nn.Dense(16, activation="relu", in_units=4))
+        if with_bn:
+            net.add(nn.BatchNorm(in_channels=16))
+        net.add(nn.Dense(1, in_units=16))
+        net.initialize(mx.init.Xavier())
+        tr = gluon.Trainer(net.collect_params(), optimizer, dict(opt_args))
+        nets.append((net, tr))
+    return nets
+
+
+class LossBlock(gluon.HybridBlock):
+    def __init__(self, net, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.net = net
+
+    def hybrid_forward(self, F, x, y):
+        return ((self.net(x) - y) ** 2).mean()
+
+
+def test_matches_three_call_recipe():
+    (net_a, tr_a), (net_b, tr_b) = _make_pair(0)
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 4).astype(np.float32)
+    Y = rng.randn(64, 1).astype(np.float32)
+
+    blk_a = LossBlock(net_a)
+    blk_b = LossBlock(net_b)
+    blk_a.hybridize(static_alloc=True)
+    fused = FusedTrainStep(blk_b, tr_b)
+
+    for step in range(5):
+        x, y = nd.array(X), nd.array(Y)
+        with autograd.record():
+            la = blk_a(x, y)
+        la.backward()
+        tr_a.step(64)
+        lb = fused(x, y, batch_size=64)
+        np.testing.assert_allclose(float(la.asscalar()),
+                                   float(lb.asscalar()), rtol=1e-5)
+    # parameters identical after 5 steps
+    for (na, pa), (nb, pb) in zip(net_a.collect_params().items(),
+                                  net_b.collect_params().items()):
+        np.testing.assert_allclose(pa.data().asnumpy(),
+                                   pb.data().asnumpy(), rtol=1e-4,
+                                   atol=1e-6)
+
+
+def test_lr_change_applies():
+    (net_a, tr_a), (net_b, tr_b) = _make_pair(1, optimizer="sgd")
+    rng = np.random.RandomState(1)
+    X = rng.randn(32, 4).astype(np.float32)
+    Y = rng.randn(32, 1).astype(np.float32)
+    blk_a, blk_b = LossBlock(net_a), LossBlock(net_b)
+    fused = FusedTrainStep(blk_b, tr_b)
+    for step in range(4):
+        if step == 2:
+            tr_a.set_learning_rate(1e-3)
+            tr_b.set_learning_rate(1e-3)
+        x, y = nd.array(X), nd.array(Y)
+        with autograd.record():
+            la = blk_a(x, y)
+        la.backward()
+        tr_a.step(32)
+        fused(x, y, batch_size=32)
+    for (_, pa), (_, pb) in zip(net_a.collect_params().items(),
+                                net_b.collect_params().items()):
+        np.testing.assert_allclose(pa.data().asnumpy(),
+                                   pb.data().asnumpy(), rtol=1e-4,
+                                   atol=1e-6)
+
+
+def test_batchnorm_aux_states_update():
+    (net, tr), _ = _make_pair(2, with_bn=True)
+    blk = LossBlock(net)
+    fused = FusedTrainStep(blk, tr)
+    bn = [b for b in net._children.values()
+          if isinstance(b, nn.BatchNorm)][0]
+    before = bn.running_mean.data().asnumpy().copy()
+    rng = np.random.RandomState(2)
+    for _ in range(3):
+        x = nd.array(rng.randn(32, 4).astype(np.float32) + 5.0)
+        y = nd.zeros((32, 1))
+        fused(x, y)
+    after = bn.running_mean.data().asnumpy()
+    assert np.abs(after - before).max() > 1e-3
+
+
+def test_convergence():
+    (net, tr), _ = _make_pair(3)
+    blk = LossBlock(net)
+    fused = FusedTrainStep(blk, tr)
+    rng = np.random.RandomState(3)
+    X = rng.randn(128, 4).astype(np.float32)
+    Y = (X.sum(1, keepdims=True) * 0.5).astype(np.float32)
+    first = last = None
+    for i in range(150):
+        loss = fused(nd.array(X), nd.array(Y))
+        if i == 0:
+            first = float(loss.asscalar())
+    last = float(loss.asscalar())
+    assert last < 0.1 * first, (first, last)
+
+
+def test_sparse_grad_rejected():
+    (net, tr), _ = _make_pair(7)
+    p = next(iter(net.collect_params().values()))
+    p._grad_stype = "row_sparse"
+    with pytest.raises(mx.MXNetError):
+        FusedTrainStep(LossBlock(net), tr)
+
+
+def test_grad_add_rejected():
+    (net, tr), _ = _make_pair(4)
+    for p in net.collect_params().values():
+        p.grad_req = "add"
+    with pytest.raises(mx.MXNetError):
+        FusedTrainStep(LossBlock(net), tr)
+
+
+def test_save_load_still_works(tmp_path):
+    (net, tr), _ = _make_pair(5)
+    blk = LossBlock(net)
+    fused = FusedTrainStep(blk, tr)
+    rng = np.random.RandomState(5)
+    fused(nd.array(rng.randn(8, 4).astype(np.float32)),
+          nd.array(rng.randn(8, 1).astype(np.float32)))
+    f = str(tmp_path / "net.params")
+    net.save_parameters(f)
+    (net2, _), _ = _make_pair(6)
+    net2(nd.ones((1, 4)))          # shape init
+    net2.load_parameters(f)
+    for (_, pa), (_, pb) in zip(net.collect_params().items(),
+                                net2.collect_params().items()):
+        np.testing.assert_allclose(pa.data().asnumpy(),
+                                   pb.data().asnumpy())
