@@ -53,4 +53,9 @@ bench_cpu() {
     BENCH_CHILD=1 BENCH_STEPS=2 python bench.py
 }
 
+if [ $# -lt 1 ] || ! declare -F "$1" > /dev/null; then
+    echo "usage: ci/runtime_functions.sh <job>" >&2
+    echo "jobs: $(declare -F | awk '{print $3}' | tr '\n' ' ')" >&2
+    exit 2
+fi
 "$@"

@@ -102,10 +102,19 @@ class FusedTrainStep:
         trainable, frozen = [], []
         t_index = {id(p): i for i, p in enumerate(trainer._params)}
         for name, p in params.items():
-            if p.grad_req != "null" and id(p) in t_index:
+            if p.grad_req == "null":
+                frozen.append((name, p))
+            elif id(p) in t_index:
                 trainable.append((t_index[id(p)], name, p))
             else:
-                frozen.append((name, p))
+                # a second Trainer managing this param would read .grad
+                # buffers this step never writes: refuse loudly
+                raise MXNetError(
+                    f"FusedTrainStep: parameter {name!r} has "
+                    f"grad_req={p.grad_req!r} but is not managed by the "
+                    f"given trainer; multi-trainer setups need the "
+                    f"record/backward/step recipe (or grad_req='null' "
+                    f"to freeze it)")
         if not trainable:
             raise MXNetError("FusedTrainStep: no trainable parameters")
 

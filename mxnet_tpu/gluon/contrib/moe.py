@@ -32,6 +32,10 @@ class MoEFFN(HybridBlock):
         super().__init__(**kwargs)
         if num_experts < 1:
             raise MXNetError("MoEFFN needs num_experts >= 1")
+        if activation not in ("relu", "gelu"):
+            raise MXNetError(
+                f"MoEFFN: unsupported activation {activation!r} "
+                f"(supported: 'relu', 'gelu')")
         self._capacity_factor = float(capacity_factor)
         self._activation = activation
         with self.name_scope():

@@ -82,6 +82,11 @@ def moe_ffn(x, wg, w1, b1, w2, b2, *, capacity_factor: float = 1.25,
     E = w1.shape[0]
     cap = max(1, int(capacity_factor * S / E))
 
+    if activation not in ("relu", "gelu"):
+        from ..base import MXNetError
+        raise MXNetError(
+            f"moe_ffn: unsupported activation {activation!r} "
+            f"(supported: 'relu', 'gelu')")
     gates = jax.nn.softmax(
         (xs.astype(jnp.float32) @ wg.astype(jnp.float32)), axis=-1)
     combine, dispatch, aux = _top1_tensors(gates, cap)

@@ -22,10 +22,13 @@ from .sharding import ShardingRules, MEGATRON_RULES, partition_params
 from .optim import sgd_init, sgd_update, adamw_init, adamw_update
 from .trainer import ShardedTrainer
 from .ring_attention import ring_attention, ring_self_attention
+from .checkpoint import CheckpointManager, save_checkpoint, \
+    load_checkpoint
 from . import dist
 
 __all__ = ["make_mesh", "mesh_axis_size", "functionalize",
            "ShardingRules", "MEGATRON_RULES", "partition_params",
            "sgd_init", "sgd_update", "adamw_init", "adamw_update",
            "ShardedTrainer", "ring_attention", "ring_self_attention",
+           "CheckpointManager", "save_checkpoint", "load_checkpoint",
            "dist"]

@@ -58,6 +58,15 @@ class ShardedTrainer:
         if dtype is not None:
             params = {n: a.astype(dtype) if jnp.issubdtype(
                 a.dtype, jnp.floating) else a for n, a in params.items()}
+        else:
+            # device_put below may ALIAS the Block's live buffers on
+            # same-backend transfers; the step donates params, and
+            # donating an aliased buffer deletes the imperative API's
+            # view (a later wait_to_read/waitall then fails with
+            # "deleted or donated buffer").  astype above already
+            # copies; copy explicitly when it didn't.
+            params = {n: jnp.array(a, copy=True)
+                      for n, a in params.items()}
         self.params, self.param_shardings = partition_params(
             params, mesh, rules)
         self.opt_state = opt_init(self.params)

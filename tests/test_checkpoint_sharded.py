@@ -1,0 +1,85 @@
+"""parallel.checkpoint: sharded async Orbax checkpoints round-trip on the
+virtual mesh and resumed training matches uninterrupted training."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon, parallel
+from mxnet_tpu.gluon import nn
+
+
+def _setup(seed):
+    mx.random.seed(seed)
+    # explicit prefixes: the auto-name counter is process-global, and
+    # checkpoint trees are keyed by parameter name
+    net = nn.HybridSequential(prefix="ck_net_")
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu", in_units=8,
+                         prefix="fc1_"),
+                nn.Dense(4, in_units=16, prefix="fc2_"))
+    net.initialize(mx.init.Xavier())
+    mesh = parallel.make_mesh(dp=2, tp=2, sp=1,
+                              devices=jax.devices()[:4])
+
+    def loss_fn(out, y):
+        return ((out - y) ** 2).mean()
+
+    rng = np.random.RandomState(seed)
+    x = nd.array(rng.randn(8, 8).astype(np.float32))
+    y = nd.array(rng.randn(8, 4).astype(np.float32))
+    tr = parallel.ShardedTrainer(
+        net, loss_fn, mesh, optimizer="adamw",
+        optimizer_params={"learning_rate": 1e-2},
+        example_inputs=(x,), n_labels=1)
+    return tr, x, y
+
+
+def test_roundtrip_and_resume(tmp_path):
+    tr, x, y = _setup(0)
+    losses = [float(jax.device_get(tr.step(x, y))) for _ in range(3)]
+
+    with parallel.CheckpointManager(tmp_path / "ckpt",
+                                    async_write=False) as mngr:
+        mngr.save(3, tr)
+    # continue training: the uninterrupted trajectory
+    ref = [float(jax.device_get(tr.step(x, y))) for _ in range(3)]
+
+    # fresh trainer restores and must reproduce the same trajectory
+    tr2, x2, y2 = _setup(0)
+    step = parallel.load_checkpoint(tmp_path / "ckpt", tr2)
+    assert step == 3
+    got = [float(jax.device_get(tr2.step(x2, y2))) for _ in range(3)]
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+    assert losses[0] > got[-1]            # sanity: training progressed
+
+
+def test_restored_arrays_keep_shardings(tmp_path):
+    tr, x, y = _setup(1)
+    tr.step(x, y)
+    parallel.save_checkpoint(tmp_path / "c2", tr, step=1)
+    tr2, _, _ = _setup(1)
+    parallel.load_checkpoint(tmp_path / "c2", tr2)
+    for name, arr in tr2.params.items():
+        expect = tr.params[name].sharding
+        assert arr.sharding == expect, name
+
+
+def test_rolling_retention(tmp_path):
+    tr, x, y = _setup(2)
+    with parallel.CheckpointManager(tmp_path / "c3", max_to_keep=2,
+                                    async_write=False) as mngr:
+        for s in (1, 2, 3, 4):
+            tr.step(x, y)
+            mngr.save(s, tr)
+        mngr.wait()
+        assert mngr.latest_step() == 4
+        assert mngr.all_steps() == [3, 4]
+
+
+def test_restore_missing_raises(tmp_path):
+    tr, _, _ = _setup(3)
+    with pytest.raises(mx.MXNetError):
+        parallel.load_checkpoint(tmp_path / "nope", tr)

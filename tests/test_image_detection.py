@@ -1,0 +1,121 @@
+"""Detection augmenters + ImageDetIter (mx.image.detection parity)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.image import (CreateDetAugmenter, DetBorrowAug,
+                             DetHorizontalFlipAug, DetRandomCropAug,
+                             DetRandomPadAug, DetRandomSelectAug,
+                             ImageDetIter, CastAug)
+
+
+def _img(h=32, w=48):
+    rng = np.random.RandomState(0)
+    return nd.array(rng.randint(0, 255, (h, w, 3)).astype(np.uint8),
+                    dtype="uint8")
+
+
+def _label():
+    # one object: class 1 in the left half
+    return np.array([[1.0, 0.1, 0.2, 0.4, 0.8]], np.float32)
+
+
+def test_flip_mirrors_boxes():
+    import random as pyrandom
+    pyrandom.seed(0)
+    aug = DetHorizontalFlipAug(p=1.0)
+    src, lab = aug(_img(), _label())
+    np.testing.assert_allclose(lab[0, 1], 1.0 - 0.4, atol=1e-6)
+    np.testing.assert_allclose(lab[0, 3], 1.0 - 0.1, atol=1e-6)
+    # y coords untouched
+    np.testing.assert_allclose(lab[0, [2, 4]], [0.2, 0.8])
+    # flipping twice restores the original boxes
+    _, lab2 = aug(src, lab)
+    np.testing.assert_allclose(lab2, _label(), atol=1e-6)
+
+
+def test_random_crop_keeps_or_drops_objects():
+    import random as pyrandom
+    pyrandom.seed(1)
+    aug = DetRandomCropAug(min_object_covered=0.5,
+                           area_range=(0.5, 0.9))
+    src, lab = aug(_img(), _label())
+    kept = lab[lab[:, 0] >= 0]
+    for row in kept:
+        assert 0.0 <= row[1] <= row[3] <= 1.0
+        assert 0.0 <= row[2] <= row[4] <= 1.0
+
+
+def test_random_pad_shrinks_boxes():
+    import random as pyrandom
+    pyrandom.seed(2)
+    aug = DetRandomPadAug(area_range=(2.0, 2.0))
+    src, lab = aug(_img(), _label())
+    w_before = 0.4 - 0.1
+    w_after = lab[0, 3] - lab[0, 1]
+    assert w_after < w_before            # zoom-out shrinks the box
+    assert src.shape[0] > 32 and src.shape[1] > 48
+
+
+def test_borrow_aug_keeps_labels():
+    aug = DetBorrowAug(CastAug("float32"))
+    src, lab = aug(_img(), _label())
+    assert str(src.dtype) == "float32"
+    np.testing.assert_allclose(lab, _label())
+
+
+def test_create_det_augmenter_pipeline():
+    import random as pyrandom
+    pyrandom.seed(3)
+    augs = CreateDetAugmenter((3, 64, 64), rand_crop=0.5, rand_pad=0.5,
+                              rand_mirror=True, mean=True, std=True)
+    src, lab = _img(), _label()
+    for a in augs:
+        src, lab = a(src, lab)
+    assert src.shape[:2] == (64, 64)
+    assert str(src.dtype) == "float32"
+
+
+def test_image_det_iter_batches():
+    rng = np.random.RandomState(4)
+    samples = []
+    for i in range(5):
+        img = nd.array(rng.randint(0, 255, (24, 24, 3))
+                       .astype(np.uint8), dtype="uint8")
+        samples.append((img, [[float(i % 2), 0.1, 0.1, 0.6, 0.6]]))
+    it = ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                      imglist=samples, max_objects=4)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (2, 3, 32, 32)
+    assert batches[0].label[0].shape == (2, 4, 5)
+    assert batches[-1].pad == 1
+    lab = batches[0].label[0].asnumpy()
+    assert (lab[0, 0, 0] >= 0) and (lab[0, 1:, 0] == -1).all()
+    # reset re-iterates
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_image_det_iter_recordio_roundtrip(tmp_path):
+    from mxnet_tpu import recordio
+    from mxnet_tpu.image import imencode
+    rng = np.random.RandomState(5)
+    rec_path = str(tmp_path / "det.rec")
+    rec = recordio.MXRecordIO(rec_path, "w")
+    for i in range(3):
+        img = rng.randint(0, 255, (20, 20, 3)).astype(np.uint8)
+        # det-record header: [4, 5, cls, x0, y0, x1, y1]
+        label = np.array([4.0, 5.0, float(i), 0.2, 0.2, 0.8, 0.8],
+                         np.float32)
+        header = recordio.IRHeader(0, label, i, 0)
+        rec.write(recordio.pack(header, imencode(img, ".png")))
+    rec.close()
+    it = ImageDetIter(batch_size=3, data_shape=(3, 20, 20),
+                      path_imgrec=rec_path, aug_list=[], max_objects=2)
+    batch = next(iter(it))
+    lab = batch.label[0].asnumpy()
+    np.testing.assert_allclose(lab[:, 0, 0], [0.0, 1.0, 2.0])
+    np.testing.assert_allclose(lab[:, 0, 1:], [[0.2, 0.2, 0.8, 0.8]] * 3,
+                               atol=1e-6)
