@@ -119,6 +119,30 @@ def _op_specs(large=False):
                     nd.array(rng.rand(4096, n[1]).astype(np.float32))):
             ([q[0], w[0], None, q[1], q[2], w[1], w[2], None, None],
              {"num_hidden": 4096, "no_bias": True}))()),
+        # attention (interleaved layout: (L, B, H*3*D))
+        "_contrib_interleaved_matmul_selfatt_qk": ("attention",
+            lambda nd, rng: (
+                [nd.array(rng.rand(128, 8, 16 * 3 * 64)
+                          .astype(np.float32))], {"heads": 16})),
+        "flash_selfatt_nomask": ("attention", lambda nd, rng: (
+            [nd.array(rng.rand(512, 4, 16 * 3 * 64).astype(np.float32))],
+            {"heads": 16})),
+        # detection
+        "MultiBoxPrior": ("detection", lambda nd, rng: (
+            [nd.zeros((4, 64, 32, 32))],
+            {"sizes": (0.3, 0.5), "ratios": (1.0, 2.0, 0.5)})),
+        "MultiBoxDetection": ("detection", lambda nd, rng: (
+            [nd.array(rng.rand(4, 3, 4096).astype(np.float32)),
+             nd.array(rng.randn(4, 4096 * 4).astype(np.float32) * 0.1),
+             nd.array(rng.rand(1, 4096, 4).astype(np.float32))], {})),
+        # MoE (GShard dense routing)
+        "moe_ffn": ("moe", lambda nd, rng: (
+            [nd.array(rng.rand(8, 128, 512).astype(np.float32)),
+             nd.array(rng.randn(512, 8).astype(np.float32)),
+             nd.array(rng.randn(8, 512, 1024).astype(np.float32) * 0.05),
+             nd.zeros((8, 1024)),
+             nd.array(rng.randn(8, 1024, 512).astype(np.float32) * 0.05),
+             nd.zeros((8, 512))], {})),
     }
     return specs
 
@@ -186,7 +210,7 @@ def main():
                     help="comma-separated op names (default: all)")
     ap.add_argument("--categories", default=None,
                     help="comma-separated: elemwise,broadcast,reduce,"
-                         "gemm,conv,nn,optimizer")
+                         "gemm,conv,nn,optimizer,attention,detection,moe")
     ap.add_argument("--runs", type=int, default=10)
     ap.add_argument("--warmup", type=int, default=2)
     ap.add_argument("--large", action="store_true",
