@@ -24,6 +24,7 @@ from .trainer import ShardedTrainer
 from .ring_attention import ring_attention, ring_self_attention
 from .checkpoint import CheckpointManager, save_checkpoint, \
     load_checkpoint
+from .pipeline import pipeline_apply, make_pipeline_mesh
 from . import dist
 
 __all__ = ["make_mesh", "mesh_axis_size", "functionalize",
@@ -31,4 +32,5 @@ __all__ = ["make_mesh", "mesh_axis_size", "functionalize",
            "sgd_init", "sgd_update", "adamw_init", "adamw_update",
            "ShardedTrainer", "ring_attention", "ring_self_attention",
            "CheckpointManager", "save_checkpoint", "load_checkpoint",
+           "pipeline_apply", "make_pipeline_mesh",
            "dist"]
