@@ -31,6 +31,13 @@ Semantic differences from the three-call recipe (documented contract):
   unsupported and raises.
 - the autograd tape is bypassed — do not wrap calls in
   ``autograd.record()``.
+- a step that fails AFTER dispatch consumes the donated weight and
+  optimizer-state buffers (unlike the three-call recipe, which leaves
+  weights intact).  Errors surfacing at dispatch poison the instance
+  with a reload-and-``reset()`` message; with fully asynchronous
+  dispatch an execution error can instead surface at a later sync point
+  as a raw XLA error, and the next ``__call__`` detects the deleted
+  buffers and raises the same guidance.
 """
 from __future__ import annotations
 
@@ -63,6 +70,7 @@ class FusedTrainStep:
         self._block = block
         self._trainer = trainer
         self._cache = {}
+        self._poisoned = None
         o = trainer._optimizer
         if not getattr(o, "fused", False):
             raise MXNetError(
@@ -87,6 +95,24 @@ class FusedTrainStep:
                     f"{p.name!r} requests grad_stype="
                     f"{p._grad_stype!r} lazy sparse updates — use the "
                     f"record/backward/step recipe")
+
+    def reset(self):
+        """Clear the poisoned flag after parameters (and optimizer state)
+        have been reloaded following a failed donated step.
+
+        Optimizer states the user restored (``trainer.load_states``) are
+        kept; only states still pointing at buffers deleted by the failed
+        donation are dropped (they are recreated from scratch on the next
+        step)."""
+        self._poisoned = None
+        upd = self._trainer._updater
+        for i in list(upd.states):
+            leaves = jax.tree_util.tree_leaves(_as_raw(upd.states[i]))
+            if any(getattr(a, "is_deleted", lambda: False)()
+                   for a in leaves):
+                del upd.states[i]
+        for entry in self._cache.values():
+            entry["ts"] = None      # ts was donated with weights/states
 
     # ---------------------------------------------------------------- build
     def _build(self, sig, inputs):
@@ -190,6 +216,16 @@ class FusedTrainStep:
 
         from ... import autograd
 
+        if self._poisoned is not None:
+            raise MXNetError(
+                "FusedTrainStep: a previous donated step failed after "
+                "dispatch; the block's weight and optimizer-state buffers "
+                "were consumed and are gone.  Reload parameters "
+                "(load_parameters / initialize(force_reinit=True)), then "
+                "call .reset() on this FusedTrainStep (or construct a new "
+                "one) before training again.  Original failure: "
+                f"{self._poisoned!r}") from self._poisoned
+
         trainer = self._trainer
         o = trainer._optimizer
         upd = trainer._updater
@@ -213,6 +249,20 @@ class FusedTrainStep:
         if entry is None:
             entry = self._build(sig, inputs)
         trainable, frozen = entry["trainable"], entry["frozen"]
+
+        # detect an asynchronously-surfaced donation failure BEFORE the
+        # bookkeeping below advances update counts (a failed/aborted step
+        # must never advance schedules)
+        stale = [a for _i, _n, p in trainable
+                 for a in (p.data(ctx)._data,)] + [
+            a for i, _n, _p in trainable if i in upd.states
+            for a in jax.tree_util.tree_leaves(_as_raw(upd.states[i]))]
+        if any(getattr(a, "is_deleted", lambda: False)() for a in stale):
+            raise MXNetError(
+                "FusedTrainStep: weight/optimizer-state buffers were "
+                "deleted by a previously failed donated step (the failure "
+                "surfaced asynchronously).  Reload parameters, then call "
+                ".reset() (or construct a new FusedTrainStep).")
 
         # same per-step bookkeeping as Trainer._fused_update: ensure
         # states, advance the python-side update counts, keep ts on device
@@ -239,10 +289,39 @@ class FusedTrainStep:
         states = [_as_raw(upd.states[i]) for i, _n, _p in trainable]
         key = mxrand.next_key()
 
-        loss, aux, new_w, new_s, new_ts = entry["prog"](
-            key, entry["ts"], entry["lrs"], entry["wds"],
-            entry["rescale"], [x._data for x in inputs], weights,
-            frozen_arrays, states)
+        try:
+            loss, aux, new_w, new_s, new_ts = entry["prog"](
+                key, entry["ts"], entry["lrs"], entry["wds"],
+                entry["rescale"], [x._data for x in inputs], weights,
+                frozen_arrays, states)
+        except BaseException as e:
+            # the program donated weights/states: a failure after dispatch
+            # (async XLA error, OOM, interrupt — incl. KeyboardInterrupt,
+            # hence BaseException) consumes them without the write-back
+            # below ever running — unlike the three-call recipe a failed
+            # fused step does NOT leave weights intact.  Trace/compile
+            # failures happen BEFORE donation though, so only poison when
+            # a donated buffer was actually deleted.
+            consumed = any(
+                getattr(a, "is_deleted", lambda: False)()
+                for a in jax.tree_util.tree_leaves((weights, states)))
+            # the failed step never applied: roll back the update counts
+            # advanced above so lr schedules / bias correction don't drift
+            for i, _n, _p in trainable:
+                o._index_update_count[i] -= 1
+            entry["counts"] = counts
+            if not consumed:
+                raise
+            self._poisoned = e
+            entry["ts"] = None          # donated alongside weights/states
+            if isinstance(e, Exception):
+                raise MXNetError(
+                    "FusedTrainStep failed after dispatch; weight and "
+                    "optimizer-state buffers were donated to the failed "
+                    "program and may be deleted.  Reload parameters, then "
+                    "call .reset() (or construct a new FusedTrainStep). "
+                    f"Cause: {e!r}") from e
+            raise   # KeyboardInterrupt/SystemExit must propagate as-is
         entry["ts"] = new_ts
         for (i, _n, p), nw, ns in zip(trainable, new_w, new_s):
             p.data(ctx)._set_data(nw)

@@ -167,7 +167,12 @@ class DetRandomPadAug(DetAugmenter):
         scale = pyrandom.uniform(*self.area_range)
         if scale <= 1.0:
             return src, label
-        new_h, new_w = int(H * np.sqrt(scale)), int(W * np.sqrt(scale))
+        # canvas aspect sampled from aspect_ratio_range (reference
+        # samples a ratio and sizes the canvas anisotropically)
+        ratio = pyrandom.uniform(*self.aspect_ratio_range)
+        new_h = int(H * np.sqrt(scale / ratio))
+        new_w = int(W * np.sqrt(scale * ratio))
+        new_h, new_w = max(new_h, H), max(new_w, W)
         off_y = pyrandom.randint(0, new_h - H)
         off_x = pyrandom.randint(0, new_w - W)
         canvas = np.empty((new_h, new_w) + img.shape[2:], img.dtype)
@@ -265,13 +270,38 @@ class ImageDetIter:
                 break
             header, img_bytes = recordio.unpack(s)
             flat = np.asarray(header.label, np.float32)
-            # reference det-record layout: [A, B, ...] header then
-            # B-wide object rows; accept plain (N*5,) too
-            if flat.size >= 2 and float(flat[0]) == 4.0:
-                width = int(flat[1])
-                objs = flat[2:].reshape(-1, width)[:, :5]
-            else:
+            # reference det-record layout: flat[0] = header WIDTH (number
+            # of leading header fields incl. itself), flat[1] = object
+            # row width; object rows start at flat[header_width].
+            # Accept a plain (N*5,) label too.  When both layouts parse
+            # (ambiguous), prefer the one that yields object rows, then
+            # the header layout (upstream canonical).
+            header_ok = (
+                flat.size >= 2 and float(flat[0]).is_integer()
+                and 2 <= int(flat[0]) <= flat.size
+                and float(flat[1]).is_integer() and int(flat[1]) >= 5
+                and (flat.size - int(flat[0])) % int(flat[1]) == 0)
+            plain_ok = flat.size > 0 and flat.size % 5 == 0
+            header_rows = ((flat.size - int(flat[0])) // int(flat[1])
+                           if header_ok else 0)
+            if header_ok and (header_rows > 0 or not plain_ok):
+                header_width = int(flat[0])
+                obj_width = int(flat[1])
+                objs = flat[header_width:].reshape(-1, obj_width)[:, :5]
+            elif plain_ok:
                 objs = flat.reshape(-1, 5)
+            else:
+                raise MXNetError(
+                    f"ImageDetIter: cannot parse det-record label of "
+                    f"size {flat.size} (head {flat[:4].tolist()}): "
+                    f"expected [header_width, obj_width, ...header..., "
+                    f"obj rows] with objects starting at "
+                    f"flat[header_width], or a plain (N*5,) "
+                    f"[cls, x0, y0, x1, y1] list.  (Records written "
+                    f"against this package's pre-r3 nonstandard layout "
+                    f"— objects hard-coded at flat[2:] — must be "
+                    f"re-packed with the standard header, e.g. "
+                    f"[2, 5, cls, x0, y0, x1, y1].)")
             self._samples.append((imdecode(img_bytes),
                                   objs.astype(np.float32)))
         rec.close()

@@ -55,18 +55,18 @@ class ShardedTrainer:
 
         apply_fn, params = functionalize(block, *example_inputs,
                                          train_mode=True)
-        if dtype is not None:
-            params = {n: a.astype(dtype) if jnp.issubdtype(
-                a.dtype, jnp.floating) else a for n, a in params.items()}
-        else:
-            # device_put below may ALIAS the Block's live buffers on
-            # same-backend transfers; the step donates params, and
-            # donating an aliased buffer deletes the imperative API's
-            # view (a later wait_to_read/waitall then fails with
-            # "deleted or donated buffer").  astype above already
-            # copies; copy explicitly when it didn't.
-            params = {n: jnp.array(a, copy=True)
-                      for n, a in params.items()}
+        # device_put below may ALIAS the Block's live buffers on
+        # same-backend transfers; the step donates params, and donating
+        # an aliased buffer deletes the imperative API's view (a later
+        # wait_to_read/waitall then fails with "deleted or donated
+        # buffer").  astype is a no-op alias when the dtype already
+        # matches, so copy unconditionally in BOTH branches.
+        def _own(a):
+            if dtype is not None and jnp.issubdtype(a.dtype, jnp.floating):
+                return jnp.array(a, dtype=dtype, copy=True)
+            return jnp.array(a, copy=True)
+
+        params = {n: _own(a) for n, a in params.items()}
         self.params, self.param_shardings = partition_params(
             params, mesh, rules)
         self.opt_state = opt_init(self.params)

@@ -156,3 +156,87 @@ def test_save_load_still_works(tmp_path):
                                 net2.collect_params().items()):
         np.testing.assert_allclose(pa.data().asnumpy(),
                                    pb.data().asnumpy())
+
+
+def test_failure_recovery_poison_and_reset(tmp_path):
+    """A step failing after dispatch consumes donated buffers: the guard
+    poisons the instance, rolls back update counts, and reset() (after a
+    reload) makes training work again."""
+    import jax
+    import mxnet_tpu.base as base
+    (net, tr), _ = _make_pair(3)
+    rng = np.random.RandomState(3)
+    x = nd.array(rng.randn(8, 4).astype(np.float32))
+    y = nd.array(rng.randn(8, 1).astype(np.float32))
+    step = FusedTrainStep(LossBlock(net), tr)
+    step(x, y)  # build + one good step
+    net.save_parameters(str(tmp_path / "fused_recover.params"))
+    o = tr._optimizer
+    counts_before = dict(o._index_update_count)
+
+    sig, entry = next(iter(step._cache.items()))
+    real_prog = entry["prog"]
+
+    def failing_prog(key, ts, lrs, wds, rescale, inputs, weights,
+                     frozen, states):
+        # emulate a post-dispatch failure: donated buffers consumed
+        for a in jax.tree_util.tree_leaves((ts, weights, states)):
+            a.delete()
+        raise RuntimeError("synthetic post-dispatch failure")
+
+    entry["prog"] = failing_prog
+    with pytest.raises(base.MXNetError, match="donated"):
+        step(x, y)
+    # counts rolled back: the failed step must not advance schedules
+    assert dict(o._index_update_count) == counts_before
+    # subsequent calls raise the poisoned guidance without touching counts
+    with pytest.raises(base.MXNetError, match="reset"):
+        step(x, y)
+    assert dict(o._index_update_count) == counts_before
+
+    entry["prog"] = real_prog
+    net.load_parameters(str(tmp_path / "fused_recover.params"))
+    step.reset()
+    l1 = float(step(x, y).asnumpy())
+    l2 = float(step(x, y).asnumpy())
+    assert np.isfinite(l1) and np.isfinite(l2)
+
+
+def test_failure_before_donation_does_not_poison():
+    """Trace/compile failures happen before donation: weights stay
+    intact and the instance is NOT poisoned."""
+    (net, tr), _ = _make_pair(4)
+    rng = np.random.RandomState(4)
+    x = nd.array(rng.randn(8, 4).astype(np.float32))
+    y = nd.array(rng.randn(8, 1).astype(np.float32))
+    step = FusedTrainStep(LossBlock(net), tr)
+    step(x, y)
+    sig, entry = next(iter(step._cache.items()))
+    real_prog = entry["prog"]
+
+    def pre_dispatch_fail(*a, **k):
+        raise ValueError("synthetic compile failure")
+
+    entry["prog"] = pre_dispatch_fail
+    with pytest.raises(ValueError, match="synthetic compile"):
+        step(x, y)
+    assert step._poisoned is None
+    entry["prog"] = real_prog
+    # weights intact, training continues without reset
+    assert np.isfinite(float(step(x, y).asnumpy()))
+
+
+def test_reset_keeps_reloaded_optimizer_states():
+    """reset() must not wipe optimizer states the user restored — only
+    states still pointing at deleted buffers are dropped."""
+    (net, tr), _ = _make_pair(5)
+    rng = np.random.RandomState(5)
+    x = nd.array(rng.randn(8, 4).astype(np.float32))
+    y = nd.array(rng.randn(8, 1).astype(np.float32))
+    step = FusedTrainStep(LossBlock(net), tr)
+    step(x, y)
+    upd = tr._updater
+    live_states = dict(upd.states)
+    step._poisoned = RuntimeError("synthetic")
+    step.reset()
+    assert upd.states == live_states  # live states preserved

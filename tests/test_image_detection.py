@@ -106,9 +106,17 @@ def test_image_det_iter_recordio_roundtrip(tmp_path):
     rec = recordio.MXRecordIO(rec_path, "w")
     for i in range(3):
         img = rng.randint(0, 255, (20, 20, 3)).astype(np.uint8)
-        # det-record header: [4, 5, cls, x0, y0, x1, y1]
-        label = np.array([4.0, 5.0, float(i), 0.2, 0.2, 0.8, 0.8],
-                         np.float32)
+        # upstream det-record layout: flat[0] = header WIDTH (objects
+        # start at flat[int(flat[0])]), flat[1] = object row width.
+        if i % 2 == 0:
+            # minimal 2-field header: [2, 5, cls, x0, y0, x1, y1]
+            label = np.array([2.0, 5.0, float(i), 0.2, 0.2, 0.8, 0.8],
+                             np.float32)
+        else:
+            # 4-field header with extra fields:
+            # [4, 5, extra, extra, cls, x0, y0, x1, y1]
+            label = np.array([4.0, 5.0, -1.0, -1.0,
+                              float(i), 0.2, 0.2, 0.8, 0.8], np.float32)
         header = recordio.IRHeader(0, label, i, 0)
         rec.write(recordio.pack(header, imencode(img, ".png")))
     rec.close()

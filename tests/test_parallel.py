@@ -131,3 +131,23 @@ def test_graft_entry_dryrun():
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     mod.dryrun_multichip(8)
+
+
+def test_sharded_trainer_dtype_noop_does_not_alias():
+    """ADVICE r2: with dtype set to the params' existing dtype, astype is
+    a no-op alias; the donated step must not delete the Block's live
+    buffers (p.data() stays readable after step())."""
+    from mxnet_tpu.gluon import nn
+    mesh = parallel.make_mesh(dp=2, tp=1, sp=1)
+    for dt in (jnp.float32, None):
+        net = nn.Dense(4, in_units=8)
+        net.initialize()
+        x = nd.random.uniform(shape=(4, 8))
+        y = nd.random.uniform(shape=(4, 4))
+        tr = parallel.ShardedTrainer(
+            net, lambda o, t: ((o - t) ** 2).mean(), mesh,
+            optimizer="sgd", optimizer_params={"learning_rate": 0.1},
+            example_inputs=(x,), n_labels=1, dtype=dt)
+        tr.step(x, y)
+        for name, p in net.collect_params().items():
+            p.data().asnumpy()  # must not raise "Array has been deleted"
