@@ -45,7 +45,7 @@ for _mod in ("initializer", "optimizer", "metric", "gluon", "io", "kvstore",
              "recordio", "callback", "profiler", "util", "runtime",
              "test_utils", "executor", "module", "image", "contrib",
              "parallel", "models", "np", "npx", "lr_scheduler", "operator",
-             "library", "subgraph"):
+             "library", "subgraph", "deploy"):
     try:
         globals()[_mod] = _importlib.import_module(f".{_mod}", __name__)
     except ModuleNotFoundError as _e:

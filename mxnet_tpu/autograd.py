@@ -26,7 +26,8 @@ from .base import MXNetError
 
 __all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
            "is_training", "set_recording", "set_training", "backward",
-           "grad", "get_symbol", "Function", "mark_variables"]
+           "grad", "get_symbol", "Function", "mark_variables",
+           "flush_pending"]
 
 
 class _AGState(threading.local):
@@ -36,6 +37,9 @@ class _AGState(threading.local):
         # True while a retain_graph=True backward replays: cached-program
         # backward (CachedOp) must then keep residual buffers (no donation)
         self.retain = False
+        # deferred single-CachedOp backward awaiting Trainer.step fusion
+        # (see backward() / flush_pending)
+        self.pending = None
 
 
 _STATE = _AGState()
@@ -109,7 +113,7 @@ class TapeNode:
     """
 
     __slots__ = ("fn", "input_entries", "n_outputs", "out_grads", "name",
-                 "_pending", "custom_backward", "key")
+                 "_pending", "custom_backward", "key", "fused_info")
 
     def __init__(self, fn: Callable, input_entries, n_outputs: int,
                  name: str = "", custom_backward: Optional[Callable] = None,
@@ -125,6 +129,9 @@ class TapeNode:
         # programs across tapes (engine bulk-exec).  None = not bulkable.
         self.key = key
         self._pending = 0
+        # set by CachedOp on recorded dispatch: exposes (bwd_impl, res)
+        # so Trainer.step can fuse backward+optimizer into one program
+        self.fused_info = None
 
 
 def _accumulate(slot_list, idx, value):
@@ -165,14 +172,72 @@ def in_retain_backward() -> bool:
     return _STATE.retain
 
 
+def flush_pending():
+    """Execute a deferred backward (see backward()'s deferral below).
+
+    Called from every grad-reading surface (.grad property,
+    Parameter.grad/list_grad, waitall, the next backward) so deferral is
+    invisible to user code — grads materialize before anyone can observe
+    their absence."""
+    p = _STATE.pending
+    if p is None:
+        return
+    _STATE.pending = None
+    leaf_acc = {}
+
+    def _leaf_contribute(arr, g):
+        key = id(arr)
+        if key in leaf_acc:
+            leaf_acc[key] = (arr, leaf_acc[key][1] + g)
+        else:
+            leaf_acc[key] = (arr, g)
+
+    prev_retain = _STATE.retain
+    _STATE.retain = False
+    try:
+        with pause(train_mode=p["train_mode"]):
+            _replay([p["node"]], leaf_acc, _leaf_contribute)
+    finally:
+        _STATE.retain = prev_retain
+    for arr, g in leaf_acc.values():
+        _write_grad(arr, g)
+    for h in p["heads"]:
+        h._autograd_node = None
+
+
+def peek_pending():
+    """The deferred-backward record, or None (Trainer.step fusion hook)."""
+    return _STATE.pending
+
+
+def clear_pending():
+    """Drop the deferred backward WITHOUT executing it (the caller fused
+    it into its own program).  Clears head tape links like a normal
+    backward."""
+    p = _STATE.pending
+    _STATE.pending = None
+    if p is not None:
+        for h in p["heads"]:
+            h._autograd_node = None
+
+
 def backward(heads, head_grads=None, retain_graph: bool = False,
              train_mode: bool = True):
     """Compute gradients of ``heads`` w.r.t. all arrays that were
     ``attach_grad()``-ed (reference: MXAutogradBackwardEx ->
     Imperative::Backward).  Grad arrays are written into ``arr.grad``
-    respecting each array's ``grad_req`` ('write' or 'add')."""
+    respecting each array's ``grad_req`` ('write' or 'add').
+
+    Deferral: when the tape is a single CachedOp node (the hybridized
+    three-call recipe), the replay is DEFERRED — ``Trainer.step`` then
+    compiles backward+optimizer into ONE donated XLA program (engine
+    bulk-exec pushed to its limit; reference: the async engine made
+    ``backward()`` return before compute finished too, so laziness here
+    is the same contract).  Any grad read in between flushes first.
+    Disable with ``MXNET_FUSED_HYBRID_STEP=0``."""
     from .ndarray import NDArray, array as _mkarray
 
+    flush_pending()                     # at most one deferred tape
     if isinstance(heads, NDArray):
         heads = [heads]
     if head_grads is None:
@@ -208,6 +273,21 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
         g = jax.numpy.ones_like(h._data) if hg is None else hg._data
         _accumulate(node.out_grads, out_idx, g)
         root_nodes.append(node)
+
+    # Deferral eligibility: one CachedOp root carrying fusion info, no
+    # leaf heads, all grad-receiving leaves use grad_req='write', eager
+    # (non-naive) engine, and the knob is on.
+    from .base import get_env
+    from .engine import is_naive
+    if (not retain_graph and len(root_nodes) == 1 and not leaf_acc
+            and root_nodes[0].fused_info is not None
+            and not is_naive()
+            and get_env("MXNET_FUSED_HYBRID_STEP", "1") != "0"
+            and all(arr._grad is None or arr._grad_req == "write"
+                    for _p, _o, arr in root_nodes[0].input_entries)):
+        _STATE.pending = {"node": root_nodes[0], "heads": list(heads),
+                          "train_mode": train_mode}
+        return
 
     prev_retain = _STATE.retain
     _STATE.retain = bool(retain_graph)

@@ -1,0 +1,78 @@
+"""Language-neutral deployment artifacts (docs/frontends.md §2).
+
+The reference serves non-Python consumers through the flat C ABI
+(`cpp-package`, Scala, …, SURVEY.md §2.3) and `amalgamation/` for
+predict-only mobile builds.  Here the deployment boundary is the
+compiled program, not the API: a hybridized block exports to a
+**StableHLO artifact** (serialized `jax.export` module with the weights
+baked in) that any PJRT-bearing runtime executes WITHOUT importing this
+framework — the test suite proves it by running one in a subprocess
+that imports only ``jax``.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+from .base import MXNetError
+
+__all__ = ["export_stablehlo", "load_stablehlo"]
+
+
+def export_stablehlo(block, *example_inputs, path, emit_text=False):
+    """Export ``block``'s inference forward as a StableHLO artifact.
+
+    Writes ``path.shlo`` (serialized module, weights embedded as
+    constants) and ``path.json`` (input/output signature manifest).
+    With ``emit_text=True`` also writes ``path.stablehlo.txt`` (the MLIR
+    module, for inspection / non-JAX StableHLO consumers).
+
+    The artifact is self-contained: load it with
+    ``jax.export.deserialize(open(...).read()).call(*arrays)`` — no
+    ``mxnet_tpu`` import needed at serving time (the deployment-boundary
+    equivalent of the reference's amalgamation predict-only build).
+    """
+    import jax
+    from jax import export as jexport
+
+    from .parallel.functional import functionalize
+
+    apply_fn, params = functionalize(block, *example_inputs,
+                                     train_mode=False)
+
+    def infer(*xs):
+        out, _aux = apply_fn(params, *xs)
+        return out
+
+    args = tuple(
+        jax.ShapeDtypeStruct(tuple(x.shape), x._data.dtype)
+        for x in example_inputs)
+    try:
+        exported = jexport.export(jax.jit(infer))(*args)
+    except Exception as e:
+        raise MXNetError(f"export_stablehlo: lowering failed: {e}") from e
+    blob = exported.serialize()
+    with open(path + ".shlo", "wb") as f:
+        f.write(bytes(blob))
+    manifest = {
+        "format": "jax.export/stablehlo",
+        "inputs": [{"shape": list(x.shape), "dtype": str(x._data.dtype)}
+                   for x in example_inputs],
+        "block": type(block).__name__,
+    }
+    with open(path + ".json", "w") as f:
+        json.dump(manifest, f, indent=1)
+    if emit_text:
+        with open(path + ".stablehlo.txt", "w") as f:
+            f.write(exported.mlir_module())
+    return path + ".shlo"
+
+
+def load_stablehlo(path):
+    """Reload an exported artifact for in-process serving (the exporting
+    side of the round trip; serving-side consumers only need jax)."""
+    from jax import export as jexport
+    if not os.path.exists(path):
+        raise MXNetError(f"no artifact at {path}")
+    with open(path, "rb") as f:
+        return jexport.deserialize(bytearray(f.read()))

@@ -121,6 +121,10 @@ def engine() -> Engine:
 
 
 def waitall():
+    # a deferred hybrid backward counts as outstanding async work
+    from . import autograd
+    if autograd._STATE.pending is not None:
+        autograd.flush_pending()
     Engine.get().wait_for_all()
 
 

@@ -17,6 +17,7 @@ returned as extra outputs (purity restored; XLA donates buffers).
 from __future__ import annotations
 
 import contextvars
+import functools
 import re
 import threading
 from collections import OrderedDict
@@ -326,25 +327,77 @@ class CachedOp:
     buffer assignment replaces PlanMemory wholesale.
     """
 
-    def __init__(self, block, static_alloc=False, static_shape=False):
+    def __init__(self, block, static_alloc=False, static_shape=False,
+                 cache_size=None, bucket_shapes=None):
+        from ..base import get_env
         self._block = block
         self._static_alloc = static_alloc
-        self._cache = {}
+        self._cache = OrderedDict()        # LRU over shape signatures
+        if cache_size is None:
+            cache_size = int(get_env("MXNET_CACHED_OP_CACHE_SIZE", "16"))
+        self._cache_size = max(1, int(cache_size))
+        self._n_evictions = 0
+        if bucket_shapes is not None:
+            bucket_shapes = {int(ax): sorted(int(s) for s in sizes)
+                             for ax, sizes in dict(bucket_shapes).items()}
+        self._bucket_shapes = bucket_shapes
+
+    def _bucketize(self, inputs):
+        """Pad each input's bucketed axes up to the next declared bucket
+        size (zeros), collapsing ragged shapes onto a fixed program set.
+
+        Contract (documented at ``hybridize(bucket_shapes=...)``): the
+        model must be padding-safe on those axes — mask via
+        valid_length/attention masks; outputs keep the padded size.
+        """
+        from ..ops.registry import LightOpDef, invoke
+        out = []
+        for x in inputs:
+            pads = [(0, 0)] * x.ndim
+            changed = False
+            for ax, sizes in self._bucket_shapes.items():
+                if ax >= x.ndim:
+                    continue
+                cur = x.shape[ax]
+                fit = [s for s in sizes if s >= cur]
+                if not fit:
+                    raise MXNetError(
+                        f"CachedOp bucket_shapes: input axis {ax} has "
+                        f"size {cur}, larger than the largest declared "
+                        f"bucket {sizes[-1]}")
+                if fit[0] != cur:
+                    pads[ax] = (0, fit[0] - cur)
+                    changed = True
+            if changed:
+                # pad through the op dispatcher so a TapeNode attaches:
+                # input gradients must flow through bucketing (the vjp of
+                # pad is slice — padding rows receive no cotangent)
+                opdef = LightOpDef(
+                    "bucket_pad",
+                    functools.partial(jnp.pad, pad_width=tuple(pads)),
+                    1, 1)
+                x = invoke(opdef, [x], {})
+            out.append(x)
+        return out
 
     def __call__(self, inputs, param_list, ctx):
         from .. import autograd
-        from ..ops.registry import OpDef, invoke
+        from ..ops.registry import LightOpDef, invoke
 
         # probe params before anything else (deferred init must surface
         # before signatures or RNG are touched)
         for _n, p in param_list:
             p.data(ctx)
+        if self._bucket_shapes:
+            inputs = self._bucketize(inputs)
         sig = (tuple((tuple(x.shape), str(x._data.dtype)) for x in inputs),
                tuple((tuple(p.shape), str(p.dtype)) for _n, p in param_list),
                autograd.is_training())
         entry = self._cache.get(sig)
         if entry is None:
             entry = self._build(inputs, param_list, sig, ctx)
+        else:
+            self._cache.move_to_end(sig)
         jitted, meta = entry
 
         from .. import random as mxrand
@@ -362,8 +415,8 @@ class CachedOp:
             outs = self._call_recorded(meta, all_in, n_out, ctx)
         else:
             fn = jitted if n_out > 1 else meta["unwrap1"]
-            opdef = OpDef(f"cached_op_{self._block.name}", fn,
-                          len(all_in), n_out, True)
+            opdef = LightOpDef(f"cached_op_{self._block.name}", fn,
+                               len(all_in), n_out)
             outs = invoke(opdef, all_in, {})
             if n_out == 1:
                 outs = [outs]
@@ -398,11 +451,25 @@ class CachedOp:
                 grads = _meta["bwd_res_retain"](_res, tuple(out_grads))
             else:
                 consumed[0] = True        # donating replay frees residuals
-                grads = _meta["bwd_res"](_res, tuple(out_grads))
+                import warnings
+                with warnings.catch_warnings():
+                    # residuals are donated to be FREED early (they never
+                    # alias the grad outputs); the "not usable" warning
+                    # is the expected cost of that, not a donation miss
+                    warnings.filterwarnings(
+                        "ignore",
+                        message="Some donated buffers were not usable")
+                    grads = _meta["bwd_res"](_res, tuple(out_grads))
             return (None,) + tuple(grads)
 
-        autograd.record_custom_node(all_in, outs, custom_backward,
-                                    name=f"cached_op_{self._block.name}")
+        node = autograd.record_custom_node(
+            all_in, outs, custom_backward,
+            name=f"cached_op_{self._block.name}")
+        # fusion hook: Trainer.step may compile this backward together
+        # with the optimizer update into one donated program (see
+        # autograd.backward deferral / Trainer._try_fused_hybrid_step)
+        node.fused_info = {"bwd_impl": meta["bwd_impl"], "res": res,
+                           "consumed": consumed}
         from ..engine import engine, is_naive
         eng = engine()
         if is_naive():
@@ -502,6 +569,7 @@ class CachedOp:
             return vjp_fn(tuple(cots))
 
         meta["fwd_rec"] = fwd_rec
+        meta["bwd_impl"] = bwd_impl        # un-jitted: Trainer step fusion
         # residuals are dead after one replay: donating them lets XLA free
         # each saved tensor as soon as its consuming bwd op runs (the
         # reference frees saved tensors the same way).  retain_graph=True
@@ -511,6 +579,19 @@ class CachedOp:
         _N_CACHED_PROGRAMS += 1
         entry = (jitted, dict(meta))
         self._cache[sig] = entry
+        while len(self._cache) > self._cache_size:
+            self._cache.popitem(last=False)       # evict LRU program
+            self._n_evictions += 1
+            if self._n_evictions in (1, 10, 100, 1000):
+                import warnings
+                warnings.warn(
+                    f"CachedOp for {self._block.name!r}: "
+                    f"{self._n_evictions} compiled-program eviction(s) — "
+                    f"ragged input shapes are forcing recompiles.  "
+                    f"Declare hybridize(bucket_shapes={{axis: [sizes]}}) "
+                    f"to pad onto a fixed bucket set, or raise "
+                    f"MXNET_CACHED_OP_CACHE_SIZE "
+                    f"(now {self._cache_size}).", stacklevel=3)
         return entry
 
 
@@ -554,10 +635,23 @@ class HybridBlock(Block):
         self._flags = {}
 
     def hybridize(self, active=True, static_alloc=False, static_shape=False,
-                  **kwargs):
+                  cache_size=None, bucket_shapes=None, **kwargs):
+        """Swap the python forward for a compiled CachedOp.
+
+        ``cache_size``: bound on compiled programs kept per CachedOp
+        (default env ``MXNET_CACHED_OP_CACHE_SIZE``, 16); LRU-evicted
+        beyond that, with a churn warning.  ``bucket_shapes``: optional
+        ``{axis: [sizes]}`` — inputs are zero-padded up along those axes
+        to the next declared size so ragged shapes share programs
+        (BucketingModule's policy for the Gluon layer).  The model must
+        be padding-safe on bucketed axes (mask via valid_length etc.);
+        outputs keep the padded size.
+        """
         self._active = active
         self._flags = {"static_alloc": static_alloc,
-                       "static_shape": static_shape}
+                       "static_shape": static_shape,
+                       "cache_size": cache_size,
+                       "bucket_shapes": bucket_shapes}
         self._cached_op = None
         super().hybridize(active, static_alloc=static_alloc,
                           static_shape=static_shape, **kwargs)

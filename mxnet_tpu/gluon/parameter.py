@@ -192,12 +192,18 @@ class Parameter:
         if self._grad_req == "null":
             raise MXNetError(f"Parameter {self.name!r} has grad_req='null'")
         self._check_initialized(ctx)
+        from .. import autograd
+        if autograd._STATE.pending is not None:
+            autograd.flush_pending()        # deferred backward: materialize
         if ctx is None:
             return next(iter(self._grad.values()))
         return self._grad[ctx]
 
     def list_grad(self) -> List[NDArray]:
         self._check_initialized()
+        from .. import autograd
+        if autograd._STATE.pending is not None:
+            autograd.flush_pending()        # deferred backward: materialize
         return list(self._grad.values())
 
     def list_ctx(self) -> List[Context]:
@@ -226,6 +232,9 @@ class Parameter:
     def zero_grad(self):
         if self._grad_req == "null":
             return
+        from .. import autograd
+        if autograd._STATE.pending is not None:
+            autograd.flush_pending()    # grad-writing surface: flush first
         for g in self._grad.values():
             import jax.numpy as jnp
             g._set_data(jnp.zeros_like(g._data))

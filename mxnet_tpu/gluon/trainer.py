@@ -141,10 +141,19 @@ class Trainer:
     # ---------------------------------------------------------------- steps
     def step(self, batch_size, ignore_stale_grad=False):
         """allreduce grads → rescale 1/batch_size → optimizer update
-        (reference: Trainer.step)."""
+        (reference: Trainer.step).
+
+        When the preceding ``loss.backward()`` deferred a single-CachedOp
+        tape (see autograd.backward), the whole backward+update runs as
+        ONE donated XLA program here — the three-call recipe at fused-step
+        cost."""
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
+        if self._kvstore is None and self._try_fused_hybrid_step():
+            return
+        from .. import autograd
+        autograd.flush_pending()
         self._allreduce_grads()
         self._update(ignore_stale_grad)
 
@@ -208,6 +217,176 @@ class Trainer:
                     g = g.tostype("row_sparse")
                 self._dev_updaters[j](i, g, w)
         self._optimizer._set_current_context(0)
+
+    # ------------------------------------------- fused backward+update step
+    def _try_fused_hybrid_step(self):
+        """Fuse a deferred CachedOp backward with the optimizer update
+        into one donated XLA program (VERDICT r2 item 3: the user-facing
+        three-call recipe should cost what ShardedTrainer costs).
+
+        Semantics preserved vs the eager path: ``.grad`` buffers are
+        still written (as program outputs), update counts advance the
+        same way, and any non-parameter leaf (e.g. an attach_grad input)
+        gets its grad too.  Falls back to flush+eager on any mismatch.
+        """
+        from .. import autograd
+        pending = autograd.peek_pending()
+        if pending is None or not self._fused_eligible():
+            return False
+        import jax
+        import jax.numpy as jnp
+
+        node = pending["node"]
+        info = node.fused_info
+        items = [(i, p) for i, p in enumerate(self._params)
+                 if p.grad_req != "null"]
+        if not items:
+            return False
+        param_by_arr = {}
+        for i, p in items:
+            try:
+                param_by_arr[id(p.data())] = (i, p)
+            except Exception:           # noqa: BLE001 — uninitialized etc.
+                return False
+        # entries: [rng_key] + inputs + params; bwd_impl grads align with
+        # entries[1:].  All must be leaves (pure three-call shape).
+        entries = node.input_entries
+        param_slots, other_slots = {}, []
+        for ei, (prod, _oidx, arr) in enumerate(entries):
+            if ei == 0:
+                continue                # the PRNG key input
+            if prod is not None:
+                return False
+            hit = param_by_arr.get(id(arr))
+            if hit is not None:
+                param_slots[ei] = hit
+            elif arr._grad is not None and arr._grad_req != "null":
+                other_slots.append(ei)
+        if len(param_slots) != len(items):
+            return False                # stale/uncovered params: eager path
+
+        o = self._optimizer
+        upd = self._updater
+        for i, p in items:
+            if i not in upd.states:
+                upd.states[i] = o.create_state_multi_precision(i, p.data())
+            o._update_count(i)
+
+        order = sorted(param_slots)                 # entry index order
+        params_ordered = [param_slots[ei] for ei in order]
+        weights = [p.data()._data for _i, p in params_ordered]
+        states = [_state_raw(upd.states[i]) for i, _p in params_ordered]
+        res = info["res"]
+        from ..autograd import _node_out_avals
+        avals = _node_out_avals(node)
+        cots = [g if g is not None else jnp.zeros(a.shape, a.dtype)
+                for g, a in zip(node.out_grads, avals)]
+
+        key = (id(info["bwd_impl"]), type(o), o._fused_key(),
+               tuple(order), tuple(other_slots),
+               tuple((tuple(w.shape), str(w.dtype),
+                      _state_sig(upd.states[i]))
+                     for (i, _p), w in zip(params_ordered, weights)),
+               tuple((tuple(c.shape), str(c.dtype)) for c in cots))
+        from collections import OrderedDict
+        cache = getattr(self, "_fused_step_progs", None)
+        if cache is None:
+            cache = self._fused_step_progs = OrderedDict()
+        entry = cache.get(key)
+        if entry is not None:
+            cache.move_to_end(key)
+        if entry is None:
+            bwd_impl = info["bwd_impl"]
+            n_entries = len(entries)
+
+            def body(res, cots, weights, states, ts, lrs, wds, rescale):
+                grads_all = bwd_impl(list(res), tuple(cots))
+                new_w, new_s, pgrads = [], [], []
+                for k, ei in enumerate(order):
+                    g = grads_all[ei - 1]
+                    nw, ns = o._fused_one(weights[k], g, states[k], ts[k],
+                                          lrs[k], wds[k], rescale)
+                    new_w.append(nw)
+                    new_s.append(ns)
+                    pgrads.append(g)
+                ograds = [grads_all[ei - 1] for ei in other_slots]
+                return new_w, new_s, ts + 1.0, pgrads, ograds
+
+            # donate residuals (dead after this), weights, states, ts:
+            # params update in place at the memory level
+            entry = {"prog": jax.jit(body, donate_argnums=(0, 2, 3, 4)),
+                     "keepalive": bwd_impl, "n_entries": n_entries}
+            cache[key] = entry
+            # LRU bound: ragged shapes must not pin evicted CachedOps'
+            # backward closures (and their compiled programs) forever
+            while len(cache) > 8:
+                cache.popitem(last=False)
+
+        counts = [o._index_update_count[i] for i, _p in params_ordered]
+        if entry.get("ts") is None or entry.get("counts") != counts:
+            entry["ts"] = jnp.asarray([float(c) for c in counts],
+                                      jnp.float32)
+        entry["counts"] = [c + 1 for c in counts]
+        lrs_py = tuple(float(o._get_lr(i)) for i, _p in params_ordered)
+        wds_py = tuple(float(o._get_wd(i)) for i, _p in params_ordered)
+        rs_py = float(o.rescale_grad)
+        if entry.get("hyper") != (lrs_py, wds_py, rs_py):
+            entry["lrs"] = jnp.asarray(lrs_py, jnp.float32)
+            entry["wds"] = jnp.asarray(wds_py, jnp.float32)
+            entry["rescale"] = jnp.float32(rs_py)
+            entry["hyper"] = (lrs_py, wds_py, rs_py)
+
+        try:
+            import warnings
+            with warnings.catch_warnings():
+                # residuals are donated to be FREED early (they can never
+                # alias the outputs); the "not usable" warning is the
+                # expected cost of that, not a miss
+                warnings.filterwarnings(
+                    "ignore",
+                    message="Some donated buffers were not usable")
+                new_w, new_s, new_ts, pgrads, ograds = entry["prog"](
+                    list(res), cots, weights, states, entry["ts"],
+                    entry["lrs"], entry["wds"], entry["rescale"])
+        except BaseException as e:
+            # the failed step never applied: never advance schedules
+            for i, _p in params_ordered:
+                o._index_update_count[i] -= 1
+            entry["counts"] = counts
+            entry["ts"] = None
+            consumed = any(
+                getattr(a, "is_deleted", lambda: False)()
+                for a in jax.tree_util.tree_leaves(
+                    (res, weights, states)))
+            if not consumed and isinstance(e, Exception):
+                # trace/compile failure happens before donation: the
+                # deferred tape is untouched — fall back to eager
+                return False
+            autograd.clear_pending()    # residuals are gone: no replay
+            info["consumed"][0] = True
+            if isinstance(e, Exception):
+                raise MXNetError(
+                    "fused hybrid step failed after dispatch; weight, "
+                    "optimizer-state and residual buffers were donated "
+                    "to the failed program and may be deleted.  Reload "
+                    "parameters before continuing.  Cause: "
+                    f"{e!r}") from e
+            raise   # KeyboardInterrupt/SystemExit propagate as-is
+        entry["ts"] = new_ts
+        autograd.clear_pending()
+        info["consumed"][0] = True      # residuals donated: no replay
+        for (i, p), nw, ns, g in zip(params_ordered, new_w, new_s, pgrads):
+            p.data()._set_data(nw)
+            _state_write_back(upd.states[i], ns)
+            p.data()._grad._set_data(
+                jnp.asarray(g, dtype=p.data()._grad._data.dtype)
+                if g.dtype != p.data()._grad._data.dtype else g)
+        for ei, g in zip(other_slots, ograds):
+            arr = entries[ei][2]
+            arr._grad._set_data(
+                g if g.dtype == arr._grad._data.dtype
+                else jnp.asarray(g, dtype=arr._grad._data.dtype))
+        return True
 
     # ------------------------------------------------------- fused update
     # One XLA program updates every parameter (reference: the multi-tensor

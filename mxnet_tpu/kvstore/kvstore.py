@@ -310,8 +310,9 @@ class XLA(KVStore):
         fn = self._fn_cache.get(cache_key)
         if fn is None:
             mesh, _ = self._sharding(devices)
-            body = jax.shard_map(lambda x: lax.psum(x, "dev"), mesh=mesh,
-                                 in_specs=P("dev"), out_specs=P())
+            from .._jax_compat import shard_map
+            body = shard_map(lambda x: lax.psum(x, "dev"), mesh=mesh,
+                             in_specs=P("dev"), out_specs=P())
             fn = jax.jit(body,
                          out_shardings=NamedSharding(mesh, P()))
             self._fn_cache[cache_key] = fn
@@ -440,9 +441,29 @@ KVStoreBase.register_alias("dist_device_sync", DistSync)
 
 
 def create(name="local") -> KVStore:
-    """Factory (reference: kvstore.create / KVStoreBase registry)."""
+    """Factory (reference: kvstore.create / KVStoreBase registry).
+
+    ``dist_async`` (reference: KVStoreDist async push + server-side
+    optimizer) is **documented-unsupported** on TPU by design, not an
+    omission: asynchronous, per-key eventually-consistent updates assume
+    a parameter-server topology with CPU-side optimizers.  On a TPU pod
+    the same scale point is served by the synchronous ``'xla'``/
+    ``'dist_sync'`` tiers, whose allreduce rides ICI/DCN collectives
+    inside the compiled step — faster than a PS round trip, with none of
+    the staleness.  Use ``'dist_sync'`` (or raw
+    ``parallel.ShardedTrainer`` over a multi-host mesh).
+    """
     if not isinstance(name, str):
         raise MXNetError("kvstore name must be a string")
+    if name.lower() in ("dist_async", "dist_device_async"):
+        raise MXNetError(
+            f"kvstore type {name!r} is intentionally unsupported on this "
+            f"framework: asynchronous parameter-server SGD assumes "
+            f"CPU-side per-key optimizers and tolerates gradient "
+            f"staleness; on TPU the synchronous 'xla'/'dist_sync' tiers "
+            f"(ICI/DCN allreduce compiled into the step) cover the same "
+            f"scale without staleness.  Use 'dist_sync' instead.  See "
+            f"kvstore.create.__doc__.")
     klass = KVStoreBase.kv_registry.get(name.lower())
     if klass is None:
         raise MXNetError(

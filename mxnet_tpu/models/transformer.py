@@ -178,17 +178,29 @@ class Transformer(HybridBlock):
 
     def beam_search(self, src, src_valid=None, bos=2, eos=3, beam_size=4,
                     max_decode_len=32, alpha=0.6):
-        """Length-normalized beam search (Sockeye-style).  Host-side loop
-        over compiled decode steps (each target length compiles once)."""
+        """Length-normalized beam search (Sockeye-style), COMPILED: the
+        whole batched search (incremental KV-cache decoder + beam
+        bookkeeping) is one jitted lax.while_loop program
+        (models/decoding.py).  Returns (B, max_decode_len+1) ids."""
+        from .decoding import TransformerBeamDecoder
+        dec = getattr(self, "_beam_decoder", None)
+        if dec is None:
+            dec = self._beam_decoder = TransformerBeamDecoder(self)
+        return dec(src, src_valid, bos=bos, eos=eos, beam_size=beam_size,
+                   max_decode_len=max_decode_len, alpha=alpha)
+
+    def beam_search_host(self, src, src_valid=None, bos=2, eos=3,
+                         beam_size=4, max_decode_len=32, alpha=0.6):
+        """Legacy host-side beam search (per-sentence python loop); kept
+        as the readable oracle the compiled search is tested against."""
         B = src.shape[0]
         if B != 1:
             return nd.op.concat(*[
-                self.beam_search(src.slice_axis(axis=0, begin=i, end=i + 1),
-                                 None if src_valid is None else
-                                 src_valid.slice_axis(axis=0, begin=i,
-                                                      end=i + 1),
-                                 bos, eos, beam_size, max_decode_len,
-                                 alpha)
+                self.beam_search_host(
+                    src.slice_axis(axis=0, begin=i, end=i + 1),
+                    None if src_valid is None else
+                    src_valid.slice_axis(axis=0, begin=i, end=i + 1),
+                    bos, eos, beam_size, max_decode_len, alpha)
                 for i in range(B)], dim=0)
         mem = self.encode(src, src_valid)          # (Ls, 1, C)
         beams = [([bos], 0.0, False)]

@@ -142,6 +142,10 @@ class NDArray:
 
     @property
     def grad(self):
+        if self._grad is not None:
+            from .. import autograd
+            if autograd._STATE.pending is not None:
+                autograd.flush_pending()    # deferred backward: materialize
         return self._grad
 
     # --------------------------------------------------------------- engine
@@ -263,6 +267,9 @@ class NDArray:
 
     def zero_grad(self):
         if self._grad is not None:
+            from .. import autograd
+            if autograd._STATE.pending is not None:
+                autograd.flush_pending()  # grad write: flush deferred first
             self._grad._set_data(jnp.zeros_like(self._grad._data))
 
     # ------------------------------------------------------- generic dispatch

@@ -9,10 +9,12 @@ TPU-native redesign: the reference needed a parallel operator stack
 Here the array IS jax-backed, and ``jax.numpy`` already implements NumPy's
 semantics exactly — so ``mx.np`` is a *generated veneer*: each function
 unwraps NDArray→jax.Array, calls the ``jax.numpy`` twin, and re-wraps.
-One source of truth for numerics; differentiable and jittable for free
-(the wrappers tape through the autograd dispatcher's pause-free path —
-arrays used under ``autograd.record`` should go through ``mx.nd`` ops or
-Gluon; ``mx.np`` targets the data/numerics API surface).
+One source of truth for numerics; differentiable and jittable for free:
+under ``autograd.record()`` each call routes through the op dispatcher
+(``ops.registry.invoke``) so a TapeNode is attached exactly as for
+``mx.nd`` ops — models written in ``mx.np`` train like Gluon models
+(reference parity: GluonNLP-era models train on ``mx.np``).  Metadata
+functions (``shape``, ``result_type``, …) stay tape-free.
 """
 from __future__ import annotations
 
@@ -60,16 +62,120 @@ def _unwrap(x):
     return x
 
 
+def _rebuild_seq(typ, items):
+    """Rebuild list/tuple/NamedTuple results (jnp.linalg returns
+    NamedTuple types like EighResult, whose ctor takes *fields)."""
+    if hasattr(typ, "_fields"):
+        return typ._make(items)
+    return typ(items)
+
+
 def _wrap_out(out):
     if isinstance(out, (list, tuple)):
-        return type(out)(_wrap_out(o) for o in out)
+        return _rebuild_seq(type(out), [_wrap_out(o) for o in out])
     if hasattr(out, "dtype") and hasattr(out, "shape"):
         return NDArray(_jnp.asarray(out))
     return out
 
 
+# metadata/introspection functions: python-value outputs, never taped
+_NO_TAPE = frozenset({
+    "shape", "ndim", "size", "result_type", "promote_types", "can_cast",
+    "may_share_memory", "shares_memory", "isscalar", "iscomplexobj",
+    "isrealobj",
+})
+
+
+class _Slot:
+    """Placeholder for an NDArray leaf inside a call's (args, kwargs)
+    template (see _invoke_recorded)."""
+    __slots__ = ("i",)
+
+    def __init__(self, i):
+        self.i = i
+
+    def __repr__(self):                 # stable across calls (cache keys)
+        return f"<arr{self.i}>"
+
+
+def _invoke_recorded(jfn, name, args, kwargs):
+    """Route one np call through the op dispatcher so the autograd tape
+    records it (same TapeNode machinery as every mx.nd op)."""
+    from ..ops.registry import LightOpDef, invoke
+
+    leaves = []
+
+    def scan(x):
+        if isinstance(x, NDArray):
+            leaves.append(x)
+            return _Slot(len(leaves) - 1)
+        if isinstance(x, (list, tuple)):
+            return type(x)(scan(e) for e in x)
+        return x
+
+    t_args = tuple(scan(a) for a in args)
+    t_kwargs = {k: scan(v) for k, v in kwargs.items()}
+    if not leaves:
+        return None                     # nothing to tape: use eager path
+    out_meta = {}
+
+    def fn(*arrays):
+        def fill(x):
+            if isinstance(x, _Slot):
+                return arrays[x.i]
+            if isinstance(x, (list, tuple)):
+                return type(x)(fill(e) for e in x)
+            return x
+
+        out = jfn(*[fill(a) for a in t_args],
+                  **{k: fill(v) for k, v in t_kwargs.items()})
+        if isinstance(out, (list, tuple)):
+            out_meta["n"], out_meta["type"] = len(out), type(out)
+            return tuple(out)
+        out_meta["n"], out_meta["type"] = 1, None
+        return out
+
+    # Constants baked into the closure must be part of the bulk-replay
+    # cache identity: two calls differing only in a scalar (multiply(x,3)
+    # vs multiply(x,5)) would otherwise share a compiled backward and the
+    # second would silently reuse the first's constant.  Array-valued
+    # constants have no stable cheap repr — disable bulk keying for those.
+    op_name = f"np.{name}"
+    no_bulk = False
+    if t_kwargs or _builtins.any(not isinstance(a, _Slot) for a in t_args):
+        consts = (t_args, tuple(sorted(t_kwargs.items())))
+        if _builtins.any(
+                hasattr(c, "shape") and hasattr(c, "dtype")
+                for c in _jax.tree_util.tree_leaves(consts)):
+            no_bulk = True
+        else:
+            op_name = f"np.{name}/{repr(consts)}"
+    opdef = LightOpDef(op_name, fn, len(leaves),
+                       lambda kw: out_meta["n"])
+    if no_bulk:
+        opdef.no_bulk_key = True
+    outs = invoke(opdef, leaves, {})
+    if out_meta["type"] is not None:
+        outs = outs if isinstance(outs, list) else [outs]
+        return _rebuild_seq(out_meta["type"], outs)
+    return outs
+
+
 def _make(jfn, name):
+    taped = name not in _NO_TAPE
+
     def f(*args, **kwargs):
+        if taped:
+            from .. import autograd
+            if autograd.is_recording():
+                try:
+                    out = _invoke_recorded(jfn, name, args, kwargs)
+                except MXNetError:
+                    raise
+                except Exception as exc:
+                    raise MXNetError(f"np.{name}: {exc}") from exc
+                if out is not None:
+                    return out
         args = tuple(_unwrap(a) for a in args)
         kwargs = {k: _unwrap(v) for k, v in kwargs.items()}
         try:
@@ -81,7 +187,8 @@ def _make(jfn, name):
     f.__name__ = name
     f.__qualname__ = name
     f.__doc__ = (f"NumPy-semantics ``{name}`` (delegates to "
-                 f"jax.numpy.{name}; see numpy docs).")
+                 f"jax.numpy.{name}; see numpy docs).  Differentiable: "
+                 f"records on the autograd tape under record().")
     return f
 
 

@@ -99,9 +99,12 @@ def _fwd_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(needed)
     def _step():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        # q/k/v stay in their storage dtype (bf16 on the training path):
+        # bf16xbf16->fp32 is the MXU fast path — upcasting inputs first
+        # would halve matmul throughput.  Softmax statistics are fp32.
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale  # (bq, bk)
@@ -113,7 +116,7 @@ def _fwd_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         p = jnp.exp(s - m_new)                              # (bq, bk)
         l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_scr[:] = m_new
 
@@ -150,10 +153,10 @@ def _bwd_dq_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     @pl.when(needed)
     def _step():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
@@ -165,7 +168,7 @@ def _bwd_dq_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0]) * sm_scale
         dq_scr[:] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(ik == nk - 1)
@@ -195,10 +198,10 @@ def _bwd_dkv_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     @pl.when(needed)
     def _step():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
@@ -206,14 +209,14 @@ def _bwd_dkv_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                         block_k)
         p = jnp.exp(s - lse_ref[0])                # (bq, bk)
         dv_scr[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)             # (bk, D)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)             # (bq, bk)
         ds = p * (dp - delta_ref[0]) * sm_scale
         dk_scr[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)             # (bk, D)
 
     @pl.when(iq == nq - 1)
@@ -332,12 +335,31 @@ def _ceil_to(x, m):
     return (x + m - 1) // m * m
 
 
+def _default_blocks(Lq, Lk, D):
+    """Block sizes per (seqlen, head-dim), tuned on a v5e chip (see
+    benchmark/opperf.py flash rows).  Bigger k blocks amortize the
+    per-block softmax bookkeeping; VMEM comfortably holds a
+    (256, 512) fp32 score tile at D<=128.  Override with
+    MXNET_FLASH_BLOCK_Q/MXNET_FLASH_BLOCK_K or the explicit args."""
+    from ..base import get_env
+    bq = get_env("MXNET_FLASH_BLOCK_Q", None)
+    bk = get_env("MXNET_FLASH_BLOCK_K", None)
+    if bq or bk:
+        return int(bq or 128), int(bk or 128)
+    if Lk <= 128:
+        return 128, 128
+    if Lk <= 1024:
+        return min(512, _ceil_to(Lq, 8)), min(512, _ceil_to(Lk, 8))
+    return min(1024, _ceil_to(Lq, 8)), min(1024, _ceil_to(Lk, 8))
+
+
 def flash_attention(q, k, v, lengths=None, causal=False, sm_scale=None,
-                    block_q=128, block_k=128, interpret=None):
+                    block_q=None, block_k=None, interpret=None):
     """Fused attention over (B*H, L, D) tensors.
 
     ``lengths``: optional int32 (B*H,) valid key lengths (padding mask).
-    Returns (B*H, Lq, D) in the query dtype.
+    Returns (B*H, Lq, D) in the query dtype.  Block sizes default to a
+    per-(seqlen, head-dim) tuned table (_default_blocks).
     """
     if not pallas_available():
         from ..base import MXNetError
@@ -350,6 +372,9 @@ def flash_attention(q, k, v, lengths=None, causal=False, sm_scale=None,
         sm_scale = 1.0 / float(np.sqrt(D))
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
+    dbq, dbk = _default_blocks(Lq, Lk, D)
+    block_q = block_q or dbq
+    block_k = block_k or dbk
     block_q = min(block_q, _ceil_to(Lq, 8))
     block_k = min(block_k, _ceil_to(Lk, 8))
     Lq_p, Lk_p = _ceil_to(Lq, block_q), _ceil_to(Lk, block_k)

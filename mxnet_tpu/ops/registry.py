@@ -31,8 +31,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from ..base import MXNetError, Registry
 
-__all__ = ["OpDef", "register", "get_op", "list_ops", "invoke", "OP_REGISTRY",
-           "alias"]
+__all__ = ["OpDef", "LightOpDef", "register", "get_op", "list_ops",
+           "invoke", "OP_REGISTRY", "alias"]
 
 OP_REGISTRY = Registry("op")
 
@@ -95,6 +95,27 @@ class OpDef:
 
     def __repr__(self):
         return f"OpDef({self.name})"
+
+
+class LightOpDef(OpDef):
+    """An OpDef for per-call synthetic ops (taped np calls, CachedOp
+    dispatch): skips the inspect.signature schema harvest — ~10us of
+    host-side latency that matters on the imperative hot path.  The fn
+    is always ``*arrays`` with no keyword schema."""
+
+    def __init__(self, name, fn, num_inputs, num_outputs,
+                 differentiable=True):
+        self.name = name
+        self.fn = fn
+        self.num_inputs = num_inputs
+        self.num_outputs = num_outputs
+        self.differentiable = differentiable
+        self.mutates_rng = False
+        self.aux_update = None
+        self.aliases = []
+        self.params = {}
+        self.open_schema = False
+        self.doc = f"Operator {name}."
 
 
 def register(name: str, num_inputs=1, num_outputs=1, differentiable=True,
@@ -216,7 +237,8 @@ def invoke(opdef: OpDef, inputs, kwargs: Dict[str, Any], out=None):
         # compiled replay programs on it (None = closed-over constants,
         # not bulkable)
         key = None
-        if len(nd_inputs) == len(inputs):
+        if len(nd_inputs) == len(inputs) and \
+                not getattr(opdef, "no_bulk_key", False):
             try:
                 key = (opdef.name, tuple(sorted(kwargs.items())))
                 hash(key)

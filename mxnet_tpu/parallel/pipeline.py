@@ -25,10 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..base import MXNetError
 
-try:                                      # jax >= 0.8 public location
-    from jax import shard_map
-except ImportError:                       # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from .._jax_compat import shard_map, to_varying
 
 __all__ = ["pipeline_apply", "make_pipeline_mesh"]
 
@@ -61,10 +58,7 @@ def pipeline_apply(stage_fn, stage_params, micro_inputs, mesh: Mesh,
     def _varying(x):
         # newer shard_map tracks varying-manual-axes: scan carries that
         # BECOME pp-varying must start pp-varying
-        pcast = getattr(lax, "pcast", None)
-        if pcast is None:
-            return x
-        return pcast(x, (axis,), to="varying")
+        return to_varying(x, axis)
 
     def per_device(params_stage, xs):
         # params_stage leaves: (1, ...) — this device's stage slice

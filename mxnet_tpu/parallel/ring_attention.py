@@ -14,10 +14,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-try:
-    from jax import shard_map
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
+from .._jax_compat import shard_map, to_varying
 
 __all__ = ["ring_attention", "ring_self_attention"]
 
@@ -36,11 +33,9 @@ def _ring_attention_local(q, k, v, q_pos, k_pos, axis_name, causal, scale):
     m0 = jnp.full((B, H, Lq), -jnp.inf, dtype=jnp.float32)
     l0 = jnp.zeros((B, H, Lq), dtype=jnp.float32)
     acc0 = jnp.zeros((B, H, Lq, D), dtype=jnp.float32)
-    if hasattr(lax, "pvary"):
-        # constants start axis-unvarying under shard_map's vma typing;
-        # the loop carry becomes varying, so pre-cast the initial carry
-        m0, l0, acc0 = (lax.pvary(x, (axis_name,))
-                        for x in (m0, l0, acc0))
+    # constants start axis-unvarying under shard_map's vma typing;
+    # the loop carry becomes varying, so pre-cast the initial carry
+    m0, l0, acc0 = (to_varying(x, axis_name) for x in (m0, l0, acc0))
 
     def body(i, carry):
         m, l, acc, k, v, k_pos = carry

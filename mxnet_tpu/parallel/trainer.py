@@ -52,6 +52,8 @@ class ShardedTrainer:
         opt_kw = dict(optimizer_params or {})
         if "learning_rate" in opt_kw:
             opt_kw["lr"] = opt_kw.pop("learning_rate")
+        if "weight_decay" in opt_kw:            # Gluon naming → optim's
+            opt_kw["wd"] = opt_kw.pop("weight_decay")
 
         apply_fn, params = functionalize(block, *example_inputs,
                                          train_mode=True)
@@ -71,6 +73,12 @@ class ShardedTrainer:
             params, mesh, rules)
         self.opt_state = opt_init(self.params)
         self._n_inputs = len(example_inputs)
+        # aux/frozen params (grad_req='null': BatchNorm running stats,
+        # positional constants) must NOT receive optimizer updates — with
+        # zero grads the weight-decay term would silently erode them
+        trainable = frozenset(
+            n for n, p in block.collect_params().items()
+            if p.grad_req != "null" and n in params)
 
         batch_spec = NamedSharding(mesh, P("dp"))
         repl = NamedSharding(mesh, P())
@@ -94,6 +102,14 @@ class ShardedTrainer:
                 loss_of, has_aux=True)(params)
             new_params, new_state = opt_update(params, grads, opt_state,
                                                **opt_kw)
+            # frozen params pass through untouched; aux states take the
+            # forward-captured update (BatchNorm moving stats), exactly
+            # like the eager/CachedOp paths
+            new_params = {n: (v if n in trainable else params[n])
+                          for n, v in new_params.items()}
+            for n, v in aux.items():
+                if n in new_params:
+                    new_params[n] = v.astype(new_params[n].dtype)
             return new_params, new_state, loss
 
         self._step = jax.jit(

@@ -1,0 +1,67 @@
+"""Compiled batched beam search (models/decoding.py) vs the host-side
+oracle and greedy decode."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, models
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    mx.random.seed(0)
+    m = models.transformer_base(src_vocab_size=32, units=32,
+                                hidden_size=64, num_layers=2,
+                                num_heads=4, dropout=0.0, max_length=64)
+    m.initialize(mx.init.Xavier())
+    return m
+
+
+def test_compiled_matches_host_oracle(tiny_model):
+    m = tiny_model
+    rng = np.random.RandomState(0)
+    src = nd.array(rng.randint(4, 32, (3, 7)).astype(np.int32),
+                   dtype="int32")
+    sv = nd.array(np.array([7, 5, 7], np.float32))
+    out_c = m.beam_search(src, sv, beam_size=4, max_decode_len=10) \
+        .asnumpy()
+    out_h = m.beam_search_host(src, sv, beam_size=4,
+                               max_decode_len=10).asnumpy()
+    for b in range(3):
+        n = out_h[b].shape[0]
+        assert list(out_c[b][:n]) == list(out_h[b][:n]), b
+
+
+def test_beam1_matches_greedy(tiny_model):
+    m = tiny_model
+    rng = np.random.RandomState(1)
+    src = nd.array(rng.randint(4, 32, (2, 6)).astype(np.int32),
+                   dtype="int32")
+    sv = nd.array(np.array([6, 6], np.float32))
+    g = m.greedy_decode(src, sv, max_decode_len=8).asnumpy()
+    b1 = m.beam_search(src, sv, beam_size=1, max_decode_len=8).asnumpy()
+    for b in range(2):
+        n = g[b].shape[0]
+        assert list(b1[b][:n]) == list(g[b][:n]), b
+
+
+def test_program_cache_and_refresh(tiny_model):
+    m = tiny_model
+    rng = np.random.RandomState(2)
+    src = nd.array(rng.randint(4, 32, (2, 5)).astype(np.int32),
+                   dtype="int32")
+    m.beam_search(src, beam_size=2, max_decode_len=6)
+    dec = m._beam_decoder
+    n_progs = len(dec._progs)
+    m.beam_search(src, beam_size=2, max_decode_len=6)
+    assert len(dec._progs) == n_progs          # same signature: cache hit
+    # weight update must change results without recompiling
+    before = m.beam_search(src, beam_size=2, max_decode_len=6).asnumpy()
+    for _name, p in m.collect_params().items():
+        if p.grad_req != "null":
+            p.set_data(p.data() * 1.5)
+            break
+    dec.refresh()
+    assert len(dec._progs) == n_progs          # programs survive refresh
+    after = m.beam_search(src, beam_size=2, max_decode_len=6).asnumpy()
+    assert before.shape == after.shape

@@ -1,0 +1,92 @@
+"""Deployment-artifact proof (VERDICT r2 missing #3 / docs/frontends.md
+§2): an exported StableHLO artifact must execute OUTSIDE the framework —
+a subprocess that imports only jax+numpy reproduces the block's outputs.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, deploy
+from mxnet_tpu.gluon import nn
+
+
+def _build_net():
+    mx.random.seed(7)
+    net = nn.HybridSequential(prefix="shlo_net_")
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu", in_units=8))
+        net.add(nn.BatchNorm(in_channels=16))
+        net.add(nn.Dense(4, in_units=16))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    return net
+
+
+def test_artifact_runs_without_framework(tmp_path):
+    net = _build_net()
+    x = nd.random.uniform(shape=(5, 8))
+    ref = net(x).asnumpy()                      # inference outputs
+
+    path = str(tmp_path / "model")
+    artifact = deploy.export_stablehlo(net, x, path=path, emit_text=True)
+    assert os.path.exists(artifact)
+    assert os.path.exists(path + ".json")
+    # the MLIR text is genuine StableHLO
+    text = open(path + ".stablehlo.txt").read()
+    assert "stablehlo" in text and "func.func public @main" in text
+    manifest = json.load(open(path + ".json"))
+    assert manifest["inputs"][0]["shape"] == [5, 8]
+
+    np.save(str(tmp_path / "x.npy"), x.asnumpy())
+    np.save(str(tmp_path / "ref.npy"), ref)
+
+    # serving-side consumer: ONLY jax + numpy.  A poisoned meta-importer
+    # makes any mxnet_tpu import a hard failure, proving independence.
+    runner = textwrap.dedent("""
+        import sys
+        class _Block:
+            def find_module(self, name, path=None):
+                if name.split('.')[0] == 'mxnet_tpu':
+                    raise ImportError('framework import attempted at '
+                                      'serving time: ' + name)
+                return None
+        sys.meta_path.insert(0, _Block())
+        import numpy as np
+        from jax import export
+        blob = bytearray(open(sys.argv[1], 'rb').read())
+        fn = export.deserialize(blob)
+        x = np.load(sys.argv[2])
+        out = np.asarray(fn.call(x))
+        np.save(sys.argv[3], out)
+        print('served', out.shape)
+    """)
+    out_path = str(tmp_path / "out.npy")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PYTHONPATH", None)                 # no repo on the path
+    proc = subprocess.run(
+        [sys.executable, "-c", runner, artifact,
+         str(tmp_path / "x.npy"), out_path],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=str(tmp_path))                      # not the repo root
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    served = np.load(out_path)
+    np.testing.assert_allclose(served, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_load_stablehlo_roundtrip(tmp_path):
+    net = _build_net()
+    x = nd.random.uniform(shape=(3, 8))
+    path = str(tmp_path / "m2")
+    deploy.export_stablehlo(net, x, path=path)
+    fn = deploy.load_stablehlo(path + ".shlo")
+    np.testing.assert_allclose(np.asarray(fn.call(x.asnumpy())),
+                               net(x).asnumpy(), rtol=1e-5, atol=1e-5)
+    import pytest
+    from mxnet_tpu.base import MXNetError
+    with pytest.raises(MXNetError, match="no artifact"):
+        deploy.load_stablehlo(str(tmp_path / "missing.shlo"))

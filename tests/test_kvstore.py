@@ -290,3 +290,14 @@ def test_trainer_update_on_kvstore():
     server_side = run(True)
     for w, s in zip(worker_side, server_side):
         np.testing.assert_allclose(s, w, rtol=1e-5, atol=1e-6)
+
+
+def test_dist_async_documented_unsupported():
+    """SURVEY P4: dist_async is parity-by-documentation — a specific,
+    explanatory error, not the generic unknown-type one."""
+    import pytest
+    from mxnet_tpu.base import MXNetError
+    with pytest.raises(MXNetError, match="intentionally unsupported"):
+        kvstore.create("dist_async")
+    with pytest.raises(MXNetError, match="dist_sync"):
+        kvstore.create("dist_device_async")

@@ -132,3 +132,40 @@ def test_bert_use_flash_matches_dense():
 def test_runtime_reports_pallas_honestly():
     feats = mx.runtime.Features()
     assert feats.is_enabled("PALLAS")  # interpret mode counts as available
+
+def test_flash_bf16_inputs_close_to_fp32_dense():
+    """The r3 kernel keeps q/k/v in bf16 for the MXU dots (fp32 softmax
+    stats): outputs must stay within bf16-grade tolerance of the fp32
+    dense oracle."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas_kernels import flash_attention
+    rng = np.random.RandomState(0)
+    BH, L, D = 4, 64, 16
+    qf = rng.randn(BH, L, D).astype(np.float32)
+    kf = rng.randn(BH, L, D).astype(np.float32)
+    vf = rng.randn(BH, L, D).astype(np.float32)
+    out = np.asarray(flash_attention(
+        jnp.asarray(qf, jnp.bfloat16), jnp.asarray(kf, jnp.bfloat16),
+        jnp.asarray(vf, jnp.bfloat16), causal=True)).astype(np.float32)
+    s = np.einsum("bqd,bkd->bqk", qf, kf) / np.sqrt(D)
+    s[:, np.triu(np.ones((L, L), bool), k=1)] = -1e30
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = np.einsum("bqk,bkd->bqd", p, vf)
+    assert np.abs(out - ref).max() < 0.06, np.abs(out - ref).max()
+
+
+def test_flash_block_defaults_table():
+    from mxnet_tpu.ops.pallas_kernels import _default_blocks
+    assert _default_blocks(128, 128, 64) == (128, 128)
+    assert _default_blocks(512, 512, 64) == (512, 512)
+    assert _default_blocks(2048, 2048, 64) == (1024, 1024)
+    import os
+    os.environ["MXNET_FLASH_BLOCK_Q"] = "64"
+    os.environ["MXNET_FLASH_BLOCK_K"] = "32"
+    try:
+        assert _default_blocks(512, 512, 64) == (64, 32)
+    finally:
+        del os.environ["MXNET_FLASH_BLOCK_Q"]
+        del os.environ["MXNET_FLASH_BLOCK_K"]

@@ -151,3 +151,47 @@ def test_sharded_trainer_dtype_noop_does_not_alias():
         tr.step(x, y)
         for name, p in net.collect_params().items():
             p.data().asnumpy()  # must not raise "Array has been deleted"
+
+
+def test_sharded_trainer_updates_batchnorm_stats_preserves_frozen():
+    """Aux states (BatchNorm moving stats) must update through the
+    sharded step; frozen (grad_req='null') params must pass through
+    untouched (weight decay with zero grads would erode them)."""
+    from mxnet_tpu.gluon import nn
+    mesh = parallel.make_mesh(dp=2, tp=1, sp=1)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=4))
+    net.add(nn.BatchNorm(in_channels=8))
+    net.add(nn.Dense(2, in_units=8))
+    net.initialize()
+    x = nd.random.uniform(shape=(8, 4)) + 3.0       # nonzero mean input
+    y = nd.random.uniform(shape=(8, 2))
+    tr = parallel.ShardedTrainer(
+        net, lambda o, t: ((o - t) ** 2).mean(), mesh,
+        optimizer="adamw",
+        optimizer_params={"learning_rate": 1e-3, "weight_decay": 0.1},
+        example_inputs=(x,), n_labels=1)
+    rm_name = [n for n in tr.params if n.endswith("running_mean")][0]
+    before = np.asarray(jax.device_get(tr.params[rm_name])).copy()
+    for _ in range(5):
+        tr.step(x, y)
+    after = np.asarray(jax.device_get(tr.params[rm_name]))
+    assert np.abs(after - before).max() > 1e-4, \
+        "running_mean did not update through the sharded step"
+    assert np.isfinite(after).all()
+    # frozen param: freeze a weight and check wd does not decay it
+    net2 = nn.Dense(4, in_units=4)
+    net2.initialize()
+    net2.weight.grad_req = "null"
+    w0 = net2.weight.data().asnumpy().copy()
+    tr2 = parallel.ShardedTrainer(
+        net2, lambda o, t: ((o - t) ** 2).mean(), mesh,
+        optimizer="adamw",
+        optimizer_params={"learning_rate": 1e-2, "weight_decay": 0.5},
+        example_inputs=(x,), n_labels=1)
+    for _ in range(5):
+        tr2.step(x, nd.random.uniform(shape=(8, 4)))
+    wname = [n for n in tr2.params if n.endswith("weight")][0]
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(tr2.params[wname])), w0, rtol=1e-6,
+        err_msg="frozen param was eroded by the sharded optimizer")
