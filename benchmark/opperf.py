@@ -187,6 +187,51 @@ def time_op(name, build, warmup=2, runs=10):
             "compile_ms": round(compile_ms, 2)}
 
 
+def time_beam_decode(large=False, warmup=1, runs=5):
+    """Decode throughput of the compiled batched beam search
+    (models/decoding.py) — tokens/sec on a transformer (Sockeye-facing
+    surface: decode is a perf path, not just a correctness path)."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, models
+
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    if large:
+        B, Ls, Lt, K = 16, 32, 32, 5
+        m = models.transformer_base(src_vocab_size=32000)
+    else:
+        B, Ls, Lt, K = 8, 12, 12, 4
+        m = models.transformer_base(src_vocab_size=128, units=64,
+                                    hidden_size=128, num_layers=2,
+                                    num_heads=4, max_length=64)
+    m.initialize(mx.init.Xavier())
+    src = nd.array(rng.randint(4, 100, (B, Ls)).astype(np.int32),
+                   dtype="int32")
+    sv = nd.array(np.full((B,), Ls, np.float32))
+
+    def once():
+        out = m.beam_search(src, sv, beam_size=K, max_decode_len=Lt)
+        jax.device_get(out._data[:1, :1])
+
+    t0 = time.perf_counter()
+    once()
+    compile_ms = (time.perf_counter() - t0) * 1e3
+    for _ in range(warmup):
+        once()
+    samples = []
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        once()
+        samples.append(time.perf_counter() - t0)
+    dt = float(np.median(samples))
+    return {"op": "beam_search", "shape": f"B{B}xK{K}xL{Lt}",
+            "avg_ms": round(float(np.mean(samples)) * 1e3, 2),
+            "p50_ms": round(dt * 1e3, 2),
+            "tokens_per_sec": round(B * Lt / dt, 1),
+            "compile_ms": round(compile_ms, 2)}
+
+
 def run_performance_test(ops=None, categories=None, warmup=2, runs=10,
                          large=False):
     """Programmatic entry (reference: opperf.run_performance_test)."""
@@ -201,6 +246,12 @@ def run_performance_test(ops=None, categories=None, warmup=2, runs=10,
             results.append(time_op(name, build, warmup, runs))
         except Exception as e:                        # noqa: BLE001
             results.append({"op": name, "error": str(e)[:120]})
+    if (not ops or "beam_search" in ops) and \
+            (not categories or "decode" in categories):
+        try:
+            results.append(time_beam_decode(large))
+        except Exception as e:                        # noqa: BLE001
+            results.append({"op": "beam_search", "error": str(e)[:120]})
     return results
 
 
@@ -210,7 +261,8 @@ def main():
                     help="comma-separated op names (default: all)")
     ap.add_argument("--categories", default=None,
                     help="comma-separated: elemwise,broadcast,reduce,"
-                         "gemm,conv,nn,optimizer,attention,detection,moe")
+                         "gemm,conv,nn,optimizer,attention,detection,"
+                         "moe,decode")
     ap.add_argument("--runs", type=int, default=10)
     ap.add_argument("--warmup", type=int, default=2)
     ap.add_argument("--large", action="store_true",

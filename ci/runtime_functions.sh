@@ -46,6 +46,8 @@ examples_smoke() {
     python examples/mnist_gluon.py --epochs 1
     python examples/word_language_model.py --epochs 1
     python examples/ssd_detection.py --iters 40
+    python examples/nmt_transformer.py --epochs 1 --min-match 0
+    python examples/train_imagenet.py --iters 10 --model resnet18_v1
 }
 
 bench_cpu() {
