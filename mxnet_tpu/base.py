@@ -178,7 +178,7 @@ declare_env("MXNET_FUSED_HYBRID_STEP", "1",
             "Fuse a deferred single-CachedOp backward with the optimizer "
             "update into one donated program in Trainer.step "
             "(record/backward/step at fused-step cost); 0 = always eager.")
-declare_env("MXNET_CACHED_OP_SAVE_POLICY", "dots",
+declare_env("MXNET_CACHED_OP_SAVE_POLICY", "dots_no_batch",
             "What the hybridized training forward saves for backward: "
             "all | dots | dots_no_batch | none (memory/recompute dial).")
 declare_env("MXNET_KVSTORE_BIGARRAY_BOUND", 1000000,

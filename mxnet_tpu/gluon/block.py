@@ -541,7 +541,7 @@ class CachedOp:
         #                    matmuls (attention) recompute
         #   none           — full rematerialization, minimal memory
         from ..base import get_env
-        policy_name = get_env("MXNET_CACHED_OP_SAVE_POLICY", "dots")
+        policy_name = get_env("MXNET_CACHED_OP_SAVE_POLICY", "dots_no_batch")
         policies = {
             "all": None,
             "dots": jax.checkpoint_policies.dots_saveable,
@@ -549,7 +549,7 @@ class CachedOp:
                 jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
             "none": jax.checkpoint_policies.nothing_saveable,
         }
-        policy = policies.get(str(policy_name), policies["dots"])
+        policy = policies.get(str(policy_name), policies["dots_no_batch"])
 
         @jax.jit
         def fwd_rec(key, *arrays):

@@ -172,7 +172,7 @@ class FusedTrainStep:
                 aux_order.extend(cap.keys())
             return flat[0]._data, tuple(cap.values())
 
-        policy_name = get_env("MXNET_CACHED_OP_SAVE_POLICY", "dots")
+        policy_name = get_env("MXNET_CACHED_OP_SAVE_POLICY", "dots_no_batch")
         policies = {
             "all": None,
             "dots": jax.checkpoint_policies.dots_saveable,
@@ -180,7 +180,7 @@ class FusedTrainStep:
                 jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
             "none": jax.checkpoint_policies.nothing_saveable,
         }
-        policy = policies.get(str(policy_name), policies["dots"])
+        policy = policies.get(str(policy_name), policies["dots_no_batch"])
 
         def prog(key, ts, lrs, wds, rescale, input_arrays, weights,
                  frozen_arrays, states):

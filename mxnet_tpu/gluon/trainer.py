@@ -270,7 +270,6 @@ class Trainer:
         for i, p in items:
             if i not in upd.states:
                 upd.states[i] = o.create_state_multi_precision(i, p.data())
-            o._update_count(i)
 
         order = sorted(param_slots)                 # entry index order
         params_ordered = [param_slots[ei] for ei in order]
@@ -294,7 +293,13 @@ class Trainer:
             cache = self._fused_step_progs = OrderedDict()
         entry = cache.get(key)
         if entry is not None:
-            cache.move_to_end(key)
+            cache.move_to_end(key)      # broken entries too: stay resident
+            if entry.get("broken"):
+                return False            # negative-cached failing build
+        # update counts advance only once fusion is committed (the eager
+        # fallback advances its own) — after the broken-entry early out
+        for i, _p in items:
+            o._update_count(i)
         if entry is None:
             bwd_impl = info["bwd_impl"]
             n_entries = len(entries)
@@ -359,8 +364,16 @@ class Trainer:
                 for a in jax.tree_util.tree_leaves(
                     (res, weights, states)))
             if not consumed and isinstance(e, Exception):
-                # trace/compile failure happens before donation: the
-                # deferred tape is untouched — fall back to eager
+                # pre-donation failure: the deferred tape is untouched —
+                # fall back to eager.  Negative-cache ONLY never-succeeded
+                # entries (a genuine trace/compile failure); a transient
+                # runtime error on a proven program keeps the fused path.
+                if not entry.get("succeeded"):
+                    entry["broken"] = True
+                    warnings.warn(
+                        f"fused hybrid step disabled for this signature "
+                        f"(falling back to separate backward+update): "
+                        f"{e!r}", stacklevel=2)
                 return False
             autograd.clear_pending()    # residuals are gone: no replay
             info["consumed"][0] = True
@@ -373,6 +386,7 @@ class Trainer:
                     f"{e!r}") from e
             raise   # KeyboardInterrupt/SystemExit propagate as-is
         entry["ts"] = new_ts
+        entry["succeeded"] = True
         autograd.clear_pending()
         info["consumed"][0] = True      # residuals donated: no replay
         for (i, p), nw, ns, g in zip(params_ordered, new_w, new_s, pgrads):

@@ -335,3 +335,30 @@ class TestFusedHybridStep:
         assert autograd.peek_pending() is not None
         mx.waitall()
         assert autograd.peek_pending() is None
+
+    def test_broken_fusion_no_double_count_advance(self):
+        """A negative-cached (broken) fused signature must not
+        double-advance optimizer update counts: the early return happens
+        before bookkeeping, the eager path advances once."""
+        rng = np.random.RandomState(4)
+        net, blk = self._build(25)
+        tr = gluon.Trainer(net.collect_params(), "adam",
+                           {"learning_rate": 1e-2})
+        x = nd.array(rng.randn(8, 4).astype(np.float32))
+        y = nd.array(rng.randn(8, 1).astype(np.float32))
+        with autograd.record():
+            l = blk(x, y)
+        l.backward()
+        tr.step(8)                                  # builds fused entry
+        o = tr._optimizer
+        counts1 = dict(o._index_update_count)
+        cache = tr._fused_step_progs
+        for entry in cache.values():
+            entry["broken"] = True                  # simulate neg-cache
+        with autograd.record():
+            l = blk(x, y)
+        l.backward()
+        tr.step(8)                                  # eager fallback
+        counts2 = dict(o._index_update_count)
+        assert all(counts2[k] == counts1[k] + 1 for k in counts1), \
+            (counts1, counts2)
