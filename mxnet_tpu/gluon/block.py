@@ -541,7 +541,7 @@ class CachedOp:
         #                    matmuls (attention) recompute
         #   none           — full rematerialization, minimal memory
         from ..base import get_env
-        policy_name = get_env("MXNET_CACHED_OP_SAVE_POLICY", "dots_no_batch")
+        policy_name = get_env("MXNET_CACHED_OP_SAVE_POLICY")
         policies = {
             "all": None,
             "dots": jax.checkpoint_policies.dots_saveable,

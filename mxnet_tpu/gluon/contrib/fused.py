@@ -172,7 +172,7 @@ class FusedTrainStep:
                 aux_order.extend(cap.keys())
             return flat[0]._data, tuple(cap.values())
 
-        policy_name = get_env("MXNET_CACHED_OP_SAVE_POLICY", "dots_no_batch")
+        policy_name = get_env("MXNET_CACHED_OP_SAVE_POLICY")
         policies = {
             "all": None,
             "dots": jax.checkpoint_policies.dots_saveable,

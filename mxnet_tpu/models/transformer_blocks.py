@@ -84,7 +84,7 @@ class MultiHeadSelfAttention(HybridBlock):
     """
 
     def __init__(self, units, num_heads, dropout=0.0, use_flash=False,
-                 causal=False, **kwargs):
+                 causal=False, window=None, **kwargs):
         super().__init__(**kwargs)
         if units % num_heads:
             raise MXNetError(f"units {units} not divisible by heads "
@@ -93,10 +93,18 @@ class MultiHeadSelfAttention(HybridBlock):
             raise MXNetError(
                 "causal=True requires use_flash=True; on the dense path "
                 "pass an explicit additive causal mask instead")
+        if window is not None:
+            if not (use_flash and causal):
+                raise MXNetError(
+                    "window (sliding-window attention) requires "
+                    "use_flash=True and causal=True")
+            if int(window) < 1:
+                raise MXNetError(f"window must be >= 1, got {window}")
         self._units = units
         self._heads = num_heads
         self._use_flash = use_flash
         self._causal = causal
+        self._window = -1 if window is None else int(window)
         with self.name_scope():
             self.qkv = nn.Dense(3 * units, in_units=units, flatten=False,
                                 prefix="qkv_")
@@ -110,12 +118,20 @@ class MultiHeadSelfAttention(HybridBlock):
         if self._use_flash and mask is None:
             if valid_length is None:
                 out = F.flash_selfatt_nomask(qkv, heads=self._heads,
-                                             causal=self._causal)
+                                             causal=self._causal,
+                                             window=self._window)
             else:
                 out = F.flash_selfatt(qkv, valid_length,
                                       heads=self._heads,
-                                      causal=self._causal)
+                                      causal=self._causal,
+                                      window=self._window)
             return self.out_proj(self.dropout_layer(out))
+        if self._window > 0:
+            raise MXNetError(
+                "window (sliding-window attention) is only honored on "
+                "the flash path (mask=None); passing an explicit mask "
+                "would silently drop the window — fold the window into "
+                "the mask instead")
         if valid_length is not None:
             raise MXNetError(
                 "valid_length is only consumed by the flash path "

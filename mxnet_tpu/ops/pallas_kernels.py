@@ -59,8 +59,10 @@ def _lens_spec():
     return pl.BlockSpec(memory_space=pltpu.SMEM)
 
 
-def _block_mask(s, kv_len, q_start, k_start, causal, block_q, block_k):
-    """Mask a (block_q, block_k) score tile: key padding + causal."""
+def _block_mask(s, kv_len, q_start, k_start, causal, block_q, block_k,
+                window=-1):
+    """Mask a (block_q, block_k) score tile: key padding + causal
+    (+ sliding window: key in [q-window+1, q])."""
     k_idx = k_start + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
     mask = k_idx < kv_len
@@ -68,6 +70,8 @@ def _block_mask(s, kv_len, q_start, k_start, causal, block_q, block_k):
         q_idx = q_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         mask = jnp.logical_and(mask, k_idx <= q_idx)
+        if window > 0:
+            mask = jnp.logical_and(mask, k_idx >= q_idx - (window - 1))
     return jnp.where(mask, s, _NEG_INF)
 
 
@@ -76,7 +80,7 @@ def _block_mask(s, kv_len, q_start, k_start, causal, block_q, block_k):
 # ---------------------------------------------------------------------------
 def _fwd_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, sm_scale, causal, block_q,
-                block_k, nk):
+                block_k, nk, window=-1):
     b = pl.program_id(0)
     iq = pl.program_id(1)
     ik = pl.program_id(2)
@@ -96,6 +100,9 @@ def _fwd_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     if causal:
         needed = jnp.logical_and(needed,
                                  k_start <= q_start + block_q - 1)
+        if window > 0:
+            needed = jnp.logical_and(
+                needed, k_start + block_k - 1 >= q_start - (window - 1))
 
     @pl.when(needed)
     def _step():
@@ -109,7 +116,7 @@ def _fwd_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale  # (bq, bk)
         s = _block_mask(s, kv_len, q_start, k_start, causal, block_q,
-                        block_k)
+                        block_k, window)
         m_prev = m_scr[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         corr = jnp.exp(m_prev - m_new)
@@ -134,7 +141,7 @@ def _fwd_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 # ---------------------------------------------------------------------------
 def _bwd_dq_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                    delta_ref, dq_ref, dq_scr, *, sm_scale, causal,
-                   block_q, block_k, nk):
+                   block_q, block_k, nk, window=-1):
     b = pl.program_id(0)
     iq = pl.program_id(1)
     ik = pl.program_id(2)
@@ -150,6 +157,9 @@ def _bwd_dq_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     if causal:
         needed = jnp.logical_and(needed,
                                  k_start <= q_start + block_q - 1)
+        if window > 0:
+            needed = jnp.logical_and(
+                needed, k_start + block_k - 1 >= q_start - (window - 1))
 
     @pl.when(needed)
     def _step():
@@ -161,7 +171,7 @@ def _bwd_dq_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
         s = _block_mask(s, kv_len, q_start, k_start, causal, block_q,
-                        block_k)
+                        block_k, window)
         p = jnp.exp(s - lse_ref[0])                # (bq, bk)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -178,7 +188,7 @@ def _bwd_dq_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 def _bwd_dkv_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                     delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
-                    sm_scale, causal, block_q, block_k, nq):
+                    sm_scale, causal, block_q, block_k, nq, window=-1):
     b = pl.program_id(0)
     ik = pl.program_id(1)
     iq = pl.program_id(2)
@@ -195,6 +205,9 @@ def _bwd_dkv_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     if causal:
         needed = jnp.logical_and(needed,
                                  q_start + block_q - 1 >= k_start)
+        if window > 0:
+            needed = jnp.logical_and(
+                needed, k_start + block_k - 1 >= q_start - (window - 1))
 
     @pl.when(needed)
     def _step():
@@ -206,7 +219,7 @@ def _bwd_dkv_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
         s = _block_mask(s, kv_len, q_start, k_start, causal, block_q,
-                        block_k)
+                        block_k, window)
         p = jnp.exp(s - lse_ref[0])                # (bq, bk)
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -258,15 +271,16 @@ def _run(kernel, grid, in_specs, out_shape, out_specs, scratch, inputs,
     )(*inputs)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash(q, k, v, lens, causal, sm_scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, lens, causal, sm_scale, block_q, block_k, interpret,
+           window):
     out, _ = _flash_fwd(q, k, v, lens, causal, sm_scale, block_q,
-                        block_k, interpret)
+                        block_k, interpret, window)
     return out
 
 
 def _flash_fwd(q, k, v, lens, causal, sm_scale, block_q, block_k,
-               interpret):
+               interpret, window):
     BH, Lq, D = q.shape
     Lk = k.shape[1]
     nq, nk = Lq // block_q, Lk // block_k
@@ -274,7 +288,7 @@ def _flash_fwd(q, k, v, lens, causal, sm_scale, block_q, block_k,
     lens_spec = _lens_spec()
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale,
                                causal=causal, block_q=block_q,
-                               block_k=block_k, nk=nk)
+                               block_k=block_k, nk=nk, window=window)
     out, lse = _run(
         kernel, (BH, nq, nk),
         [lens_spec, q_spec, k_spec, k_spec],
@@ -288,7 +302,8 @@ def _flash_fwd(q, k, v, lens, causal, sm_scale, block_q, block_k,
     return out, (q, k, v, lens, out, lse)
 
 
-def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, dout):
+def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, window,
+               res, dout):
     q, k, v, lens, out, lse = res
     BH, Lq, D = q.shape
     Lk = k.shape[1]
@@ -301,7 +316,7 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, dout):
     dq = _run(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale,
                           causal=causal, block_q=block_q,
-                          block_k=block_k, nk=nk),
+                          block_k=block_k, nk=nk, window=window),
         (BH, nq, nk),
         [lens_spec, q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
         jax.ShapeDtypeStruct((BH, Lq, D), q.dtype),
@@ -314,7 +329,7 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, dout):
     dk, dv = _run(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
                           causal=causal, block_q=block_q,
-                          block_k=block_k, nq=nq),
+                          block_k=block_k, nq=nq, window=window),
         (BH, nk, nq),
         [lens_spec, q_spec2, k_spec2, k_spec2, q_spec2, row_spec2,
          row_spec2],
@@ -354,12 +369,17 @@ def _default_blocks(Lq, Lk, D):
 
 
 def flash_attention(q, k, v, lengths=None, causal=False, sm_scale=None,
-                    block_q=None, block_k=None, interpret=None):
+                    block_q=None, block_k=None, interpret=None,
+                    window=None):
     """Fused attention over (B*H, L, D) tensors.
 
     ``lengths``: optional int32 (B*H,) valid key lengths (padding mask).
-    Returns (B*H, Lq, D) in the query dtype.  Block sizes default to a
-    per-(seqlen, head-dim) tuned table (_default_blocks).
+    ``window``: optional causal sliding-window width — query q attends
+    keys in [q-window+1, q] (Mistral/Longformer-style local attention);
+    out-of-window blocks are SKIPPED, so compute scales O(L*window)
+    (the splash-style sparsity SURVEY §5.7 asks for).  Requires
+    causal=True.  Returns (B*H, Lq, D) in the query dtype.  Block sizes
+    default to a per-(seqlen, head-dim) tuned table (_default_blocks).
     """
     if not pallas_available():
         from ..base import MXNetError
@@ -387,8 +407,17 @@ def flash_attention(q, k, v, lengths=None, causal=False, sm_scale=None,
     if Lk_p != Lk:
         k = jnp.pad(k, ((0, 0), (0, Lk_p - Lk), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, Lk_p - Lk), (0, 0)))
+    if window is not None:
+        from ..base import MXNetError
+        if not causal:
+            raise MXNetError(
+                "flash_attention: window requires causal=True")
+        if int(window) < 1:
+            raise MXNetError(
+                f"flash_attention: window must be >= 1, got {window}")
     out = _flash(q, k, v, lengths, causal, float(sm_scale), block_q,
-                 block_k, bool(interpret))
+                 block_k, bool(interpret),
+                 -1 if window is None else int(window))
     return out[:, :Lq] if Lq_p != Lq else out
 
 
@@ -399,9 +428,10 @@ def flash_attention(q, k, v, lengths=None, causal=False, sm_scale=None,
 @register("_contrib_flash_selfatt", num_inputs=2,
           aliases=["flash_selfatt"])
 def flash_selfatt(queries_keys_values, valid_length, *, heads: int = 1,
-                  causal: bool = False):
+                  causal: bool = False, window: int = -1):
     """Flash-attention drop-in for the interleaved selfatt qk->softmax->
     valatt chain.  ``valid_length``: (B,) float/int valid KEY lengths.
+    ``window > 0``: causal sliding-window attention of that width.
     """
     L, B, H3D = queries_keys_values.shape
     D = H3D // (heads * 3)
@@ -410,7 +440,8 @@ def flash_selfatt(queries_keys_values, valid_length, *, heads: int = 1,
     q, k, v = (x[:, :, :, i, :].transpose(1, 2, 0, 3)
                .reshape(B * heads, L, D) for i in range(3))
     lens = jnp.repeat(valid_length.astype(jnp.int32), heads)
-    out = flash_attention(q, k, v, lengths=lens, causal=causal)
+    out = flash_attention(q, k, v, lengths=lens, causal=causal,
+                          window=None if window <= 0 else window)
     return out.reshape(B, heads, L, D).transpose(2, 0, 1, 3).reshape(
         L, B, heads * D)
 
@@ -418,13 +449,14 @@ def flash_selfatt(queries_keys_values, valid_length, *, heads: int = 1,
 @register("_contrib_flash_selfatt_nomask", num_inputs=1,
           aliases=["flash_selfatt_nomask"])
 def flash_selfatt_nomask(queries_keys_values, *, heads: int = 1,
-                         causal: bool = False):
+                         causal: bool = False, window: int = -1):
     """flash_selfatt without a padding mask (full key length)."""
     L, B, H3D = queries_keys_values.shape
     D = H3D // (heads * 3)
     x = queries_keys_values.reshape(L, B, heads, 3, D)
     q, k, v = (x[:, :, :, i, :].transpose(1, 2, 0, 3)
                .reshape(B * heads, L, D) for i in range(3))
-    out = flash_attention(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal,
+                          window=None if window <= 0 else window)
     return out.reshape(B, heads, L, D).transpose(2, 0, 1, 3).reshape(
         L, B, heads * D)

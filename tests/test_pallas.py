@@ -169,3 +169,44 @@ def test_flash_block_defaults_table():
     finally:
         del os.environ["MXNET_FLASH_BLOCK_Q"]
         del os.environ["MXNET_FLASH_BLOCK_K"]
+
+
+def test_flash_sliding_window_matches_dense():
+    """Causal sliding-window attention (window w: keys in [q-w+1, q])
+    matches the dense masked oracle, forward and grads."""
+    q, k, v = _rand_qkv(BH=2, L=48, D=8, seed=11)
+    w = 12
+
+    def dense_win(q, k, v):
+        D = q.shape[-1]
+        s = jnp.einsum("bqd,bkd->bqk", q, k) / np.sqrt(D)
+        qi = jnp.arange(48)[:, None]
+        ki = jnp.arange(48)[None, :]
+        mask = (ki <= qi) & (ki >= qi - (w - 1))
+        s = jnp.where(mask[None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bqk,bkd->bqd", p, v)
+
+    out = flash_attention(q, k, v, causal=True, window=w,
+                          block_q=16, block_k=16)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(dense_win(q, k, v)), atol=1e-5)
+
+    cot = jnp.asarray(np.random.RandomState(12).randn(*q.shape),
+                      jnp.float32)
+    gf = jax.grad(lambda q, k, v: (flash_attention(
+        q, k, v, causal=True, window=w, block_q=16, block_k=16)
+        * cot).sum(), argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(lambda q, k, v: (dense_win(q, k, v) * cot).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4)
+
+
+def test_flash_window_requires_causal():
+    import pytest
+    from mxnet_tpu.base import MXNetError
+    q, k, v = _rand_qkv(BH=1, L=16, D=8)
+    with pytest.raises(MXNetError, match="causal"):
+        flash_attention(q, k, v, causal=False, window=4)
