@@ -271,8 +271,8 @@ def _orchestrate():
     timeout = int(os.environ.get("BENCH_PHASE_TIMEOUT", 1500))
     attempts = {
         "headline": [{}, {}, {"BENCH_BATCH": "24"}, {"BENCH_BATCH": "16"}],
-        "hybrid": [{}, {}, {"BENCH_BATCH": "16"}],
-        "fused": [{}, {}, {"BENCH_BATCH": "16"}],
+        "hybrid": [{}, {}, {"BENCH_BATCH": "24"}, {"BENCH_BATCH": "16"}],
+        "fused": [{}, {}, {"BENCH_BATCH": "24"}, {"BENCH_BATCH": "16"}],
         "flash": [{}, {}, {"BENCH_FLASH_BATCH": "4"}],
     }
     enabled = {
