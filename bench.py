@@ -27,7 +27,7 @@ import time
 
 import numpy as np
 
-PHASES = ("headline", "hybrid", "fused", "flash")
+PHASES = ("headline", "hybrid", "fused", "flash", "flash2048")
 
 
 def _mlm_batch(nd, rng, vocab_size, B, L):
@@ -233,10 +233,27 @@ def phase_flash(env):
             "flash512_batch": Bf}
 
 
+def phase_flash2048(env):
+    """Long-context stretch: seq-2048 flash-attention pretrain step.
+    The dense path cannot run this at all on one 16GB chip (O(L^2) fp32
+    scores); flash trains it.  Token count B*L matches the headline's
+    (2*2048 vs 32*128) so MFU is comparable."""
+    if not env.on_tpu:
+        return {}
+    Lf = 2048
+    Bf = int(os.environ.get("BENCH_FLASH2048_BATCH", 2))
+    _model, head = env.build_pretrain(use_flash=True, max_length=Lf)
+    mfu, sps, _loss, _n, _tr = env.sharded_phase(head, Bf, Lf)
+    return {"flash2048_mfu": round(mfu, 4),
+            "flash2048_samples_per_sec": round(sps, 2),
+            "flash2048_batch": Bf}
+
+
 def run_phase(name):
     env = _Env()
     out = {"headline": phase_headline, "hybrid": phase_hybrid,
-           "fused": phase_fused, "flash": phase_flash}[name](env)
+           "fused": phase_fused, "flash": phase_flash,
+           "flash2048": phase_flash2048}[name](env)
     print(json.dumps(out))
 
 
@@ -274,12 +291,14 @@ def _orchestrate():
         "hybrid": [{}, {}, {"BENCH_BATCH": "24"}, {"BENCH_BATCH": "16"}],
         "fused": [{}, {}, {"BENCH_BATCH": "24"}, {"BENCH_BATCH": "16"}],
         "flash": [{}, {}, {"BENCH_FLASH_BATCH": "4"}],
+        "flash2048": [{}, {}, {"BENCH_FLASH2048_BATCH": "1"}],
     }
     enabled = {
         "headline": True,
         "hybrid": os.environ.get("BENCH_HYBRID", "1") != "0",
         "fused": os.environ.get("BENCH_FUSED", "1") != "0",
         "flash": os.environ.get("BENCH_FLASH", "1") != "0",
+        "flash2048": os.environ.get("BENCH_FLASH2048", "1") != "0",
     }
     merged = {}
     for phase in PHASES:
@@ -318,7 +337,9 @@ def _orchestrate():
     order = ["metric", "value", "unit", "vs_baseline", "samples_per_sec",
              "batch", "seqlen", "params", "loss", "hybrid_mfu",
              "hybrid_vs_sharded", "fused_step_mfu", "flash512_mfu",
-             "flash512_samples_per_sec"]
+             "flash512_samples_per_sec", "flash512_batch",
+             "flash2048_mfu", "flash2048_samples_per_sec",
+             "flash2048_batch"]
     out = {k: merged[k] for k in order if k in merged}
     out.update({k: v for k, v in merged.items() if k not in out})
     print(json.dumps(out))
