@@ -759,3 +759,99 @@ def CTCLoss(data, label, data_lengths=None, label_lengths=None, *,
     per_n = jax.vmap(_ctc_forward_single, in_axes=(1, 0, 0, 0))(
         logprobs, label, t_lens, l_lens)
     return per_n.astype(data.dtype)
+
+
+@register("hard_sigmoid")
+def hard_sigmoid(data, *, alpha: float = 0.2, beta: float = 0.5):
+    """Piecewise-linear sigmoid (reference: mshadow_op hard_sigmoid)."""
+    return jnp.clip(alpha * data + beta, 0.0, 1.0)
+
+
+@register("im2col")
+def im2col(data, *, kernel=(), stride=(1, 1), dilate=(1, 1),
+           pad=(0, 0)):
+    """Sliding-window patch extraction, NCHW -> (N, C*kh*kw, L)
+    (reference: src/operator/nn/im2col.h).  XLA's dilated-patch
+    primitive keeps it one fused op."""
+    kh, kw = kernel
+    patches = jax.lax.conv_general_dilated_patches(
+        data, (kh, kw), tuple(stride),
+        [(pad[0], pad[0]), (pad[1], pad[1])],
+        rhs_dilation=tuple(dilate),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    n, ckk, oh, ow = patches.shape
+    return patches.reshape(n, ckk, oh * ow)
+
+
+@register("col2im")
+def col2im(data, *, output_size=(), kernel=(), stride=(1, 1),
+           dilate=(1, 1), pad=(0, 0)):
+    """Inverse of im2col: scatter-add patches back to NCHW (reference:
+    src/operator/nn/im2col.h col2im).  Implemented as the linear
+    transpose of im2col — exact adjoint by construction."""
+    H, W = output_size
+    n, ckk, _L = data.shape
+    kh, kw = kernel
+    c = ckk // (kh * kw)
+
+    def fwd(img):
+        return im2col(img, kernel=kernel, stride=stride, dilate=dilate,
+                      pad=pad)
+
+    img_shape = jax.ShapeDtypeStruct((n, c, H, W), data.dtype)
+    (out,) = jax.linear_transpose(fwd, img_shape)(data)
+    return out
+
+
+@register("SpatialTransformer", num_inputs=2)
+def SpatialTransformer(data, loc, *, target_shape=(),
+                       transform_type: str = "affine",
+                       sampler_type: str = "bilinear",
+                       cudnn_off: bool = False):
+    """Affine spatial transformer network: GridGenerator +
+    BilinearSampler composed (reference:
+    src/operator/spatial_transformer.cc)."""
+    grid = GridGenerator(loc, transform_type=transform_type,
+                         target_shape=target_shape)
+    return BilinearSampler(data, grid)
+
+
+@register("ROIPooling", num_inputs=2)
+def ROIPooling(data, rois, *, pooled_size=(), spatial_scale: float = 1.0):
+    """Max pooling over ROI bins (reference: src/operator/roi_pooling.cc).
+
+    TPU-native deviation: the reference max-pools over the exact integer
+    pixels of each quantized bin (data-dependent bin sizes); here each
+    bin is sampled on a static sub-grid DENSE ENOUGH that consecutive
+    samples are <= 1 pixel apart for any ROI in the feature map
+    (sg = ceil(H/ph) per side), so the nearest-pixel gather + max sees
+    every pixel of every bin — equal to the reference max up to corner
+    quantization.  Prefer ROIAlign for new models."""
+    ph, pw = pooled_size
+    n, c, h, w = data.shape
+    batch_idx = rois[:, 0].astype(jnp.int32)
+    # quantize roi corners like the reference (round to pixels)
+    x1 = jnp.round(rois[:, 1] * spatial_scale)
+    y1 = jnp.round(rois[:, 2] * spatial_scale)
+    x2 = jnp.round(rois[:, 3] * spatial_scale)
+    y2 = jnp.round(rois[:, 4] * spatial_scale)
+    bin_h = jnp.maximum(y2 - y1 + 1, 1.0) / ph
+    bin_w = jnp.maximum(x2 - x1 + 1, 1.0) / pw
+    # sub-samples per bin side: max bin size is H/ph (W/pw) pixels, so
+    # this guarantees <=1px sample spacing for any ROI
+    sgy = max(2, -(-h // ph))
+    sgx = max(2, -(-w // pw))
+    iy = (jnp.arange(ph * sgy) + 0.5) / sgy    # (ph*sgy,) in bin units
+    ix = (jnp.arange(pw * sgx) + 0.5) / sgx
+    ys = y1[:, None] + iy[None, :] * bin_h[:, None]     # (R, ph*sgy)
+    xs = x1[:, None] + ix[None, :] * bin_w[:, None]     # (R, pw*sgx)
+    yi = jnp.clip(jnp.floor(ys), 0, h - 1).astype(jnp.int32)
+    xi = jnp.clip(jnp.floor(xs), 0, w - 1).astype(jnp.int32)
+    imgs = data[batch_idx]                     # (R, C, H, W)
+    rows = jnp.take_along_axis(
+        imgs, yi[:, None, :, None], axis=2)    # (R, C, ph*sgy, W)
+    vals = jnp.take_along_axis(
+        rows, xi[:, None, None, :], axis=3)    # (R, C, ph*sgy, pw*sgx)
+    R = vals.shape[0]
+    vals = vals.reshape(R, c, ph, sgy, pw, sgx)
+    return vals.max(axis=(3, 5))

@@ -760,3 +760,76 @@ def all_finite(*data, init_output: bool = True):
     for d in data:
         ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(d)))
     return ok.astype(jnp.float32).reshape(1)
+
+
+@register("cumsum", aliases=["_np_cumsum"])
+def cumsum(data, *, axis=None, dtype=None):
+    """Cumulative sum (reference: tensor/cumsum.cc; axis=None flattens,
+    numpy semantics)."""
+    out = jnp.cumsum(data if axis is not None else data.ravel(),
+                     axis=axis)
+    return out.astype(dtype) if dtype else out
+
+
+@register("cumprod")
+def cumprod(data, *, axis=None, dtype=None):
+    """Cumulative product (numpy semantics; axis=None flattens)."""
+    out = jnp.cumprod(data if axis is not None else data.ravel(),
+                      axis=axis)
+    return out.astype(dtype) if dtype else out
+
+
+@register("digamma")
+def digamma(data):
+    """Derivative of gammaln (reference: unary math op family)."""
+    return jax.scipy.special.digamma(data)
+
+
+@register("unravel_index", differentiable=False)
+def unravel_index(data, *, shape=()):
+    """Flat index -> multi-index, stacked on a leading ndim axis
+    (reference: tensor/ravel.cc Unravel)."""
+    idxs = jnp.unravel_index(data.astype(jnp.int32), shape)
+    return jnp.stack(idxs, axis=0)
+
+
+def _split_v2_n_out(kwargs):
+    ios = kwargs.get("indices_or_sections", 1)
+    if isinstance(ios, int):
+        return ios
+    return len(tuple(ios)) + 1
+
+
+@register("split_v2", num_outputs=_split_v2_n_out)
+def split_v2(data, *, indices_or_sections=1, axis: int = 0,
+             squeeze_axis: bool = False):
+    """numpy-style split (reference: matrix_op split_v2: int = equal
+    sections, tuple = split points)."""
+    ios = indices_or_sections
+    parts = jnp.split(data, ios if isinstance(ios, int) else list(ios),
+                      axis=axis)
+    if squeeze_axis:
+        parts = [p.squeeze(axis=axis) for p in parts]
+    return tuple(parts) if len(parts) > 1 else parts[0]
+
+
+@register("Crop", num_inputs=None, aliases=["crop_v1"])
+def Crop(*inputs, offset=(0, 0), h_w=(0, 0), center_crop: bool = False,
+         num_args: int = 1):
+    """Spatial crop of NCHW data (reference: src/operator/crop.cc).
+    With two inputs, crops data to the second input's (H, W)."""
+    data = inputs[0]
+    H, W = data.shape[2], data.shape[3]
+    if len(inputs) == 2:
+        th, tw = inputs[1].shape[2], inputs[1].shape[3]
+    else:
+        th, tw = h_w
+    if center_crop:
+        oy, ox = (H - th) // 2, (W - tw) // 2
+    else:
+        oy, ox = offset
+    if not (0 <= oy and 0 <= ox and oy + th <= H and ox + tw <= W):
+        raise ValueError(
+            f"Crop: region offset={int(oy), int(ox)} h_w={th, tw} "
+            f"exceeds input spatial size {H, W}")
+    return data[:, :, oy:oy + th, ox:ox + tw]

@@ -1,0 +1,111 @@
+"""Long-tail reference ops added in r3 (cumsum/cumprod, split_v2, Crop,
+im2col/col2im, SpatialTransformer, ROIPooling, ...)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def test_cumsum_cumprod():
+    x = nd.array(np.arange(6.0).reshape(2, 3))
+    np.testing.assert_allclose(nd.cumsum(x, axis=1).asnumpy(),
+                               np.cumsum(x.asnumpy(), axis=1))
+    np.testing.assert_allclose(nd.cumsum(x).asnumpy(),
+                               np.cumsum(x.asnumpy()))
+    np.testing.assert_allclose(nd.cumprod(x, axis=0).asnumpy(),
+                               np.cumprod(x.asnumpy(), axis=0))
+    # differentiable
+    x.attach_grad()
+    with autograd.record():
+        y = nd.sum(nd.cumsum(x, axis=1))
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [[3, 2, 1], [3, 2, 1]])
+
+
+def test_digamma_unravel():
+    np.testing.assert_allclose(
+        nd.digamma(nd.array(np.array([1.0]))).asnumpy(), [-0.5772157],
+        rtol=1e-5)
+    u = nd.unravel_index(nd.array(np.array([5, 7]), dtype="int32"),
+                         shape=(3, 4))
+    assert u.asnumpy().tolist() == [[1, 1], [1, 3]]
+
+
+def test_split_v2():
+    a, b = nd.split_v2(nd.array(np.arange(8.0)),
+                       indices_or_sections=(3,))
+    assert a.shape == (3,) and b.shape == (5,)
+    parts = nd.split_v2(nd.array(np.arange(8.0).reshape(2, 4)),
+                        indices_or_sections=2, axis=0, squeeze_axis=True)
+    assert parts[0].shape == (4,)
+
+
+def test_crop():
+    img = nd.array(np.arange(2 * 3 * 6 * 6, dtype=np.float32)
+                   .reshape(2, 3, 6, 6))
+    c = nd.Crop(img, offset=(1, 2), h_w=(3, 3))
+    np.testing.assert_allclose(c.asnumpy(),
+                               img.asnumpy()[:, :, 1:4, 2:5])
+    like = nd.zeros((1, 1, 4, 4))
+    c2 = nd.Crop(img, like, center_crop=True, num_args=2)
+    np.testing.assert_allclose(c2.asnumpy(),
+                               img.asnumpy()[:, :, 1:5, 1:5])
+
+
+def test_im2col_col2im_adjoint():
+    rng = np.random.RandomState(0)
+    img = nd.array(rng.randn(2, 3, 8, 8).astype(np.float32))
+    kw = dict(kernel=(3, 3), stride=(2, 2), pad=(1, 1))
+    cols = nd.im2col(img, **kw)
+    assert cols.shape == (2, 27, 16)
+    y = nd.array(rng.randn(*cols.shape).astype(np.float32))
+    lhs = float((cols * y).sum().asnumpy())
+    rhs = float((img * nd.col2im(y, output_size=(8, 8), **kw))
+                .sum().asnumpy())
+    assert abs(lhs - rhs) < 1e-2 * max(1.0, abs(lhs))
+
+
+def test_hard_sigmoid():
+    x = nd.array(np.linspace(-5, 5, 11))
+    hs = nd.hard_sigmoid(x).asnumpy()
+    np.testing.assert_allclose(
+        hs, np.clip(0.2 * x.asnumpy() + 0.5, 0, 1), rtol=1e-6)
+
+
+def test_spatial_transformer_identity():
+    rng = np.random.RandomState(1)
+    img = nd.array(rng.randn(2, 3, 5, 5).astype(np.float32))
+    ident = nd.array(np.tile(
+        np.array([1, 0, 0, 0, 1, 0], np.float32), (2, 1)))
+    out = nd.SpatialTransformer(img, ident, target_shape=(5, 5))
+    np.testing.assert_allclose(out.asnumpy(), img.asnumpy(), atol=1e-5)
+
+
+def test_roi_pooling():
+    data = nd.array(np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8))
+    rois = nd.array(np.array([[0, 0, 0, 7, 7]], np.float32))
+    out = nd.ROIPooling(data, rois, pooled_size=(2, 2),
+                        spatial_scale=1.0)
+    assert out.shape == (1, 1, 2, 2)
+    # max of each quadrant-ish bin: bottom-right bin holds the max value
+    assert float(out.asnumpy()[0, 0, 1, 1]) == 63.0
+    assert float(out.asnumpy().min()) >= 0.0
+
+
+def test_roi_pooling_covers_all_pixels():
+    """Wide bins must not skip pixels: a lone max in a corner survives."""
+    arr = np.zeros((1, 1, 8, 8), np.float32)
+    arr[0, 0, 0, 0] = 100.0
+    out = nd.ROIPooling(nd.array(arr),
+                        nd.array(np.array([[0, 0, 0, 7, 7]], np.float32)),
+                        pooled_size=(2, 2), spatial_scale=1.0)
+    assert float(out.asnumpy()[0, 0, 0, 0]) == 100.0
+
+
+def test_crop_out_of_bounds_raises():
+    img = nd.zeros((1, 1, 4, 4))
+    with pytest.raises(mx.base.MXNetError, match="exceeds"):
+        nd.Crop(img, h_w=(6, 6))
+    with pytest.raises(mx.base.MXNetError, match="exceeds"):
+        nd.Crop(img, nd.zeros((1, 1, 6, 6)), center_crop=True, num_args=2)
