@@ -362,3 +362,55 @@ class TestFusedHybridStep:
         counts2 = dict(o._index_update_count)
         assert all(counts2[k] == counts1[k] + 1 for k in counts1), \
             (counts1, counts2)
+
+    def test_lr_change_and_frozen_param_through_fusion(self):
+        """set_learning_rate mid-training reaches the fused program, and
+        frozen (grad_req='null') params pass through untouched."""
+        from mxnet_tpu.gluon import nn
+        rng = np.random.RandomState(6)
+        mx.random.seed(26)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, activation="relu", in_units=4))
+        net.add(nn.Dense(1, in_units=8))
+        net.initialize(mx.init.Xavier())
+        first = net[0] if hasattr(net, "__getitem__") else None
+        frozen_p = next(iter(net.collect_params().values()))
+        frozen_p.grad_req = "null"
+        w0 = frozen_p.data().asnumpy().copy()
+
+        class LB(gluon.HybridBlock):
+            def __init__(self, inner, **kw):
+                super().__init__(**kw)
+                with self.name_scope():
+                    self.inner = inner
+
+            def hybrid_forward(self, F, x, y):
+                return ((self.inner(x) - y) ** 2).mean()
+
+        blk = LB(net)
+        blk.hybridize(static_alloc=True)
+        tr = gluon.Trainer(
+            [p for p in net.collect_params().values()
+             if p.grad_req != "null"], "sgd", {"learning_rate": 0.1})
+        x = nd.array(rng.randn(8, 4).astype(np.float32))
+        y = nd.array(rng.randn(8, 1).astype(np.float32))
+
+        def step():
+            with autograd.record():
+                l = blk(x, y)
+            l.backward()
+            tr.step(8)
+            return float(l.asnumpy())
+
+        step()
+        tuned = next(p for p in net.collect_params().values()
+                     if p.grad_req != "null")
+        before = tuned.data().asnumpy().copy()
+        tr.set_learning_rate(0.0)       # zero LR: params must FREEZE
+        step()
+        np.testing.assert_allclose(tuned.data().asnumpy(), before,
+                                   rtol=1e-6)
+        tr.set_learning_rate(0.1)
+        step()
+        assert np.abs(tuned.data().asnumpy() - before).max() > 0
+        np.testing.assert_allclose(frozen_p.data().asnumpy(), w0)
