@@ -51,8 +51,9 @@ examples_smoke() {
 }
 
 bench_cpu() {
-    # tiny-config bench harness end-to-end (no TPU required)
-    BENCH_CHILD=1 BENCH_STEPS=2 python bench.py
+    # tiny-config bench harness end-to-end (no TPU required): the full
+    # per-phase orchestrator, not just one child phase
+    BENCH_STEPS=2 python bench.py
 }
 
 if [ $# -lt 1 ] || ! declare -F "$1" > /dev/null; then
