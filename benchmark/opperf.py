@@ -206,6 +206,7 @@ def time_beam_decode(large=False, warmup=1, runs=5):
                                     hidden_size=128, num_layers=2,
                                     num_heads=4, max_length=64)
     m.initialize(mx.init.Xavier())
+    m.hybridize()          # eager per-op dispatch would dominate decode
     src = nd.array(rng.randint(4, 100, (B, Ls)).astype(np.int32),
                    dtype="int32")
     sv = nd.array(np.full((B,), Ls, np.float32))
