@@ -210,6 +210,18 @@ def peek_pending():
     return _STATE.pending
 
 
+def flush_if_pending_grad(arr):
+    """Flush the deferred backward iff ``arr`` IS one of its grad
+    destination buffers.  Covers code that hoisted grad-array aliases
+    out of the loop (``grads = [p.grad() for p in params]``) and then
+    reads or consumes them between ``loss.backward()`` and
+    ``trainer.step()`` — without this they'd silently observe the
+    previous step's gradients (the eager path wrote in place)."""
+    p = _STATE.pending
+    if p is not None and id(arr) in p["grad_ids"]:
+        flush_pending()
+
+
 def clear_pending():
     """Drop the deferred backward WITHOUT executing it (the caller fused
     it into its own program).  Clears head tape links like a normal
@@ -286,7 +298,15 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
             and all(arr._grad is None or arr._grad_req == "write"
                     for _p, _o, arr in root_nodes[0].input_entries)):
         _STATE.pending = {"node": root_nodes[0], "heads": list(heads),
-                          "train_mode": train_mode}
+                          "train_mode": train_mode,
+                          # id()s of the grad buffers this deferral will
+                          # write: a read of any of them (held alias from
+                          # an earlier p.grad()) must flush first or it
+                          # sees the PREVIOUS step's gradients
+                          "grad_ids": {
+                              id(arr._grad) for _p, _o, arr
+                              in root_nodes[0].input_entries
+                              if arr._grad is not None}}
         return
 
     prev_retain = _STATE.retain

@@ -266,6 +266,7 @@ class FusedTrainStep:
 
         # same per-step bookkeeping as Trainer._fused_update: ensure
         # states, advance the python-side update counts, keep ts on device
+        prev_num_update = o.num_update
         for i, _n, p in trainable:
             if i not in upd.states:
                 upd.states[i] = o.create_state_multi_precision(i, p.data())
@@ -307,8 +308,10 @@ class FusedTrainStep:
                 for a in jax.tree_util.tree_leaves((weights, states)))
             # the failed step never applied: roll back the update counts
             # advanced above so lr schedules / bias correction don't drift
+            # (num_update advanced via max(); restore it alongside)
             for i, _n, _p in trainable:
                 o._index_update_count[i] -= 1
+            o.num_update = prev_num_update
             entry["counts"] = counts
             if not consumed:
                 raise

@@ -298,6 +298,7 @@ class Trainer:
                 return False            # negative-cached failing build
         # update counts advance only once fusion is committed (the eager
         # fallback advances its own) — after the broken-entry early out
+        prev_num_update = o.num_update
         for i, _p in items:
             o._update_count(i)
         if entry is None:
@@ -355,8 +356,11 @@ class Trainer:
                     entry["lrs"], entry["wds"], entry["rescale"])
         except BaseException as e:
             # the failed step never applied: never advance schedules
+            # (num_update advanced via max() in _update_count, so the
+            # index decrement alone leaves lr schedules one step ahead)
             for i, _p in params_ordered:
                 o._index_update_count[i] -= 1
+            o.num_update = prev_num_update
             entry["counts"] = counts
             entry["ts"] = None
             consumed = any(

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .. import ndarray as nd
+from ..base import MXNetError
 
 __all__ = ["TransformerBeamDecoder"]
 
@@ -230,6 +231,17 @@ class TransformerBeamDecoder:
         (BOS first; positions past EOS hold EOS)."""
         self._maybe_refresh()
         m = self.model
+        n_pos = int(self.params["pos"].shape[0])
+        if int(max_decode_len) > n_pos:
+            # the decode loop reads pos[t] for t in [0, max_decode_len-1];
+            # beyond the table lax.dynamic_slice would silently clamp the
+            # start index and reuse the last position embedding for every
+            # further step — wrong decodes with no error
+            raise MXNetError(
+                f"max_decode_len={max_decode_len} exceeds the model's "
+                f"positional table ({n_pos} positions); rebuild the model "
+                f"with max_length >= {int(max_decode_len)} or decode "
+                f"shorter sequences")
         B, Ls = src.shape
         from .. import autograd
         with autograd.pause(train_mode=False):

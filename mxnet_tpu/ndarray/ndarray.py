@@ -152,6 +152,9 @@ class NDArray:
     def wait_to_read(self):
         """Block until computed; re-raise any deferred async error
         (reference: NDArray::WaitToRead + exception-on-var rethrow)."""
+        from .. import autograd
+        if autograd._STATE.pending is not None:
+            autograd.flush_if_pending_grad(self)   # stale grad-alias read
         self._var.check()
         try:
             self._data.block_until_ready()

@@ -170,8 +170,15 @@ def invoke(opdef: OpDef, inputs, kwargs: Dict[str, Any], out=None):
     from ..engine import engine, is_naive
 
     raw = []
+    pend = autograd.peek_pending()
     for a in inputs:
         if isinstance(a, NDArray):
+            if pend is not None and id(a) in pend["grad_ids"]:
+                # consuming a deferred-backward grad buffer as an op input
+                # (e.g. clip_global_norm over hoisted grad aliases) must
+                # see THIS step's gradients
+                autograd.flush_pending()
+                pend = None
             a._var.check()          # async error propagation: raise pending
             raw.append(a._data)
         else:

@@ -65,3 +65,17 @@ def test_program_cache_and_refresh(tiny_model):
     assert len(dec._progs) == n_progs          # programs survive refresh
     after = m.beam_search(src, beam_size=2, max_decode_len=6).asnumpy()
     assert before.shape == after.shape
+
+
+def test_max_decode_len_beyond_pos_table_raises(tiny_model):
+    """The positional table has max_length rows; a longer decode would
+    silently clamp dynamic_slice and reuse the last embedding (ADVICE
+    r3) — must raise instead.  Decoding at EXACTLY the table size is
+    safe (the loop reads pos[t] for t < max_decode_len) and must work."""
+    from mxnet_tpu.base import MXNetError
+    m = tiny_model
+    src = nd.array(np.array([[5, 6, 7]], np.int32), dtype="int32")
+    out = m.beam_search(src, beam_size=2, max_decode_len=64)   # == table
+    assert out.shape == (1, 65)
+    with pytest.raises(MXNetError, match="positional"):
+        m.beam_search(src, beam_size=2, max_decode_len=65)     # > table

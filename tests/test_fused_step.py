@@ -173,6 +173,7 @@ def test_failure_recovery_poison_and_reset(tmp_path):
     net.save_parameters(str(tmp_path / "fused_recover.params"))
     o = tr._optimizer
     counts_before = dict(o._index_update_count)
+    num_update_before = o.num_update
 
     sig, entry = next(iter(step._cache.items()))
     real_prog = entry["prog"]
@@ -189,6 +190,7 @@ def test_failure_recovery_poison_and_reset(tmp_path):
         step(x, y)
     # counts rolled back: the failed step must not advance schedules
     assert dict(o._index_update_count) == counts_before
+    assert o.num_update == num_update_before
     # subsequent calls raise the poisoned guidance without touching counts
     with pytest.raises(base.MXNetError, match="reset"):
         step(x, y)

@@ -336,6 +336,105 @@ class TestFusedHybridStep:
         mx.waitall()
         assert autograd.peek_pending() is None
 
+    def test_hoisted_grad_alias_sees_fresh_grads(self):
+        """Grad-buffer aliases hoisted out of the loop (``grads =
+        [p.grad() for p in params]``) must observe THIS step's gradients
+        when read between backward() and step() — the deferred tape
+        flushes on wait_to_read/asnumpy of a pending grad destination."""
+        rng = np.random.RandomState(5)
+        net, blk = self._build(27)
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 1e-2})
+        params = [p for p in net.collect_params().values()
+                  if p.grad_req != "null"]
+        x = nd.array(rng.randn(8, 4).astype(np.float32))
+        y = nd.array(rng.randn(8, 1).astype(np.float32))
+        with autograd.record():
+            l = blk(x, y)
+        l.backward()
+        tr.step(8)
+        grads = [p.grad() for p in params]          # hoisted aliases
+        stale = [g.asnumpy().copy() for g in grads]
+        x2 = nd.array(3 * rng.randn(8, 4).astype(np.float32))
+        y2 = nd.array(3 * rng.randn(8, 1).astype(np.float32))
+        with autograd.record():
+            l = blk(x2, y2)
+        l.backward()
+        assert autograd.peek_pending() is not None
+        fresh = [g.asnumpy() for g in grads]        # must flush first
+        assert autograd.peek_pending() is None
+        assert any(not np.allclose(a, b)
+                   for a, b in zip(stale, fresh))
+        tr.step(8)                                  # eager fallback works
+
+    def test_hoisted_grad_alias_as_op_input_flushes(self):
+        """Consuming a pending grad buffer as an op INPUT (the
+        clip_global_norm pattern) flushes the deferred backward too."""
+        rng = np.random.RandomState(6)
+        net, blk = self._build(28)
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 1e-2})
+        params = [p for p in net.collect_params().values()
+                  if p.grad_req != "null"]
+        x = nd.array(rng.randn(8, 4).astype(np.float32))
+        y = nd.array(rng.randn(8, 1).astype(np.float32))
+        with autograd.record():
+            l = blk(x, y)
+        l.backward()
+        tr.step(8)
+        grads = [p.grad() for p in params]
+        stale0 = grads[0].asnumpy().copy()
+        x2 = nd.array(3 * rng.randn(8, 4).astype(np.float32))
+        y2 = nd.array(3 * rng.randn(8, 1).astype(np.float32))
+        with autograd.record():
+            l = blk(x2, y2)
+        l.backward()
+        assert autograd.peek_pending() is not None
+        scaled = grads[0] * 1.0                     # op input → flush
+        assert autograd.peek_pending() is None
+        assert not np.allclose(scaled.asnumpy(), stale0)
+        tr.step(8)
+
+    def test_failed_fused_step_restores_num_update(self):
+        """A failed fused step rolls back num_update alongside the
+        per-index counts — lr_scheduler/_get_lr must not run one step
+        ahead after a failure (ADVICE r3)."""
+        import jax
+        import mxnet_tpu.base as base
+        rng = np.random.RandomState(7)
+        net, blk = self._build(29)
+        tr = gluon.Trainer(net.collect_params(), "adam",
+                           {"learning_rate": 1e-2})
+        x = nd.array(rng.randn(8, 4).astype(np.float32))
+        y = nd.array(rng.randn(8, 1).astype(np.float32))
+        with autograd.record():
+            l = blk(x, y)
+        l.backward()
+        tr.step(8)                                  # builds fused entry
+        o = tr._optimizer
+        counts_before = dict(o._index_update_count)
+        num_update_before = o.num_update
+
+        entry = next(e for e in tr._fused_step_progs.values()
+                     if e.get("prog") is not None)
+
+        def failing_prog(res, cots, weights, states, ts, lrs, wds,
+                         rescale):
+            for a in jax.tree_util.tree_leaves((res, weights, states)):
+                a.delete()                          # donated + consumed
+            raise RuntimeError("synthetic post-dispatch failure")
+
+        real_prog = entry["prog"]
+        entry["prog"] = failing_prog
+        with autograd.record():
+            l = blk(x, y)
+        l.backward()
+        with pytest.raises(base.MXNetError, match="donated"):
+            tr.step(8)
+        assert dict(o._index_update_count) == counts_before
+        assert o.num_update == num_update_before
+        entry["prog"] = real_prog
+
     def test_broken_fusion_no_double_count_advance(self):
         """A negative-cached (broken) fused signature must not
         double-advance optimizer update counts: the early return happens
