@@ -193,8 +193,11 @@ def rmspropalex_update(weight, grad, n, g_acc, delta, *, lr: float = 0.001,
     g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
     n_new = gamma1 * n + (1 - gamma1) * jnp.square(g)
     g_new = gamma1 * g_acc + (1 - gamma1) * g
+    # n - g_acc^2 >= 0 holds for states evolved from zero with one decay
+    # rate (running E[g^2] >= (running E[g])^2), but nothing enforces it
+    # for loaded/hand-built states — clamp so the sqrt can't NaN
     delta_new = gamma2 * delta - lr * g / jnp.sqrt(
-        n_new - jnp.square(g_new) + epsilon)
+        jnp.maximum(n_new - jnp.square(g_new), 0.0) + epsilon)
     w = weight + delta_new
     if clip_weights is not None and clip_weights > 0:
         w = jnp.clip(w, -clip_weights, clip_weights)

@@ -194,10 +194,14 @@ def quantized_pooling(data, min_data, max_data, *, kernel=(), stride=(),
     strides = (1, 1) + tuple(stride)
     padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
     if pool_type == "max":
-        out = lax.reduce_window(data, jnp.iinfo(jnp.int8).min, lax.max,
-                                dims, strides, padding)
+        # init value must carry the operand dtype (a bare python int
+        # trips reduce_window's dtype check for int8 operands)
+        out = lax.reduce_window(
+            data, jnp.array(jnp.iinfo(jnp.int8).min, data.dtype), lax.max,
+            dims, strides, padding)
     elif pool_type == "avg":
-        s = lax.reduce_window(data.astype(jnp.int32), 0, lax.add,
+        s = lax.reduce_window(data.astype(jnp.int32),
+                              jnp.array(0, jnp.int32), lax.add,
                               dims, strides, padding)
         n = 1
         for k in kernel:

@@ -1,0 +1,827 @@
+"""Registry-wide operator correctness sweep (VERDICT r4 item 4).
+
+Reference pattern: ``tests/python/unittest/test_operator.py`` — the
+biggest single test file upstream, where (nearly) every registered op is
+forward-checked against a NumPy oracle and numeric-gradient-checked
+(SURVEY.md §4 row 1, ``check_numeric_gradient``).  Here the whole
+``list_ops()`` registry is enumerated so a newly registered op is swept
+automatically; an op may opt out only via the explicit skip tables below,
+each entry with a one-line reason.
+
+Three layers per op:
+  1. forward smoke — the generated frontend runs on canonical small
+     inputs; outputs are finite (float) and well-formed;
+  2. NumPy oracle — where a clean numpy equivalent exists, outputs match;
+  3. finite-difference gradient — every differentiable op's autograd
+     gradient (the tape path) matches central differences, with
+     integer/index inputs held fixed (``wrt``).
+"""
+import math
+import zlib
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+import mxnet_tpu.ndarray.op as opmod
+from mxnet_tpu.ops.registry import OP_REGISTRY, list_ops
+
+# --------------------------------------------------------------- enumeration
+_seen = {}
+for _n in list_ops():
+    _od = OP_REGISTRY[_n]
+    _seen.setdefault(id(_od), _n)          # first registration = primary name
+CANONICAL = sorted(_seen.values())
+
+
+def _rng(name):
+    # crc32, not hash(): str hashes are salted per interpreter run and
+    # would make per-op inputs (and any failure) non-reproducible
+    return np.random.RandomState(zlib.crc32(name.encode()) % (2 ** 31))
+
+
+def _f32(rng, *shape):
+    return rng.randn(*shape).astype(np.float32)
+
+
+def _pos(rng, *shape):
+    return (np.abs(rng.randn(*shape)) + 0.3).astype(np.float32)
+
+
+def _idx(rng, n, *shape):
+    """index-like float input: x.5 values so ±eps FD perturbation never
+    crosses an integer boundary (the op casts to int internally)."""
+    return (rng.randint(0, n, shape) + 0.5).astype(np.float32)
+
+
+def _spd(rng, n, batch=()):
+    m = rng.randn(*batch, n, n)
+    a = m @ np.swapaxes(m, -1, -2) + n * np.eye(n)
+    return a.astype(np.float32)
+
+
+# --------------------------------------------------------------------- skips
+# Ops the sweep does not run AT ALL (each covered elsewhere or not
+# meaningfully invokable standalone).  Budget: < 10% of the registry.
+FWD_SKIP = {
+    "Custom": "python CustomOp trampoline; needs a registered user op "
+              "(covered by tests/test_operator_custom.py)",
+}
+
+# Differentiable ops whose FD gradient check is skipped (forward still
+# swept).  Each reason is a property of the op, not a TODO.
+GRAD_SKIP = {
+    "BlockGrad": "gradient is zero BY CONTRACT (identity forward); FD "
+                 "sees the identity — asserted separately below",
+    "Softmax": "SoftmaxOutput's training gradient is (p - one_hot) by "
+               "contract, not d(forward)/dx (covered by test_loss)",
+    "MakeLoss": "custom grad_scale gradient by contract, not "
+                "d(forward)/dx (reference MakeLoss semantics)",
+    "_linalg_syevd": "eigenvector gradient is ill-conditioned under FD "
+                     "(sign/ordering flips at crossings)",
+    "_linalg_gelqf": "LQ factor gradients are sign-ambiguous under FD",
+    "RNN": "fused multi-layer kernel; 100+-element parameter vector "
+           "makes FD impractical (gradients covered by test_gluon_rnn "
+           "training-convergence tests)",
+    "Dropout": "rng op: each FD evaluation draws a fresh mask "
+               "(p=0 forward identity is asserted in the oracle)",
+    "ceil": "piecewise-constant: gradient is zero a.e. and FD at a step "
+            "is undefined",
+    "floor": "piecewise-constant (as ceil)",
+    "rint": "piecewise-constant (as ceil)",
+    "round": "piecewise-constant (as ceil)",
+    "trunc": "piecewise-constant (as ceil)",
+    "sign": "piecewise-constant (as ceil)",
+    "_shuffle": "rng op: each FD evaluation permutes differently",
+    "_sample_multinomial": "rng sampler (forward distribution checked "
+                           "in test_ndarray random tests)",
+}
+
+# ------------------------------------------------------------------- domains
+# unary float ops needing a restricted input domain for a well-defined,
+# smooth forward (name -> generator(rng) for the single input)
+_DOMAIN = {
+    "arccos": lambda r: (r.uniform(-0.8, 0.8, (2, 3))).astype(np.float32),
+    "arcsin": lambda r: (r.uniform(-0.8, 0.8, (2, 3))).astype(np.float32),
+    "arctanh": lambda r: (r.uniform(-0.8, 0.8, (2, 3))).astype(np.float32),
+    "erfinv": lambda r: (r.uniform(-0.8, 0.8, (2, 3))).astype(np.float32),
+    "arccosh": lambda r: (1.5 + np.abs(r.randn(2, 3))).astype(np.float32),
+    "log": lambda r: _pos(r, 2, 3),
+    "log2": lambda r: _pos(r, 2, 3),
+    "log10": lambda r: _pos(r, 2, 3),
+    "log1p": lambda r: _pos(r, 2, 3),
+    "sqrt": lambda r: _pos(r, 2, 3),
+    "rsqrt": lambda r: _pos(r, 2, 3),
+    "cbrt": lambda r: _pos(r, 2, 3),
+    "rcbrt": lambda r: _pos(r, 2, 3),
+    "reciprocal": lambda r: _pos(r, 2, 3),
+    "gamma": lambda r: _pos(r, 2, 3),
+    "gammaln": lambda r: _pos(r, 2, 3),
+    "digamma": lambda r: (1.0 + _pos(r, 2, 3)).astype(np.float32),
+    # keep FD away from the |x|=1 kink / integer steps
+    "abs": lambda r: (np.sign(r.randn(2, 3)) *
+                      (0.3 + np.abs(r.randn(2, 3)))).astype(np.float32),
+}
+
+# ------------------------------------------------------------------- oracles
+_ERF = np.vectorize(math.erf)
+_GAMMA = np.vectorize(math.gamma)
+_LGAMMA = np.vectorize(math.lgamma)
+
+
+def _np_softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+# name -> callable(*np_inputs, **kwargs) returning the expected FIRST
+# output as a numpy array.  Only ops with a clean numpy equivalent.
+ORACLES = {
+    # elementwise unary
+    "abs": np.abs, "arccos": np.arccos, "arccosh": np.arccosh,
+    "arcsin": np.arcsin, "arcsinh": np.arcsinh, "arctan": np.arctan,
+    "arctanh": np.arctanh, "cbrt": np.cbrt, "ceil": np.ceil,
+    "cos": np.cos, "cosh": np.cosh, "degrees": np.degrees,
+    "erf": _ERF, "erfc": lambda x: 1.0 - _ERF(x),
+    "exp": np.exp, "expm1": np.expm1, "floor": np.floor,
+    "gamma": _GAMMA, "gammaln": _LGAMMA,
+    "log": np.log, "log10": np.log10, "log1p": np.log1p, "log2": np.log2,
+    "logical_not": lambda x: (x == 0).astype(np.float32),
+    "negative": np.negative, "radians": np.radians,
+    "rcbrt": lambda x: 1.0 / np.cbrt(x),
+    "reciprocal": lambda x: 1.0 / x,
+    "relu": lambda x: np.maximum(x, 0),
+    "rint": np.rint,
+    "rsqrt": lambda x: 1.0 / np.sqrt(x),
+    "sigmoid": lambda x: 1.0 / (1.0 + np.exp(-x)),
+    "sign": np.sign, "sin": np.sin, "sinh": np.sinh,
+    "softsign": lambda x: x / (1.0 + np.abs(x)),
+    "sqrt": np.sqrt, "square": np.square, "tan": np.tan, "tanh": np.tanh,
+    "trunc": np.trunc,
+    "hard_sigmoid": lambda x, alpha=0.2, beta=0.5:
+        np.clip(alpha * x + beta, 0, 1),
+    "smooth_l1": lambda x, scalar=1.0: np.where(
+        np.abs(x) < 1.0 / scalar ** 2, 0.5 * (scalar * x) ** 2,
+        np.abs(x) - 0.5 / scalar ** 2),
+    "_copy": lambda x: x, "BlockGrad": lambda x: x, "Flatten":
+        lambda x: x.reshape(x.shape[0], -1),
+    "_contrib_div_sqrt_dim": lambda x: x / np.sqrt(x.shape[-1]),
+    "_contrib_gelu_erf": lambda x: 0.5 * x * (1 + _ERF(x / np.sqrt(2))),
+    "zeros_like": np.zeros_like, "ones_like": np.ones_like,
+    "full_like": lambda x, fill_value=0.0: np.full_like(x, fill_value),
+    "shape_array": lambda x: np.array(x.shape, np.int64),
+    "size_array": lambda x: np.array([x.size], np.int64),
+    # binary / broadcast
+    "_add": np.add, "_minus": np.subtract, "_mul": np.multiply,
+    "_div": np.divide, "_power": np.power,
+    "broadcast_add": np.add, "broadcast_minus": np.subtract,
+    "broadcast_mul": np.multiply, "broadcast_div": np.divide,
+    "broadcast_maximum": np.maximum, "broadcast_minimum": np.minimum,
+    "broadcast_hypot": np.hypot, "broadcast_arctan2": np.arctan2,
+    "broadcast_mod": np.mod,
+    "broadcast_equal": lambda a, b: (a == b).astype(np.float32),
+    "broadcast_not_equal": lambda a, b: (a != b).astype(np.float32),
+    "broadcast_greater": lambda a, b: (a > b).astype(np.float32),
+    "broadcast_greater_equal": lambda a, b: (a >= b).astype(np.float32),
+    "broadcast_lesser": lambda a, b: (a < b).astype(np.float32),
+    "broadcast_lesser_equal": lambda a, b: (a <= b).astype(np.float32),
+    "broadcast_logical_and": lambda a, b:
+        np.logical_and(a, b).astype(np.float32),
+    "broadcast_logical_or": lambda a, b:
+        np.logical_or(a, b).astype(np.float32),
+    "broadcast_logical_xor": lambda a, b:
+        np.logical_xor(a, b).astype(np.float32),
+    # scalar ops
+    "_plus_scalar": lambda x, scalar=0.0: x + scalar,
+    "_minus_scalar": lambda x, scalar=0.0: x - scalar,
+    "_rminus_scalar": lambda x, scalar=0.0: scalar - x,
+    "_mul_scalar": lambda x, scalar=1.0: x * scalar,
+    "_div_scalar": lambda x, scalar=1.0: x / scalar,
+    "_rdiv_scalar": lambda x, scalar=1.0: scalar / x,
+    "_mod_scalar": lambda x, scalar=1.0: np.mod(x, scalar),
+    "_rmod_scalar": lambda x, scalar=1.0: np.mod(scalar, x),
+    "_power_scalar": lambda x, scalar=1.0: np.power(x, scalar),
+    "_rpower_scalar": lambda x, scalar=1.0: np.power(scalar, x),
+    "_hypot_scalar": lambda x, scalar=0.0: np.hypot(x, scalar),
+    "_maximum_scalar": lambda x, scalar=0.0: np.maximum(x, scalar),
+    "_minimum_scalar": lambda x, scalar=0.0: np.minimum(x, scalar),
+    "_equal_scalar": lambda x, scalar=0.0: (x == scalar).astype(np.float32),
+    "_not_equal_scalar": lambda x, scalar=0.0:
+        (x != scalar).astype(np.float32),
+    "_greater_scalar": lambda x, scalar=0.0:
+        (x > scalar).astype(np.float32),
+    "_greater_equal_scalar": lambda x, scalar=0.0:
+        (x >= scalar).astype(np.float32),
+    "_greater_scalar_rev": lambda x, scalar=0.0:
+        (scalar > x).astype(np.float32),
+    "_lesser_scalar": lambda x, scalar=0.0:
+        (x < scalar).astype(np.float32),
+    "_lesser_equal_scalar": lambda x, scalar=0.0:
+        (x <= scalar).astype(np.float32),
+    # reductions
+    "sum": lambda x, **k: np.sum(x, axis=k.get("axis")),
+    "mean": lambda x, **k: np.mean(x, axis=k.get("axis")),
+    "max": lambda x, **k: np.max(x, axis=k.get("axis")),
+    "min": lambda x, **k: np.min(x, axis=k.get("axis")),
+    "prod": lambda x, **k: np.prod(x, axis=k.get("axis")),
+    "nansum": lambda x, **k: np.nansum(x, axis=k.get("axis")),
+    "nanprod": lambda x, **k: np.nanprod(x, axis=k.get("axis")),
+    "norm": lambda x, **k: np.sqrt(np.sum(np.square(x))),
+    "_np_cumsum": lambda x, axis=None, dtype=None: np.cumsum(x, axis=axis),
+    "cumprod": lambda x, axis=None, dtype=None: np.cumprod(x, axis=axis),
+    "argmax": lambda x, axis=None, keepdims=False:
+        np.argmax(x, axis=axis).astype(np.float32),
+    "argmin": lambda x, axis=None, keepdims=False:
+        np.argmin(x, axis=axis).astype(np.float32),
+    "argmax_channel": lambda x: np.argmax(x, axis=1).astype(np.float32),
+    # shape / indexing
+    "transpose": lambda x, axes=(): np.transpose(
+        x, axes if axes else None),
+    "expand_dims": lambda x, axis=0: np.expand_dims(x, axis),
+    "squeeze": lambda x, axis=None: np.squeeze(x, axis),
+    "flip": lambda x, axis=0: np.flip(x, axis),
+    "tile": lambda x, reps=(): np.tile(x, reps),
+    "repeat": lambda x, repeats=1, axis=None: np.repeat(x, repeats, axis),
+    "SwapAxis": lambda x, dim1=0, dim2=0: np.swapaxes(x, dim1, dim2),
+    "Reshape": lambda x, shape=(), reverse=False: x.reshape(shape),
+    "broadcast_to": lambda x, shape=(): np.broadcast_to(x, shape),
+    "clip": lambda x, a_min=None, a_max=None: np.clip(x, a_min, a_max),
+    "diag": lambda x, k=0, axis1=0, axis2=1: np.diag(x, k),
+    "sort": lambda x, axis=-1, is_ascend=True: np.sort(x, axis),
+    "argsort": lambda x, axis=-1, is_ascend=True, dtype=None:
+        np.argsort(x, axis, kind="stable").astype(np.float32),
+    "one_hot": lambda i, depth=0, on_value=1.0, off_value=0.0, dtype=None:
+        np.where(np.eye(depth)[i.astype(np.int64)] > 0, on_value,
+                 off_value).astype(np.float32),
+    "where": lambda c, x, y: np.where(c != 0, x, y),
+    "slice_axis": lambda x, axis=0, begin=0, end=None:
+        np.take(x, np.arange(begin, end if end is not None
+                             else x.shape[axis]), axis=axis),
+    "space_to_depth": lambda x, block_size=1: x.reshape(
+        x.shape[0], x.shape[1], x.shape[2] // block_size, block_size,
+        x.shape[3] // block_size, block_size).transpose(
+            0, 3, 5, 1, 2, 4).reshape(
+            x.shape[0], x.shape[1] * block_size ** 2,
+            x.shape[2] // block_size, x.shape[3] // block_size),
+    # linear algebra
+    "dot": lambda a, b, transpose_a=False, transpose_b=False: np.dot(
+        a.T if transpose_a else a, b.T if transpose_b else b),
+    "batch_dot": lambda a, b, transpose_a=False, transpose_b=False:
+        np.matmul(np.swapaxes(a, -1, -2) if transpose_a else a,
+                  np.swapaxes(b, -1, -2) if transpose_b else b),
+    "FullyConnected": lambda x, w, b, num_hidden=0, no_bias=False,
+        flatten=True: x.reshape(x.shape[0], -1) @ w.T + b,
+    "_linalg_det": lambda a: np.linalg.det(a).astype(np.float32),
+    "_linalg_inverse": np.linalg.inv,
+    "_linalg_potrf": np.linalg.cholesky,
+    "_linalg_sumlogdiag": lambda a: np.log(np.diagonal(
+        a, axis1=-2, axis2=-1)).sum(-1).astype(np.float32),
+    "_linalg_extractdiag": lambda a, offset=0: np.diagonal(
+        a, offset, -2, -1),
+    "_linalg_makediag": lambda a, offset=0: np.apply_along_axis(
+        lambda v: np.diag(v, offset), -1, a),
+    "khatri_rao": lambda a, b: np.vstack(
+        [np.kron(a[:, j], b[:, j]).reshape(-1, 1)
+         for j in range(a.shape[1])]).reshape(a.shape[1], -1).T,
+    # softmax family
+    "softmax": lambda x, axis=-1, **k: _np_softmax(x, axis),
+    "softmin": lambda x, axis=-1, **k: _np_softmax(-x, axis),
+    "log_softmax": lambda x, axis=-1, **k: np.log(_np_softmax(x, axis)),
+    "SoftmaxActivation": lambda x, mode="instance": _np_softmax(x, -1),
+    "L2Normalization": lambda x, eps=1e-10, mode="instance":
+        x / np.sqrt((x.reshape(x.shape[0], -1) ** 2).sum(-1)
+                    + eps).reshape(-1, *([1] * (x.ndim - 1))),
+    "_contrib_gelu_tanh": lambda x: 0.5 * x * (1 + np.tanh(
+        np.sqrt(2 / np.pi) * (x + 0.044715 * x ** 3))),
+    # fills
+    "_zeros": lambda shape=(), dtype=None, ctx=None: np.zeros(shape),
+    "_ones": lambda shape=(), dtype=None, ctx=None: np.ones(shape),
+    "_full": lambda shape=(), value=0.0, dtype=None, ctx=None:
+        np.full(shape, value),
+    "_eye": lambda N=0, M=0, k=0, dtype=None, ctx=None:
+        np.eye(N, M or None, k),
+    "_arange": lambda start=0, stop=None, step=1.0, repeat=1, dtype=None,
+        ctx=None, infer_range=False: np.arange(start, stop, step),
+    "_linspace": lambda start=0, stop=1, num=50, endpoint=True,
+        dtype=None, ctx=None: np.linspace(start, stop, num, endpoint),
+    "Concat": lambda a, b, dim=1, num_args=0: np.concatenate([a, b], dim),
+    "stack": lambda a, b, axis=0: np.stack([a, b], axis),
+    "Pad": lambda x, mode="constant", pad_width=(), constant_value=0.0:
+        np.pad(x, [(pad_width[2 * i], pad_width[2 * i + 1])
+                   for i in range(x.ndim)], mode="constant",
+               constant_values=constant_value),
+    "Cast": lambda x, dtype="float32": x.astype(dtype),
+    "amp_cast": lambda x, dtype="float32": x.astype(dtype),
+    "Dropout": lambda x, p=0.5, **k: x,              # spec pins p=0.0
+    "take": lambda a, i, axis=0, mode="clip": np.take(
+        a, i.astype(np.int64), axis=axis),
+    "pick": lambda x, i, axis=-1, keepdims=False, mode="clip":
+        np.take_along_axis(x, i.astype(np.int64)[..., None],
+                           axis=-1).squeeze(-1),
+    "gather_nd": lambda d, i: d[tuple(i.astype(np.int64))],
+    "unravel_index": lambda x, shape=(): np.stack(
+        np.unravel_index(x.astype(np.int64), shape)),
+    "_contrib_arange_like": lambda x, start=0.0, step=1.0, repeat=1,
+        axis=None: np.arange(start, start + x.size * step,
+                             step).reshape(x.shape),
+}
+
+
+# -------------------------------------------------------------------- specs
+# Per-op canonical inputs.  An entry is dict(inputs=callable(rng) ->
+# [np arrays], kwargs={}, wrt=[indices FD-checked]); ops absent from
+# SPECS get arity-default float inputs (with _DOMAIN overrides).
+def _i8(rng, *shape):
+    return np.clip(rng.randn(*shape) * 50, -127, 127).astype(np.int8)
+
+
+_MINMAX = lambda: [np.array([-1.0], np.float32), np.array([1.0], np.float32)]
+
+SPECS = {
+    # ---------------- NN layers
+    "Activation": dict(inputs=lambda r: [_f32(r, 2, 3)]),
+    "AdaptiveAvgPooling2D": dict(inputs=lambda r: [_f32(r, 1, 2, 6, 6)],
+                                 kwargs=dict(output_size=(2, 2))),
+    "BatchNorm": dict(
+        inputs=lambda r: [_f32(r, 2, 3, 4, 4), _pos(r, 3), _f32(r, 3),
+                          _f32(r, 3), _pos(r, 3)],
+        wrt=[0, 2]),   # batch stats: moving_* unused in train fwd
+    "BilinearResize2D": dict(inputs=lambda r: [_f32(r, 1, 2, 4, 4)],
+                             kwargs=dict(height=6, width=6)),
+    "BilinearSampler": dict(
+        inputs=lambda r: [_f32(r, 1, 2, 4, 4),
+                          np.clip(r.randn(1, 2, 3, 3), -0.9,
+                                  0.9).astype(np.float32)]),
+    "CTCLoss": dict(
+        inputs=lambda r: [_f32(r, 4, 2, 5),
+                          np.array([[1, 2], [2, 1]], np.float32)],
+        wrt=[0]),
+    "Concat": dict(inputs=lambda r: [_f32(r, 2, 3), _f32(r, 2, 3)],
+                   kwargs=dict(dim=1, num_args=2)),
+    "Convolution": dict(
+        inputs=lambda r: [_f32(r, 1, 2, 5, 5), _f32(r, 3, 2, 3, 3),
+                          _f32(r, 3)],
+        kwargs=dict(kernel=(3, 3), num_filter=3)),
+    "Correlation": dict(
+        inputs=lambda r: [_f32(r, 1, 2, 4, 4), _f32(r, 1, 2, 4, 4)]),
+    "Crop": dict(inputs=lambda r: [_f32(r, 1, 2, 6, 6)],
+                 kwargs=dict(h_w=(4, 4), num_args=1)),
+    "Deconvolution": dict(
+        inputs=lambda r: [_f32(r, 1, 3, 4, 4), _f32(r, 3, 2, 3, 3)],
+        kwargs=dict(kernel=(3, 3), num_filter=2)),
+    "Dropout": dict(inputs=lambda r: [_f32(r, 2, 3)],
+                    kwargs=dict(p=0.0)),
+    "Embedding": dict(
+        inputs=lambda r: [_idx(r, 5, 2, 3), _f32(r, 5, 4)],
+        kwargs=dict(input_dim=5, output_dim=4), wrt=[1]),
+    "FullyConnected": dict(
+        inputs=lambda r: [_f32(r, 2, 3), _f32(r, 4, 3), _f32(r, 4)],
+        kwargs=dict(num_hidden=4)),
+    "GridGenerator": dict(inputs=lambda r: [_f32(r, 1, 6)],
+                          kwargs=dict(target_shape=(3, 3))),
+    "GroupNorm": dict(
+        inputs=lambda r: [_f32(r, 2, 4, 3, 3), _pos(r, 4), _f32(r, 4)],
+        kwargs=dict(num_groups=2)),
+    "InstanceNorm": dict(
+        inputs=lambda r: [_f32(r, 2, 3, 4, 4), _pos(r, 3), _f32(r, 3)]),
+    "L2Normalization": dict(inputs=lambda r: [_f32(r, 2, 3, 4)]),
+    "LRN": dict(inputs=lambda r: [_f32(r, 1, 3, 4, 4)],
+                kwargs=dict(nsize=3)),
+    "LayerNorm": dict(
+        inputs=lambda r: [_f32(r, 2, 3, 4), _pos(r, 4), _f32(r, 4)]),
+    "LeakyReLU": dict(inputs=lambda r: [
+        (np.sign(r.randn(2, 3)) * (0.3 + np.abs(r.randn(2, 3))))
+        .astype(np.float32)]),
+    "Pad": dict(inputs=lambda r: [_f32(r, 1, 2, 3, 3)],
+                kwargs=dict(mode="constant",
+                            pad_width=(0, 0, 0, 0, 1, 1, 1, 1))),
+    "Pooling": dict(inputs=lambda r: [_f32(r, 1, 2, 4, 4)],
+                    kwargs=dict(kernel=(2, 2), pool_type="avg")),
+    "RNN": dict(
+        inputs=lambda r: [_f32(r, 3, 2, 4), _f32(r, 108) * 0.1,
+                          _f32(r, 1, 2, 3), _f32(r, 1, 2, 3)],
+        kwargs=dict(state_size=3, num_layers=1, mode="lstm")),
+    "ROIAlign": dict(
+        inputs=lambda r: [_f32(r, 1, 2, 6, 6),
+                          np.array([[0, 0.5, 0.5, 3.5, 3.5],
+                                    [0, 1.0, 1.0, 4.0, 4.0]],
+                                   np.float32)],
+        kwargs=dict(pooled_size=(2, 2)), wrt=[0]),
+    "ROIPooling": dict(
+        inputs=lambda r: [_f32(r, 1, 2, 6, 6),
+                          np.array([[0, 0, 0, 3, 3]], np.float32)],
+        kwargs=dict(pooled_size=(2, 2)), wrt=[0]),
+    "Reshape": dict(inputs=lambda r: [_f32(r, 2, 3)],
+                    kwargs=dict(shape=(3, 2))),
+    "SequenceLast": dict(
+        inputs=lambda r: [_f32(r, 3, 2, 4),
+                          np.array([1.5, 2.5], np.float32)], wrt=[0]),
+    "SequenceMask": dict(
+        inputs=lambda r: [_f32(r, 3, 2, 4),
+                          np.array([1.5, 2.5], np.float32)], wrt=[0]),
+    "SequenceReverse": dict(
+        inputs=lambda r: [_f32(r, 3, 2, 4),
+                          np.array([1.5, 2.5], np.float32)], wrt=[0]),
+    "SliceChannel": dict(inputs=lambda r: [_f32(r, 2, 4, 3)],
+                         kwargs=dict(num_outputs=2, axis=1)),
+    "Softmax": dict(
+        inputs=lambda r: [_f32(r, 4, 5), _idx(r, 5, 4)], wrt=[0]),
+    "SoftmaxActivation": dict(inputs=lambda r: [_f32(r, 2, 3)]),
+    "SpatialTransformer": dict(
+        inputs=lambda r: [_f32(r, 1, 2, 5, 5),
+                          np.array([[1.0, 0.1, 0.0, -0.1, 1.0, 0.0]],
+                                   np.float32)],
+        kwargs=dict(target_shape=(4, 4))),
+    "SwapAxis": dict(inputs=lambda r: [_f32(r, 2, 3)],
+                     kwargs=dict(dim1=0, dim2=1)),
+    "UpSampling": dict(inputs=lambda r: [_f32(r, 1, 2, 3, 3)],
+                       kwargs=dict(scale=2, sample_type="nearest",
+                                   num_args=1)),
+    # ---------------- detection (forward-only; diff=False)
+    "MultiBoxPrior": dict(inputs=lambda r: [_f32(r, 1, 3, 4, 4)],
+                          kwargs=dict(sizes=(0.5,), ratios=(1.0, 2.0))),
+    "MultiBoxDetection": dict(
+        inputs=lambda r: [np.abs(r.rand(1, 2, 4)).astype(np.float32),
+                          _f32(r, 1, 16),
+                          np.abs(r.rand(1, 4, 4)).astype(np.float32)]),
+    "MultiBoxTarget": dict(
+        inputs=lambda r: [np.abs(r.rand(1, 4, 4)).astype(np.float32),
+                          np.array([[[1, 0.1, 0.1, 0.4, 0.4, 0]]],
+                                   np.float32),
+                          np.abs(r.rand(1, 2, 4)).astype(np.float32)]),
+    # ---------------- contrib
+    "_contrib_boolean_mask": dict(
+        inputs=lambda r: [_f32(r, 4, 3),
+                          np.array([1, 0, 1, 1], np.float32)]),
+    "_contrib_index_array": dict(inputs=lambda r: [_f32(r, 2, 3)]),
+    "_contrib_index_copy": dict(
+        inputs=lambda r: [_f32(r, 4, 3), np.array([1.5, 2.5], np.float32),
+                          _f32(r, 2, 3)], wrt=[0, 2]),
+    "_contrib_flash_selfatt": dict(
+        inputs=lambda r: [_f32(r, 4, 2, 12),
+                          np.array([3.5, 4.0], np.float32)],
+        kwargs=dict(heads=2), wrt=[0], rtol=3e-2, atol=3e-3),
+    "_contrib_flash_selfatt_nomask": dict(
+        inputs=lambda r: [_f32(r, 4, 2, 12)], kwargs=dict(heads=2),
+        rtol=3e-2, atol=3e-3),
+    "_contrib_interleaved_matmul_selfatt_qk": dict(
+        inputs=lambda r: [_f32(r, 4, 2, 12)], kwargs=dict(heads=2)),
+    "_contrib_interleaved_matmul_selfatt_valatt": dict(
+        inputs=lambda r: [_f32(r, 4, 2, 12), _pos(r, 4, 4, 4)],
+        kwargs=dict(heads=2)),
+    "_contrib_interleaved_matmul_encdec_qk": dict(
+        inputs=lambda r: [_f32(r, 3, 2, 8), _f32(r, 4, 2, 16)],
+        kwargs=dict(heads=2)),
+    "_contrib_interleaved_matmul_encdec_valatt": dict(
+        inputs=lambda r: [_f32(r, 4, 2, 16), _pos(r, 4, 3, 4)],
+        kwargs=dict(heads=2)),
+    "_contrib_moe_ffn": dict(
+        inputs=lambda r: [_f32(r, 4, 3), _f32(r, 3, 2), _f32(r, 2, 3, 5),
+                          _f32(r, 2, 5), _f32(r, 2, 5, 3), _f32(r, 2, 3)],
+        rtol=3e-2, atol=3e-3),
+    "_contrib_moe_top1_dispatch": dict(inputs=lambda r: [_f32(r, 4, 2)],
+                                       kwargs=dict(capacity=2)),
+    "_contrib_multi_lars": dict(
+        inputs=lambda r: [_pos(r, 3), _pos(r, 3), _pos(r, 3),
+                          _pos(r, 3)]),
+    "_contrib_arange_like": dict(inputs=lambda r: [_f32(r, 2, 3)]),
+    # ---------------- quantization (int8; forward-only, diff=False)
+    "_contrib_quantize": dict(
+        inputs=lambda r: [_f32(r, 2, 3)] + _MINMAX()),
+    "_contrib_quantize_v2": dict(inputs=lambda r: [_f32(r, 2, 3)]),
+    "_contrib_dequantize": dict(
+        inputs=lambda r: [_i8(r, 2, 3)] + _MINMAX()),
+    "_contrib_requantize": dict(
+        inputs=lambda r: [(r.randn(2, 3) * 1000).astype(np.int32)]
+        + _MINMAX()),
+    "_contrib_quantized_flatten": dict(
+        inputs=lambda r: [_i8(r, 1, 2, 3)] + _MINMAX()),
+    "_contrib_quantized_act": dict(
+        inputs=lambda r: [_i8(r, 2, 3)] + _MINMAX()),
+    "_contrib_quantized_pooling": dict(
+        inputs=lambda r: [_i8(r, 1, 2, 4, 4)] + _MINMAX(),
+        kwargs=dict(kernel=(2, 2))),
+    "_contrib_quantized_conv": dict(
+        inputs=lambda r: [_i8(r, 1, 2, 4, 4), _i8(r, 3, 2, 3, 3),
+                          (r.randn(3) * 10).astype(np.int32)]
+        + _MINMAX() * 3,
+        kwargs=dict(kernel=(3, 3), num_filter=3)),
+    "_contrib_quantized_fully_connected": dict(
+        inputs=lambda r: [_i8(r, 2, 6), _i8(r, 4, 6),
+                          (r.randn(4) * 10).astype(np.int32)]
+        + _MINMAX() * 3,
+        kwargs=dict(num_hidden=4)),
+    # ---------------- linalg
+    "_linalg_det": dict(inputs=lambda r: [_spd(r, 3)]),
+    "_linalg_slogdet": dict(inputs=lambda r: [_spd(r, 3)]),
+    "_linalg_inverse": dict(inputs=lambda r: [_spd(r, 3)]),
+    "_linalg_potrf": dict(inputs=lambda r: [_spd(r, 3)]),
+    "_linalg_potri": dict(inputs=lambda r: [_spd(r, 3)]),
+    "_linalg_sumlogdiag": dict(inputs=lambda r: [_spd(r, 3)]),
+    "_linalg_syevd": dict(inputs=lambda r: [_spd(r, 3)]),
+    "_linalg_gelqf": dict(inputs=lambda r: [_f32(r, 2, 3)]),
+    "_linalg_syrk": dict(inputs=lambda r: [_f32(r, 2, 3)]),
+    "_linalg_extractdiag": dict(inputs=lambda r: [_f32(r, 3, 3)]),
+    "_linalg_makediag": dict(inputs=lambda r: [_f32(r, 3)]),
+    "_linalg_gemm": dict(
+        inputs=lambda r: [_f32(r, 2, 3), _f32(r, 3, 4), _f32(r, 2, 4)]),
+    "_linalg_gemm2": dict(inputs=lambda r: [_f32(r, 2, 3), _f32(r, 3, 4)]),
+    "_linalg_trmm": dict(
+        inputs=lambda r: [np.tril(_spd(r, 3)), _f32(r, 3, 3)]),
+    "_linalg_trsm": dict(
+        inputs=lambda r: [np.tril(_spd(r, 3)), _f32(r, 3, 3)]),
+    # ---------------- optimizer update ops (first output = new weight)
+    "sgd_update": dict(inputs=lambda r: [_f32(r, 4), _f32(r, 4)]),
+    "signsgd_update": dict(inputs=lambda r: [_f32(r, 4), _f32(r, 4)],
+                           grad=False,
+                           grad_reason="sign() of grad: piecewise-const"),
+    "sgd_mom_update": dict(
+        inputs=lambda r: [_f32(r, 4), _f32(r, 4), _f32(r, 4)]),
+    "mp_sgd_update": dict(
+        inputs=lambda r: [_f32(r, 4), _f32(r, 4), _f32(r, 4)]),
+    "mp_sgd_mom_update": dict(
+        inputs=lambda r: [_f32(r, 4), _f32(r, 4), _f32(r, 4),
+                          _f32(r, 4)]),
+    "nag_mom_update": dict(
+        inputs=lambda r: [_f32(r, 4), _f32(r, 4), _f32(r, 4)]),
+    "signum_update": dict(
+        inputs=lambda r: [_f32(r, 4), _f32(r, 4), _f32(r, 4)],
+        grad=False, grad_reason="sign() of momentum: piecewise-const"),
+    "adam_update": dict(
+        inputs=lambda r: [_f32(r, 4), _f32(r, 4), _f32(r, 4), _pos(r, 4)]),
+    "_adamw_update": dict(
+        inputs=lambda r: [_f32(r, 4), _f32(r, 4), _f32(r, 4), _pos(r, 4),
+                          np.array([1.0], np.float32)]),
+    "ftrl_update": dict(
+        inputs=lambda r: [_f32(r, 4), _f32(r, 4), _f32(r, 4), _pos(r, 4)]),
+    "rmsprop_update": dict(
+        inputs=lambda r: [_f32(r, 4), _f32(r, 4), _pos(r, 4)]),
+    "rmspropalex_update": dict(
+        # consistent running stats: n >= g_acc^2 (true for states evolved
+        # from zero; keeps the Graves-RMSProp radicand positive so the
+        # FD check probes the smooth region)
+        inputs=lambda r: (lambda ga: [_f32(r, 4), _f32(r, 4),
+                                      ga ** 2 + _pos(r, 4), ga,
+                                      _f32(r, 4)])(_f32(r, 4))),
+    "lamb_update_phase1": dict(
+        inputs=lambda r: [_f32(r, 4), _f32(r, 4), _f32(r, 4), _pos(r, 4)]),
+    "lamb_update_phase2": dict(
+        inputs=lambda r: [_f32(r, 4), _f32(r, 4),
+                          np.array([1.3], np.float32),
+                          np.array([0.7], np.float32)]),
+    "lamb_update_states": dict(
+        inputs=lambda r: [_f32(r, 4), _f32(r, 4), _f32(r, 4), _pos(r, 4)]),
+    # ---------------- indexing / misc
+    "dot": dict(inputs=lambda r: [_f32(r, 2, 3), _f32(r, 3, 4)]),
+    "batch_dot": dict(inputs=lambda r: [_f32(r, 2, 2, 3),
+                                        _f32(r, 2, 3, 4)]),
+    "_power": dict(inputs=lambda r: [_pos(r, 2, 3), _f32(r, 2, 3)]),
+    "_rmod_scalar": dict(
+        inputs=lambda r: [(1.2 + np.abs(r.randn(2, 3)) % 1.5)
+                          .astype(np.float32)]),
+    "broadcast_mod": dict(
+        inputs=lambda r: [_f32(r, 2, 3) * 2.0,
+                          (1.5 + np.abs(r.randn(1, 3)) % 1.4)
+                          .astype(np.float32)]),
+    "take": dict(inputs=lambda r: [_f32(r, 4, 3), _idx(r, 4, 5)],
+                 wrt=[0]),
+    "pick": dict(inputs=lambda r: [_f32(r, 3, 4), _idx(r, 4, 3)],
+                 wrt=[0]),
+    "gather_nd": dict(
+        inputs=lambda r: [_f32(r, 3, 4),
+                          np.array([[0.5, 1.5], [1.5, 2.5]], np.float32)],
+        wrt=[0]),
+    "scatter_nd": dict(
+        inputs=lambda r: [_f32(r, 2, 3),
+                          np.array([[0.5, 1.5]], np.float32)],
+        kwargs=dict(shape=(2, 3)), wrt=[0]),
+    "_contrib_index_array_2": None,      # placeholder never hit
+    "one_hot": dict(inputs=lambda r: [_idx(r, 4, 3)],
+                    kwargs=dict(depth=4)),
+    "where": dict(
+        inputs=lambda r: [(r.rand(2, 3) > 0.5).astype(np.float32),
+                          _f32(r, 2, 3), _f32(r, 2, 3)], wrt=[1, 2]),
+    "softmax_cross_entropy": dict(
+        inputs=lambda r: [_f32(r, 3, 4), _idx(r, 4, 3)], wrt=[0]),
+    "broadcast_like": dict(inputs=lambda r: [_f32(r, 1, 3), _f32(r, 2, 3)],
+                           wrt=[0]),
+    "slice_like": dict(inputs=lambda r: [_f32(r, 4, 5), _f32(r, 2, 3)],
+                       wrt=[0]),
+    "broadcast_axes": dict(inputs=lambda r: [_f32(r, 1, 3)],
+                           kwargs=dict(axis=(0,), size=(4,))),
+    "broadcast_to": dict(inputs=lambda r: [_f32(r, 1, 3)],
+                         kwargs=dict(shape=(2, 3))),
+    "crop": dict(inputs=lambda r: [_f32(r, 4, 5)],
+                 kwargs=dict(begin=(1, 1), end=(3, 4))),
+    "clip": dict(inputs=lambda r: [_f32(r, 2, 3)],
+                 kwargs=dict(a_min=-0.4, a_max=0.4)),
+    "depth_to_space": dict(inputs=lambda r: [_f32(r, 1, 4, 2, 2)],
+                           kwargs=dict(block_size=2)),
+    "space_to_depth": dict(inputs=lambda r: [_f32(r, 1, 1, 4, 4)],
+                           kwargs=dict(block_size=2)),
+    "im2col": dict(inputs=lambda r: [_f32(r, 1, 2, 4, 4)],
+                   kwargs=dict(kernel=(2, 2))),
+    "col2im": dict(inputs=lambda r: [_f32(r, 1, 8, 4)],
+                   kwargs=dict(output_size=(3, 3), kernel=(2, 2))),
+    "unravel_index": dict(
+        inputs=lambda r: [np.array([1, 3, 5], np.float32)],
+        kwargs=dict(shape=(2, 3))),
+    "khatri_rao": dict(inputs=lambda r: [_f32(r, 2, 3), _f32(r, 4, 3)]),
+    "stack": dict(inputs=lambda r: [_f32(r, 2, 3), _f32(r, 2, 3)]),
+    "all_finite": dict(inputs=lambda r: [_f32(r, 2, 3), _f32(r, 3)]),
+    "amp_multicast": dict(inputs=lambda r: [_f32(r, 2, 3), _f32(r, 3)],
+                          kwargs=dict(num_outputs=2)),
+    "topk": dict(inputs=lambda r: [_f32(r, 3, 5)], kwargs=dict(k=2)),
+    "split_v2": dict(inputs=lambda r: [_f32(r, 4, 3)],
+                     kwargs=dict(indices_or_sections=2)),
+    "diag": dict(inputs=lambda r: [_f32(r, 3, 3)]),
+    "tile": dict(inputs=lambda r: [_f32(r, 2, 3)], kwargs=dict(reps=(2, 1))),
+    "repeat": dict(inputs=lambda r: [_f32(r, 2, 3)],
+                   kwargs=dict(repeats=2, axis=1)),
+    "slice_axis": dict(inputs=lambda r: [_f32(r, 4, 5)],
+                       kwargs=dict(axis=1, begin=1, end=4)),
+    "norm": dict(inputs=lambda r: [_f32(r, 2, 3)]),
+    "squeeze": dict(inputs=lambda r: [_f32(r, 2, 1, 3)]),
+    "flip": dict(inputs=lambda r: [_f32(r, 2, 3)], kwargs=dict(axis=1)),
+    "transpose": dict(inputs=lambda r: [_f32(r, 2, 3)]),
+    "expand_dims": dict(inputs=lambda r: [_f32(r, 2, 3)],
+                        kwargs=dict(axis=1)),
+    "sort": dict(inputs=lambda r: [_f32(r, 2, 5)]),
+    "argsort": dict(inputs=lambda r: [_f32(r, 2, 5)]),
+    "smooth_l1": dict(inputs=lambda r: [
+        (np.sign(r.randn(2, 3)) * (0.3 + np.abs(r.randn(2, 3)) % 0.5))
+        .astype(np.float32)]),
+    "_sample_multinomial": dict(
+        inputs=lambda r: [np.abs(r.rand(2, 4)).astype(np.float32) + 0.1]),
+    "sample_normal": dict(
+        inputs=lambda r: [_f32(r, 3), _pos(r, 3)]),
+    "sample_uniform": dict(
+        inputs=lambda r: [_f32(r, 3), _f32(r, 3) ** 2 + 1.0]),
+    "_shuffle": dict(inputs=lambda r: [_f32(r, 6)]),
+    "_sample_unique_zipfian": dict(inputs=lambda r: [],
+                                   kwargs=dict(range_max=20, shape=(2, 5))),
+    # fills: no inputs, kwargs drive
+    "_zeros": dict(inputs=lambda r: [], kwargs=dict(shape=(2, 3))),
+    "_ones": dict(inputs=lambda r: [], kwargs=dict(shape=(2, 3))),
+    "_full": dict(inputs=lambda r: [], kwargs=dict(shape=(2, 2),
+                                                   value=1.5)),
+    "_eye": dict(inputs=lambda r: [], kwargs=dict(N=3)),
+    "_arange": dict(inputs=lambda r: [], kwargs=dict(start=0, stop=5)),
+    "_linspace": dict(inputs=lambda r: [], kwargs=dict(num=7)),
+    "_random_exponential": dict(inputs=lambda r: [],
+                                kwargs=dict(shape=(2, 3))),
+    "_random_gamma": dict(inputs=lambda r: [], kwargs=dict(shape=(2, 3))),
+    "_random_negative_binomial": dict(inputs=lambda r: [],
+                                      kwargs=dict(k=3, p=0.5,
+                                                  shape=(2, 3))),
+    "_random_normal": dict(inputs=lambda r: [], kwargs=dict(shape=(2, 3))),
+    "_random_poisson": dict(inputs=lambda r: [], kwargs=dict(shape=(2, 3))),
+    "_random_randint": dict(inputs=lambda r: [],
+                            kwargs=dict(low=0, high=10, shape=(2, 3))),
+    "_random_uniform": dict(inputs=lambda r: [], kwargs=dict(shape=(2, 3))),
+}
+
+
+def _default_inputs(name, od, rng):
+    if name in _DOMAIN:
+        return [_DOMAIN[name](rng)]
+    ni = od.num_inputs
+    if ni is None:                      # variadic without a spec: 2 inputs
+        return [_f32(rng, 2, 3), _f32(rng, 2, 3)]
+    if callable(ni):
+        raise AssertionError(
+            f"op {name} has callable num_inputs and no SPECS entry — "
+            f"add one")
+    return [_f32(rng, 2, 3) for _ in range(ni)]
+
+
+def _get_spec(name, od):
+    spec = SPECS.get(name)
+    rng = _rng(name)
+    if spec is None:
+        return _default_inputs(name, od, rng), {}, None, None, 1e-2, 1e-3
+    return (spec["inputs"](rng), dict(spec.get("kwargs", {})),
+            spec.get("wrt"), spec.get("grad_reason"),
+            spec.get("rtol", 1e-2), spec.get("atol", 1e-3))
+
+
+def _to_nd(x):
+    return nd.array(x, dtype=str(x.dtype))
+
+
+def _first(outs):
+    return outs[0] if isinstance(outs, (list, tuple)) else outs
+
+
+def _run(name, np_inputs, kwargs):
+    frontend = getattr(opmod, name)
+    return frontend(*[_to_nd(x) for x in np_inputs], **kwargs)
+
+
+# --------------------------------------------------------------------- tests
+@pytest.mark.parametrize("name", CANONICAL)
+def test_forward(name):
+    if name in FWD_SKIP:
+        pytest.skip(FWD_SKIP[name])
+    od = OP_REGISTRY[name]
+    np_inputs, kwargs, _wrt, _gr, rtol, atol = _get_spec(name, od)
+    outs = _run(name, np_inputs, kwargs)
+    for o in (outs if isinstance(outs, (list, tuple)) else [outs]):
+        a = o.asnumpy()
+        assert a.size > 0 or name in ("_contrib_boolean_mask",), name
+        if np.issubdtype(a.dtype, np.floating):
+            assert np.isfinite(a).all(), f"{name}: non-finite output"
+    oracle = ORACLES.get(name)
+    if oracle is not None:
+        got = _first(outs).asnumpy()
+        want = np.asarray(oracle(*np_inputs, **kwargs))
+        assert got.shape == tuple(want.shape), \
+            f"{name}: shape {got.shape} vs oracle {want.shape}"
+        np.testing.assert_allclose(got.astype(np.float64),
+                                   want.astype(np.float64),
+                                   rtol=1e-4, atol=1e-5, err_msg=name)
+
+
+DIFF = [n for n in CANONICAL
+        if OP_REGISTRY[n].differentiable and n not in FWD_SKIP]
+
+
+@pytest.mark.parametrize("name", DIFF)
+def test_gradient(name):
+    od = OP_REGISTRY[name]
+    np_inputs, kwargs, wrt, grad_reason, rtol, atol = _get_spec(name, od)
+    spec = SPECS.get(name, {})
+    if name in GRAD_SKIP:
+        pytest.skip(GRAD_SKIP[name])
+    if spec and spec.get("grad") is False:
+        pytest.skip(spec["grad_reason"])
+    if not np_inputs:
+        pytest.skip("no array inputs (fill op)")
+    if wrt is None:
+        wrt = [i for i, x in enumerate(np_inputs)
+               if np.issubdtype(x.dtype, np.floating)]
+    if not wrt:
+        pytest.skip("no float inputs to differentiate")
+
+    from mxnet_tpu import autograd
+    from mxnet_tpu.test_utils import numeric_grad, assert_almost_equal
+
+    # fixed random projection of the first output: a plain .sum() is
+    # structurally zero-gradient for normalization ops (the normalized
+    # values sum to a constant) and would only compare FD noise
+    with autograd.train_mode():
+        out0 = _first(_run(name, np_inputs, kwargs)).asnumpy()
+    proj = np.asarray(_rng(name + "/proj").randn(*out0.shape),
+                      np.float32)
+
+    def scalar_f(wrt_vals):
+        full = list(np_inputs)
+        for i, v in zip(wrt, wrt_vals):
+            full[i] = v.astype(np.float32)
+        # train_mode: mode-dependent ops (BatchNorm) must linearize the
+        # same branch the recorded forward below uses
+        with autograd.train_mode():
+            out = _first(_run(name, full, kwargs))
+        return float((out.asnumpy().astype(np.float64) * proj).sum())
+
+    expected = numeric_grad(
+        scalar_f, [np_inputs[i].astype(np.float64) for i in wrt],
+        eps=1e-3)
+
+    nd_inputs = [_to_nd(x) for x in np_inputs]
+    for i in wrt:
+        nd_inputs[i].attach_grad()
+    with autograd.record():
+        out = _first(getattr(opmod, name)(*nd_inputs, **kwargs))
+        loss = (out * _to_nd(proj)).sum()
+    loss.backward()
+    for i, exp in zip(wrt, expected):
+        assert_almost_equal(
+            nd_inputs[i].grad.asnumpy(), exp.astype(np.float32),
+            rtol=rtol, atol=atol,
+            names=(f"{name}.grad[{i}]", f"{name}.fd[{i}]"))
+
+
+def test_blockgrad_gradient_is_zero():
+    """BlockGrad: identity forward, zero gradient BY CONTRACT (why it is
+    excluded from the FD sweep)."""
+    from mxnet_tpu import autograd
+    x = _to_nd(np.ones((2, 3), np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = (opmod.BlockGrad(x) * 3.0).sum()
+    y.backward()
+    assert float(np.abs(x.grad.asnumpy()).sum()) == 0.0
+
+
+def test_sweep_budget():
+    """The skip lists stay small and every skipped name really is a
+    registered op (a rename must not silently disable its coverage)."""
+    for k in list(FWD_SKIP) + list(GRAD_SKIP):
+        assert k in CANONICAL, f"skip-list entry {k} not in registry"
+    assert len(FWD_SKIP) <= 0.02 * len(CANONICAL)
+    n_grad_skips = len(GRAD_SKIP) + sum(
+        1 for s in SPECS.values()
+        if isinstance(s, dict) and s.get("grad") is False)
+    assert n_grad_skips <= 0.1 * len(CANONICAL), n_grad_skips
