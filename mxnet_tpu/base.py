@@ -180,9 +180,12 @@ declare_env("MXNET_FUSED_HYBRID_STEP", "1",
             "(record/backward/step at fused-step cost); 0 = always eager.")
 declare_env("MXNET_CACHED_OP_SAVE_POLICY", "dots_no_batch",
             "What the hybridized training forward saves for backward: "
-            "all | dots | dots_no_batch | none (memory/recompute dial).")
+            "all / dots / dots_no_batch / none (memory/recompute dial).")
 declare_env("MXNET_KVSTORE_BIGARRAY_BOUND", 1000000,
             "Arrays above this many elements get their own allreduce bucket.")
 declare_env("MXNET_PROFILER_AUTOSTART", 0, "Start profiler at import.")
 declare_env("MXNET_EXCEPTION_VERBOSE", 0, "Verbose async error traces.")
 declare_env("MXNET_DEFAULT_DTYPE", "float32", "Default dtype for new arrays.")
+declare_env("MXNET_TPU_DISABLE_NATIVE", "0",
+            "1 = skip building/loading the native C++ IO library and use "
+            "the pure-python RecordIO tier.")

@@ -41,7 +41,8 @@ def _load():
         if _tried:
             return _lib
         _tried = True
-        if os.environ.get("MXNET_TPU_DISABLE_NATIVE"):
+        # '0'/'' = off, like every other boolean knob
+        if os.environ.get("MXNET_TPU_DISABLE_NATIVE") not in (None, "", "0"):
             return None
         stale = (not os.path.exists(_SO) or
                  os.path.getmtime(_SO) < os.path.getmtime(_SRC))

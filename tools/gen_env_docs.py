@@ -1,0 +1,46 @@
+"""Regenerate the knob table in docs/env_vars.md from the single-source
+env registry (``mxnet_tpu.base.declare_env`` — SURVEY.md §5.6: one
+documented registry, not scattered getenv).
+
+Usage: python tools/gen_env_docs.py [--check]
+  --check: exit 1 if the committed doc is out of date (CI mode; also run
+  by tests/test_env_docs.py).
+"""
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOC = os.path.join(REPO, "docs", "env_vars.md")
+BEGIN = "<!-- BEGIN generated knob table (tools/gen_env_docs.py) -->"
+END = "<!-- END generated knob table -->"
+
+
+def render_table():
+    sys.path.insert(0, REPO)
+    import mxnet_tpu as mx
+    rows = ["| variable | default | effect |", "|---|---|---|"]
+    for name, (default, doc) in sorted(mx.base.list_env_vars().items()):
+        doc = doc.replace("|", "\\|")       # literal pipes break the table
+        rows.append(f"| `{name}` | `{default}` | {doc} |")
+    return "\n".join(rows)
+
+
+def main(check=False):
+    with open(DOC) as f:
+        text = f.read()
+    head, rest = text.split(BEGIN, 1)
+    _old, tail = rest.split(END, 1)
+    new = head + BEGIN + "\n" + render_table() + "\n" + END + tail
+    if check:
+        if new != text:
+            sys.stderr.write(
+                "docs/env_vars.md is stale — run tools/gen_env_docs.py\n")
+            return 1
+        return 0
+    with open(DOC, "w") as f:
+        f.write(new)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(check="--check" in sys.argv[1:]))
