@@ -233,6 +233,106 @@ def time_beam_decode(large=False, warmup=1, runs=5):
             "compile_ms": round(compile_ms, 2)}
 
 
+def time_input_pipeline(large=False, threads=None):
+    """ImageRecordIter end-to-end throughput (RecordIO read → JPEG decode
+    → augment → batch at 224²) vs the resnet-50 training step's
+    consumption rate (SURVEY §7.3 M4 'measure early'; reference:
+    src/io/iter_image_recordio_2.cc).  The pipeline must sustain
+    >= 1.2x the step rate or training is input-bound."""
+    import shutil
+    import tempfile
+
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, recordio, gluon, parallel
+    import mxnet_tpu.io as mxio
+
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    n_rec = 768 if large else 64
+    B = 64 if large else 16
+    # large: raw-photo-sized sources (the DCT-reduced decode fast path
+    # engages at >= 2x the resize target); small: pre-resized-style shard
+    src_hw = (540, 720) if large else (360, 480)
+    tmp = tempfile.mkdtemp(prefix="opperf_rec_")
+    try:
+        rec_path = os.path.join(tmp, "synth.rec")
+        w = recordio.MXIndexedRecordIO(rec_path + ".idx", rec_path, "w")
+        for i in range(n_rec):
+            img = rng.randint(0, 255, src_hw + (3,), dtype=np.uint8)
+            w.write_idx(i, recordio.pack_img(
+                recordio.IRHeader(0, float(i % 10), i, 0), img,
+                quality=90))
+        w.close()
+
+        threads = threads or max(1, (os.cpu_count() or 4) - 1)
+        it = mxio.ImageRecordIter(
+            path_imgrec=rec_path, data_shape=(3, 224, 224), batch_size=B,
+            shuffle=True, rand_crop=True, rand_mirror=True, resize=256,
+            preprocess_threads=threads, prefetch_buffer=4)
+
+        def epoch():
+            n = 0
+            it.reset()
+            while True:
+                try:
+                    batch = it.next()
+                except StopIteration:
+                    break
+                n += batch.data[0].shape[0]
+            return n
+
+        epoch()                                   # warm: file cache, pool
+        t0 = time.perf_counter()
+        n = epoch() + epoch()
+        imgs_per_sec = n / (time.perf_counter() - t0)
+
+        # consumption side: resnet-50 on the accelerator; a tiny
+        # resnet-18 proxy when only the CPU is available (a large CPU
+        # step would take minutes and the comparison is not meaningful)
+        on_tpu = any(d.platform != "cpu" for d in jax.devices())
+        model_name = "resnet50_v1" if (large and on_tpu) else "resnet18_v1"
+        Bs = B if (large and on_tpu) else 2
+        net = gluon.model_zoo.vision.get_model(model_name, classes=10)
+        net.initialize(mx.init.Xavier())
+        import jax.numpy as jnp
+
+        def loss_fn(outputs, y):
+            logits = outputs[0] if isinstance(outputs, (list, tuple)) \
+                else outputs
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+            return -jnp.take_along_axis(
+                logp, y[:, None].astype(jnp.int32), axis=-1).mean()
+
+        x_np = rng.randn(Bs, 3, 224, 224).astype(np.float32)
+        x = nd.array(x_np, dtype="bfloat16") if on_tpu else nd.array(x_np)
+        y = nd.array(rng.randint(0, 10, (Bs,)).astype(np.int32),
+                     dtype="int32")
+        mesh = parallel.make_mesh(dp=1, tp=1, sp=1,
+                                  devices=jax.devices()[:1])
+        tr = parallel.ShardedTrainer(
+            net, loss_fn, mesh, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.01},
+            example_inputs=(x,), n_labels=1,
+            dtype=jnp.bfloat16 if on_tpu else None)
+        for _ in range(3):
+            jax.device_get(tr.step(x, y))
+        steps = 8 if large else 3
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = tr.step(x, y)
+        jax.device_get(out)
+        step_sps = Bs * steps / (time.perf_counter() - t0)
+        return {"op": "input_pipeline", "imgs_per_sec":
+                round(imgs_per_sec, 1), "threads": threads,
+                "batch": B, "records": n_rec, "src_hw": list(src_hw),
+                "step_model": model_name,
+                "step_samples_per_sec": round(step_sps, 1),
+                "pipeline_vs_step": round(imgs_per_sec / step_sps, 2)}
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def run_performance_test(ops=None, categories=None, warmup=2, runs=10,
                          large=False):
     """Programmatic entry (reference: opperf.run_performance_test)."""
@@ -253,17 +353,31 @@ def run_performance_test(ops=None, categories=None, warmup=2, runs=10,
             results.append(time_beam_decode(large))
         except Exception as e:                        # noqa: BLE001
             results.append({"op": "beam_search", "error": str(e)[:120]})
+    if (not ops or "input_pipeline" in ops) and \
+            (not categories or "pipeline" in categories):
+        try:
+            results.append(time_input_pipeline(large))
+        except Exception as e:                        # noqa: BLE001
+            results.append({"op": "input_pipeline",
+                            "error": str(e)[:120]})
     return results
 
 
 def main():
+    # honor JAX_PLATFORMS=cpu even when a sitecustomize pre-registers an
+    # accelerator plugin (same dance as the repo-root bench.py and
+    # tests/conftest.py) — a stray opperf run must not share the TPU
+    # with a live bench
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
     ap = argparse.ArgumentParser()
     ap.add_argument("--ops", default=None,
                     help="comma-separated op names (default: all)")
     ap.add_argument("--categories", default=None,
                     help="comma-separated: elemwise,broadcast,reduce,"
                          "gemm,conv,nn,optimizer,attention,detection,"
-                         "moe,decode")
+                         "moe,decode,pipeline")
     ap.add_argument("--runs", type=int, default=10)
     ap.add_argument("--warmup", type=int, default=2)
     ap.add_argument("--large", action="store_true",

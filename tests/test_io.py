@@ -370,3 +370,57 @@ def test_pipeline_feeds_training(tmp_path):
             trainer.step(batch.data[0].shape[0])
             losses.append(float(l.asscalar()))
     assert all(np.isfinite(losses))
+
+
+def test_jpeg_dims_header_scan():
+    """_jpeg_dims reads SOF dimensions without decoding; non-JPEG
+    payloads return None (decode then falls back to full IMREAD_COLOR)."""
+    import cv2
+    from mxnet_tpu.io.io import _jpeg_dims
+    rng = np.random.RandomState(0)
+    for hw in ((540, 720), (37, 61), (256, 256)):
+        img = rng.randint(0, 255, hw + (3,), np.uint8)
+        ok, enc = cv2.imencode(".jpg", img,
+                               [cv2.IMWRITE_JPEG_QUALITY, 90])
+        assert ok
+        assert _jpeg_dims(enc.tobytes()) == hw
+    ok, enc = cv2.imencode(".png", rng.randint(0, 255, (8, 9, 3),
+                                               np.uint8))
+    assert _jpeg_dims(enc.tobytes()) is None
+
+
+def test_reduced_decode_matches_full_decode(tmp_path):
+    """The DCT-reduced decode fast path (source >= 2x resize target)
+    must produce images close to the full-decode + resize reference."""
+    import cv2
+    rng = np.random.RandomState(1)
+    prefix = str(tmp_path / "big")
+    writer = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec",
+                                        "w")
+    raws = []
+    for i in range(4):
+        # smooth natural-ish image (pure noise is the DCT worst case)
+        base = rng.randint(0, 255, (68, 90, 3), np.uint8)
+        img = cv2.resize(base, (720, 540),
+                         interpolation=cv2.INTER_CUBIC)
+        raws.append(img)
+        writer.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img, quality=95,
+            img_fmt=".jpg"))
+    writer.close()
+    it = io.ImageRecordIter(path_imgrec=prefix + ".rec",
+                            path_imgidx=prefix + ".idx",
+                            data_shape=(3, 224, 224), batch_size=4,
+                            resize=256, shuffle=False)
+    got = next(it).data[0].asnumpy()
+    assert got.shape == (4, 3, 224, 224)
+    for i, raw in enumerate(raws):
+        # reference: full-resolution source, resize short side to 256,
+        # center crop (q95 encode noise is within the tolerance)
+        h, w = raw.shape[:2]
+        ref = cv2.resize(raw, (int(w * 256 / h), 256))
+        y, x = (256 - 224) // 2, (ref.shape[1] - 224) // 2
+        ref = ref[y:y + 224, x:x + 224, ::-1]        # BGR->RGB
+        ref = np.transpose(ref, (2, 0, 1)).astype(np.float32)
+        diff = np.abs(got[i] - ref).mean()
+        assert diff < 8.0, (i, diff)
