@@ -184,11 +184,13 @@ def phase_hybrid(env):
         head.cast("bfloat16")
     step_blk = env.models.BERTPretrainLoss(head)
     step_blk.hybridize(static_alloc=True)
-    # multi_precision=True: fp32 master weights (the robust user
-    # recipe; measured no slower than bf16 moments on the v5e)
+    # pure-bf16 recipe (no fp32 masters), matching what the fused and
+    # sharded phases run: in the ONE-program step the fp32
+    # master+moment traffic costs ~16B/param of HBM per step — the
+    # dominant tax once the residual round trip is gone
     gtrainer = gluon.Trainer(
         head.collect_params(), "adamw",
-        {"learning_rate": 1e-4, "multi_precision": env.on_tpu})
+        {"learning_rate": 1e-4, "multi_precision": False})
     feats, labels = _mlm_batch(env.nd, env.rng, env.cfg["vocab_size"],
                                env.B, env.L)
     n_params = sum(int(np.prod(p.shape))

@@ -113,7 +113,8 @@ class TapeNode:
     """
 
     __slots__ = ("fn", "input_entries", "n_outputs", "out_grads", "name",
-                 "_pending", "custom_backward", "key", "fused_info")
+                 "_pending", "custom_backward", "key", "fused_info",
+                 "out_avals")
 
     def __init__(self, fn: Callable, input_entries, n_outputs: int,
                  name: str = "", custom_backward: Optional[Callable] = None,
@@ -128,6 +129,7 @@ class TapeNode:
         # determined by it — lets the bulk backward cache compiled replay
         # programs across tapes (engine bulk-exec).  None = not bulkable.
         self.key = key
+        self.out_avals = None
         self._pending = 0
         # set by CachedOp on recorded dispatch: exposes (bwd_impl, res)
         # so Trainer.step can fuse backward+optimizer into one program
@@ -505,14 +507,18 @@ def _replay(root_nodes, leaf_acc, _leaf_contribute):
 
 
 def _node_out_avals(node: TapeNode):
-    """Output abstract values, recovered lazily from live output refs or by
-    abstract eval of the node fn."""
+    """Output abstract values: stashed at record time for custom nodes
+    (a per-step eval_shape costs ~10ms of host time on the fused-step
+    hot path), else recovered by abstract eval of the node fn."""
+    if node.out_avals is not None:
+        return node.out_avals
     in_avals = [jax.ShapeDtypeStruct(e[2].shape, e[2]._data.dtype)
                 for e in node.input_entries]
     outs = jax.eval_shape(node.fn, *in_avals)
     if node.n_outputs == 1 and not isinstance(outs, (tuple, list)):
-        return [outs]
-    return list(outs)
+        outs = [outs]
+    node.out_avals = list(outs)
+    return node.out_avals
 
 
 def _write_grad(arr, g):
@@ -830,6 +836,7 @@ def record_custom_node(inputs, outputs, custom_backward, name=""):
                     n_outputs=len(outputs), name=name,
                     custom_backward=custom_backward)
     avals = [jax.ShapeDtypeStruct(o.shape, o._data.dtype) for o in outputs]
+    node.out_avals = list(avals)
     node.fn = lambda *xs: tuple(
         jax.numpy.zeros(a.shape, a.dtype) for a in avals)
     for i, o in enumerate(outputs):

@@ -178,9 +178,20 @@ declare_env("MXNET_FUSED_HYBRID_STEP", "1",
             "Fuse a deferred single-CachedOp backward with the optimizer "
             "update into one donated program in Trainer.step "
             "(record/backward/step at fused-step cost); 0 = always eager.")
+declare_env("MXNET_DEFERRED_HYBRID_FWD", "1",
+            "Defer a hybridized training forward so Trainer.step can "
+            "compile forward+backward+optimizer into ONE donated program "
+            "(any output read before step materializes the standalone "
+            "forward); 0 = always dispatch the forward eagerly.")
 declare_env("MXNET_CACHED_OP_SAVE_POLICY", "dots_no_batch",
             "What the hybridized training forward saves for backward: "
             "all / dots / dots_no_batch / none (memory/recompute dial).")
+declare_env("MXNET_FUSED_STEP_SAVE_POLICY", "auto",
+            "Save policy INSIDE the one-program fused step: 'auto' "
+            "(default) AOT-probes the save-everything variant's peak "
+            "memory and uses it when it fits (reclaims the checkpoint "
+            "recompute tax), else falls back to the CachedOp policy; "
+            "or force all / dots / dots_no_batch / none / inherit.")
 declare_env("MXNET_KVSTORE_BIGARRAY_BOUND", 1000000,
             "Arrays above this many elements get their own allreduce bucket.")
 declare_env("MXNET_PROFILER_AUTOSTART", 0, "Start profiler at import.")

@@ -89,7 +89,23 @@ class Engine:
     def wait_for_all(self):
         """Block until all tracked arrays are ready (reference:
         Engine::WaitForAll / mx.nd.waitall)."""
+        import jax
         for arr in list(self._live.values()):
+            # dense arrays only: sparse NDArrays' _data is a densifying
+            # property the sweep must not trigger
+            if not hasattr(arr, "_components"):
+                d = arr._data
+                if not isinstance(d, jax.Array):
+                    if arr._lazy_cb is None:
+                        # husk of a failed fused step: its error was
+                        # already raised synchronously at step(); direct
+                        # reads still raise via the var's stored exception
+                        continue
+                    # else: pending deferred forward — materialize below
+                elif getattr(d, "is_deleted", None) and d.is_deleted():
+                    # donated away (stale alias of an updated buffer):
+                    # no pending compute to wait on
+                    continue
             try:
                 arr.wait_to_read()
             except Exception:

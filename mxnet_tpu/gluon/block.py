@@ -423,30 +423,86 @@ class CachedOp:
         flat_outputs = outs[:meta["n_flat_out"]]
         aux_values = outs[meta["n_flat_out"]:]
         for p, v in zip(meta["aux_params"], aux_values):
-            update_aux_state(p, v, ctx=None)
+            if v._lazy_cb is None:      # deferred forward writes aux at
+                update_aux_state(p, v, ctx=None)   # materialization/step
         return _unflatten(flat_outputs, meta["tree"])
 
     def _call_recorded(self, meta, all_in, n_out, ctx):
         """Training-mode dispatch: one forward program that also emits the
         vjp residuals, so backward is one cached program with NO forward
         recompute (reference: CachedOp caches fwd and bwd graphs and keeps
-        the saved-tensor buffers between them)."""
-        from .. import autograd
-        for a in all_in:
-            a._var.check()
-        raw = meta["fwd_rec"](*[a._data for a in all_in])
-        vis, res = raw[:n_out], raw[n_out:]
-        outs = [NDArray(o, ctx=all_in[1].context if len(all_in) > 1
-                        else None) for o in vis]
-        consumed = [False]
+        the saved-tensor buffers between them).
 
-        def custom_backward(out_grads, in_primals, _meta=meta, _res=res):
+        Deferred-forward mode (after the first recorded call per
+        signature): the forward is NOT dispatched here — outputs are
+        lazy NDArrays and ``Trainer.step`` compiles
+        forward+backward+optimizer into ONE donated program (the
+        residuals never round-trip HBM between programs).  Any read of
+        an output before step materializes the standalone forward and
+        everything degrades to exactly the eager-forward behavior."""
+        from .. import autograd
+        from ..base import get_env
+        from ..engine import engine, is_naive
+        for a in all_in:
+            if a._lazy_cb is not None:
+                a._lazy_materialize()
+            a._var.check()
+        out_ctx = all_in[1].context if len(all_in) > 1 else None
+        consumed = [False]
+        res_holder = [None]
+        fwd_pending = [False]
+
+        defer = (meta.get("out_avals") is not None
+                 and not is_naive()
+                 and get_env("MXNET_FUSED_HYBRID_STEP", "1") != "0"
+                 and get_env("MXNET_DEFERRED_HYBRID_FWD", "1") != "0")
+        if defer:
+            fwd_pending[0] = True
+            raw_in = [a._data for a in all_in]
+            outs = [NDArray._deferred(av, None, ctx=out_ctx)
+                    for av in meta["out_avals"]]
+
+            def materialize_fwd(_meta=meta, _raw_in=raw_in):
+                """Idempotent standalone-forward fallback (any read
+                before step, or a step that can't fuse)."""
+                if not fwd_pending[0]:
+                    return
+                fwd_pending[0] = False
+                raw = _meta["fwd_rec"](*_raw_in)
+                res_holder[0] = raw[n_out:]
+                for o, v in zip(outs, raw[:n_out]):
+                    o._lazy_cb = None
+                    o._set_data(v)
+                for p, v in zip(_meta["aux_params"],
+                                raw[_meta["n_flat_out"]:n_out]):
+                    update_aux_state(p, NDArray(v), ctx=None)
+
+            for o in outs:
+                o._lazy_cb = materialize_fwd
+        else:
+            raw_in = None
+            raw = meta["fwd_rec"](*[a._data for a in all_in])
+            vis = raw[:n_out]
+            res_holder[0] = raw[n_out:]
+            if meta.get("out_avals") is None:
+                # unlock deferral from the next recorded call on: the
+                # first call runs eagerly so build errors surface here
+                meta["out_avals"] = [jax.ShapeDtypeStruct(v.shape, v.dtype)
+                                     for v in vis]
+            outs = [NDArray(o, ctx=out_ctx) for o in vis]
+
+            def materialize_fwd():
+                return None
+
+        def custom_backward(out_grads, in_primals, _meta=meta):
+            materialize_fwd()             # deferred fwd: run it standalone
             if consumed[0]:
                 raise MXNetError(
                     "backward through this hybridized graph a second "
                     "time: the saved buffers were freed after the first "
                     "pass — call every earlier backward with "
                     "retain_graph=True")
+            _res = res_holder[0]
             if autograd.in_retain_backward():
                 grads = _meta["bwd_res_retain"](_res, tuple(out_grads))
             else:
@@ -465,12 +521,21 @@ class CachedOp:
         node = autograd.record_custom_node(
             all_in, outs, custom_backward,
             name=f"cached_op_{self._block.name}")
-        # fusion hook: Trainer.step may compile this backward together
-        # with the optimizer update into one donated program (see
+        # fusion hook: Trainer.step may compile this backward (and, when
+        # the forward is still pending, the forward too) together with
+        # the optimizer update into one donated program (see
         # autograd.backward deferral / Trainer._try_fused_hybrid_step)
-        node.fused_info = {"bwd_impl": meta["bwd_impl"], "res": res,
-                           "consumed": consumed}
-        from ..engine import engine, is_naive
+        node.fused_info = {"bwd_impl": meta["bwd_impl"],
+                           "res_holder": res_holder,
+                           "consumed": consumed,
+                           "fwd_pending": fwd_pending,
+                           "materialize_fwd": materialize_fwd,
+                           "fwd_bwd_impl": meta.get("fwd_bwd_impl"),
+                           "fwd_bwd_factory": meta.get("fwd_bwd_factory"),
+                           "raw_in": raw_in,
+                           "outs": outs,
+                           "aux_params": meta["aux_params"],
+                           "n_flat_out": meta["n_flat_out"]}
         eng = engine()
         if is_naive():
             for o in outs:
@@ -568,7 +633,30 @@ class CachedOp:
             # inputs+params only; _call_recorded prepends None for the key
             return vjp_fn(tuple(cots))
 
+        def _make_fwd_bwd_impl(p):
+            def fwd_bwd_impl(key, arrays, cots):
+                """Whole fwd+bwd as one traceable body (un-jitted): the
+                deferred-forward step fusion embeds this next to the
+                optimizer update so residuals stay program-internal."""
+                fn = lambda *arr: pure(key, *arr)      # noqa: E731
+                if p is not None:
+                    fn = jax.checkpoint(fn, policy=p)
+                outs, vjp_fn = jax.vjp(fn, *arrays)
+                grads = vjp_fn(tuple(cots))
+                return outs, grads
+            return fwd_bwd_impl
+
+        # the ONE-program step can afford a more generous save policy
+        # than the two-program path (residuals are program-internal,
+        # freed as consumed, not materialized program outputs) — the
+        # factory lets Trainer pick per MXNET_FUSED_STEP_SAVE_POLICY,
+        # including the memory-probed 'auto' mode
+        fwd_bwd_impl = _make_fwd_bwd_impl(policy)
+
         meta["fwd_rec"] = fwd_rec
+        meta["fwd_bwd_impl"] = fwd_bwd_impl
+        meta["fwd_bwd_factory"] = \
+            lambda name: _make_fwd_bwd_impl(policies.get(str(name), policy))
         meta["bwd_impl"] = bwd_impl        # un-jitted: Trainer step fusion
         # residuals are dead after one replay: donating them lets XLA free
         # each saved tensor as soon as its consuming bwd op runs (the

@@ -45,6 +45,64 @@ def _state_write_back(dst, new):
         return
     dst._set_data(new)
 
+
+def _fused_hyper_refresh(entry, o, params_ordered):
+    """Per-step ts/lr/wd/rescale upload with staleness guards — shared
+    by the one-program and two-program fused step paths (any divergence
+    here silently desynchronizes optimizer schedules between them)."""
+    import jax.numpy as jnp
+    counts = [o._index_update_count[i] for i, _p in params_ordered]
+    if entry.get("ts") is None or entry.get("counts") != counts:
+        entry["ts"] = jnp.asarray([float(c) for c in counts], jnp.float32)
+    entry["counts"] = [c + 1 for c in counts]
+    lrs_py = tuple(float(o._get_lr(i)) for i, _p in params_ordered)
+    wds_py = tuple(float(o._get_wd(i)) for i, _p in params_ordered)
+    rs_py = float(o.rescale_grad)
+    if entry.get("hyper") != (lrs_py, wds_py, rs_py):
+        entry["lrs"] = jnp.asarray(lrs_py, jnp.float32)
+        entry["wds"] = jnp.asarray(wds_py, jnp.float32)
+        entry["rescale"] = jnp.float32(rs_py)
+        entry["hyper"] = (lrs_py, wds_py, rs_py)
+    return counts
+
+
+def _fused_rollback(o, params_ordered, prev_num_update, entry, counts):
+    """A failed fused step never applied: rewind per-index counts AND
+    num_update (advanced via max() in _update_count) so lr schedules
+    don't run one step ahead."""
+    for i, _p in params_ordered:
+        o._index_update_count[i] -= 1
+    o.num_update = prev_num_update
+    entry["counts"] = counts
+    entry["ts"] = None
+
+
+def _device_capacity_bytes(dev):
+    """Usable accelerator memory, from runtime stats when available,
+    else a device-kind table (the axon tunnel reports no memory_stats).
+    None = unknown (callers must then choose the memory-safe path)."""
+    try:
+        stats = dev.memory_stats()
+    except Exception:       # noqa: BLE001
+        stats = None
+    if stats and stats.get("bytes_limit"):
+        return float(stats["bytes_limit"])
+    kind = getattr(dev, "device_kind", "").lower()
+    if "lite" in kind or "v5e" in kind:
+        return 16e9
+    if "v5p" in kind:
+        return 95e9
+    if "v4" in kind:
+        return 32e9         # megacore: one jax device per 32GB chip
+    if "v3" in kind:
+        return 16e9         # one jax device per TensorCore, 16GB each
+    if "v2" in kind:
+        return 8e9
+    if dev.platform == "cpu":
+        return 8e9          # CI-scale assumption; tiny models only
+    return None
+
+
 __all__ = ["Trainer"]
 
 
@@ -275,18 +333,31 @@ class Trainer:
         params_ordered = [param_slots[ei] for ei in order]
         weights = [p.data()._data for _i, p in params_ordered]
         states = [_state_raw(upd.states[i]) for i, _p in params_ordered]
-        res = info["res"]
         from ..autograd import _node_out_avals
         avals = _node_out_avals(node)
         cots = [g if g is not None else jnp.zeros(a.shape, a.dtype)
                 for g, a in zip(node.out_grads, avals)]
 
+        # deferred forward still pending: try the ONE-program path
+        # (forward+backward+optimizer; residuals never leave the program)
+        if (info.get("fwd_pending") or [False])[0] \
+                and info.get("fwd_bwd_impl") is not None:
+            handled = self._try_full_fused_step(
+                node, info, params_ordered, order, other_slots,
+                weights, states, cots)
+            if handled:
+                return True
+            # clean bail: run the standalone forward, then fall through
+            # to the two-program backward+optimizer fusion below
+
+        info["materialize_fwd"]()
+        res = info["res_holder"][0]
+
+        # cheap cache key: jax.jit re-traces on any aval change, so the
+        # per-param shape/dtype signature would only duplicate that at
+        # ~10ms host time per step
         key = (id(info["bwd_impl"]), type(o), o._fused_key(),
-               tuple(order), tuple(other_slots),
-               tuple((tuple(w.shape), str(w.dtype),
-                      _state_sig(upd.states[i]))
-                     for (i, _p), w in zip(params_ordered, weights)),
-               tuple((tuple(c.shape), str(c.dtype)) for c in cots))
+               tuple(order), tuple(other_slots))
         from collections import OrderedDict
         cache = getattr(self, "_fused_step_progs", None)
         if cache is None:
@@ -304,6 +375,12 @@ class Trainer:
         if entry is None:
             bwd_impl = info["bwd_impl"]
             n_entries = len(entries)
+            # grad-buffer dtypes baked in: cast INSIDE the program (an
+            # eager convert per parameter per step otherwise)
+            g_dtypes = tuple(p.data()._grad._data.dtype
+                             for _i, p in params_ordered)
+            og_dtypes = tuple(entries[ei][2]._grad._data.dtype
+                              for ei in other_slots)
 
             def body(res, cots, weights, states, ts, lrs, wds, rescale):
                 grads_all = bwd_impl(list(res), tuple(cots))
@@ -314,8 +391,12 @@ class Trainer:
                                           lrs[k], wds[k], rescale)
                     new_w.append(nw)
                     new_s.append(ns)
-                    pgrads.append(g)
-                ograds = [grads_all[ei - 1] for ei in other_slots]
+                    pgrads.append(g.astype(g_dtypes[k])
+                                  if g.dtype != g_dtypes[k] else g)
+                ograds = [grads_all[ei - 1].astype(og_dtypes[k])
+                          if grads_all[ei - 1].dtype != og_dtypes[k]
+                          else grads_all[ei - 1]
+                          for k, ei in enumerate(other_slots)]
                 return new_w, new_s, ts + 1.0, pgrads, ograds
 
             # donate residuals (dead after this), weights, states, ts:
@@ -328,19 +409,7 @@ class Trainer:
             while len(cache) > 8:
                 cache.popitem(last=False)
 
-        counts = [o._index_update_count[i] for i, _p in params_ordered]
-        if entry.get("ts") is None or entry.get("counts") != counts:
-            entry["ts"] = jnp.asarray([float(c) for c in counts],
-                                      jnp.float32)
-        entry["counts"] = [c + 1 for c in counts]
-        lrs_py = tuple(float(o._get_lr(i)) for i, _p in params_ordered)
-        wds_py = tuple(float(o._get_wd(i)) for i, _p in params_ordered)
-        rs_py = float(o.rescale_grad)
-        if entry.get("hyper") != (lrs_py, wds_py, rs_py):
-            entry["lrs"] = jnp.asarray(lrs_py, jnp.float32)
-            entry["wds"] = jnp.asarray(wds_py, jnp.float32)
-            entry["rescale"] = jnp.float32(rs_py)
-            entry["hyper"] = (lrs_py, wds_py, rs_py)
+        counts = _fused_hyper_refresh(entry, o, params_ordered)
 
         try:
             import warnings
@@ -356,12 +425,8 @@ class Trainer:
                     entry["lrs"], entry["wds"], entry["rescale"])
         except BaseException as e:
             # the failed step never applied: never advance schedules
-            # (num_update advanced via max() in _update_count, so the
-            # index decrement alone leaves lr schedules one step ahead)
-            for i, _p in params_ordered:
-                o._index_update_count[i] -= 1
-            o.num_update = prev_num_update
-            entry["counts"] = counts
+            _fused_rollback(o, params_ordered, prev_num_update,
+                            entry, counts)
             entry["ts"] = None
             consumed = any(
                 getattr(a, "is_deleted", lambda: False)()
@@ -394,16 +459,256 @@ class Trainer:
         autograd.clear_pending()
         info["consumed"][0] = True      # residuals donated: no replay
         for (i, p), nw, ns, g in zip(params_ordered, new_w, new_s, pgrads):
-            p.data()._set_data(nw)
+            pd = p.data()
+            pd._set_data(nw)
             _state_write_back(upd.states[i], ns)
-            p.data()._grad._set_data(
-                jnp.asarray(g, dtype=p.data()._grad._data.dtype)
-                if g.dtype != p.data()._grad._data.dtype else g)
+            gb = pd._grad
+            gb._set_data(g if g.dtype == gb._data.dtype
+                         else jnp.asarray(g, dtype=gb._data.dtype))
         for ei, g in zip(other_slots, ograds):
-            arr = entries[ei][2]
-            arr._grad._set_data(
-                g if g.dtype == arr._grad._data.dtype
-                else jnp.asarray(g, dtype=arr._grad._data.dtype))
+            gb = entries[ei][2]._grad
+            gb._set_data(g if g.dtype == gb._data.dtype
+                         else jnp.asarray(g, dtype=gb._data.dtype))
+        return True
+
+    def _pick_fused_program(self, info, fpol, make_body, key_arr,
+                            nonparams, cots, weights, states):
+        """Resolve the save policy for the one-program step and return
+        (fwd_bwd_impl, callable program).
+
+        'auto' (the default) AOT-compiles the save-everything variant
+        and checks its fitted peak memory against the device capacity:
+        save-all reclaims the checkpoint recompute tax (measured +10-15%
+        MFU on BERT-large) but would OOM AFTER donation on memory-tight
+        models, so it is only chosen when the compiler-reported peak
+        fits with margin.  Any probe failure falls back to the
+        CachedOp's (memory-safe) policy."""
+        import jax
+        import jax.numpy as jnp
+
+        factory = info.get("fwd_bwd_factory")
+        safe_impl = info["fwd_bwd_impl"]
+        if factory is None or fpol == "inherit":
+            return safe_impl, jax.jit(make_body(safe_impl),
+                                      donate_argnums=(3, 4, 5))
+        if fpol != "auto":
+            impl = factory(fpol)
+            return impl, jax.jit(make_body(impl), donate_argnums=(3, 4, 5))
+
+        try:
+            # capacity first: with no capacity estimate the probe result
+            # is unusable and the AOT compile (minutes at BERT-large
+            # scale) would be pure waste
+            cap = _device_capacity_bytes(jax.devices()[0])
+            if cap is None:
+                return safe_impl, jax.jit(make_body(safe_impl),
+                                          donate_argnums=(3, 4, 5))
+            impl_all = factory("all")
+            jitted = jax.jit(make_body(impl_all), donate_argnums=(3, 4, 5))
+            aval = jax.ShapeDtypeStruct
+            n = len(weights)
+            lowered = jitted.lower(
+                key_arr, nonparams, cots, weights, states,
+                aval((n,), jnp.float32), aval((n,), jnp.float32),
+                aval((n,), jnp.float32), aval((), jnp.float32))
+            compiled = lowered.compile()
+            ma = compiled.memory_analysis()
+            peak = (ma.argument_size_in_bytes + ma.output_size_in_bytes
+                    + ma.temp_size_in_bytes - ma.alias_size_in_bytes)
+            if peak <= 0.9 * cap:
+                # AOT executables are shape-monomorphic, which is fine:
+                # a shape change means a new CachedOp signature and
+                # therefore a new entry
+                return impl_all, compiled
+        except Exception:       # noqa: BLE001 — any probe failure: safe
+            pass
+        return safe_impl, jax.jit(make_body(safe_impl),
+                                  donate_argnums=(3, 4, 5))
+
+    def _try_full_fused_step(self, node, info, params_ordered, order,
+                             other_slots, weights, states, cots):
+        """Deferred-forward fusion: forward+backward+optimizer compiled
+        as ONE donated program — the three-call recipe at ShardedTrainer
+        shape (no residual HBM round trip between programs).
+
+        Returns True on success.  Returns None to fall back cleanly: the
+        forward has NOT run and no state was touched, so the caller's
+        two-program (or eager) path proceeds normally.  Raises MXNetError
+        only when the program failed after buffer donation."""
+        import warnings
+
+        import jax
+        import jax.numpy as jnp
+
+        from .. import autograd
+        from .block import update_aux_state
+
+        o = self._optimizer
+        upd = self._updater
+        entries = node.input_entries
+        n_entries = len(entries)
+        pset = set(order)
+        nonparam_slots = [ei for ei in range(1, n_entries)
+                          if ei not in pset]
+        # the record-time snapshot, NOT live buffers: an input (or param)
+        # mutated in place between record() and step() must not change
+        # what this step computes — eager and the materialize_fwd
+        # fallback both use the recorded values
+        raw_in = info["raw_in"]
+        key_arr = raw_in[0]
+        nonparams = [raw_in[ei] for ei in nonparam_slots]
+        weights = [raw_in[ei] for ei in order]
+
+        from ..base import get_env
+        fpol = str(get_env("MXNET_FUSED_STEP_SAVE_POLICY", "auto"))
+        # cheap cache key: jax.jit itself re-traces on any aval change,
+        # so per-param shape/dtype signatures here would only duplicate
+        # that at ~10ms of host time per step (the fused path is
+        # host-latency sensitive — one python step per ~20ms of chip)
+        key = ("full", id(info["fwd_bwd_impl"]), fpol, type(o),
+               o._fused_key(), tuple(order), tuple(other_slots),
+               tuple(nonparam_slots))
+        from collections import OrderedDict
+        cache = getattr(self, "_fused_step_progs", None)
+        if cache is None:
+            cache = self._fused_step_progs = OrderedDict()
+        entry = cache.get(key)
+        if entry is not None:
+            cache.move_to_end(key)
+            if entry.get("broken"):
+                return None                 # negative-cached failing build
+        prev_num_update = o.num_update
+        for i, _p in params_ordered:
+            o._update_count(i)
+        if entry is None:
+            ne = n_entries
+            p_slots = tuple(order)
+            np_slots = tuple(nonparam_slots)
+            # grad-buffer dtypes baked in: casting INSIDE the program
+            # replaces one eager convert dispatch per parameter per step
+            # (~400 host round trips at BERT-large scale)
+            g_dtypes = tuple(p.data()._grad._data.dtype
+                             for _i, p in params_ordered)
+            og_dtypes = tuple(entries[ei][2]._grad._data.dtype
+                              for ei in other_slots)
+
+            def make_body(fwd_bwd):
+                def body(key, nonparams, cots, weights, states, ts, lrs,
+                         wds, rescale):
+                    arrays = [None] * (ne - 1)
+                    for k, ei in enumerate(p_slots):
+                        arrays[ei - 1] = weights[k]
+                    for k, ei in enumerate(np_slots):
+                        arrays[ei - 1] = nonparams[k]
+                    outs, grads_all = fwd_bwd(key, arrays, tuple(cots))
+                    new_w, new_s, pgrads = [], [], []
+                    for k, ei in enumerate(p_slots):
+                        g = grads_all[ei - 1]
+                        nw, ns = o._fused_one(weights[k], g, states[k],
+                                              ts[k], lrs[k], wds[k],
+                                              rescale)
+                        new_w.append(nw)
+                        new_s.append(ns)
+                        pgrads.append(g.astype(g_dtypes[k])
+                                      if g.dtype != g_dtypes[k] else g)
+                    ograds = [grads_all[ei - 1].astype(og_dtypes[k])
+                              if grads_all[ei - 1].dtype != og_dtypes[k]
+                              else grads_all[ei - 1]
+                              for k, ei in enumerate(other_slots)]
+                    return (list(outs), new_w, new_s, ts + 1.0, pgrads,
+                            ograds)
+                return body
+
+            # donate weights/states/ts: params update in place at the
+            # memory level.  Inputs and cotangents are NOT donated (user
+            # arrays may be reused across steps).
+            fwd_bwd, prog = self._pick_fused_program(
+                info, fpol, make_body, key_arr, nonparams, cots,
+                weights, states)
+            # pin BOTH impls: the cache key uses id(info["fwd_bwd_impl"])
+            # and a recycled id after CachedOp-LRU eviction would hit a
+            # stale shape-monomorphic entry
+            entry = {"prog": prog,
+                     "keepalive": (fwd_bwd, info["fwd_bwd_impl"])}
+            cache[key] = entry
+            while len(cache) > 8:
+                cache.popitem(last=False)
+
+        counts = _fused_hyper_refresh(entry, o, params_ordered)
+
+        try:
+            with warnings.catch_warnings():
+                warnings.filterwarnings(
+                    "ignore",
+                    message="Some donated buffers were not usable")
+                new_outs, new_w, new_s, new_ts, pgrads, ograds = \
+                    entry["prog"](key_arr, nonparams, cots, weights,
+                                  states, entry["ts"], entry["lrs"],
+                                  entry["wds"], entry["rescale"])
+        except BaseException as e:
+            # the failed step never applied: never advance schedules
+            _fused_rollback(o, params_ordered, prev_num_update,
+                            entry, counts)
+            consumed_bufs = any(
+                getattr(a, "is_deleted", lambda: False)()
+                for a in jax.tree_util.tree_leaves((weights, states)))
+            if not consumed_bufs and isinstance(e, Exception):
+                # pre-donation failure (trace/compile): nothing ran, the
+                # deferred forward is untouched — negative-cache a
+                # never-succeeded build and fall back
+                if not entry.get("succeeded"):
+                    entry["broken"] = True
+                    warnings.warn(
+                        f"one-program hybrid step disabled for this "
+                        f"signature (falling back to the two-program "
+                        f"path): {e!r}", stacklevel=2)
+                return None
+            # donation happened: weights/states are gone and the deferred
+            # outputs can never materialize.  Store the error on each
+            # output's var (reference: exception-on-var) — direct reads
+            # raise it, while the waitall sweep skips these husks (the
+            # failure below is already raised synchronously here)
+            autograd.clear_pending()
+            info["consumed"][0] = True
+            info["fwd_pending"][0] = False
+            for out in info.get("outs") or []:
+                if out._lazy_cb is not None:
+                    out._lazy_cb = None
+                    out._var.set_exception(MXNetError(
+                        "this output's producing fused step failed after "
+                        f"donation; reload parameters.  Cause: {e!r}"))
+            if isinstance(e, Exception):
+                raise MXNetError(
+                    "fused hybrid step failed after dispatch; weight and "
+                    "optimizer-state buffers were donated to the failed "
+                    "program and may be deleted.  Reload parameters "
+                    f"before continuing.  Cause: {e!r}") from e
+            raise   # KeyboardInterrupt/SystemExit propagate as-is
+
+        entry["ts"] = new_ts
+        entry["succeeded"] = True
+        autograd.clear_pending()
+        info["consumed"][0] = True
+        info["fwd_pending"][0] = False
+        outs_nd = info.get("outs") or []
+        for out, v in zip(outs_nd, new_outs):
+            out._lazy_cb = None
+            out._set_data(v)
+        n_flat = info["n_flat_out"]
+        for p, v in zip(info["aux_params"], new_outs[n_flat:]):
+            update_aux_state(p, v, ctx=None)
+        for (i, p), nw, ns, g in zip(params_ordered, new_w, new_s,
+                                     pgrads):
+            pd = p.data()
+            pd._set_data(nw)
+            _state_write_back(upd.states[i], ns)
+            gb = pd._grad
+            gb._set_data(g if g.dtype == gb._data.dtype
+                         else jnp.asarray(g, dtype=gb._data.dtype))
+        for ei, g in zip(other_slots, ograds):
+            gb = entries[ei][2]._grad
+            gb._set_data(g if g.dtype == gb._data.dtype
+                         else jnp.asarray(g, dtype=gb._data.dtype))
         return True
 
     # ------------------------------------------------------- fused update

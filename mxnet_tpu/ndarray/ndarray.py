@@ -46,7 +46,7 @@ class NDArray:
     """Multi-dimensional array on a device (see module docstring)."""
 
     __slots__ = ("_data", "_ctx", "_var", "_grad", "_grad_req",
-                 "_autograd_node", "__weakref__")
+                 "_autograd_node", "_lazy_cb", "__weakref__")
 
     # NumPy interop precedence so ndarray + NDArray defers to us
     __array_priority__ = 1000.0
@@ -69,7 +69,31 @@ class NDArray:
         self._grad = None
         self._grad_req = "null"
         self._autograd_node = None
+        self._lazy_cb = None
         engine().track(self)
+
+    @classmethod
+    def _deferred(cls, aval, materialize_cb, ctx=None):
+        """A lazy NDArray: ``_data`` holds a jax.ShapeDtypeStruct (so
+        shape/dtype/size/ndim work) until ``materialize_cb`` fills the
+        real value — the engine-style async handle behind CachedOp's
+        deferred forward (reference: every NDArray was such a future
+        under the ThreadedEngine; reads blocked at WaitToRead)."""
+        obj = cls.__new__(cls)
+        obj._data = aval
+        obj._ctx = ctx
+        obj._var = Var()
+        obj._grad = None
+        obj._grad_req = "null"
+        obj._autograd_node = None
+        obj._lazy_cb = materialize_cb
+        engine().track(obj)
+        return obj
+
+    def _lazy_materialize(self):
+        cb, self._lazy_cb = self._lazy_cb, None
+        if cb is not None:
+            cb()        # fills _data (for every output of the program)
 
     # ------------------------------------------------------------------ data
     @property
@@ -152,6 +176,8 @@ class NDArray:
     def wait_to_read(self):
         """Block until computed; re-raise any deferred async error
         (reference: NDArray::WaitToRead + exception-on-var rethrow)."""
+        if self._lazy_cb is not None:
+            self._lazy_materialize()               # deferred forward
         from .. import autograd
         if autograd._STATE.pending is not None:
             autograd.flush_if_pending_grad(self)   # stale grad-alias read
@@ -228,6 +254,8 @@ class NDArray:
 
     # ------------------------------------------------------------- placement
     def as_in_context(self, ctx: Context) -> "NDArray":
+        if self._lazy_cb is not None:
+            self._lazy_materialize()
         if ctx == self.context:
             return self
         return NDArray(jax.device_put(self._data, ctx.jax_device()), ctx=ctx)
@@ -237,6 +265,8 @@ class NDArray:
     def copyto(self, other):
         """Copy into another NDArray (writes it) or onto a Context
         (reference: NDArray::CopyFromTo / ndarray.py copyto)."""
+        if self._lazy_cb is not None:
+            self._lazy_materialize()
         if isinstance(other, Context):
             return NDArray(jax.device_put(self._data, other.jax_device()),
                            ctx=other)
@@ -248,9 +278,13 @@ class NDArray:
         raise MXNetError(f"copyto: unsupported target {type(other)}")
 
     def copy(self) -> "NDArray":
+        if self._lazy_cb is not None:
+            self._lazy_materialize()
         return NDArray(self._data, ctx=self._ctx)
 
     def detach(self) -> "NDArray":
+        if self._lazy_cb is not None:
+            self._lazy_materialize()
         out = NDArray(self._data, ctx=self._ctx)
         return out
 
@@ -417,9 +451,13 @@ class NDArray:
         if autograd.is_recording() and self._in_grad_graph():
             op = OpDef("getitem", lambda x: x[key], 1, 1, True)
             return invoke(op, [self], {})
+        if self._lazy_cb is not None:
+            self._lazy_materialize()
         return NDArray(self._data[key], ctx=self._ctx)
 
     def __setitem__(self, key, value):
+        if self._lazy_cb is not None:
+            self._lazy_materialize()
         key = self._normalize_index(key)
         if isinstance(value, NDArray):
             value = value._data

@@ -52,6 +52,7 @@ class BaseSparseNDArray(NDArray):
         self._grad = None
         self._grad_req = "null"
         self._autograd_node = None
+        self._lazy_cb = None
         engine().track(self)
 
     # -- the dense fallback hook -------------------------------------------

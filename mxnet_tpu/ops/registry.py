@@ -173,6 +173,8 @@ def invoke(opdef: OpDef, inputs, kwargs: Dict[str, Any], out=None):
     pend = autograd.peek_pending()
     for a in inputs:
         if isinstance(a, NDArray):
+            if a._lazy_cb is not None:
+                a._lazy_materialize()   # deferred forward consumed eagerly
             if pend is not None and id(a) in pend["grad_ids"]:
                 # consuming a deferred-backward grad buffer as an op input
                 # (e.g. clip_global_norm over hoisted grad aliases) must

@@ -336,6 +336,67 @@ class TestFusedHybridStep:
         mx.waitall()
         assert autograd.peek_pending() is None
 
+    def test_deferred_forward_compiles_one_program(self):
+        """From the second recorded call on, record/backward/step runs
+        as ONE fwd+bwd+opt program: the 'full' entry appears in the
+        step-program cache and the loss is only materialized by step."""
+        rng = np.random.RandomState(9)
+        net, blk = self._build(31)
+        tr = gluon.Trainer(net.collect_params(), "adam",
+                           {"learning_rate": 1e-2})
+        x = nd.array(rng.randn(8, 4).astype(np.float32))
+        y = nd.array(rng.randn(8, 1).astype(np.float32))
+        losses = []
+        for it in range(3):
+            with autograd.record():
+                l = blk(x, y)
+            if it > 0:
+                # deferred: the loss is an unmaterialized lazy array
+                assert l._lazy_cb is not None
+            l.backward()
+            tr.step(8)
+            # step materialized it (full fusion) or flushed (fallback)
+            assert l._lazy_cb is None
+            losses.append(float(l.asnumpy()))
+        assert any(isinstance(k, tuple) and k and k[0] == "full"
+                   for k in tr._fused_step_progs), \
+            "full fwd+bwd+opt fusion never engaged"
+        assert losses[0] > losses[-1]     # it's really training
+        # grads were written (contract: .grad stays observable)
+        for p in net.collect_params().values():
+            if p.grad_req != "null":
+                assert np.isfinite(p.grad().asnumpy()).all()
+
+    def test_deferred_forward_read_before_step_materializes(self):
+        """Reading the loss between backward() and step() falls back to
+        the standalone forward with identical numbers."""
+        rng = np.random.RandomState(10)
+        X = rng.randn(8, 4).astype(np.float32)
+        Y = rng.randn(8, 1).astype(np.float32)
+        out = {}
+        for read_early in (False, True):
+            net, blk = self._build(32)
+            tr = gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 1e-2})
+            vals = []
+            for _ in range(3):
+                x, y = nd.array(X), nd.array(Y)
+                with autograd.record():
+                    l = blk(x, y)
+                l.backward()
+                if read_early:
+                    vals.append(float(l.asscalar()))   # materializes
+                tr.step(8)
+                if not read_early:
+                    vals.append(float(l.asscalar()))
+            out[read_early] = (vals, [p.data().asnumpy().copy()
+                                      for p in
+                                      net.collect_params().values()])
+        np.testing.assert_allclose(out[True][0], out[False][0],
+                                   rtol=1e-5, atol=1e-6)
+        for a, b in zip(out[True][1], out[False][1]):
+            np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
     def test_hoisted_grad_alias_sees_fresh_grads(self):
         """Grad-buffer aliases hoisted out of the loop (``grads =
         [p.grad() for p in params]``) must observe THIS step's gradients
@@ -410,18 +471,25 @@ class TestFusedHybridStep:
         with autograd.record():
             l = blk(x, y)
         l.backward()
-        tr.step(8)                                  # builds fused entry
+        tr.step(8)              # first call: eager fwd + bwd-only entry
+        with autograd.record():
+            l = blk(x, y)
+        l.backward()
+        tr.step(8)              # deferred fwd: builds the FULL entry
         o = tr._optimizer
         counts_before = dict(o._index_update_count)
         num_update_before = o.num_update
 
-        entry = next(e for e in tr._fused_step_progs.values()
-                     if e.get("prog") is not None)
+        entry = next(e for k, e in tr._fused_step_progs.items()
+                     if isinstance(k, tuple) and k and k[0] == "full")
 
-        def failing_prog(res, cots, weights, states, ts, lrs, wds,
-                         rescale):
-            for a in jax.tree_util.tree_leaves((res, weights, states)):
-                a.delete()                          # donated + consumed
+        def failing_prog(*args):
+            # signature-agnostic: works for both the two-program entry
+            # (res, cots, weights, ...) and the one-program full-fusion
+            # entry (key, nonparams, cots, weights, states, ...)
+            for a in jax.tree_util.tree_leaves(args):
+                if hasattr(a, "delete"):
+                    a.delete()                      # donated + consumed
             raise RuntimeError("synthetic post-dispatch failure")
 
         real_prog = entry["prog"]
