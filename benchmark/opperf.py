@@ -305,7 +305,11 @@ def time_input_pipeline(large=False, threads=None):
                 logp, y[:, None].astype(jnp.int32), axis=-1).mean()
 
         x_np = rng.randn(Bs, 3, 224, 224).astype(np.float32)
-        x = nd.array(x_np, dtype="bfloat16") if on_tpu else nd.array(x_np)
+        # functionalize's eager pass runs against fp32 params, so the
+        # example input stays fp32; the stepped input is bf16 to match
+        # the trainer's bf16-cast params on TPU
+        x32 = nd.array(x_np)
+        x = nd.array(x_np, dtype="bfloat16") if on_tpu else x32
         y = nd.array(rng.randint(0, 10, (Bs,)).astype(np.int32),
                      dtype="int32")
         mesh = parallel.make_mesh(dp=1, tp=1, sp=1,
@@ -313,7 +317,7 @@ def time_input_pipeline(large=False, threads=None):
         tr = parallel.ShardedTrainer(
             net, loss_fn, mesh, optimizer="sgd",
             optimizer_params={"learning_rate": 0.01},
-            example_inputs=(x,), n_labels=1,
+            example_inputs=(x32,), n_labels=1,
             dtype=jnp.bfloat16 if on_tpu else None)
         for _ in range(3):
             jax.device_get(tr.step(x, y))
