@@ -40,6 +40,19 @@ native_build() {
     g++ -O2 -shared -fPIC -o mxnet_tpu/lib/libmxnet_tpu_native.so \
         mxnet_tpu/lib/src/nativelib.cc
     python -m pytest tests/test_native.py -x -q
+    # the framework-free PJRT consumer of exported StableHLO artifacts
+    # (docs/frontends.md §2); header from the bundled XLA includes
+    PJRT_INC=$(python - <<'PY'
+import os, tensorflow
+print(os.path.join(os.path.dirname(tensorflow.__file__), "include"))
+PY
+)
+    g++ -O2 -std=c++17 -I"$PJRT_INC" -o mxnet_tpu/lib/shlo_runner \
+        mxnet_tpu/lib/src/shlo_runner.cc -ldl
+    # end-to-end artifact run needs a PJRT plugin; opt-in via env
+    if [ -n "${MXNET_TEST_PJRT_PLUGIN:-}" ]; then
+        python -m pytest tests/test_shlo_runner.py -x -q
+    fi
 }
 
 examples_smoke() {
