@@ -61,6 +61,7 @@ examples_smoke() {
     python examples/ssd_detection.py --iters 40
     python examples/nmt_transformer.py --epochs 1 --min-match 0
     python examples/train_imagenet.py --iters 10 --model resnet18_v1
+    python examples/bert_squad.py --steps 20 --batch 8
 }
 
 bench_cpu() {
