@@ -22,13 +22,18 @@ The training step runs the user-facing three-call recipe — which the
 framework compiles into ONE donated fwd+bwd+opt program.
 
 Usage:
-  python examples/bert_squad.py --steps 300
-  python examples/bert_squad.py --params pretrained.params   # ckpt import
+  python examples/bert_squad.py                        # tiny, EM -> 1.0
+  python examples/bert_squad.py --min-em 0.9           # convergence gate
+  python examples/bert_squad.py --bert-params pre.params   # ckpt import
 """
 import argparse
+import os
+import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import mxnet_tpu as mx
 from mxnet_tpu import autograd, gluon, lr_scheduler, nd
@@ -104,29 +109,45 @@ def main():
     ap.add_argument("--batch", type=int, default=32)
     ap.add_argument("--vocab", type=int, default=64)
     ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--bert-params", default=None,
+                    help="pretrained BERT encoder .params to import "
+                         "(the checkpoint-import surface of config 3; "
+                         "saved via bert.save_parameters — dims must "
+                         "match the --units/--layers/... flags)")
     ap.add_argument("--params", default=None,
-                    help="pretrained BERT .params to import (the "
-                         "checkpoint-import surface of config 3)")
+                    help="fine-tuned qa .params (as written by --save) "
+                         "to resume from")
     ap.add_argument("--save", default=None,
                     help="write fine-tuned params here")
+    ap.add_argument("--units", type=int, default=128)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--hidden", type=int, default=512)
+    ap.add_argument("--min-em", type=float, default=0.0,
+                    help="exit non-zero below this final exact-match "
+                         "(CI smoke passes 0; convergence runs 0.9)")
     args = ap.parse_args()
 
     mx.random.seed(0)
     rng = np.random.RandomState(0)
 
-    # BERT-base-shaped but tiny so the example converges on CPU too;
-    # pass a real checkpoint with --params for the full-size model
+    # tiny by default so the example converges on CPU; pass
+    # --units 768 --layers 12 --heads 12 --hidden 3072 --vocab 30522
+    # (and a matching --bert-params checkpoint) for the full-size model
     bert = models.get_bert_model(
-        model_name="bert_12_768_12", vocab_size=args.vocab, units=128,
-        hidden_size=512, num_layers=2, num_heads=4, max_length=128,
+        model_name="bert_12_768_12", vocab_size=args.vocab,
+        units=args.units, hidden_size=args.hidden,
+        num_layers=args.layers, num_heads=args.heads, max_length=128,
         dropout=0.0)
     bert.initialize(mx.init.Normal(0.02))
-    if args.params:
-        bert.load_parameters(args.params, allow_missing=True,
-                             ignore_extra=True)
-        print(f"imported checkpoint {args.params}")
+    if args.bert_params:
+        bert.load_parameters(args.bert_params)       # strict: loud mismatch
+        print(f"imported pretrained encoder {args.bert_params}")
     qa = models.BERTForQA(bert)
     qa.initialize(mx.init.Normal(0.02))
+    if args.params:
+        qa.load_parameters(args.params)              # --save round trip
+        print(f"resumed fine-tuned checkpoint {args.params}")
     step_blk = SpanLoss(qa)
     step_blk.hybridize(static_alloc=True)
 
@@ -163,8 +184,8 @@ def main():
     if args.save:
         qa.save_parameters(args.save)
         print(f"saved {args.save}")
-    return em
+    return 0 if em >= args.min_em else 1
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())
