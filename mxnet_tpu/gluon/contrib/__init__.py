@@ -1,8 +1,9 @@
 """Gluon contrib (reference: python/mxnet/gluon/contrib/)."""
 from . import estimator
 from . import nn
-from . import rnn
+from . import detection, rnn
 from .fused import FusedTrainStep
 from .moe import MoEFFN
 
-__all__ = ["estimator", "nn", "rnn", "FusedTrainStep", "MoEFFN"]
+__all__ = ["detection", "estimator", "nn", "rnn",
+           "FusedTrainStep", "MoEFFN"]
