@@ -19,12 +19,21 @@ from .._jax_compat import shard_map, to_varying
 __all__ = ["ring_attention", "ring_self_attention"]
 
 
-def _ring_attention_local(q, k, v, q_pos, k_pos, axis_name, causal, scale):
+def _ring_attention_local(q, k, v, q_pos, k_pos, axis_name, causal, scale,
+                          window=None):
     """Per-device body under shard_map.
 
     q (B, H, Lq, D); k/v (B, H, Lk, D); *_pos (Lq,)/(Lk,) global token
     positions (positions travel with the rotating kv so causal masking
     stays correct on every hop).
+
+    ``window``: causal sliding window — key positions in
+    ``(q_pos - window, q_pos]`` attend.  Ring hops whose rotating KV
+    block lies entirely outside every local query's band SKIP their
+    attention compute via ``lax.cond`` (the rotation itself still runs:
+    the ring schedule is fixed); with S shards and window W, each
+    device pays for ~``ceil(W / L_loc) + 1`` hops of compute instead
+    of S.
     """
     axis_size = lax.psum(1, axis_name)
     B, H, Lq, D = q.shape
@@ -37,12 +46,13 @@ def _ring_attention_local(q, k, v, q_pos, k_pos, axis_name, causal, scale):
     # the loop carry becomes varying, so pre-cast the initial carry
     m0, l0, acc0 = (to_varying(x, axis_name) for x in (m0, l0, acc0))
 
-    def body(i, carry):
-        m, l, acc, k, v, k_pos = carry
+    def attend(m, l, acc, k, v, k_pos):
         s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                        preferred_element_type=jnp.float32) * scale
         if causal:
             mask = k_pos[None, :] > q_pos[:, None]        # (Lq, Lk)
+            if window is not None:
+                mask = mask | (k_pos[None, :] <= q_pos[:, None] - window)
             s = jnp.where(mask[None, None], neg_inf, s)
         m_new = jnp.maximum(m, s.max(axis=-1))
         p = jnp.exp(s - m_new[..., None])
@@ -50,12 +60,28 @@ def _ring_attention_local(q, k, v, q_pos, k_pos, axis_name, causal, scale):
         l_new = l * corr + p.sum(axis=-1)
         acc_new = acc * corr[..., None] + jnp.einsum(
             "bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+        return m_new, l_new, acc_new
+
+    def body(i, carry):
+        m, l, acc, k, v, k_pos = carry
+        if window is None:
+            m, l, acc = attend(m, l, acc, k, v, k_pos)
+        else:
+            # band-overlap test for THIS hop's kv block: any (q, k)
+            # with q - window < k_pos <= q_pos?
+            needed = (jnp.min(k_pos) <= jnp.max(q_pos)) & \
+                (jnp.max(k_pos) > jnp.min(q_pos) - window)
+            m, l, acc = lax.cond(
+                needed,
+                lambda args: attend(*args, k, v, k_pos),
+                lambda args: args,
+                (m, l, acc))
         # rotate kv (and its positions) one hop around the ring
         perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
         k = lax.ppermute(k, axis_name, perm)
         v = lax.ppermute(v, axis_name, perm)
         k_pos = lax.ppermute(k_pos, axis_name, perm)
-        return m_new, l_new, acc_new, k, v, k_pos
+        return m, l, acc, k, v, k_pos
 
     m, l, acc, _, _, _ = lax.fori_loop(
         0, axis_size, body, (m0, l0, acc0, k, v, k_pos))
@@ -63,9 +89,23 @@ def _ring_attention_local(q, k, v, q_pos, k_pos, axis_name, causal, scale):
     return out.astype(q.dtype)
 
 
-def ring_attention(q, k, v, mesh: Mesh, axis_name="sp", causal=False):
+def ring_attention(q, k, v, mesh: Mesh, axis_name="sp", causal=False,
+                   window=None):
     """Sharded attention over sequence: q/k/v (B, H, L, D) with L sharded
-    on ``axis_name``.  Returns (B, H, L, D) with the same sharding."""
+    on ``axis_name``.  Returns (B, H, L, D) with the same sharding.
+
+    ``window``: causal sliding-window width (key positions in
+    ``(q - window, q]``); requires ``causal=True``.  Out-of-band ring
+    hops skip their attention compute, so cost scales with the window,
+    not the full context."""
+    if window is not None:
+        from ..base import MXNetError
+        if not causal:
+            raise MXNetError("ring_attention: window= requires "
+                             "causal=True (sliding-window attention is "
+                             "causal)")
+        if int(window) < 1:
+            raise MXNetError("ring_attention: window must be >= 1")
     n = mesh.shape[axis_name]
     B, H, L, D = q.shape
     scale = 1.0 / (D ** 0.5)
@@ -76,7 +116,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name="sp", causal=False):
 
     def local_fn(q, k, v, q_pos, k_pos):
         return _ring_attention_local(q, k, v, q_pos, k_pos, axis_name,
-                                     causal, scale)
+                                     causal, scale, window=window)
 
     fn = shard_map(
         local_fn, mesh=mesh,
@@ -86,7 +126,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name="sp", causal=False):
 
 
 def ring_self_attention(x, w_qkv, w_out, num_heads, mesh, axis_name="sp",
-                        causal=True):
+                        causal=True, window=None):
     """x (B, L, C) sequence-sharded -> same; projections computed locally
     (they're pointwise over sequence)."""
     B, L, C = x.shape
@@ -96,6 +136,6 @@ def ring_self_attention(x, w_qkv, w_out, num_heads, mesh, axis_name="sp",
     q = qkv[:, :, 0].transpose(0, 2, 1, 3)
     k = qkv[:, :, 1].transpose(0, 2, 1, 3)
     v = qkv[:, :, 2].transpose(0, 2, 1, 3)
-    out = ring_attention(q, k, v, mesh, axis_name, causal)
+    out = ring_attention(q, k, v, mesh, axis_name, causal, window=window)
     out = out.transpose(0, 2, 1, 3).reshape(B, L, C)
     return jnp.einsum("blc,oc->blo", out, w_out)

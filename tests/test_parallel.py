@@ -195,3 +195,42 @@ def test_sharded_trainer_updates_batchnorm_stats_preserves_frozen():
     np.testing.assert_allclose(
         np.asarray(jax.device_get(tr2.params[wname])), w0, rtol=1e-6,
         err_msg="frozen param was eroded by the sharded optimizer")
+
+
+def test_ring_attention_windowed_matches_dense():
+    """Sliding-window ring attention (out-of-band hops skip compute)
+    matches the dense windowed oracle; window >= L degenerates to
+    plain causal."""
+    mesh = parallel.make_mesh(dp=1, tp=1, sp=8)
+    B, H, L, D = 2, 2, 32, 8
+    rng = np.random.RandomState(1)
+    q = rng.randn(B, H, L, D).astype(np.float32)
+    k = rng.randn(B, H, L, D).astype(np.float32)
+    v = rng.randn(B, H, L, D).astype(np.float32)
+
+    def dense_ref(window):
+        s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+        qi = np.arange(L)[:, None]
+        ki = np.arange(L)[None, :]
+        dead = (ki > qi) | (ki <= qi - window)
+        s[:, :, dead] = -1e30
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        return np.einsum("bhqk,bhkd->bhqd", p, v)
+
+    for window in (4, 7, 16, 64):
+        out = np.asarray(parallel.ring_attention(
+            jnp.array(q), jnp.array(k), jnp.array(v), mesh, "sp",
+            causal=True, window=window))
+        assert np.abs(out - dense_ref(window)).max() < 1e-4, window
+
+    import pytest as _pytest
+    from mxnet_tpu.base import MXNetError
+    with _pytest.raises(MXNetError, match="causal"):
+        parallel.ring_attention(jnp.array(q), jnp.array(k),
+                                jnp.array(v), mesh, "sp", causal=False,
+                                window=4)
+    with _pytest.raises(MXNetError, match=">= 1"):
+        parallel.ring_attention(jnp.array(q), jnp.array(k),
+                                jnp.array(v), mesh, "sp", causal=True,
+                                window=0)
