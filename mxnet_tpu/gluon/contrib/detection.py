@@ -127,10 +127,13 @@ def box_iou(a, b):
 
 
 def encode_deltas(anchors, gt):
-    """Box regression targets (tx,ty,tw,th) — R-CNN parameterization."""
+    """Box regression targets (tx,ty,tw,th) — R-CNN parameterization.
+    Degenerate (zero-area) anchors/rois are clamped so they encode to
+    finite garbage rather than inf/nan — callers mask them out, and
+    0 * inf would poison the loss otherwise."""
     import jax.numpy as jnp
-    aw = anchors[..., 2] - anchors[..., 0]
-    ah = anchors[..., 3] - anchors[..., 1]
+    aw = jnp.clip(anchors[..., 2] - anchors[..., 0], 1e-6)
+    ah = jnp.clip(anchors[..., 3] - anchors[..., 1], 1e-6)
     ax = anchors[..., 0] + aw / 2
     ay = anchors[..., 1] + ah / 2
     gw = jnp.clip(gt[..., 2] - gt[..., 0], 1e-6)
@@ -178,6 +181,25 @@ def nms_static(boxes, scores, topk, iou_thr=0.7):
     live0 = jnp.ones(scores.shape[0], bool)
     _, (idx, keep) = jax.lax.scan(body, live0, None, length=topk)
     return boxes[idx], jnp.where(keep, scores[idx], -jnp.inf), keep
+
+
+def _match_gt(boxes, gt_boxes):
+    """IoU-match fixed boxes against (possibly zero-area-padded) gt:
+    -> (best_iou (N,), best_gt (N,)).  Shared by the RPN and ROI-head
+    target assignment so the matching rule cannot drift between them."""
+    import jax.numpy as jnp
+    iou = box_iou(boxes, gt_boxes)
+    valid_gt = (gt_boxes[:, 2] > gt_boxes[:, 0]) & \
+        (gt_boxes[:, 3] > gt_boxes[:, 1])
+    iou = jnp.where(valid_gt[None, :], iou, 0.0)
+    return iou.max(axis=1), iou.argmax(axis=1)
+
+
+def _smooth_l1(diff):
+    """Huber/smooth-L1 summed over the last axis."""
+    import jax.numpy as jnp
+    return jnp.where(jnp.abs(diff) < 1.0, 0.5 * diff * diff,
+                     jnp.abs(diff) - 0.5).sum(axis=-1)
 
 
 def fpn_level_index(w, h, n_levels, base_level=3):
@@ -269,7 +291,10 @@ class FasterRCNN(HybridBlock):
         return levels, anchors, obj, reg
 
     def proposals(self, anchors, obj, reg):
-        """Static top-k + NMS per image -> rois (B, post, 4), scores."""
+        """Static top-k + NMS per image -> (rois (B, post, 4),
+        scores (B, post), keep (B, post)).  Slots past the NMS survivors
+        hold DUPLICATES of the top box with score -inf and keep=False —
+        consumers must respect the mask."""
         import jax
         import jax.numpy as jnp
         anchors_j = jnp.asarray(anchors)
@@ -281,44 +306,53 @@ class FasterRCNN(HybridBlock):
             boxes = jnp.clip(boxes,
                              jnp.zeros(4, jnp.float32),
                              jnp.array([W, H, W, H], jnp.float32))
-            b, s, keep = nms_static(boxes, score, self._post)
-            return b, s
+            return nms_static(boxes, score, self._post)
 
         return jax.vmap(one)(obj._data, reg._data)
 
     def roi_align(self, levels, rois):
         """FPN level assignment by box scale + ROIAlign (GluonCV
         ``_pyramid_roi_feats``): all levels aligned, one gathered.
-        ``rois``: raw (B, R, 4) jnp array."""
+        ``rois``: raw (B, R, 4) jnp array.  Dispatched as ONE op through
+        the registry so the autograd tape links the output to the FPN
+        feature maps — the second-stage gradient must reach the
+        FPN/backbone, not stop at the align."""
         from ... import nd
         import jax.numpy as jnp
-        rois = jnp.asarray(rois)
-        B, R = rois.shape[0], rois.shape[1]
-        w = rois[..., 2] - rois[..., 0]
-        h = rois[..., 3] - rois[..., 1]
-        lvl = fpn_level_index(w, h, len(levels))
-        batch_ix = jnp.broadcast_to(
-            jnp.arange(B, dtype=jnp.float32)[:, None], (B, R))
-        flat = jnp.concatenate([batch_ix.reshape(-1, 1),
-                                rois.reshape(-1, 4)], axis=1)   # (BR, 5)
-        per_level = []
-        for i, f in enumerate(levels):
-            al = nd.ROIAlign(f, nd.NDArray(flat),
-                             pooled_size=(self._roi, self._roi),
-                             spatial_scale=1.0 / self.anchors.strides[i])
-            per_level.append(al._data)
-        stacked = jnp.stack(per_level, axis=0)       # (L, BR, C, r, r)
-        sel = jnp.take_along_axis(
-            stacked, lvl.reshape(1, -1, 1, 1, 1).astype(jnp.int32),
-            axis=0)[0]
-        return nd.NDArray(sel)                        # (BR, C, r, r)
+        from ...ops.registry import LightOpDef, invoke, get_op
+
+        roi_fn = get_op("ROIAlign").fn
+        strides = self.anchors.strides
+        r = self._roi
+        n_levels = len(levels)
+
+        def fn(rois_j, *feats):
+            B, R = rois_j.shape[0], rois_j.shape[1]
+            w = rois_j[..., 2] - rois_j[..., 0]
+            h = rois_j[..., 3] - rois_j[..., 1]
+            lvl = fpn_level_index(w, h, n_levels)
+            batch_ix = jnp.broadcast_to(
+                jnp.arange(B, dtype=jnp.float32)[:, None], (B, R))
+            flat = jnp.concatenate([batch_ix.reshape(-1, 1),
+                                    rois_j.reshape(-1, 4)], axis=1)
+            per_level = [
+                roi_fn(f, flat, pooled_size=(r, r),
+                       spatial_scale=1.0 / strides[i])
+                for i, f in enumerate(feats)]
+            stacked = jnp.stack(per_level, axis=0)   # (L, BR, C, r, r)
+            return jnp.take_along_axis(
+                stacked, lvl.reshape(1, -1, 1, 1, 1).astype(jnp.int32),
+                axis=0)[0]
+
+        op = LightOpDef("pyramid_roi_align", fn, 1 + n_levels, 1, True)
+        return invoke(op, [nd.NDArray(jnp.asarray(rois)), *levels], {})
 
     def hybrid_forward(self, F, x):
         """Inference: -> (class scores (B,R,nc+1), boxes (B,R,nc,4),
         roi scores (B,R))."""
         from ... import nd
         levels, anchors, obj, reg = self.rpn_forward(x)
-        rois, rscores = self.proposals(anchors, obj, reg)
+        rois, rscores, _keep = self.proposals(anchors, obj, reg)
         roi_feats = self.roi_align(levels, rois)
         cls, deltas = self.box_head(roi_feats)
         B, R = rois.shape[0], rois.shape[1]
@@ -336,12 +370,7 @@ class FasterRCNN(HybridBlock):
         static (pad with zero-area boxes)."""
         import jax.numpy as jnp
         anchors = jnp.asarray(anchors)
-        iou = box_iou(anchors, gt_boxes)                # (N, G)
-        valid_gt = (gt_boxes[:, 2] > gt_boxes[:, 0]) & \
-            (gt_boxes[:, 3] > gt_boxes[:, 1])
-        iou = jnp.where(valid_gt[None, :], iou, 0.0)
-        best_iou = iou.max(axis=1)
-        best_gt = iou.argmax(axis=1)
+        best_iou, best_gt = _match_gt(anchors, gt_boxes)
         pos = best_iou >= pos_iou
         neg = best_iou < neg_iou
         obj_t = pos.astype(jnp.float32)
@@ -363,9 +392,7 @@ class FasterRCNN(HybridBlock):
             bce = jnp.maximum(o, 0) - o * obj_t + \
                 jnp.log1p(jnp.exp(-jnp.abs(o)))
             cls_l = (bce * obj_m).sum() / jnp.clip(obj_m.sum(), 1.0)
-            d = r - delta_t
-            sl1 = jnp.where(jnp.abs(d) < 1.0, 0.5 * d * d,
-                            jnp.abs(d) - 0.5).sum(axis=-1)
+            sl1 = _smooth_l1(r - delta_t)
             reg_l = (sl1 * pos).sum() / jnp.clip(pos.sum(), 1.0)
             return cls_l + reg_l
 
@@ -374,3 +401,69 @@ class FasterRCNN(HybridBlock):
 
         op = LightOpDef("rpn_loss", fn, 3, 1, True)
         return invoke(op, [obj, reg, gt_boxes], {})
+
+    def rcnn_targets(self, rois, gt_boxes, gt_classes, fg_iou=0.5):
+        """Per-image second-stage targets over FIXED rois (R,4):
+        (cls_target (R,) int — 0=background, 1..nc=fg;
+         delta_target (R,4); fg_mask (R,)).  gt_classes are 1-based
+        foreground ids; padded gt rows have zero area and never match."""
+        import jax.numpy as jnp
+        best_iou, best_gt = _match_gt(rois, gt_boxes)
+        fg = best_iou >= fg_iou
+        cls_t = jnp.where(fg, gt_classes[best_gt], 0).astype(jnp.int32)
+        delta_t = encode_deltas(rois, gt_boxes[best_gt])
+        return cls_t, delta_t, fg.astype(jnp.float32)
+
+    def rcnn_loss(self, levels, rois, gt_boxes, gt_classes, keep=None):
+        """Second-stage loss over the proposals: softmax CE over
+        nc+1 classes + smooth-L1 on the matched class's deltas for
+        foreground rois.  ``rois`` (B,R,4) raw jnp (treated as fixed
+        samples — no gradient flows into the proposal coordinates,
+        matching the two-stage training convention); ``keep`` (B,R) is
+        the NMS validity mask from ``proposals`` — suppressed slots
+        hold duplicates of the top box and must not be counted as
+        extra training samples.  The head computation is dispatched
+        through the op registry so the tape records it end to end
+        (roi_align links back to the FPN features)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ...ops.registry import LightOpDef, invoke
+        from ... import nd
+
+        rois = jnp.asarray(rois)
+        B, R = rois.shape[0], rois.shape[1]
+        if keep is None:
+            keep = jnp.ones((B, R), bool)
+        roi_feats = self.roi_align(levels, rois)        # (BR, C, r, r)
+        cls, deltas = self.box_head(roi_feats)          # (BR, nc+1), (BR, nc, 4)
+        nc = self._nc
+
+        def fn(cls_flat, deltas_flat, rois_b, gt_b, gtc_b, keep_b):
+            def one(c, d, ro, gt, gtc, valid):
+                valid = valid.astype(jnp.float32)
+                cls_t, delta_t, fg = self.rcnn_targets(ro, gt, gtc)
+                fg = fg * valid
+                logp = jax.nn.log_softmax(c.astype(jnp.float32), -1)
+                ce_all = -jnp.take_along_axis(
+                    logp, cls_t[:, None], axis=1)[:, 0]
+                ce = (ce_all * valid).sum() / jnp.clip(valid.sum(), 1.0)
+                # pick the matched class's delta row (class 1 -> row 0)
+                row = jnp.clip(cls_t - 1, 0)
+                dsel = jnp.take_along_axis(
+                    d, row[:, None, None].repeat(4, 2), axis=1)[:, 0]
+                sl1 = _smooth_l1(dsel - delta_t)
+                # where(), not multiply: a background roi's (unused)
+                # delta target can be huge and 0 * inf = nan
+                reg = jnp.where(fg > 0, sl1, 0.0).sum() / \
+                    jnp.clip(fg.sum(), 1.0)
+                return ce + reg
+
+            return jax.vmap(one)(
+                cls_flat.reshape(B, R, nc + 1),
+                deltas_flat.reshape(B, R, nc, 4),
+                rois_b, gt_b, gtc_b, keep_b).mean()
+
+        op = LightOpDef("rcnn_loss", fn, 6, 1, True)
+        return invoke(op, [cls, deltas, nd.NDArray(rois), gt_boxes,
+                           gt_classes, nd.NDArray(jnp.asarray(keep))], {})

@@ -164,3 +164,52 @@ def test_fpn_level_routing():
     # 112px -> k=3 -> index 0; 224px -> k=4 -> index 1 (P4)
     # 500px -> k=5 -> index 2 (P5)
     assert list(lvl) == [0, 0, 1, 2], list(lvl)
+
+
+def test_rcnn_targets_and_second_stage_trains():
+    """Second-stage targets assign the right class, and the full
+    two-stage loss (RPN + ROI head) decreases on a fixed scene."""
+    import jax.numpy as jnp
+    mx.random.seed(4)
+    feats, chans = _backbone()
+    net = det.FasterRCNN(feats, chans, num_classes=2,
+                         image_size=(128, 128), channels=32,
+                         rpn_pre_topk=64, rpn_post_topk=16)
+    net.initialize(mx.init.Xavier())
+
+    # targets: a roi sitting on gt box 1 (class 2) gets class 2
+    rois = jnp.asarray(np.array([[20, 20, 60, 60], [90, 90, 120, 120],
+                                 [0, 0, 8, 8]], np.float32))
+    gt = jnp.asarray(np.array([[22, 22, 58, 58], [88, 88, 118, 118]],
+                              np.float32))
+    gtc = jnp.asarray(np.array([1, 2], np.int32))
+    cls_t, delta_t, fg = net.rcnn_targets(rois, gt, gtc)
+    assert list(np.asarray(cls_t)) == [1, 2, 0]
+    assert list(np.asarray(fg)) == [1.0, 1.0, 0.0]
+
+    # end-to-end two-stage training step decreases the joint loss
+    from mxnet_tpu import autograd, gluon, nd
+    rng = np.random.RandomState(4)
+    x = nd.array(rng.randn(2, 3, 128, 128).astype(np.float32))
+    gt_b = nd.array(np.array([[[20, 20, 60, 60]], [[60, 60, 100, 100]]],
+                             np.float32))
+    gtc_b = nd.array(np.array([[1], [2]], np.int32), dtype="int32")
+    params = {k: p for k, p in net.collect_params().items()
+              if p.grad_req != "null"}
+    # lr matters: the ROI head chases moving proposals while the RPN
+    # trains; 2e-3 oscillates, 5e-4 converges cleanly (measured)
+    tr = gluon.Trainer(params, "adam", {"learning_rate": 5e-4})
+    losses = []
+    for _ in range(60):
+        with autograd.record():
+            levels, anchors, obj, reg = net.rpn_forward(x)
+            rloss = net.rpn_loss(anchors, obj, reg, gt_b)
+            rois_b, _sc, keep_b = net.proposals(anchors, obj, reg)
+            closs = net.rcnn_loss(levels, rois_b, gt_b, gtc_b,
+                                  keep=keep_b)
+            loss = rloss + closs
+        loss.backward()
+        tr.step(2)
+        losses.append(float(loss.asnumpy()))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.3, \
+        (np.mean(losses[:5]), np.mean(losses[-5:]))
