@@ -111,12 +111,31 @@ def adaptive_avg_pooling2d(data, *, output_size=()):
 def bilinear_resize2d(data, *, height: int = 1, width: int = 1,
                       scale_height=None, scale_width=None,
                       mode: str = "size", align_corners: bool = True):
-    """reference: contrib/bilinear_resize.cc."""
+    """reference: contrib/bilinear_resize.cc.  The reference default is
+    align_corners=True (source/dest corners map exactly); jax.image's
+    "linear" is half-pixel (align_corners=False), so the True path is an
+    explicit gather-lerp."""
     n, c, h, w = data.shape
     if scale_height is not None:
         height = int(h * scale_height)
         width = int(w * scale_width)
-    return jax.image.resize(data, (n, c, height, width), method="linear")
+    if not align_corners:
+        return jax.image.resize(data, (n, c, height, width),
+                                method="linear")
+    # align-corners mapping degenerates per-axis at size 1 (0/0): that
+    # axis samples its center, the other keeps corner alignment
+    ys = (jnp.linspace(0.0, h - 1.0, height) if height > 1
+          else jnp.full((1,), (h - 1) / 2.0))
+    xs = (jnp.linspace(0.0, w - 1.0, width) if width > 1
+          else jnp.full((1,), (w - 1) / 2.0))
+    y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+    x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+    y1 = jnp.minimum(y0 + 1, h - 1)
+    x1 = jnp.minimum(x0 + 1, w - 1)
+    wy = (ys - y0).astype(data.dtype)[None, None, :, None]
+    wx = (xs - x0).astype(data.dtype)
+    rows = data[:, :, y0, :] * (1 - wy) + data[:, :, y1, :] * wy
+    return rows[:, :, :, x0] * (1 - wx) + rows[:, :, :, x1] * wx
 
 
 @register("_contrib_ROIAlign", num_inputs=2, aliases=["ROIAlign"])

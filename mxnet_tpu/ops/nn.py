@@ -311,13 +311,22 @@ def BatchNorm(data, gamma, beta, moving_mean, moving_var, *,
     shape[axis] = data.shape[axis]
     training = autograd.is_training() and not use_global_stats
     if training:
+        # stats accumulate AND flow onward in fp32 regardless of
+        # activation dtype: a bf16 sum over B*H*W (≈1e5-1e6) elements
+        # loses ~3 decimal digits, which corrupts the moving-stat EMA
+        # over a long schedule.  Only the normalize expression casts
+        # back to the activation dtype, so XLA still fuses it into the
+        # producing conv with no extra HBM traffic and the
+        # output_mean_var / aux-update consumers see full precision.
         red = tuple(i for i in range(data.ndim) if i != axis)
-        mean = jnp.mean(data, axis=red)
-        var = jnp.var(data, axis=red)
+        data32 = data.astype(jnp.float32)
+        mean = jnp.mean(data32, axis=red)
+        var = jnp.var(data32, axis=red)
     else:
         mean, var = moving_mean, moving_var
     inv_std = lax.rsqrt(var + eps)
-    out = (data - mean.reshape(shape)) * inv_std.reshape(shape) \
+    out = (data - mean.astype(data.dtype).reshape(shape)) \
+        * inv_std.astype(data.dtype).reshape(shape) \
         * gamma.reshape(shape) + beta.reshape(shape)
     if output_mean_var:
         return out, mean, inv_std

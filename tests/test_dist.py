@@ -139,3 +139,101 @@ class TestWatchdog:
             assert var not in os.environ or True
         dist.initialize()      # no env, no args: standalone no-op
         assert not dist.is_initialized()
+
+
+class TestMultiHostSPMD:
+    """The DCN-spanning codepath a v5p multi-slice job will actually
+    use: 2 PROCESSES x 4 virtual CPU devices each, one GLOBAL 8-device
+    mesh, a full ShardedTrainer step compiled over it (dp grads cross
+    the process boundary through XLA collectives over gloo), verified
+    against a single-device oracle.  Every other multi-device proof in
+    the suite is single-process; mesh construction, device_put to
+    non-addressable shardings, and collective bootstrap all break
+    differently across process boundaries (SURVEY §4 multi-node,
+    §5.8)."""
+
+    def test_two_process_global_mesh_trainer_step(self, tmp_path):
+        script = _write(tmp_path, "w.py", """
+            import numpy as np
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from mxnet_tpu.parallel import dist
+            dist.initialize()
+            assert jax.process_count() == 2, jax.process_count()
+            assert jax.device_count() == 8, jax.device_count()
+            assert len(jax.local_devices()) == 4
+
+            import mxnet_tpu as mx
+            from mxnet_tpu import nd, models, parallel
+
+            mx.random.seed(0)
+            bert = models.get_bert_model(
+                "bert_12_768_12", vocab_size=96, units=64,
+                hidden_size=128, num_layers=2, num_heads=4,
+                max_length=32, dropout=0.0)
+            bert.initialize()
+            head = models.BERTClassifier(bert, num_classes=2, dropout=0.0)
+            head.initialize()
+            B, L = 8, 16
+            rng = np.random.RandomState(0)
+            inp = nd.array(rng.randint(0, 96, (B, L)), dtype="int32")
+            tt = nd.zeros((B, L), dtype="int32")
+            vl = nd.array(np.full((B,), L, np.float32))
+            lab = nd.array(rng.randint(0, 2, (B,)), dtype="int32")
+
+            def loss_fn(logits, labels):
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                return -jnp.take_along_axis(
+                    logp, labels[:, None], axis=1).mean()
+
+            def checksums(tr, mesh):
+                names = sorted(tr.params)
+                fn = jax.jit(
+                    lambda ps: jnp.stack(
+                        [jnp.sum(ps[n].astype(jnp.float32))
+                         for n in names]),
+                    out_shardings=NamedSharding(mesh, P()))
+                return names, np.asarray(jax.device_get(fn(tr.params)))
+
+            # single-device oracle (each process computes it identically
+            # from the same seed; only local devices involved)
+            mesh1 = parallel.make_mesh(
+                dp=1, tp=1, sp=1, devices=jax.local_devices()[:1])
+            tr1 = parallel.ShardedTrainer(
+                head, loss_fn, mesh1, optimizer="adamw",
+                optimizer_params={"learning_rate": 1e-3},
+                example_inputs=(inp, tt, vl), n_labels=1)
+            o_l0 = float(jax.device_get(tr1.step(inp, tt, vl, lab)))
+            o_l1 = float(jax.device_get(tr1.step(inp, tt, vl, lab)))
+            _names, o_ck = checksums(tr1, mesh1)
+
+            # global dp=2 x tp=2 x sp=2 mesh spanning BOTH processes
+            mesh = parallel.make_mesh(dp=2, tp=2, sp=2)
+            assert len(set(d.process_index for d in
+                           mesh.devices.flat)) == 2
+            tr = parallel.ShardedTrainer(
+                head, loss_fn, mesh, optimizer="adamw",
+                optimizer_params={"learning_rate": 1e-3},
+                example_inputs=(inp, tt, vl), n_labels=1)
+            # tp really sharded across the process boundary
+            qkv = [n for n in tr.params if n.endswith("qkv_weight")][0]
+            assert tr.params[qkv].sharding.spec[0] == "tp"
+            d_l0 = float(jax.device_get(tr.step(inp, tt, vl, lab)))
+            d_l1 = float(jax.device_get(tr.step(inp, tt, vl, lab)))
+            names, d_ck = checksums(tr, mesh)
+
+            np.testing.assert_allclose(d_l0, o_l0, rtol=2e-4, atol=2e-5)
+            np.testing.assert_allclose(d_l1, o_l1, rtol=2e-3, atol=2e-4)
+            bad = [(n, a, b) for n, a, b in zip(names, d_ck, o_ck)
+                   if not np.isclose(a, b, rtol=2e-3, atol=2e-3)]
+            assert not bad, bad[:5]
+            dist.barrier()
+            print("SPMD_OK", dist.rank())
+        """)
+        env = _worker_env()
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        rc = launch_mod.launch(2, [sys.executable, script],
+                               env_extra=env, timeout=420)
+        assert rc == 0

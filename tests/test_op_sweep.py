@@ -328,6 +328,345 @@ ORACLES = {
 }
 
 
+# ------------------------------------------------- r5: NN-core oracles
+# Independent NumPy forward implementations of the reference semantics
+# (VERDICT r4 item 6: FD checks prove gradient/forward CONSISTENCY, not
+# forward correctness — a conv with flipped padding passes FD).  These
+# are written from the reference op contracts (src/operator/nn/*.cc),
+# not transcribed from the jnp bodies.
+def _np_sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _np_conv2d(x, w, b=None, kernel=(), stride=(), dilate=(), pad=(),
+               num_filter=0, num_group=1, no_bias=False, **_):
+    sh, sw = tuple(stride) or (1, 1)
+    ph, pw = tuple(pad) or (0, 0)
+    dh, dw = tuple(dilate) or (1, 1)
+    n, c, H, W = x.shape
+    o, cg, kh, kw = w.shape
+    xp = np.pad(x.astype(np.float64),
+                ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    eh, ew = (kh - 1) * dh + 1, (kw - 1) * dw + 1
+    oh = (H + 2 * ph - eh) // sh + 1
+    ow = (W + 2 * pw - ew) // sw + 1
+    out = np.zeros((n, o, oh, ow), np.float64)
+    og = o // num_group
+    for g in range(num_group):
+        xs = xp[:, g * cg:(g + 1) * cg]
+        ws = w[g * og:(g + 1) * og].astype(np.float64)
+        for i in range(oh):
+            for j in range(ow):
+                patch = xs[:, :, i * sh:i * sh + eh:dh,
+                           j * sw:j * sw + ew:dw]
+                out[:, g * og:(g + 1) * og, i, j] = np.einsum(
+                    "nchw,ochw->no", patch, ws)
+    if b is not None and not no_bias:
+        out = out + b.reshape(1, -1, 1, 1)
+    return out
+
+
+def _np_deconv2d(x, w, b=None, kernel=(), stride=(), dilate=(), pad=(),
+                 adj=(), num_filter=0, num_group=1, no_bias=True,
+                 target_shape=(), **_):
+    sh, sw = tuple(stride) or (1, 1)
+    ph, pw = tuple(pad) or (0, 0)
+    ah, aw = tuple(adj) or (0, 0)
+    n, ci, H, W = x.shape
+    _, og, kh, kw = w.shape
+    OH, OW = (H - 1) * sh + kh, (W - 1) * sw + kw
+    out = np.zeros((n, og, OH, OW), np.float64)
+    for i in range(H):
+        for j in range(W):
+            out[:, :, i * sh:i * sh + kh, j * sw:j * sw + kw] += np.einsum(
+                "nc,cokl->nokl", x[:, :, i, j].astype(np.float64),
+                w.astype(np.float64))
+    out = out[:, :, ph:OH - ph + ah, pw:OW - pw + aw]
+    if b is not None and not no_bias:
+        out = out + b.reshape(1, -1, 1, 1)
+    return out
+
+
+def _np_pool2d(x, kernel=(), pool_type="max", stride=(), pad=(),
+               global_pool=False, count_include_pad=True,
+               pooling_convention="valid", **_):
+    if global_pool:
+        red = tuple(range(2, x.ndim))
+        f = {"max": np.max, "avg": np.mean, "sum": np.sum}[pool_type]
+        return f(x, axis=red, keepdims=True)
+    kh, kw = kernel
+    sh, sw = tuple(stride) or (1, 1)
+    ph, pw = tuple(pad) or (0, 0)
+    n, c, H, W = x.shape
+    fill = -np.inf if pool_type == "max" else 0.0
+    xp = np.pad(x.astype(np.float64),
+                ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+                constant_values=fill)
+    oh = (H + 2 * ph - kh) // sh + 1
+    ow = (W + 2 * pw - kw) // sw + 1
+    out = np.zeros((n, c, oh, ow), np.float64)
+    for i in range(oh):
+        for j in range(ow):
+            win = xp[:, :, i * sh:i * sh + kh, j * sw:j * sw + kw]
+            if pool_type == "max":
+                out[:, :, i, j] = win.max((2, 3))
+            elif pool_type == "sum":
+                out[:, :, i, j] = win.sum((2, 3))
+            elif count_include_pad:
+                out[:, :, i, j] = win.mean((2, 3))
+            else:
+                iy = max(i * sh, ph), min(i * sh + kh, H + ph)
+                ix = max(j * sw, pw), min(j * sw + kw, W + pw)
+                cnt = (iy[1] - iy[0]) * (ix[1] - ix[0])
+                out[:, :, i, j] = win.sum((2, 3)) / cnt
+    return out
+
+
+def _np_im2col(x, kernel=(), stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+               **_):
+    kh, kw = kernel
+    sh, sw = tuple(stride) or (1, 1)
+    dh, dw = tuple(dilate) or (1, 1)
+    ph, pw = tuple(pad) or (0, 0)
+    n, c, H, W = x.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    eh, ew = (kh - 1) * dh + 1, (kw - 1) * dw + 1
+    oh = (H + 2 * ph - eh) // sh + 1
+    ow = (W + 2 * pw - ew) // sw + 1
+    cols = np.zeros((n, c * kh * kw, oh * ow), x.dtype)
+    L = 0
+    for i in range(oh):
+        for j in range(ow):
+            cols[:, :, L] = xp[:, :, i * sh:i * sh + eh:dh,
+                               j * sw:j * sw + ew:dw].reshape(n, -1)
+            L += 1
+    return cols
+
+
+def _np_col2im(cols, output_size=(), kernel=(), stride=(1, 1),
+               dilate=(1, 1), pad=(0, 0), **_):
+    H, W = output_size
+    kh, kw = kernel
+    sh, sw = tuple(stride) or (1, 1)
+    dh, dw = tuple(dilate) or (1, 1)
+    ph, pw = tuple(pad) or (0, 0)
+    n, ckk, _L = cols.shape
+    c = ckk // (kh * kw)
+    eh, ew = (kh - 1) * dh + 1, (kw - 1) * dw + 1
+    oh = (H + 2 * ph - eh) // sh + 1
+    ow = (W + 2 * pw - ew) // sw + 1
+    img = np.zeros((n, c, H + 2 * ph, W + 2 * pw), np.float64)
+    c6 = cols.reshape(n, c, kh, kw, oh, ow)
+    for i in range(oh):
+        for j in range(ow):
+            img[:, :, i * sh:i * sh + eh:dh,
+                j * sw:j * sw + ew:dw] += c6[:, :, :, :, i, j]
+    return img[:, :, ph:H + ph, pw:W + pw]
+
+
+def _np_lstm(data, params, state, state_cell, state_size=0, num_layers=1,
+             mode="lstm", **_):
+    """Single-layer LSTM with the cudnn packed layout (all weights, then
+    all biases) and i,f,g,o gate order — reference rnn-inl.h."""
+    T, N, I = data.shape
+    H = state_size
+    o = 0
+    Wx = params[o:o + 4 * H * I].reshape(4 * H, I); o += 4 * H * I
+    Wh = params[o:o + 4 * H * H].reshape(4 * H, H); o += 4 * H * H
+    bx = params[o:o + 4 * H]; o += 4 * H
+    bh = params[o:o + 4 * H]
+    h, c = state[0].astype(np.float64), state_cell[0].astype(np.float64)
+    outs = []
+    for t in range(T):
+        g = data[t] @ Wx.T + bx + h @ Wh.T + bh
+        i_g, f_g, g_g, o_g = np.split(g, 4, axis=-1)
+        c = _np_sigmoid(f_g) * c + _np_sigmoid(i_g) * np.tanh(g_g)
+        h = _np_sigmoid(o_g) * np.tanh(c)
+        outs.append(h)
+    return np.stack(outs)
+
+
+def _np_bilinear_resize(x, height=1, width=1, scale_height=None,
+                        scale_width=None, mode="size",
+                        align_corners=True, **_):
+    n, c, h, w = x.shape
+    if scale_height is not None:
+        height, width = int(h * scale_height), int(w * scale_width)
+    ys = (np.linspace(0, h - 1, height) if align_corners and height > 1
+          else (np.arange(height) + 0.5) * h / height - 0.5)
+    xs = (np.linspace(0, w - 1, width) if align_corners and width > 1
+          else (np.arange(width) + 0.5) * w / width - 0.5)
+    ys, xs = np.clip(ys, 0, h - 1), np.clip(xs, 0, w - 1)
+    y0 = np.clip(np.floor(ys).astype(int), 0, h - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, w - 1)
+    y1, x1 = np.minimum(y0 + 1, h - 1), np.minimum(x0 + 1, w - 1)
+    wy, wx = ys - y0, xs - x0
+    rows = (x[:, :, y0, :] * (1 - wy)[None, None, :, None]
+            + x[:, :, y1, :] * wy[None, None, :, None])
+    return (rows[:, :, :, x0] * (1 - wx) + rows[:, :, :, x1] * wx)
+
+
+def _np_groupnorm(x, gamma, beta, num_groups=1, eps=1e-5, **_):
+    n, c = x.shape[:2]
+    xg = x.reshape((n, num_groups, c // num_groups) + x.shape[2:])
+    red = tuple(range(2, xg.ndim))
+    mean = xg.mean(red, keepdims=True)
+    var = xg.var(red, keepdims=True)
+    out = ((xg - mean) / np.sqrt(var + eps)).reshape(x.shape)
+    shp = (1, -1) + (1,) * (x.ndim - 2)
+    return out * gamma.reshape(shp) + beta.reshape(shp)
+
+
+def _np_lrn(x, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5, **_):
+    sq = np.square(x)
+    half = nsize // 2
+    p = np.pad(sq, [(0, 0), (half, half)] + [(0, 0)] * (x.ndim - 2))
+    windows = sum(p[:, i:i + x.shape[1]] for i in range(nsize))
+    return x / np.power(knorm + alpha * windows / nsize, beta)
+
+
+_SCIPY = __import__("scipy.special", fromlist=["special"])
+
+ORACLES.update({
+    # activations / softmax family
+    "Activation": lambda x, act_type="relu": {
+        "relu": lambda v: np.maximum(v, 0),
+        "sigmoid": _np_sigmoid, "tanh": np.tanh,
+        "softrelu": lambda v: np.log1p(np.exp(v)),
+        "softsign": lambda v: v / (1 + np.abs(v))}[act_type](x),
+    "LeakyReLU": lambda x, act_type="leaky", slope=0.25, **k:
+        np.where(x >= 0, x, slope * x),
+    "Softmax": lambda x, label, **k: _np_softmax(x, -1),
+    "MakeLoss": lambda x, **k: x,
+    "softmax_cross_entropy": lambda x, label: np.array(
+        -np.take_along_axis(
+            np.log(_np_softmax(x, -1)),
+            label.astype(np.int64)[:, None], 1).sum(), np.float32),
+    # normalization (test_forward runs OUTSIDE train_mode: BatchNorm is
+    # inference-mode, fix_gamma=True means gamma is forced to 1)
+    "BatchNorm": lambda x, gamma, beta, mm, mv, eps=1e-3, axis=1, **k:
+        (x - mm.reshape(1, -1, 1, 1)) / np.sqrt(
+            mv.reshape(1, -1, 1, 1) + eps) + beta.reshape(1, -1, 1, 1),
+    "LayerNorm": lambda x, gamma, beta, axis=-1, eps=1e-5, **k:
+        (x - x.mean(axis, keepdims=True)) / np.sqrt(
+            x.var(axis, keepdims=True) + eps) * gamma + beta,
+    "InstanceNorm": lambda x, gamma, beta, eps=1e-3, **k:
+        (x - x.mean((2, 3), keepdims=True)) / np.sqrt(
+            x.var((2, 3), keepdims=True) + eps)
+        * gamma.reshape(1, -1, 1, 1) + beta.reshape(1, -1, 1, 1),
+    "GroupNorm": _np_groupnorm,
+    "LRN": _np_lrn,
+    # NN layers
+    "Convolution": _np_conv2d,
+    "Deconvolution": _np_deconv2d,
+    "Pooling": _np_pool2d,
+    "im2col": _np_im2col,
+    "col2im": _np_col2im,
+    "RNN": _np_lstm,
+    "Embedding": lambda idx, w, **k: w[np.clip(
+        idx.astype(np.int64), 0, w.shape[0] - 1)],
+    "UpSampling": lambda x, scale=1, sample_type="nearest", **k:
+        np.repeat(np.repeat(x, scale, 2), scale, 3),
+    "AdaptiveAvgPooling2D": lambda x, output_size=(): x.reshape(
+        x.shape[0], x.shape[1], output_size[0],
+        x.shape[2] // output_size[0], output_size[-1],
+        x.shape[3] // output_size[-1]).mean((3, 5)),
+    "BilinearResize2D": _np_bilinear_resize,
+    "Crop": lambda x, offset=(0, 0), h_w=(0, 0), center_crop=False,
+        num_args=1: x[:, :, offset[0]:offset[0] + h_w[0],
+                      offset[1]:offset[1] + h_w[1]],
+    # sequence ops (time-major; the 2-input frontends consume the
+    # lengths — use_sequence_length defaults True here)
+    "SequenceLast": lambda x, lens, **k: np.stack(
+        [x[int(lens[b]) - 1, b] for b in range(x.shape[1])]),
+    "SequenceMask": lambda x, lens, value=0.0, **k: np.where(
+        (np.arange(x.shape[0])[:, None]
+         < lens.astype(np.int64)[None, :]).reshape(
+            (x.shape[0], x.shape[1]) + (1,) * (x.ndim - 2)), x, value),
+    "SequenceReverse": lambda x, lens, **k: np.stack(
+        [np.concatenate([x[:int(lens[b]), b][::-1], x[int(lens[b]):, b]])
+         for b in range(x.shape[1])], axis=1),
+    "SliceChannel": lambda x, num_outputs=1, axis=1, **k:
+        np.split(x, num_outputs, axis)[0],
+    # shape / indexing
+    "topk": lambda x, axis=-1, k=1, ret_typ="indices", is_ascend=False,
+        dtype="float32": np.argsort(
+            x if is_ascend else -x, axis=-1, kind="stable")
+        .take(range(k), -1).astype(np.float32),
+    "split_v2": lambda x, indices_or_sections=1, axis=0, squeeze_axis=False:
+        np.split(x, indices_or_sections, axis)[0],
+    "crop": lambda x, begin=(), end=(), step=():
+        x[tuple(slice(b, e) for b, e in zip(begin, end))],
+    "depth_to_space": lambda x, block_size=1: x.reshape(
+        x.shape[0], block_size, block_size,
+        x.shape[1] // block_size ** 2, x.shape[2], x.shape[3]).transpose(
+        0, 3, 4, 1, 5, 2).reshape(
+        x.shape[0], x.shape[1] // block_size ** 2,
+        x.shape[2] * block_size, x.shape[3] * block_size),
+    "slice_like": lambda a, b, axes=(): a[tuple(
+        slice(0, b.shape[i]) if (not axes or i in tuple(axes)) else
+        slice(None) for i in range(a.ndim))],
+    "broadcast_like": lambda a, b, **k: np.broadcast_to(a, b.shape),
+    "broadcast_axes": lambda x, axis=(), size=(): np.broadcast_to(
+        x, tuple(size[list(axis).index(i)] if i in tuple(axis) else s
+                 for i, s in enumerate(x.shape))),
+    "scatter_nd": lambda data, idx, shape=(): (
+        lambda out: (np.add.at(out, tuple(idx.astype(np.int64)), data),
+                     out)[1])(np.zeros(shape, data.dtype)),
+    "all_finite": lambda *data, **k: np.array(
+        [float(all(np.isfinite(d).all() for d in data))], np.float32),
+    "amp_multicast": lambda *data, **k: data[0],
+    "round": np.round,
+    "digamma": lambda x: _SCIPY.digamma(x),
+    "erfinv": lambda x: _SCIPY.erfinv(x),
+    # linalg (spec feeds SPD or tril matrices)
+    "_linalg_gemm": lambda A, B, C, transpose_a=False, transpose_b=False,
+        alpha=1.0, beta=1.0, axis=-2: alpha * (
+            (A.T if transpose_a else A) @ (B.T if transpose_b else B))
+        + beta * C,
+    "_linalg_gemm2": lambda A, B, transpose_a=False, transpose_b=False,
+        alpha=1.0, axis=-2: alpha * (
+            (A.T if transpose_a else A) @ (B.T if transpose_b else B)),
+    "_linalg_potri": lambda A: np.linalg.inv(np.tril(A) @ np.tril(A).T),
+    "_linalg_trmm": lambda A, B, transpose=False, rightside=False,
+        lower=True, alpha=1.0: alpha * (np.tril(A) @ B),
+    "_linalg_trsm": lambda A, B, transpose=False, rightside=False,
+        lower=True, alpha=1.0: np.linalg.solve(np.tril(A), alpha * B),
+    "_linalg_syrk": lambda A, transpose=False, alpha=1.0:
+        alpha * (A.T @ A if transpose else A @ A.T),
+    "_linalg_slogdet": lambda A: np.linalg.slogdet(A)[0],
+    # optimizer update ops (reference: optimizer_op.cc formulas; first
+    # output = new weight; spec passes no kwargs so defaults apply)
+    "sgd_update": lambda w, g, lr=0.01, wd=0.0, **k:
+        w - lr * (g + wd * w),
+    "sgd_mom_update": lambda w, g, m, lr=0.01, momentum=0.0, wd=0.0, **k:
+        w + momentum * m - lr * (g + wd * w),
+    "nag_mom_update": lambda w, g, m, lr=0.01, momentum=0.0, wd=0.0, **k:
+        w - lr * ((g + wd * w) + momentum
+                  * (momentum * m + (g + wd * w))),
+    "signsgd_update": lambda w, g, lr=0.01, wd=0.0, **k:
+        w - lr * np.sign(g + wd * w),
+    "signum_update": lambda w, g, m, lr=0.01, momentum=0.0, wd=0.0,
+        wd_lh=0.0, **k: (1 - lr * wd_lh) * w + lr * np.sign(
+            momentum * m - (1 - momentum) * (g + wd * w)),
+    "rmsprop_update": lambda w, g, n, lr=0.001, gamma1=0.95,
+        epsilon=1e-8, wd=0.0, **k: w - lr * (g + wd * w) / np.sqrt(
+            gamma1 * n + (1 - gamma1) * np.square(g + wd * w) + epsilon),
+    "adam_update": lambda w, g, m, v, lr=0.001, beta1=0.9, beta2=0.999,
+        epsilon=1e-8, wd=0.0, **k: w - lr * (
+            beta1 * m + (1 - beta1) * (g + wd * w)) / (np.sqrt(
+                beta2 * v + (1 - beta2) * np.square(g + wd * w))
+                + epsilon),
+    "_adamw_update": lambda w, g, m, v, rescale, lr=0.001, beta1=0.9,
+        beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0, **k: w - eta * (
+            lr * (beta1 * m + (1 - beta1) * g * rescale) / (np.sqrt(
+                beta2 * v + (1 - beta2) * np.square(g * rescale))
+                + epsilon) + wd * w),
+    "mp_sgd_update": lambda w, g, w32, lr=0.01, wd=0.0, **k:
+        w32 - lr * (g + wd * w32),
+})
+
+
 # -------------------------------------------------------------------- specs
 # Per-op canonical inputs.  An entry is dict(inputs=callable(rng) ->
 # [np arrays], kwargs={}, wrt=[indices FD-checked]); ops absent from
@@ -825,3 +1164,9 @@ def test_sweep_budget():
         1 for s in SPECS.values()
         if isinstance(s, dict) and s.get("grad") is False)
     assert n_grad_skips <= 0.1 * len(CANONICAL), n_grad_skips
+    # tier-2 oracle-coverage floor (r5): most of the registry must have
+    # an independent NumPy forward reference, not just smoke+FD — and
+    # the floor is asserted so coverage can only ratchet up
+    n_oracle = sum(1 for n in CANONICAL if n in ORACLES)
+    assert n_oracle >= 200, n_oracle
+    assert n_oracle >= 0.75 * len(CANONICAL), (n_oracle, len(CANONICAL))
