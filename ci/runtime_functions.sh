@@ -62,6 +62,12 @@ examples_smoke() {
     python examples/nmt_transformer.py --epochs 1 --min-match 0
     python examples/train_imagenet.py --iters 10 --model resnet18_v1
     python examples/bert_squad.py --steps 20 --batch 8
+    # two-stage detector: smoke tier (the convergence gate needs ~120
+    # iters; tests/test_detection_contrib.py carries the training
+    # assertions, and the full-gate run is
+    # `python examples/faster_rcnn.py --iters 120`)
+    python examples/faster_rcnn.py --iters 8 --batch-size 4 \
+        --min-recall 0
 }
 
 bench_cpu() {

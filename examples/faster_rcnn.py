@@ -1,0 +1,197 @@
+"""Faster R-CNN two-stage detection, end to end (reference:
+GluonCV ``faster_rcnn`` + upstream example/rcnn; SURVEY.md §2.3).
+
+No egress here, so the data is a synthetic detection shard packed in the
+im2rec RecordIO layout (JPEG images + 5-wide labels
+``[cls, x0, y0, x1, y1]`` in pixels) and read back through
+``ImageRecordIter`` — the same pipeline real VOC/COCO shards use.  Each
+image holds one colored rectangle; the class is the color, so the ROI
+head must use appearance (not just geometry) to classify.
+
+Training is the full two-stage path per step, all static-shape compiled:
+RPN forward over FPN levels → RPN target matching + loss → static
+top-k + NMS proposals → level-assigned ROIAlign → ROI-head class/box
+loss, with gradients flowing through the ROIAlign into the FPN and
+backbone (one joint backward).
+
+Success criterion printed at the end: fraction of held-out images whose
+top detection has IoU >= 0.5 with the ground-truth box AND the right
+class (exits 1 below ``--min-recall``).
+
+  python examples/faster_rcnn.py --iters 120
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import mxnet_tpu as mx                                    # noqa: E402
+from mxnet_tpu import autograd, gluon, nd, recordio       # noqa: E402
+from mxnet_tpu.gluon import nn                            # noqa: E402
+from mxnet_tpu.gluon.contrib import detection as det      # noqa: E402
+from mxnet_tpu.io import ImageRecordIter                  # noqa: E402
+
+IMG = 128
+# class -> rectangle fill color (RGB); ids 1..2, 0 is background
+COLORS = {1: (200, 60, 40), 2: (40, 200, 60)}
+
+
+def synth_rec(path, n, seed=0):
+    """Pack one-rectangle-per-image JPEG detection shards."""
+    import cv2
+    rng = np.random.RandomState(seed)
+    rec = recordio.MXIndexedRecordIO(path + ".idx", path + ".rec", "w")
+    for i in range(n):
+        cls = rng.randint(1, 3)
+        w = rng.randint(28, 72)
+        h = rng.randint(28, 72)
+        x0 = rng.randint(4, IMG - w - 4)
+        y0 = rng.randint(4, IMG - h - 4)
+        img = rng.randint(0, 60, (IMG, IMG, 3)).astype(np.uint8)
+        img[y0:y0 + h, x0:x0 + w] = np.array(
+            COLORS[cls], np.uint8) + rng.randint(-20, 20, 3).astype(
+                np.int16).astype(np.uint8)
+        header = recordio.IRHeader(
+            0, np.array([cls, x0, y0, x0 + w, y0 + h], np.float32), i, 0)
+        rec.write_idx(i, recordio.pack(
+            header, cv2.imencode(".jpg", img[:, :, ::-1],
+                                 [1, 92])[1].tobytes()))
+    rec.close()
+
+
+def backbone():
+    """Three-stage feature extractor: strides 8/16/32."""
+    class Feats(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.s1 = nn.HybridSequential()
+                for _ in range(3):
+                    self.s1.add(nn.Conv2D(32, 3, strides=2, padding=1,
+                                          activation="relu"))
+                self.s2 = nn.Conv2D(48, 3, strides=2, padding=1,
+                                    activation="relu")
+                self.s3 = nn.Conv2D(64, 3, strides=2, padding=1,
+                                    activation="relu")
+
+        def hybrid_forward(self, F, x):
+            c3 = self.s1(x)
+            c4 = self.s2(c3)
+            c5 = self.s3(c4)
+            return c3, c4, c5
+    return Feats(), (32, 48, 64)
+
+
+def box_iou_np(a, b):
+    x0 = max(a[0], b[0]); y0 = max(a[1], b[1])
+    x1 = min(a[2], b[2]); y1 = min(a[3], b[3])
+    inter = max(0.0, x1 - x0) * max(0.0, y1 - y0)
+    ua = ((a[2] - a[0]) * (a[3] - a[1])
+          + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+    return inter / max(ua, 1e-9)
+
+
+def evaluate(net, it, n_batches):
+    """Top-detection recall: IoU >= 0.5 with gt AND correct class."""
+    import jax
+    hits = total = 0
+    it.reset()
+    for _ in range(n_batches):
+        batch = it.next()
+        x = batch.data[0]
+        lab = batch.label[0].asnumpy()
+        cls, boxes, rscores = net(x)
+        prob = nd.softmax(cls, axis=-1).asnumpy()       # (B, R, nc+1)
+        boxes = boxes.asnumpy()
+        rs = rscores.asnumpy()
+        for b in range(x.shape[0]):
+            fg = prob[b, :, 1:]                          # (R, nc)
+            fg = np.where(np.isfinite(rs[b])[:, None], fg, 0.0)
+            r, c = np.unravel_index(np.argmax(fg), fg.shape)
+            pred_cls = c + 1
+            pred_box = boxes[b, r, c]
+            gt_cls = int(lab[b, 0])
+            gt_box = lab[b, 1:5]
+            ok = (pred_cls == gt_cls
+                  and box_iou_np(pred_box, gt_box) >= 0.5)
+            hits += ok
+            total += 1
+    return hits / max(total, 1)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--iters", type=int, default=120)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--lr", type=float, default=5e-4)
+    p.add_argument("--min-recall", type=float, default=0.5,
+                   help="fail below this top-detection recall "
+                        "(0 disables)")
+    p.add_argument("--rec", default=None)
+    args = p.parse_args()
+
+    mx.random.seed(0)
+    rec_path = args.rec
+    if rec_path is None:
+        rec_path = "/tmp/synth_frcnn"
+        if not os.path.exists(rec_path + ".rec"):
+            synth_rec(rec_path, 256)
+    else:
+        rec_path = rec_path[:-4] if rec_path.endswith(".rec") else rec_path
+
+    it = ImageRecordIter(
+        path_imgrec=rec_path + ".rec", data_shape=(3, IMG, IMG),
+        batch_size=args.batch_size, shuffle=True, label_width=5,
+        scale=1.0 / 255, preprocess_threads=2, round_batch=True)
+
+    feats, chans = backbone()
+    net = det.FasterRCNN(feats, chans, num_classes=2,
+                         image_size=(IMG, IMG), channels=32,
+                         rpn_pre_topk=64, rpn_post_topk=16)
+    net.initialize(mx.init.Xavier())
+    params = {k: p_ for k, p_ in net.collect_params().items()
+              if p_.grad_req != "null"}
+    trainer = gluon.Trainer(params, "adam", {"learning_rate": args.lr})
+
+    step = 0
+    while step < args.iters:
+        it.reset()
+        while step < args.iters:
+            try:
+                batch = it.next()
+            except StopIteration:
+                break
+            x = batch.data[0]
+            lab = batch.label[0].asnumpy()
+            gt_b = nd.array(lab[:, None, 1:5])
+            gtc_b = nd.array(lab[:, None, 0].astype(np.int32),
+                             dtype="int32")
+            with autograd.record():
+                levels, anchors, obj, reg = net.rpn_forward(x)
+                rloss = net.rpn_loss(anchors, obj, reg, gt_b)
+                rois_b, _sc, keep_b = net.proposals(anchors, obj, reg)
+                closs = net.rcnn_loss(levels, rois_b, gt_b, gtc_b,
+                                      keep=keep_b)
+                loss = rloss + closs
+            loss.backward()
+            trainer.step(x.shape[0])
+            if step % 20 == 0 or step == args.iters - 1:
+                print(f"iter {step}: loss {float(loss.asnumpy()):.4f} "
+                      f"(rpn {float(rloss.asnumpy()):.4f} "
+                      f"roi {float(closs.asnumpy()):.4f})")
+            step += 1
+
+    recall = evaluate(net, it, n_batches=4)
+    print(f"top-detection recall (IoU>=0.5 + class): {recall:.3f}")
+    if args.min_recall > 0 and recall < args.min_recall:
+        print(f"FAIL: recall below {args.min_recall}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

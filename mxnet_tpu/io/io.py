@@ -557,6 +557,14 @@ class ImageRecordIter(DataIter):
         from concurrent.futures import ThreadPoolExecutor
         self._pool = ThreadPoolExecutor(max(1, preprocess_threads)) \
             if preprocess_threads > 1 else None
+        self._nthreads = max(1, preprocess_threads)
+        # native decode tier: whole-batch JPEG decode+resize+crop+mirror
+        # on C++ OS threads in ONE call (reference: the C++ worker pool
+        # of iter_image_recordio_2.cc).  Non-JPEG payloads and decode
+        # failures fall back to the per-image Python path.
+        from ..lib import nativelib as _nativelib
+        self._native_jpeg = (self.data_shape[0] == 3
+                             and _nativelib.jpeg_available())
         self._depth = max(1, prefetch_buffer)
         self._queue = None
         self._producer = None
@@ -689,6 +697,60 @@ class ImageRecordIter(DataIter):
                 f"label_width={self.label_width} requested")
         return chw, label[:self.label_width]
 
+    def _decode_batch_native(self, payloads):
+        """Whole-batch decode on the native C++ thread pool.  Returns
+        (data, labels) or (None, None) when the batch isn't native-
+        eligible (non-JPEG records); individual decode failures are
+        re-done on the Python path.  Augmentation randomness (crop
+        position fractions, mirror coin flips) is drawn from the
+        iterator's seeded RNG here, so determinism semantics match the
+        Python tier."""
+        from ..lib import nativelib
+
+        headers, blobs = [], []
+        for p in payloads:
+            hdr, blob = recordio.unpack(p)
+            headers.append(hdr)
+            blobs.append(blob)
+        if not any(b[:2] == b"\xff\xd8" for b in blobs):
+            # not a JPEG shard — stop paying the probe on every batch
+            # (mixed batches still work: the native decoder reports
+            # per-image failure and those fall back below)
+            self._native_jpeg = False
+            return None, None
+        _c, th, tw = self.data_shape
+        n = len(blobs)
+        if self.rand_crop:
+            cy = self._rng.random_sample(n).astype(np.float32)
+            cx = self._rng.random_sample(n).astype(np.float32)
+        else:
+            # negative = center-crop sentinel (integer offset, native side)
+            cy = np.full(n, -1.0, np.float32)
+            cx = np.full(n, -1.0, np.float32)
+        mir = (self._rng.random_sample(n) < 0.5).astype(np.uint8) \
+            if self.rand_mirror else np.zeros(n, np.uint8)
+        out, status = nativelib.decode_jpeg_batch(
+            blobs, self.resize if self.resize > 0 else 0, th, tw,
+            cy, cx, mir, self._nthreads)
+        data = out.astype(np.float32)
+        if self.mean.any() or self.scale != 1.0:
+            data = (data - self.mean) * self.scale
+        labels = np.empty((n, self.label_width), np.float32)
+        for i, hdr in enumerate(headers):
+            lab = np.atleast_1d(np.asarray(hdr.label, np.float32))
+            if lab.size < self.label_width:
+                raise MXNetError(
+                    f"record id={hdr.id} has {lab.size} label value(s), "
+                    f"label_width={self.label_width} requested")
+            labels[i] = lab[:self.label_width]
+        for i in np.nonzero(status)[0]:
+            img, lab = self._decode_one(
+                payloads[i],
+                np.random.RandomState(self._rng.randint(0, 2**31)))
+            data[i] = img
+            labels[i] = lab
+        return data, labels
+
     def _next_batch_sync(self):
         """Assemble one batch; record reads stay on the producer thread,
         decode/augment fans out to the worker pool."""
@@ -710,20 +772,23 @@ class ImageRecordIter(DataIter):
         pad = self.batch_size - min(n - self._pos, self.batch_size)
         self._pos += self.batch_size
         payloads = [self._read_record(self._keys[k]) for k in idxs]
-        # per-record RNG decided here so pool workers never share state
-        rngs = [np.random.RandomState(self._rng.randint(0, 2**31))
-                for _ in idxs]
-        if self._pool is not None:
-            decoded = list(self._pool.map(self._decode_one, payloads,
-                                          rngs))
-        else:
-            decoded = [self._decode_one(p, r)
-                       for p, r in zip(payloads, rngs)]
-        data = np.empty((len(idxs),) + self.data_shape, np.float32)
-        labels = np.empty((len(idxs), self.label_width), np.float32)
-        for i, (img, lab) in enumerate(decoded):
-            data[i] = img
-            labels[i] = lab
+        data, labels = self._decode_batch_native(payloads) \
+            if self._native_jpeg else (None, None)
+        if data is None:
+            # per-record RNG decided here so pool workers never share state
+            rngs = [np.random.RandomState(self._rng.randint(0, 2**31))
+                    for _ in idxs]
+            if self._pool is not None:
+                decoded = list(self._pool.map(self._decode_one, payloads,
+                                              rngs))
+            else:
+                decoded = [self._decode_one(p, r)
+                           for p, r in zip(payloads, rngs)]
+            data = np.empty((len(idxs),) + self.data_shape, np.float32)
+            labels = np.empty((len(idxs), self.label_width), np.float32)
+            for i, (img, lab) in enumerate(decoded):
+                data[i] = img
+                labels[i] = lab
         label_arr = labels[:, 0] if self.label_width == 1 else labels
         return DataBatch(data=[nd.array(data)],
                          label=[nd.array(label_arr)], pad=pad,

@@ -27,12 +27,18 @@ _tried = False
 
 
 def _build() -> bool:
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", _SO, _SRC]
-    try:
-        proc = subprocess.run(cmd, capture_output=True, timeout=120)
-        return proc.returncode == 0 and os.path.exists(_SO)
-    except (OSError, subprocess.TimeoutExpired):
-        return False
+    base = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+            "-o", _SO, _SRC]
+    # libjpeg powers the threaded decode tier; hosts without it still
+    # get the recordio/csv tier (decode falls back to Python/cv2)
+    for cmd in (base + ["-ljpeg"], base + ["-DMXNATIVE_NO_JPEG"]):
+        try:
+            proc = subprocess.run(cmd, capture_output=True, timeout=120)
+            if proc.returncode == 0 and os.path.exists(_SO):
+                return True
+        except (OSError, subprocess.TimeoutExpired):
+            return False
+    return False
 
 
 def _load():
@@ -77,6 +83,18 @@ def _load():
             ctypes.c_char_p,
             np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
             ctypes.c_int64]
+        if lib.mxnative_has_jpeg():
+            lib.mxjpeg_decode_batch.restype = ctypes.c_int64
+            lib.mxjpeg_decode_batch.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p),
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+                ctypes.c_int64, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+                ctypes.c_int64]
         _lib = lib
         return _lib
 
@@ -163,6 +181,38 @@ class NativeRecordWriter:
             self.close()
         except Exception:
             pass
+
+
+def jpeg_available() -> bool:
+    lib = _load()
+    return lib is not None and bool(lib.mxnative_has_jpeg())
+
+
+def decode_jpeg_batch(bufs, resize_min, out_h, out_w, cy_frac, cx_frac,
+                      mirror, n_threads):
+    """Decode a batch of JPEG byte strings on native OS threads.
+
+    Returns (batch (n, 3, out_h, out_w) uint8, status (n,) uint8 —
+    0 = decoded, nonzero = that image needs the Python fallback).
+    Augmentation randomness (crop fractions, mirror flags) is supplied
+    by the caller so the seeded-RNG contract is unchanged.
+    """
+    lib = _load()
+    if lib is None or not lib.mxnative_has_jpeg():
+        raise RuntimeError("native JPEG tier unavailable")
+    n = len(bufs)
+    arr = (ctypes.c_char_p * n)(*bufs)
+    lens = np.array([len(b) for b in bufs], np.int64)
+    out = np.empty((n, 3, out_h, out_w), np.uint8)
+    status = np.ones(n, np.uint8)
+    lib.mxjpeg_decode_batch(
+        ctypes.cast(arr, ctypes.POINTER(ctypes.c_char_p)), lens, n,
+        int(resize_min or 0), int(out_h), int(out_w),
+        np.ascontiguousarray(cy_frac, np.float32),
+        np.ascontiguousarray(cx_frac, np.float32),
+        np.ascontiguousarray(mirror, np.uint8), out, status,
+        int(n_threads))
+    return out, status
 
 
 def csv_load(path: str) -> np.ndarray:

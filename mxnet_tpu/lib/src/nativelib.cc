@@ -255,3 +255,187 @@ int64_t mxcsv_parse(const char* path, float* out, int64_t cap) {
 int mxnative_abi_version() { return 1; }
 
 }  // extern "C"
+
+// --------------------------------------------------------------------------
+// Threaded JPEG decode tier (reference: src/io/iter_image_recordio_2.cc —
+// the reference's C++ decode/augment worker POOL; SURVEY.md §2.1 Data
+// iterators, §7.3).  One C call decodes a whole batch on OS threads:
+// libjpeg DCT-domain scaling (scale_denom) toward the resize target, a
+// fused bilinear resize+crop gather (no intermediate full-size image),
+// optional horizontal mirror, CHW uint8 output.  Crop positions come in
+// as fractions so augmentation randomness stays under Python's seeded
+// RNG while all byte churn happens here, GIL-free.
+// --------------------------------------------------------------------------
+#ifndef MXNATIVE_NO_JPEG
+
+#include <jpeglib.h>
+
+#include <algorithm>
+#include <atomic>
+#include <csetjmp>
+#include <thread>
+
+namespace {
+
+struct JErr {
+  jpeg_error_mgr mgr;
+  std::jmp_buf jb;
+};
+
+void jerr_exit(j_common_ptr cinfo) {
+  std::longjmp(reinterpret_cast<JErr*>(cinfo->err)->jb, 1);
+}
+
+void jerr_silent(j_common_ptr, int) {}
+
+bool decode_one(const uint8_t* buf, int64_t len, int min_side,
+                std::vector<uint8_t>* px, int* w, int* h) {
+  jpeg_decompress_struct cinfo;
+  JErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = jerr_exit;
+  jerr.mgr.emit_message = jerr_silent;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<unsigned char*>(buf),
+               static_cast<unsigned long>(len));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  cinfo.out_color_space = JCS_RGB;
+  if (min_side > 0) {
+    // largest denom in {1,2,4,8} that keeps the short side >= target:
+    // 1/denom decode happens in the DCT domain — decoding a 4x-reduced
+    // image costs ~1/16th the IDCT work
+    unsigned denom = 1;
+    unsigned short_side = std::min(cinfo.image_width, cinfo.image_height);
+    while (denom < 8 && short_side / (denom * 2) >=
+                            static_cast<unsigned>(min_side))
+      denom *= 2;
+    cinfo.scale_num = 1;
+    cinfo.scale_denom = denom;
+  }
+  jpeg_start_decompress(&cinfo);
+  if (cinfo.output_components != 3) {  // grayscale promoted by JCS_RGB;
+    jpeg_destroy_decompress(&cinfo);   // anything else is unsupported
+    return false;
+  }
+  *w = static_cast<int>(cinfo.output_width);
+  *h = static_cast<int>(cinfo.output_height);
+  px->resize(static_cast<size_t>(*w) * *h * 3);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    JSAMPROW rp = px->data() +
+                  static_cast<size_t>(cinfo.output_scanline) * *w * 3;
+    jpeg_read_scanlines(&cinfo, &rp, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+// Fused bilinear resize(short side -> R) + crop(out_h x out_w at
+// fractional offset) + mirror, sampling straight from the decoded image
+// into CHW uint8 output.
+void resize_crop(const std::vector<uint8_t>& px, int w0, int h0,
+                 int resize_min, int out_h, int out_w, float cy_frac,
+                 float cx_frac, bool mirror, uint8_t* out) {
+  float scale = 1.0f;
+  if (resize_min > 0)
+    scale = static_cast<float>(resize_min) / std::min(w0, h0);
+  int rw = std::max(out_w, static_cast<int>(w0 * scale + 0.5f));
+  int rh = std::max(out_h, static_cast<int>(h0 * scale + 0.5f));
+  float sx = static_cast<float>(w0) / rw;
+  float sy = static_cast<float>(h0) / rh;
+  // INTEGER crop offsets, exactly like the Python/cv2 tier (randint /
+  // floor-div-2 center) — a fractional offset is a half-pixel phase
+  // shift versus that tier.  frac < 0 = center crop; otherwise the
+  // fraction maps uniformly onto {0..range} inclusive.
+  auto crop_at = [](float frac, int range) -> float {
+    if (frac < 0.0f) return static_cast<float>(range / 2);
+    return static_cast<float>(
+        std::min(static_cast<int>(frac * (range + 1)), range));
+  };
+  float cy = crop_at(cy_frac, rh - out_h);
+  float cx = crop_at(cx_frac, rw - out_w);
+  const size_t plane = static_cast<size_t>(out_h) * out_w;
+  for (int i = 0; i < out_h; ++i) {
+    float fy = (cy + i + 0.5f) * sy - 0.5f;
+    fy = std::min(std::max(fy, 0.0f), static_cast<float>(h0 - 1));
+    int y0 = static_cast<int>(fy);
+    int y1 = std::min(y0 + 1, h0 - 1);
+    float wy = fy - y0;
+    for (int j = 0; j < out_w; ++j) {
+      float fx = (cx + j + 0.5f) * sx - 0.5f;
+      fx = std::min(std::max(fx, 0.0f), static_cast<float>(w0 - 1));
+      int x0 = static_cast<int>(fx);
+      int x1 = std::min(x0 + 1, w0 - 1);
+      float wx = fx - x0;
+      const uint8_t* p00 = &px[(static_cast<size_t>(y0) * w0 + x0) * 3];
+      const uint8_t* p01 = &px[(static_cast<size_t>(y0) * w0 + x1) * 3];
+      const uint8_t* p10 = &px[(static_cast<size_t>(y1) * w0 + x0) * 3];
+      const uint8_t* p11 = &px[(static_cast<size_t>(y1) * w0 + x1) * 3];
+      int jo = mirror ? out_w - 1 - j : j;
+      for (int c = 0; c < 3; ++c) {
+        float v = (1 - wy) * ((1 - wx) * p00[c] + wx * p01[c]) +
+                  wy * ((1 - wx) * p10[c] + wx * p11[c]);
+        out[c * plane + static_cast<size_t>(i) * out_w + jo] =
+            static_cast<uint8_t>(v + 0.5f);
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+int mxnative_has_jpeg() { return 1; }
+
+// Decode n JPEGs into out (n, 3, out_h, out_w) uint8 on n_threads OS
+// threads.  status[i]: 0 = ok, 1 = decode failed (caller re-tries that
+// image on its fallback path).  Returns the success count.
+int64_t mxjpeg_decode_batch(const uint8_t* const* bufs,
+                            const int64_t* lens, int64_t n,
+                            int resize_min, int out_h, int out_w,
+                            const float* cy_frac, const float* cx_frac,
+                            const uint8_t* mirror, uint8_t* out,
+                            uint8_t* status, int64_t n_threads) {
+  const size_t stride = static_cast<size_t>(3) * out_h * out_w;
+  std::atomic<int64_t> next(0), ok_count(0);
+  auto worker = [&]() {
+    std::vector<uint8_t> px;
+    int64_t i;
+    while ((i = next.fetch_add(1)) < n) {
+      int w0 = 0, h0 = 0;
+      if (!decode_one(bufs[i], lens[i], resize_min, &px, &w0, &h0) ||
+          w0 < 1 || h0 < 1) {
+        status[i] = 1;
+        continue;
+      }
+      resize_crop(px, w0, h0, resize_min, out_h, out_w, cy_frac[i],
+                  cx_frac[i], mirror[i] != 0, out + i * stride);
+      status[i] = 0;
+      ok_count.fetch_add(1);
+    }
+  };
+  int64_t nt = std::min<int64_t>(std::max<int64_t>(n_threads, 1), n);
+  std::vector<std::thread> pool;
+  for (int64_t t = 1; t < nt; ++t) pool.emplace_back(worker);
+  worker();
+  for (auto& th : pool) th.join();
+  return ok_count.load();
+}
+
+}  // extern "C"
+
+#else  // MXNATIVE_NO_JPEG
+
+extern "C" {
+int mxnative_has_jpeg() { return 0; }
+}
+
+#endif  // MXNATIVE_NO_JPEG
