@@ -97,7 +97,6 @@ def box_iou_np(a, b):
 
 def evaluate(net, it, n_batches):
     """Top-detection recall: IoU >= 0.5 with gt AND correct class."""
-    import jax
     hits = total = 0
     it.reset()
     for _ in range(n_batches):
@@ -185,8 +184,19 @@ def main():
                       f"roi {float(closs.asnumpy()):.4f})")
             step += 1
 
-    recall = evaluate(net, it, n_batches=4)
-    print(f"top-detection recall (IoU>=0.5 + class): {recall:.3f}")
+    # held-out evaluation: a FRESH shard from a different seed — the
+    # gate must measure generalization, not training-set memorization
+    eval_path = "/tmp/synth_frcnn_eval"
+    if not os.path.exists(eval_path + ".rec"):
+        synth_rec(eval_path, 64, seed=1)
+    eval_it = ImageRecordIter(
+        path_imgrec=eval_path + ".rec", data_shape=(3, IMG, IMG),
+        batch_size=args.batch_size, shuffle=False, label_width=5,
+        scale=1.0 / 255, round_batch=True)
+    recall = evaluate(net, eval_it,
+                      n_batches=max(1, 64 // args.batch_size))
+    print(f"top-detection recall (IoU>=0.5 + class, held out): "
+          f"{recall:.3f}")
     if args.min_recall > 0 and recall < args.min_recall:
         print(f"FAIL: recall below {args.min_recall}", file=sys.stderr)
         return 1

@@ -713,11 +713,15 @@ class ImageRecordIter(DataIter):
             headers.append(hdr)
             blobs.append(blob)
         if not any(b[:2] == b"\xff\xd8" for b in blobs):
-            # not a JPEG shard — stop paying the probe on every batch
-            # (mixed batches still work: the native decoder reports
-            # per-image failure and those fall back below)
-            self._native_jpeg = False
+            # Zero JPEGs in this batch.  Disable the probe only while
+            # we have NEVER seen a JPEG from this shard (first-batch
+            # evidence of an all-PNG shard); once any batch has used
+            # the native tier, a stray all-PNG batch under shuffle must
+            # not turn it off for the rest of the epoch.
+            if not getattr(self, "_native_seen_jpeg", False):
+                self._native_jpeg = False
             return None, None
+        self._native_seen_jpeg = True
         _c, th, tw = self.data_shape
         n = len(blobs)
         if self.rand_crop:

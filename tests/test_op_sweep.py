@@ -740,7 +740,111 @@ ORACLES.update({
         q.astype(np.float32) * (max(abs(mn[0]), abs(mx[0])) / 127.0),
     "BilinearSampler": lambda data, grid, **k:
         _np_bilinear_sampler(data, grid),
+    "SpatialTransformer": lambda data, loc, target_shape=(),
+        transform_type="affine", sampler_type="bilinear", **k:
+        _np_bilinear_sampler(data, ORACLES["GridGenerator"](
+            loc, target_shape=target_shape)),
+    "CTCLoss": lambda data, label, *a, **k: _np_ctc(data, label),
+    "ROIPooling": lambda data, rois, pooled_size=(), spatial_scale=1.0:
+        _np_roipool(data, rois, pooled_size, spatial_scale),
+    "ROIAlign": lambda data, rois, pooled_size=(), spatial_scale=1.0,
+        sample_ratio=-1, **k: _np_roialign(
+            data, rois, pooled_size, spatial_scale,
+            sample_ratio if sample_ratio > 0 else 2),
+    "_contrib_multi_lars": lambda lrs, wss, gss, wds, eta=0.001,
+        eps=1e-8, rescale_grad=1.0: lrs * np.where(
+            (np.sqrt(wss) > 0) & (np.sqrt(gss) * rescale_grad > 0),
+            eta * np.sqrt(wss)
+            / (np.sqrt(gss) * rescale_grad + wds * np.sqrt(wss) + eps),
+            1.0),
+    "_contrib_requantize": lambda q, mn, mx, **k: (lambda real:
+        np.clip(np.round(real / (max(abs(real.min()), abs(real.max()))
+                                 / 127.0)), -127, 127).astype(np.int8))(
+        q.astype(np.float64) * (max(abs(mn[0]), abs(mx[0]))
+                                / float(2 ** 31 - 1))),
+    "_contrib_quantized_flatten": lambda x, mn, mx:
+        x.reshape(x.shape[0], -1),
 })
+
+
+def _np_ctc(data, label):
+    """Log-space alpha recursion (Graves 2006), blank = channel 0."""
+    T, N, _C = data.shape
+    x = data - data.max(-1, keepdims=True)
+    logp = x - np.log(np.exp(x).sum(-1, keepdims=True))
+    out = np.zeros(N, np.float32)
+    for n in range(N):
+        ext = [0]
+        for v in label[n]:
+            if v > 0:
+                ext += [int(v), 0]
+        S = len(ext)
+        alpha = np.full(S, -1e30)
+        alpha[0] = logp[0, n, 0]
+        if S > 1:
+            alpha[1] = logp[0, n, ext[1]]
+        for t in range(1, T):
+            new = np.full(S, -1e30)
+            for s in range(S):
+                best = alpha[s]
+                if s >= 1:
+                    best = np.logaddexp(best, alpha[s - 1])
+                if s >= 2 and ext[s] != 0 and ext[s] != ext[s - 2]:
+                    best = np.logaddexp(best, alpha[s - 2])
+                new[s] = best + logp[t, n, ext[s]]
+            alpha = new
+        tot = np.logaddexp(alpha[-1], alpha[-2]) if S > 1 else alpha[-1]
+        out[n] = -tot
+    return out
+
+
+def _np_roipool(data, rois, pooled_size, spatial_scale):
+    """Reference roi_pooling.cc semantics: integer-quantized corners,
+    floor/ceil bin boundaries, max over the exact pixels."""
+    ph, pw = pooled_size
+    _n, c, h, w = data.shape
+    out = np.zeros((rois.shape[0], c, ph, pw), np.float32)
+    for r, roi in enumerate(rois):
+        b = int(roi[0])
+        x1, y1 = round(roi[1] * spatial_scale), round(roi[2] * spatial_scale)
+        x2, y2 = round(roi[3] * spatial_scale), round(roi[4] * spatial_scale)
+        bh = max(y2 - y1 + 1, 1) / ph
+        bw = max(x2 - x1 + 1, 1) / pw
+        for i in range(ph):
+            hs = min(max(int(np.floor(i * bh)) + int(y1), 0), h)
+            he = min(max(int(np.ceil((i + 1) * bh)) + int(y1), 0), h)
+            for j in range(pw):
+                ws = min(max(int(np.floor(j * bw)) + int(x1), 0), w)
+                we = min(max(int(np.ceil((j + 1) * bw)) + int(x1), 0), w)
+                if he > hs and we > ws:
+                    out[r, :, i, j] = data[b, :, hs:he, ws:we].max((1, 2))
+    return out
+
+
+def _np_roialign(data, rois, pooled_size, spatial_scale, s):
+    """Bilinear sample grid of (ph*s, pw*s), mean per bin (reference:
+    contrib/roi_align.cc, edge-clamped sampling)."""
+    ph, pw = pooled_size
+    _n, c, h, w = data.shape
+    out = np.zeros((rois.shape[0], c, ph, pw), np.float64)
+    for r, roi in enumerate(rois):
+        b = int(roi[0])
+        x1, y1 = roi[1] * spatial_scale, roi[2] * spatial_scale
+        x2, y2 = roi[3] * spatial_scale, roi[4] * spatial_scale
+        rw, rh = max(x2 - x1, 1.0), max(y2 - y1, 1.0)
+        ys = y1 + rh * (np.arange(ph * s) + 0.5) / (ph * s)
+        xs = x1 + rw * (np.arange(pw * s) + 0.5) / (pw * s)
+        y0 = np.clip(np.floor(ys).astype(int), 0, h - 1)
+        x0 = np.clip(np.floor(xs).astype(int), 0, w - 1)
+        y1i, x1i = np.clip(y0 + 1, 0, h - 1), np.clip(x0 + 1, 0, w - 1)
+        wy, wx = ys - y0, xs - x0
+        img = data[b].astype(np.float64)
+        v = (img[:, y0][:, :, x0] * ((1 - wy)[:, None] * (1 - wx)[None, :])
+             + img[:, y0][:, :, x1i] * ((1 - wy)[:, None] * wx[None, :])
+             + img[:, y1i][:, :, x0] * (wy[:, None] * (1 - wx)[None, :])
+             + img[:, y1i][:, :, x1i] * (wy[:, None] * wx[None, :]))
+        out[r] = v.reshape(c, ph, s, pw, s).mean((2, 4))
+    return out
 
 
 def _np_dense_selfatt(qkv, heads, vlen):
@@ -1331,5 +1435,23 @@ def test_sweep_budget():
     # an independent NumPy forward reference, not just smoke+FD — and
     # the floor is asserted so coverage can only ratchet up
     n_oracle = sum(1 for n in CANONICAL if n in ORACLES)
-    assert n_oracle >= 230, n_oracle
-    assert n_oracle >= 0.85 * len(CANONICAL), (n_oracle, len(CANONICAL))
+    assert n_oracle >= 240, n_oracle
+    assert n_oracle >= 0.9 * len(CANONICAL), (n_oracle, len(CANONICAL))
+    # every oracle-less canonical op is one of the legitimate classes:
+    # rng samplers (distribution tests live in test_ndarray/test_text),
+    # sign-ambiguous decompositions, or complex ops with dedicated
+    # oracle tests elsewhere (quantized conv/fc, MultiBox target/
+    # detection, MoE) — list pinned so a new op can't silently join it
+    allowed_no_oracle = {
+        "BilinearResize2D", "Correlation", "MultiBoxDetection",
+        "MultiBoxTarget", "_contrib_moe_ffn",
+        "_contrib_moe_top1_dispatch", "_contrib_quantized_act",
+        "_contrib_quantized_conv", "_contrib_quantized_fully_connected",
+        "_contrib_quantized_pooling", "_linalg_gelqf", "_linalg_syevd",
+        "_random_exponential", "_random_gamma",
+        "_random_negative_binomial", "_random_normal",
+        "_random_poisson", "_random_randint", "_random_uniform",
+        "_sample_multinomial", "_sample_unique_zipfian", "_shuffle",
+        "sample_normal", "sample_uniform", "Custom"}
+    missing = {n for n in CANONICAL if n not in ORACLES}
+    assert missing <= allowed_no_oracle, missing - allowed_no_oracle
