@@ -21,7 +21,16 @@ import mxnet_tpu as mx
 import mxnet_tpu.gluon, mxnet_tpu.kvstore, mxnet_tpu.io, mxnet_tpu.image
 import mxnet_tpu.module, mxnet_tpu.executor, mxnet_tpu.contrib
 import mxnet_tpu.parallel, mxnet_tpu.models, mxnet_tpu.np
+import mxnet_tpu.runtime_metrics, mxnet_tpu.monitor
 print(mx.runtime.Features())"
+    # environment/metrics doctor: end-to-end smoke of the metrics
+    # registry (enable -> dispatch -> assert counters)
+    python tools/diagnose.py --metrics-smoke
+}
+
+diagnose() {
+    # standalone doctor job (reference: tools/diagnose.py parity)
+    python tools/diagnose.py --metrics-smoke
 }
 
 multichip_dryrun() {

@@ -42,7 +42,8 @@ def waitall():
 import importlib as _importlib
 
 for _mod in ("initializer", "optimizer", "metric", "gluon", "io", "kvstore",
-             "recordio", "callback", "profiler", "util", "runtime",
+             "recordio", "callback", "profiler", "runtime_metrics",
+             "monitor", "util", "runtime",
              "test_utils", "executor", "module", "image", "contrib",
              "parallel", "models", "np", "npx", "lr_scheduler", "operator",
              "library", "subgraph", "deploy"):

@@ -200,3 +200,13 @@ declare_env("MXNET_DEFAULT_DTYPE", "float32", "Default dtype for new arrays.")
 declare_env("MXNET_TPU_DISABLE_NATIVE", "0",
             "1 = skip building/loading the native C++ IO library and use "
             "the pure-python RecordIO tier.")
+declare_env("MXNET_RUNTIME_METRICS", "0",
+            "1 = enable the process-wide runtime metrics registry "
+            "(mxnet_tpu.runtime_metrics): op dispatch counters/latency, "
+            "engine/io/kvstore/trainer instrumentation, Prometheus + "
+            "chrome-trace + TensorBoard exporters. Off by default; the "
+            "disabled path is a single flag check per site.")
+declare_env("MXNET_RUNTIME_METRICS_GRAD_NORM", "0",
+            "1 = also sample the global L2 gradient norm into the "
+            "trainer.grad_norm gauge after each step (forces a device "
+            "sync per step to read gradients; NaN/blowup debugging aid).")

@@ -4,6 +4,8 @@ from __future__ import annotations
 import logging
 import time
 
+from . import runtime_metrics as _rm
+
 __all__ = ["Speedometer", "do_checkpoint", "ProgressBar",
            "LogValidationMetricsCallback", "module_checkpoint"]
 
@@ -48,6 +50,10 @@ class Speedometer:
             if count % self.frequent == 0:
                 speed = self.frequent * self.batch_size / \
                     (time.time() - self.tic)
+                # publish into the metrics registry so throughput shows
+                # up in Prometheus/TensorBoard exports without extra
+                # wiring (no-op while MXNET_RUNTIME_METRICS is off)
+                _rm.TRAINER_SAMPLES_PER_SEC.set(speed)
                 if param.eval_metric is not None:
                     names, vals = param.eval_metric.get()
                     if not isinstance(names, list):

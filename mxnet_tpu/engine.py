@@ -18,9 +18,11 @@ What survives is the reference's **semantic contract**:
 from __future__ import annotations
 
 import threading
+import time
 import weakref
 
 from .base import get_env
+from . import runtime_metrics as _rm
 
 __all__ = ["Engine", "engine", "waitall", "is_naive", "set_bulk_size",
            "bulk", "Var"]
@@ -85,6 +87,10 @@ class Engine:
         """Register a live NDArray so waitall() can block on it."""
         with self._lock:
             self._live[id(arr)] = arr
+            if _rm._ENABLED:
+                n = len(self._live)
+                _rm.ENGINE_TRACKED.set(n)
+                _rm.ENGINE_TRACKED_PEAK.set_max(n)
 
     def wait_for_all(self):
         """Block until all tracked arrays are ready (reference:
@@ -141,7 +147,17 @@ def waitall():
     from . import autograd
     if autograd._STATE.pending is not None:
         autograd.flush_pending()
-    Engine.get().wait_for_all()
+    if not _rm._ENABLED:
+        Engine.get().wait_for_all()
+        return
+    t0 = time.perf_counter()
+    try:
+        Engine.get().wait_for_all()
+    finally:
+        # waitall is the framework's full-pipeline stall point: count it
+        # and record how long the host sat blocked
+        _rm.ENGINE_WAITALL.inc()
+        _rm.ENGINE_WAITALL_SECONDS.observe(time.perf_counter() - t0)
 
 
 def is_naive() -> bool:

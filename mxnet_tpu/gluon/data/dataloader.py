@@ -14,6 +14,7 @@ import numpy as np
 
 from ...base import MXNetError
 from ... import ndarray as nd
+from ... import runtime_metrics as _rm
 from ...ndarray import NDArray
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
 
@@ -70,6 +71,8 @@ class DataLoader:
     def __iter__(self):
         if self._pool is None:
             for indices in self._batch_sampler:
+                if _rm._ENABLED:
+                    _rm.IO_BATCHES.inc()
                 yield self._make_batch(indices)
             return
         # pipelined prefetch through the thread pool
@@ -89,6 +92,9 @@ class DataLoader:
         while queue:
             fut = queue.popleft()
             fill()
+            if _rm._ENABLED:
+                _rm.IO_BATCHES.inc()
+                _rm.IO_PREFETCH_DEPTH.set(len(queue))
             yield fut.result()
 
     def __len__(self):

@@ -13,8 +13,11 @@ copies while Adam-style step counters do not double-advance.
 """
 from __future__ import annotations
 
+import time
+
 from ..base import MXNetError
 from .. import optimizer as opt
+from .. import runtime_metrics as _rm
 from ..ndarray import NDArray
 from .parameter import Parameter, ParameterDict
 
@@ -205,6 +208,21 @@ class Trainer:
         tape (see autograd.backward), the whole backward+update runs as
         ONE donated XLA program here — the three-call recipe at fused-step
         cost."""
+        if not _rm._ENABLED:
+            self._step_impl(batch_size, ignore_stale_grad)
+        else:
+            t0 = time.perf_counter()
+            try:
+                self._step_impl(batch_size, ignore_stale_grad)
+            finally:
+                _rm.TRAINER_STEP_SECONDS.observe(time.perf_counter() - t0)
+            if _rm.grad_norm_enabled():
+                self._publish_grad_norm()
+        from .. import profiler as _prof
+        if _prof._ACTIVE and _prof._state["profile_memory"]:
+            _prof.sample_memory()   # per-step live-bytes counter event
+
+    def _step_impl(self, batch_size, ignore_stale_grad):
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
@@ -214,6 +232,10 @@ class Trainer:
         autograd.flush_pending()
         self._allreduce_grads()
         self._update(ignore_stale_grad)
+
+    def _publish_grad_norm(self):
+        _rm.publish_grad_norm(p.list_grad()[0] for p in self._params
+                              if p.grad_req != "null")
 
     def allreduce_grads(self):
         if not self._kv_initialized:

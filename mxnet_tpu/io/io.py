@@ -24,6 +24,7 @@ from ..base import MXNetError
 from .. import ndarray as nd
 from ..ndarray import NDArray
 from .. import recordio
+from .. import runtime_metrics as _rm
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "ResizeIter",
            "PrefetchingIter", "NDArrayIter", "CSVIter", "MNISTIter",
@@ -77,6 +78,8 @@ class DataIter:
 
     def next(self) -> DataBatch:
         if self.iter_next():
+            if _rm._ENABLED:
+                _rm.IO_BATCHES.inc()
             return DataBatch(data=self.getdata(), label=self.getlabel(),
                              pad=self.getpad(), index=self.getindex())
         raise StopIteration
@@ -224,6 +227,9 @@ class PrefetchingIter(DataIter):
         if self._done:
             raise StopIteration
         got = self._queue.get()
+        if _rm._ENABLED:
+            # depth AFTER this take: how far ahead the producer is
+            _rm.IO_PREFETCH_DEPTH.set(self._queue.qsize())
         if got is None:
             self._done = True  # producer exited; don't block on next call
             raise StopIteration
@@ -360,6 +366,8 @@ class NDArrayIter(DataIter):
     def next(self):
         if not self.iter_next():
             raise StopIteration
+        if _rm._ENABLED:
+            _rm.IO_BATCHES.inc()
         return DataBatch(data=self.getdata(), label=self.getlabel(),
                          pad=self.getpad(), index=None,
                          provide_data=self.provide_data,
@@ -624,12 +632,16 @@ class ImageRecordIter(DataIter):
         if self._done:
             raise StopIteration
         got = self._queue.get()
+        if _rm._ENABLED:
+            _rm.IO_PREFETCH_DEPTH.set(self._queue.qsize())
         if got is None:
             self._done = True
             raise StopIteration
         if isinstance(got, Exception):
             self._done = True
             raise got
+        if _rm._ENABLED:
+            _rm.IO_BATCHES.inc()
         return got
 
     def iter_next(self):
@@ -647,6 +659,8 @@ class ImageRecordIter(DataIter):
 
     def _decode_one(self, payload, rng):
         import cv2
+        if _rm._ENABLED:
+            _rm.IO_PYTHON_DECODE.inc()
         header, blob = recordio.unpack(payload)
         # DCT-domain reduced decode: when the source is >= 2x/4x/8x the
         # resize target, libjpeg can IDCT straight to the smaller scale —
@@ -736,6 +750,10 @@ class ImageRecordIter(DataIter):
         out, status = nativelib.decode_jpeg_batch(
             blobs, self.resize if self.resize > 0 else 0, th, tw,
             cy, cx, mir, self._nthreads)
+        if _rm._ENABLED:
+            # failed records are re-decoded on the Python path below,
+            # where _decode_one counts them
+            _rm.IO_NATIVE_DECODE.inc(n - int(np.count_nonzero(status)))
         data = out.astype(np.float32)
         if self.mean.any() or self.scale != 1.0:
             data = (data - self.mean) * self.scale

@@ -34,12 +34,28 @@ from ..base import MXNetError, get_env
 from ..context import cpu
 from ..ndarray import NDArray
 from .. import optimizer as opt
+from .. import runtime_metrics as _rm
 from .base import KVStoreBase
 
 __all__ = ["KVStore", "create"]
 
 
 from ..util import as_list as _as_list
+
+
+def _nd_bytes(vals) -> int:
+    """Payload size of a list of NDArrays (shape x itemsize; sparse and
+    exotic values count 0 rather than densifying just to be measured)."""
+    total = 0
+    for v in vals:
+        try:
+            n = 1
+            for s in v.shape:
+                n *= int(s)
+            total += n * np.dtype(v.dtype).itemsize
+        except Exception:   # noqa: BLE001
+            pass
+    return total
 
 
 def _normalize(key, value):
@@ -116,6 +132,9 @@ class KVStore(KVStoreBase):
     # ---------------------------------------------------------------- push
     def push(self, key, value, priority=0):
         for k, vals in _normalize(key, value):
+            if _rm._ENABLED:
+                _rm.KV_PUSH.inc()
+                _rm.KV_PUSH_BYTES.inc(_nd_bytes(vals))
             self._push_one(k, vals)
 
     def _push_one(self, k, vals):
@@ -145,6 +164,9 @@ class KVStore(KVStoreBase):
             if k not in self._store:
                 raise MXNetError(f"kvstore: pull of uninitialized key {k!r}")
             stored = self._store[k]
+            if _rm._ENABLED:
+                _rm.KV_PULL.inc()
+                _rm.KV_PULL_BYTES.inc(_nd_bytes(outs))
             for o in outs:
                 stored.copyto(o)
 
@@ -282,7 +304,12 @@ class XLA(KVStore):
         if any(len(v) == 1 for _, v in pairs) or self._updater is not None \
                 or self._compressor is not None:
             # degenerate / compressed path: classic push+pull via store
+            # (which carries its own push/pull accounting)
             return super().pushpull(key, value, out, priority)
+        if _rm._ENABLED:
+            for _k, vals in pairs:
+                _rm.KV_PUSH.inc()
+                _rm.KV_PUSH_BYTES.inc(_nd_bytes(vals))
         reduced = self._fused_allreduce(pairs)
         for k, _ in pairs:
             per_dev = reduced[k]
@@ -291,6 +318,9 @@ class XLA(KVStore):
         if out is not None:
             for k, outs in _normalize(key, out):
                 per_dev = reduced[k]
+                if _rm._ENABLED:
+                    _rm.KV_PULL.inc()
+                    _rm.KV_PULL_BYTES.inc(_nd_bytes(outs))
                 for o, r in zip(outs, per_dev):
                     o._set_data(r._data.astype(o._data.dtype))
 

@@ -13,6 +13,7 @@ import time
 from ..base import MXNetError
 from .. import metric as metric_mod
 from .. import ndarray as nd
+from .. import runtime_metrics as _rm
 from ..util import as_list as _as_list
 
 __all__ = ["BaseModule"]
@@ -102,13 +103,23 @@ class BaseModule:
         eval_metric = _as_metric(eval_metric)
         validation_metric = (_as_metric(validation_metric)
                              if validation_metric else eval_metric)
+        if monitor is not None:
+            monitor.install(self)
 
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
             for nbatch, data_batch in enumerate(train_data):
+                if monitor is not None:
+                    monitor.tic()
+                t_step = time.perf_counter() if _rm._ENABLED else None
                 self.forward_backward(data_batch)
                 self.update()
+                if t_step is not None:
+                    _rm.TRAINER_STEP_SECONDS.observe(
+                        time.perf_counter() - t_step)
+                if monitor is not None:
+                    monitor.toc_print()
                 self.update_metric(eval_metric, data_batch.label)
                 if batch_end_callback is not None:
                     param = _BatchEndParam(epoch=epoch, nbatch=nbatch,

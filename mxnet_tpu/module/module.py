@@ -16,6 +16,7 @@ from .. import context as ctx_mod
 from .. import initializer as init_mod
 from .. import ndarray as nd
 from .. import optimizer as opt_mod
+from .. import runtime_metrics as _rm
 from ..initializer import InitDesc
 from ..optimizer.optimizer import get_updater
 from .base_module import BaseModule
@@ -269,6 +270,11 @@ class Module(BaseModule):
                 continue
             self._updater(name, self._exec.grad_dict[name],
                           self._exec.arg_dict[name])
+        if _rm._ENABLED and _rm.grad_norm_enabled():
+            _rm.publish_grad_norm(
+                self._exec.grad_dict[n] for n in self._param_names
+                if self._exec._grad_req.get(n, "null") != "null"
+                and n in self._exec.grad_dict)
 
     def get_outputs(self, merge_multi_context=True):
         return list(self._exec.outputs)

@@ -1,0 +1,203 @@
+"""Per-batch tensor monitor (reference: ``python/mxnet/monitor.py``).
+
+The reference ``mx.monitor.Monitor`` hooks an executor's per-op outputs
+and stats weights on every ``interval``-th batch — the standard tool for
+catching NaNs/blowups mid-training.  Here the same ``tic``/``toc``/
+``toc_print`` API covers all three frontends:
+
+- **Gluon**: ``install(block)`` registers forward hooks on every
+  sub-block, so activations are statted as they are produced;
+- **Module**: ``install(module)`` (or passing ``monitor=`` to
+  ``Module.fit``) stats the bound executor's args/grads/outputs at
+  ``toc`` time;
+- **Executor**: ``install(executor)`` stats ``arg_dict``/``grad_dict``/
+  ``outputs`` directly.
+
+Stats are computed eagerly at capture time (the default stat is
+``||x||_2 / sqrt(x.size)``), which forces the monitored arrays to
+materialize — per-batch tensor inspection is inherently a synchronizing
+debug tool; expect it to serialize the async pipeline while active.
+
+Usage::
+
+    mon = mx.monitor.Monitor(interval=10, pattern=".*weight.*")
+    mon.install(net)
+    for batch in loader:
+        mon.tic()
+        ...forward/backward/step...
+        mon.toc_print()
+"""
+from __future__ import annotations
+
+import logging
+import math
+import re
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["Monitor"]
+
+_LOG = logging.getLogger("mxnet_tpu")
+
+
+def _to_numpy(x):
+    if hasattr(x, "asnumpy"):
+        return x.asnumpy()
+    return np.asarray(x)
+
+
+def _is_traced(x) -> bool:
+    """True when ``x`` is an NDArray wrapping a JAX tracer — i.e. we are
+    inside a hybridize/CachedOp trace, where values are symbolic and
+    reading them would poison the array's engine var.  Hooks skip these:
+    on a hybridized block, per-layer output stats exist only for the
+    non-traced path; weights/grads are still statted at ``toc()``."""
+    data = getattr(x, "_data", None)
+    if data is None:
+        return False
+    try:
+        import jax
+        return isinstance(data, jax.core.Tracer)
+    except Exception:       # noqa: BLE001 — jax internals moved
+        return not hasattr(data, "block_until_ready") and \
+            not isinstance(data, np.ndarray)
+
+
+def default_stat(arr) -> float:
+    """``||x||_2 / sqrt(x.size)`` (the reference's default stat_func) —
+    scale-invariant enough to eyeball across layers, and NaN-propagating
+    so a poisoned tensor is immediately visible."""
+    a = _to_numpy(arr)
+    if a.size == 0:
+        return 0.0
+    return float(np.linalg.norm(a.astype(np.float64)) / math.sqrt(a.size))
+
+
+class Monitor:
+    """reference: mx.monitor.Monitor(interval, stat_func, pattern, sort)."""
+
+    def __init__(self, interval=1, stat_func=None, pattern=".*",
+                 sort=False, monitor_all=False):
+        if interval < 1:
+            raise MXNetError("Monitor: interval must be >= 1")
+        self.interval = int(interval)
+        self.stat_func = stat_func or default_stat
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+        self.monitor_all = monitor_all
+        self.activated = False
+        self.step = 0
+        self.queue = []             # (step, name, stat)
+        self._blocks = []
+        self._modules = []
+        self._executors = []
+
+    # ------------------------------------------------------------- install
+    def install(self, target):
+        """Attach to a Gluon ``Block``, a ``Module``, or an ``Executor``.
+        May be called multiple times to monitor several targets;
+        re-installing the same target is a no-op (Module.fit installs on
+        every call)."""
+        from .gluon.block import Block
+        if isinstance(target, Block):
+            self._install_block(target)
+        elif hasattr(target, "arg_dict") and hasattr(target, "outputs"):
+            if not any(target is e for e in self._executors):
+                self._executors.append(target)
+        elif hasattr(target, "bind") and hasattr(target, "get_outputs"):
+            if not any(target is m for m in self._modules):
+                self._modules.append(target)
+        else:
+            raise MXNetError(
+                f"Monitor.install: cannot monitor {type(target).__name__} "
+                f"(expected Gluon Block, Module, or Executor)")
+        return self
+
+    def _install_block(self, root):
+        if any(root is b for b in self._blocks):
+            return              # already hooked: never double-register
+        self._blocks.append(root)
+        monitor = self
+
+        def _hook(block, _inputs, outputs):
+            if not monitor.activated:
+                return
+            outs = outputs if isinstance(outputs, (list, tuple)) \
+                else (outputs,)
+            for i, o in enumerate(outs):
+                name = f"{block.name}_output{i}" if len(outs) > 1 \
+                    else f"{block.name}_output"
+                monitor._stat_one(name, o)
+
+        for blk in root._iter_blocks():
+            blk.register_forward_hook(_hook)
+
+    # ------------------------------------------------------------ stepping
+    def tic(self):
+        """Activate collection if this batch hits the interval.  Call
+        before the forward pass (reference: Monitor.tic)."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """End the monitoring scope: stat weights/gradients of installed
+        targets, deactivate, and return ``[(step, name, stat), ...]``."""
+        if not self.activated:
+            return []
+        for blk in self._blocks:
+            self._stat_params(blk.collect_params().items())
+        for mod in self._modules:
+            exe = getattr(mod, "_exec", None)
+            if exe is not None:
+                self._stat_executor(exe)
+        for exe in self._executors:
+            self._stat_executor(exe)
+        self.activated = False
+        res = sorted(self.queue, key=lambda kv: kv[1]) if self.sort \
+            else list(self.queue)
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        """``toc()`` + log one line per stat (reference: toc_print)."""
+        res = self.toc()
+        for step, name, value in res:
+            _LOG.info("Batch: %7d %30s %s", step, name, value)
+        return res
+
+    # ------------------------------------------------------------ internals
+    def _stat_one(self, name, arr):
+        if not self.re_prog.match(name) or _is_traced(arr):
+            return
+        try:
+            self.queue.append((self.step, name, self.stat_func(arr)))
+        except Exception as e:      # noqa: BLE001 — lazy/husk arrays
+            self.queue.append((self.step, name, f"<error: {e}>"))
+
+    def _stat_params(self, items):
+        for name, p in items:
+            try:
+                data = p.data()
+            except Exception:       # noqa: BLE001 — uninitialized
+                continue
+            self._stat_one(name, data)
+            if p.grad_req != "null":
+                try:
+                    self._stat_one(name + "_grad", p.grad())
+                except Exception:   # noqa: BLE001 — no grad attached
+                    pass
+
+    def _stat_executor(self, exe):
+        for name, arr in exe.arg_dict.items():
+            self._stat_one(name, arr)
+        for name, arr in exe.grad_dict.items():
+            self._stat_one(name + "_grad", arr)
+        if self.monitor_all:
+            for name, arr in getattr(exe, "aux_dict", {}).items():
+                self._stat_one(name, arr)
+        for i, out in enumerate(getattr(exe, "outputs", []) or []):
+            self._stat_one(f"output{i}", out)

@@ -213,13 +213,20 @@ def invoke(opdef: OpDef, inputs, kwargs: Dict[str, Any], out=None):
         fn._rng_key = _fixed_key
 
     from .. import profiler as _prof
-    t0 = _prof._now_us() if _prof._ACTIVE else None
+    from .. import runtime_metrics as _rm
+    # one bool each for the two observability planes: the disabled path
+    # costs these two loads + branch (microbench-verified <2%)
+    _collect = _rm._ENABLED
+    t0 = _prof._now_us() if (_prof._ACTIVE or _collect) else None
     try:
         result = fn(*raw)
     except Exception as e:
         raise MXNetError(f"operator {opdef.name} failed: {e}") from e
     if t0 is not None:
-        _prof.record_op(opdef.name, t0, _prof._now_us())
+        t1 = _prof._now_us()
+        _prof.record_op(opdef.name, t0, t1)
+        if _collect:
+            _rm.record_op_invoke(opdef.name, (t1 - t0) * 1e-6)
 
     nout = opdef.n_outputs(kwargs)
     outs_raw = (result,) if nout == 1 and not isinstance(result, tuple) \

@@ -25,9 +25,11 @@ from .base import MXNetError
 
 __all__ = ["set_config", "set_state", "start", "stop", "pause", "resume",
            "dump", "dumps", "scope", "Task", "Frame", "Event", "Counter",
-           "Marker"]
+           "Marker", "sample_memory"]
 
-_lock = threading.Lock()
+# RLock: memory sampling and the event-append helper run inside
+# start/stop critical sections
+_lock = threading.RLock()
 _state = {
     "running": False,
     "paused": False,
@@ -39,36 +41,67 @@ _state = {
     "aggregate_stats": False,
     "device_trace": None,       # logdir for jax.profiler, or None
     "events": [],               # chrome trace events
+    "continuous_dump": False,
     "t0": None,
     "_jax_tracing": False,
 }
 
 # fast-path flag read by the dispatcher on every op call
 _ACTIVE = False
+# re-entrancy guard: dump(finished=True) stops the profiler, and stop()
+# auto-dumps under continuous_dump — without the guard they'd recurse
+_DUMPING = False
 
 
 def _now_us():
     return time.perf_counter() * 1e6
 
 
+def _append_event(ev: dict):
+    """Lock-protected event append: `dumps(reset=True)` swaps the event
+    list under `_lock`, so writers must serialize against it or an event
+    recorded mid-swap lands on the list being thrown away."""
+    with _lock:
+        _state["events"].append(ev)
+
+
+# keys that may be re-configured while the profiler is running: the
+# output path and the dump-on-stop policy affect only where/when events
+# are written, never what is recorded
+_RECONFIG_WHILE_RUNNING = {"filename", "continuous_dump"}
+
+
 def set_config(**kwargs):
     """Configure (reference: profiler.set_config).  Accepted keys:
     filename, profile_all, profile_imperative, profile_symbolic,
-    profile_memory, profile_api, aggregate_stats, device_trace (logdir
-    for the XLA/TensorBoard device trace)."""
-    if _state["running"]:
-        raise MXNetError("set_config while profiler is running")
+    profile_memory, profile_api, aggregate_stats, continuous_dump
+    (auto-dump on stop; dump() while running snapshots without reset),
+    device_trace (logdir for the XLA/TensorBoard device trace).
+
+    While the profiler is running only ``filename`` and
+    ``continuous_dump`` may be changed (so the dump target can be picked
+    after ``start()``); any other key raises."""
     allowed = {"filename", "profile_all", "profile_imperative",
                "profile_symbolic", "profile_memory", "profile_api",
                "aggregate_stats", "device_trace", "continuous_dump"}
-    for k, v in kwargs.items():
+    for k in kwargs:
         if k not in allowed:
             raise MXNetError(f"set_config: unknown option {k!r}")
-        if k == "profile_all" and v:
-            _state.update(profile_imperative=True, profile_symbolic=True,
-                          profile_api=True, profile_memory=True)
-        elif k != "profile_all":
-            _state[k] = v
+    if _state["running"]:
+        bad = set(kwargs) - _RECONFIG_WHILE_RUNNING
+        if bad:
+            raise MXNetError(
+                f"set_config while profiler is running: only "
+                f"{sorted(_RECONFIG_WHILE_RUNNING)} may change mid-run "
+                f"(got {sorted(bad)})")
+    with _lock:
+        for k, v in kwargs.items():
+            if k == "profile_all" and v:
+                _state.update(profile_imperative=True,
+                              profile_symbolic=True,
+                              profile_api=True, profile_memory=True)
+            elif k != "profile_all":
+                _state[k] = v
 
 
 def set_state(state: str):
@@ -98,6 +131,8 @@ def start():
                 _state["_jax_tracing"] = True
             except Exception:   # tracing backend unavailable: host-only
                 _state["_jax_tracing"] = False
+    if _state["profile_memory"]:
+        sample_memory()         # baseline live-bytes sample at t=0
 
 
 def stop():
@@ -105,6 +140,8 @@ def stop():
     with _lock:
         if not _state["running"]:
             return
+        if _state["profile_memory"]:
+            sample_memory()     # closing live-bytes sample while active
         _state["running"] = False
         _ACTIVE = False
         if _state["_jax_tracing"]:
@@ -114,18 +151,22 @@ def stop():
             except Exception:
                 pass
             _state["_jax_tracing"] = False
+    if _state["continuous_dump"] and not _DUMPING:
+        dump()
 
 
 def pause():
     global _ACTIVE
-    _state["paused"] = True
-    _ACTIVE = False
+    with _lock:
+        _state["paused"] = True
+        _ACTIVE = False
 
 
 def resume():
     global _ACTIVE
-    _state["paused"] = False
-    _ACTIVE = _state["running"]
+    with _lock:
+        _state["paused"] = False
+        _ACTIVE = _state["running"]
 
 
 def _record(name: str, cat: str, t_start_us: float, dur_us: float,
@@ -135,7 +176,7 @@ def _record(name: str, cat: str, t_start_us: float, dur_us: float,
           "pid": os.getpid(), "tid": threading.get_ident()}
     if args:
         ev["args"] = args
-    _state["events"].append(ev)
+    _append_event(ev)
 
 
 def record_op(opname: str, t_start_us: float, t_end_us: float):
@@ -195,7 +236,7 @@ class Counter:
     def set_value(self, value):
         self._value = value
         if _ACTIVE:
-            _state["events"].append({
+            _append_event({
                 "name": self.name, "ph": "C",
                 "ts": _now_us() - _state["t0"], "pid": os.getpid(),
                 "args": {self.name: self._value}})
@@ -215,7 +256,7 @@ class Marker:
 
     def mark(self, scope_kind="process"):
         if _ACTIVE:
-            _state["events"].append({
+            _append_event({
                 "name": self.name, "ph": "i",
                 "ts": _now_us() - _state["t0"], "pid": os.getpid(),
                 "tid": threading.get_ident(),
@@ -223,14 +264,39 @@ class Marker:
                       "global": "g"}.get(scope_kind, "p")})
 
 
+def sample_memory():
+    """Sample per-device live bytes (``jax.Device.memory_stats()``, host
+    RSS fallback) into the runtime-metrics ``memory.live_bytes`` gauge,
+    and — when the profiler is running with ``profile_memory=True`` —
+    emit a chrome-trace ``ph:"C"`` counter event so memory shares the
+    trace timeline.  Returns the sampled ``(device, bytes, limit)``
+    list."""
+    from . import runtime_metrics as _rm
+    stats = _rm.sample_memory()
+    if _ACTIVE and _state["profile_memory"]:
+        _append_event({
+            "name": "memory.live_bytes", "ph": "C",
+            "ts": _now_us() - _state["t0"], "pid": os.getpid(),
+            "args": {dev: used for dev, used, _limit in stats}})
+    return stats
+
+
 def dumps(reset=False, format="json") -> str:
     """Serialized profile.  format='json': chrome trace; 'table': the
-    reference's aggregate-stats text summary."""
+    reference's aggregate-stats text summary.
+
+    When the runtime metrics registry is enabled, the JSON trace also
+    carries one ``ph:"C"`` counter event per registry metric (snapshot
+    at dump time), so op counters/histograms line up with host spans."""
     with _lock:
         events = list(_state["events"])
         if reset:
             _state["events"] = []
+        t0 = _state["t0"]
     if format == "json":
+        from . import runtime_metrics as _rm
+        if _rm._ENABLED:
+            events = events + _rm.chrome_counter_events(t0 or 0.0)
         return json.dumps({"traceEvents": events,
                            "displayTimeUnit": "ms"}, indent=1)
     if format != "table":
@@ -249,11 +315,27 @@ def dumps(reset=False, format="json") -> str:
 
 
 def dump(finished=True, profile_process="worker"):
-    """Write the chrome-trace file (reference: profiler.dump)."""
-    path = _state["filename"]
+    """Write the chrome-trace file (reference: profiler.dump).
+
+    ``finished=True`` while the profiler is running stops it first
+    (reference semantics: the profile won't be resumed).  Under
+    ``continuous_dump`` a mid-run ``dump(finished=False)`` snapshots the
+    events so far without resetting them.  The target path is read at
+    call time, so a ``set_config(filename=...)`` issued after
+    ``start()`` is honored, and the path written is the path returned."""
+    global _DUMPING
+    if _state["running"] and finished:
+        _DUMPING = True
+        try:
+            stop()
+        finally:
+            _DUMPING = False
+    with _lock:
+        path = _state["filename"]
+        aggregate = _state["aggregate_stats"]
     with open(path, "w") as f:
         f.write(dumps())
-    if _state["aggregate_stats"]:
+    if aggregate:
         with open(path + ".summary.txt", "w") as f:
             f.write(dumps(format="table"))
     return path

@@ -1,0 +1,173 @@
+"""Monitor tests: install/tic/toc on a Gluon net, regex filtering,
+interval gating, Module integration (reference strategy:
+tests/python/unittest/test_monitor.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.monitor import Monitor, default_stat
+
+
+def _small_net():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4, activation="relu"), gluon.nn.Dense(2))
+    net.initialize()
+    return net
+
+
+class TestMonitorGluon:
+    def test_install_tic_toc_collects_outputs_weights_grads(self):
+        net = _small_net()
+        mon = Monitor(interval=1).install(net)
+        mon.tic()
+        x = nd.ones((3, 5))
+        with autograd.record():
+            out = net(x).sum()
+        out.backward()
+        res = mon.toc()
+        names = [n for _step, n, _v in res]
+        assert any(n.endswith("_output") for n in names)
+        assert any("weight" in n and not n.endswith("_grad")
+                   for n in names)
+        assert any(n.endswith("weight_grad") for n in names)
+        # default stat is finite on a healthy net
+        for _step, n, v in res:
+            if isinstance(v, float):
+                assert np.isfinite(v), (n, v)
+        # deactivated after toc: nothing collected until the next tic
+        assert mon.toc() == []
+
+    def test_pattern_filters_stats(self):
+        net = _small_net()
+        mon = Monitor(interval=1, pattern=".*weight.*").install(net)
+        mon.tic()
+        net(nd.ones((2, 5)))
+        res = mon.toc()
+        assert res
+        assert all("weight" in n for _s, n, _v in res)
+
+    def test_interval_gates_collection(self):
+        net = _small_net()
+        mon = Monitor(interval=2).install(net)
+        mon.tic()                       # step 0: active
+        net(nd.ones((2, 5)))
+        assert mon.toc()
+        mon.tic()                       # step 1: inactive
+        net(nd.ones((2, 5)))
+        assert mon.toc() == []
+        mon.tic()                       # step 2: active again
+        net(nd.ones((2, 5)))
+        assert mon.toc()
+
+    def test_custom_stat_func_detects_nan(self):
+        net = _small_net()
+        mon = Monitor(interval=1,
+                      stat_func=lambda a: float(
+                          np.isnan(a.asnumpy()).any())).install(net)
+        mon.tic()
+        x = nd.array(np.full((2, 5), np.nan, np.float32))
+        net(x)
+        res = mon.toc()
+        nan_hits = [n for _s, n, v in res
+                    if n.endswith("_output") and v == 1.0]
+        assert nan_hits                 # NaN propagated and was flagged
+
+    def test_sort_orders_by_name(self):
+        net = _small_net()
+        mon = Monitor(interval=1, sort=True).install(net)
+        mon.tic()
+        net(nd.ones((2, 5)))
+        res = mon.toc()
+        names = [n for _s, n, _v in res]
+        assert names == sorted(names)
+
+    def test_toc_print_logs_and_returns(self, caplog):
+        import logging
+        net = _small_net()
+        mon = Monitor(interval=1, pattern=".*bias.*").install(net)
+        mon.tic()
+        net(nd.ones((2, 5)))
+        with caplog.at_level(logging.INFO, logger="mxnet_tpu"):
+            res = mon.toc_print()
+        assert res
+        assert any("bias" in r.message for r in caplog.records)
+
+    def test_hybridized_block_safe(self):
+        """Hooks fire with tracer-backed outputs during the CachedOp
+        trace; they must be skipped, not poison the engine vars."""
+        net = _small_net()
+        net.hybridize(static_alloc=True)
+        mon = Monitor(interval=1).install(net)
+        for _ in range(3):              # trace pass + compiled passes
+            mon.tic()
+            with autograd.record():
+                loss = net(nd.ones((2, 5))).sum()
+            loss.backward()
+            res = mon.toc()
+            # weights/grads still statted at toc even when outputs are
+            # unavailable on the compiled path
+            assert any("weight" in n for _s, n, _v in res)
+            assert not any(str(v).startswith("<error")
+                           for _s, _n, v in res)
+
+    def test_install_is_idempotent(self):
+        net = _small_net()
+        mon = Monitor(interval=1)
+        mon.install(net)
+        mon.install(net)                # Module.fit re-installs per call
+        mon.tic()
+        net(nd.ones((2, 5)))
+        res = mon.toc()
+        names = [n for _s, n, _v in res]
+        assert len(names) == len(set(names))    # no duplicated stats
+
+    def test_default_stat(self):
+        v = default_stat(nd.array(np.ones((4,), np.float32) * 3.0))
+        assert v == pytest.approx(3.0)
+
+    def test_install_rejects_unknown_target(self):
+        with pytest.raises(mx.MXNetError):
+            Monitor().install(42)
+
+
+def _softmax_symbol():
+    from mxnet_tpu import sym
+    data = sym.var("data")
+    label = sym.var("softmax_label")
+    out = sym.FullyConnected(data, sym.var("fc_weight"),
+                             sym.var("fc_bias"), num_hidden=3, name="fc")
+    return sym.SoftmaxOutput(out, label, name="softmax")
+
+
+class TestMonitorModule:
+    def test_module_toc_stats_args_and_outputs(self):
+        from mxnet_tpu import sym
+        x = sym.var("data")
+        y = sym.FullyConnected(x, sym.var("fc_weight"),
+                               sym.var("fc_bias"), num_hidden=3, name="fc")
+        mod = mx.module.Module(y, data_names=("data",), label_names=None)
+        mod.bind(data_shapes=[("data", (2, 6))])
+        mod.init_params()
+        mon = Monitor(interval=1).install(mod)
+        mon.tic()
+        batch = mx.io.DataBatch(data=[nd.ones((2, 6))])
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        res = mon.toc()
+        names = [n for _s, n, _v in res]
+        assert "fc_weight" in names
+        assert "fc_weight_grad" in names
+        assert any(n.startswith("output") for n in names)
+
+    def test_fit_with_monitor_smoke(self):
+        """BaseModule.fit(monitor=...) wires install/tic/toc_print."""
+        mon = Monitor(interval=1, pattern=".*weight$")
+        data = np.random.rand(8, 6).astype(np.float32)
+        labels = np.zeros(8, np.float32)
+        it = mx.io.NDArrayIter(data, labels, batch_size=4,
+                               label_name="softmax_label")
+        mod = mx.module.Module(_softmax_symbol(), context=mx.cpu())
+        mod.fit(it, num_epoch=1, monitor=mon,
+                optimizer_params=(("learning_rate", 0.01),))
+        assert mon.step >= 2            # ticked once per batch

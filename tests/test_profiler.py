@@ -84,12 +84,86 @@ class TestProfiler:
     def test_set_state_and_errors(self):
         profiler.set_state("run")
         with pytest.raises(mx.MXNetError):
-            profiler.set_config(filename="x.json")  # while running
+            # recording options are locked while running (filename /
+            # continuous_dump are the only mid-run reconfigurables)
+            profiler.set_config(profile_memory=True)
         profiler.set_state("stop")
         with pytest.raises(mx.MXNetError):
             profiler.set_state("bogus")
         with pytest.raises(mx.MXNetError):
             profiler.set_config(not_an_option=1)
+
+    def test_profile_memory_emits_live_bytes_counter(self, tmp_path):
+        """profile_memory=True must be real on every backend: at least
+        one live-bytes ph:'C' event lands in the trace (device
+        memory_stats when available, host RSS fallback on CPU)."""
+        profiler.set_config(filename=str(tmp_path / "m.json"),
+                            profile_memory=True)
+        profiler.start()
+        nd.ones((16, 16)).wait_to_read()
+        profiler.stop()
+        trace = json.loads(profiler.dumps())
+        mem = [e for e in trace["traceEvents"]
+               if e["ph"] == "C" and e["name"] == "memory.live_bytes"]
+        assert mem
+        assert all(v >= 0 for e in mem for v in e["args"].values())
+        profiler.set_config(profile_memory=False)
+
+    def test_continuous_dump_writes_on_stop(self, tmp_path):
+        path_a = str(tmp_path / "auto.json")
+        profiler.set_config(filename=path_a, continuous_dump=True)
+        profiler.start()
+        nd.exp(nd.zeros((4,))).wait_to_read()
+        profiler.stop()                 # auto-dumps without explicit dump()
+        with open(path_a) as f:
+            trace = json.load(f)
+        assert any(e["name"] == "exp" for e in trace["traceEvents"])
+        profiler.set_config(continuous_dump=False)
+
+    def test_filename_set_after_start_is_honored(self, tmp_path):
+        path_b = str(tmp_path / "late.json")
+        profiler.set_config(filename=str(tmp_path / "early.json"))
+        profiler.start()
+        nd.ones((4,)).wait_to_read()
+        # filename (and continuous_dump) may change mid-run
+        profiler.set_config(filename=path_b)
+        ret = profiler.dump()           # finished=True: stops, then writes
+        assert ret == path_b
+        assert not (tmp_path / "early.json").exists()
+        with open(path_b) as f:
+            json.load(f)
+
+    def test_concurrent_record_vs_dump_reset(self):
+        """Satellite regression: event appends racing dumps(reset=True)
+        must neither crash nor corrupt the trace structure."""
+        import threading
+        profiler.set_config(filename="/tmp/_race.json")
+        profiler.start()
+        stop_evt = threading.Event()
+        errs = []
+
+        def writer():
+            c = profiler.Counter(name="race")
+            i = 0
+            try:
+                while not stop_evt.is_set():
+                    c.set_value(i)
+                    profiler._record("spin", "user", profiler._now_us(),
+                                     1.0)
+                    i += 1
+            except Exception as e:      # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=writer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for _ in range(200):
+            json.loads(profiler.dumps(reset=True))
+        stop_evt.set()
+        for t in threads:
+            t.join()
+        profiler.stop()
+        assert not errs
 
     def test_executor_spans(self, tmp_path):
         from mxnet_tpu import sym

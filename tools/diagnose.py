@@ -1,0 +1,97 @@
+"""Diagnose the runtime environment (reference parity:
+``tools/diagnose.py`` upstream, which prints platform/pip/hardware
+info for bug reports).
+
+Prints: platform + Python, jax/jaxlib/numpy versions, the JAX backend
+and device list, every ``MXNET_*`` env knob (registry defaults plus
+anything set in the environment), native-library availability, and a
+runtime-metrics snapshot.  With ``--metrics-smoke`` it also enables the
+metrics registry, dispatches one op, and verifies the pipeline end to
+end (used as a CI smoke step by ci/runtime_functions.sh).
+
+Usage: python tools/diagnose.py [--metrics-smoke]
+"""
+import os
+import platform
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _section(title):
+    print(f"\n----------{title}----------")
+
+
+def diagnose(metrics_smoke=False):
+    _section("Platform Info")
+    print(f"Platform     : {platform.platform()}")
+    print(f"system       : {platform.system()}")
+    print(f"node         : {platform.node()}")
+    print(f"release      : {platform.release()}")
+    print(f"version      : {platform.version()}")
+
+    _section("Python Info")
+    print(f"version      : {platform.python_version()}")
+    print(f"compiler     : {platform.python_compiler()}")
+    print(f"implementation: {platform.python_implementation()}")
+
+    _section("Framework Info")
+    import numpy as np
+    import jax
+    import mxnet_tpu as mx
+    print(f"mxnet_tpu    : {mx.__version__}")
+    print(f"numpy        : {np.__version__}")
+    print(f"jax          : {jax.__version__}")
+    try:
+        import jaxlib
+        print(f"jaxlib       : {jaxlib.__version__}")
+    except Exception:                       # noqa: BLE001
+        pass
+    print(f"backend      : {jax.default_backend()}")
+    print(f"device_count : {jax.device_count()}")
+    for d in jax.devices():
+        print(f"  device     : {d} ({d.device_kind})")
+    from mxnet_tpu.lib import nativelib
+    print(f"native io lib: {'available' if nativelib.available() else 'absent'}")
+
+    _section("Environment")
+    for name, (default, _doc) in sorted(mx.base.list_env_vars().items()):
+        cur = os.environ.get(name)
+        mark = f"{cur}  (set)" if cur is not None else f"{default}  (default)"
+        print(f"{name}={mark}")
+    extra = sorted(k for k in os.environ
+                   if k.startswith(("MXNET_", "DMLC_", "JAX_", "XLA_"))
+                   and k not in mx.base.list_env_vars())
+    for k in extra:
+        print(f"{k}={os.environ[k]}  (set, unregistered)")
+
+    _section("Runtime Metrics")
+    from mxnet_tpu import runtime_metrics as rm
+    print(f"enabled      : {rm.enabled()}")
+    if metrics_smoke:
+        rm.enable()
+        a = mx.nd.ones((8, 8))
+        mx.nd.dot(a, a).wait_to_read()
+        mx.waitall()
+        assert rm.OP_INVOKE.value(op="dot") >= 1, "metrics pipeline broken"
+        mem = rm.sample_memory()
+        print(f"memory sample: {mem}")
+    snap = rm.snapshot()
+    if not snap:
+        print("(no metrics recorded)")
+    for name, m in sorted(snap.items()):
+        if not m["values"]:
+            continue
+        print(f"{name} [{m['type']}]: {m['values']}")
+    if metrics_smoke:
+        print("\nmetrics smoke: OK")
+
+
+def main(argv):
+    diagnose(metrics_smoke="--metrics-smoke" in argv)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
