@@ -79,6 +79,14 @@ examples_smoke() {
         --min-recall 0
 }
 
+serving_smoke() {
+    # export LeNet -> serve 48 concurrent requests of 3 batch sizes ->
+    # assert the O(log N) program bound via the bucket-cache counter,
+    # a recorded p99, and load shedding on a saturated bounded queue
+    # (docs/serving.md; ISSUE-2 acceptance criteria)
+    python benchmark/bench_serving.py --smoke
+}
+
 bench_cpu() {
     # tiny-config bench harness end-to-end (no TPU required): the full
     # per-phase orchestrator, not just one child phase

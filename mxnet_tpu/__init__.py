@@ -46,7 +46,7 @@ for _mod in ("initializer", "optimizer", "metric", "gluon", "io", "kvstore",
              "monitor", "util", "runtime",
              "test_utils", "executor", "module", "image", "contrib",
              "parallel", "models", "np", "npx", "lr_scheduler", "operator",
-             "library", "subgraph", "deploy"):
+             "library", "subgraph", "deploy", "serving"):
     try:
         globals()[_mod] = _importlib.import_module(f".{_mod}", __name__)
     except ModuleNotFoundError as _e:

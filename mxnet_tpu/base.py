@@ -210,3 +210,30 @@ declare_env("MXNET_RUNTIME_METRICS_GRAD_NORM", "0",
             "1 = also sample the global L2 gradient norm into the "
             "trainer.grad_norm gauge after each step (forces a device "
             "sync per step to read gradients; NaN/blowup debugging aid).")
+declare_env("MXNET_SERVING_MAX_BATCH", 8,
+            "Serving: max rows coalesced into one dispatched batch "
+            "(mxnet_tpu.serving.DynamicBatcher); shape buckets are "
+            "powers of two up to this cap, so at most "
+            "ceil(log2(max_batch))+1 programs compile per model "
+            "signature.")
+declare_env("MXNET_SERVING_MAX_LATENCY_US", 2000,
+            "Serving: how long the batcher holds the FIRST request of a "
+            "forming batch waiting for more work before dispatching a "
+            "partial batch (microseconds; the latency half of the "
+            "batching policy).")
+declare_env("MXNET_SERVING_QUEUE_DEPTH", 128,
+            "Serving: bound on total outstanding work per ModelServer "
+            "(queued + dispatched-but-unfinished requests); admission "
+            "sheds at it even below the queue-only shed watermark.")
+declare_env("MXNET_SERVING_SHED_WATERMARK", None,
+            "Serving: queue depth at/above which new requests are shed "
+            "with ServerOverloadedError(retry_after_ms) instead of "
+            "queued (load-shedding watermark; default: the full queue "
+            "capacity MXNET_SERVING_QUEUE_DEPTH).")
+declare_env("MXNET_SERVING_WORKERS", 1,
+            "Serving: dispatch worker threads per ModelServer (each "
+            "forms and executes whole batches; >1 overlaps host "
+            "pre/post-processing with device execution).")
+declare_env("MXNET_SERVING_RETRY_AFTER_MS", 50,
+            "Serving: retry-after hint (milliseconds) attached to "
+            "ServerOverloadedError when a request is shed.")

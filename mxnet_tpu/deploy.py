@@ -8,24 +8,55 @@ compiled program, not the API: a hybridized block exports to a
 baked in) that any PJRT-bearing runtime executes WITHOUT importing this
 framework — the test suite proves it by running one in a subprocess
 that imports only ``jax``.
+
+The ``path.json`` manifest is the artifact's *serving signature*:
+input shapes/dtypes (``null`` marks a dimension left symbolic at export
+time), output shapes/dtypes, and whether the batch dimension is
+dynamic.  ``mxnet_tpu.serving`` consumes it to pick shape buckets and
+to validate requests before they reach PJRT, and ``load_stablehlo``
+validates calls against it so a shape/dtype mistake raises a clear
+``MXNetError`` instead of an opaque PJRT failure.
 """
 from __future__ import annotations
 
 import json
 import os
 
+import numpy as np
+
 from .base import MXNetError
 
-__all__ = ["export_stablehlo", "load_stablehlo"]
+__all__ = ["export_stablehlo", "load_stablehlo", "load_manifest",
+           "validate_inputs", "StableHLOModel"]
 
 
-def export_stablehlo(block, *example_inputs, path, emit_text=False):
+def _manifest_path(path):
+    """``model.shlo`` / ``model`` -> ``model.json``."""
+    base = path[:-len(".shlo")] if path.endswith(".shlo") else path
+    return base + ".json"
+
+
+def _sig_entry(shape, dtype):
+    return {"shape": [d if isinstance(d, int) else None for d in shape],
+            "dtype": str(dtype)}
+
+
+def export_stablehlo(block, *example_inputs, path, emit_text=False,
+                     dynamic_batch=False, version=None):
     """Export ``block``'s inference forward as a StableHLO artifact.
 
     Writes ``path.shlo`` (serialized module, weights embedded as
     constants) and ``path.json`` (input/output signature manifest).
     With ``emit_text=True`` also writes ``path.stablehlo.txt`` (the MLIR
     module, for inspection / non-JAX StableHLO consumers).
+
+    ``dynamic_batch=True`` exports the leading dimension of every input
+    as ONE shared symbolic size, so the same artifact serves any batch
+    size — the shape-bucketed serving path (``mxnet_tpu.serving``)
+    requires this to coalesce ragged request batches into O(log N)
+    compiled programs.  The manifest records the dynamic dimension as
+    ``null``.  ``version`` tags the manifest for
+    ``serving.ModelRepository`` hot-swap bookkeeping.
 
     The artifact is self-contained: load it with
     ``jax.export.deserialize(open(...).read()).call(*arrays)`` — no
@@ -44,9 +75,19 @@ def export_stablehlo(block, *example_inputs, path, emit_text=False):
         out, _aux = apply_fn(params, *xs)
         return out
 
-    args = tuple(
-        jax.ShapeDtypeStruct(tuple(x.shape), x._data.dtype)
-        for x in example_inputs)
+    if dynamic_batch:
+        if any(len(x.shape) < 1 for x in example_inputs):
+            raise MXNetError(
+                "export_stablehlo(dynamic_batch=True): every input needs "
+                "a leading batch dimension")
+        (b,) = jexport.symbolic_shape("b")
+        args = tuple(
+            jax.ShapeDtypeStruct((b,) + tuple(x.shape[1:]), x._data.dtype)
+            for x in example_inputs)
+    else:
+        args = tuple(
+            jax.ShapeDtypeStruct(tuple(x.shape), x._data.dtype)
+            for x in example_inputs)
     try:
         exported = jexport.export(jax.jit(infer))(*args)
     except Exception as e:
@@ -56,8 +97,14 @@ def export_stablehlo(block, *example_inputs, path, emit_text=False):
         f.write(bytes(blob))
     manifest = {
         "format": "jax.export/stablehlo",
-        "inputs": [{"shape": list(x.shape), "dtype": str(x._data.dtype)}
-                   for x in example_inputs],
+        # null when the caller did not pick one, so the serving
+        # repository's auto-increment stays in charge (a hard-coded 1
+        # would collide on the second default export of a model)
+        "version": version,
+        "dynamic_batch": bool(dynamic_batch),
+        "inputs": [_sig_entry(a.shape, a.dtype) for a in args],
+        "outputs": [_sig_entry(a.shape, a.dtype)
+                    for a in exported.out_avals],
         "block": type(block).__name__,
     }
     with open(path + ".json", "w") as f:
@@ -68,11 +115,140 @@ def export_stablehlo(block, *example_inputs, path, emit_text=False):
     return path + ".shlo"
 
 
+def load_manifest(path):
+    """Read the ``.json`` signature manifest next to an artifact (pass
+    either the ``.shlo`` path or the bare prefix).  Returns None when
+    the artifact ships without one (pre-manifest exports stay loadable).
+    """
+    mpath = _manifest_path(path)
+    if not os.path.exists(mpath):
+        return None
+    with open(mpath) as f:
+        manifest = json.load(f)
+    if not isinstance(manifest.get("inputs"), list):
+        raise MXNetError(f"malformed artifact manifest {mpath}: "
+                         f"missing 'inputs' signature")
+    return manifest
+
+
+def _canon_dtype(d):
+    """Canonical dtype NAME for comparison.  Works for extension dtypes
+    (bfloat16 lives in ml_dtypes: ``np.dtype('bfloat16')`` raises
+    TypeError, but an actual bfloat16 dtype object canonicalizes fine)."""
+    try:
+        return np.dtype(d).name
+    except TypeError:
+        return str(d)
+
+
+def _shape_dtype(x):
+    """(shape, dtype name) of an NDArray / numpy / jax array without
+    copying."""
+    if hasattr(x, "_data"):            # NDArray
+        x = x._data
+    a = x if hasattr(x, "shape") and hasattr(x, "dtype") else np.asarray(x)
+    return tuple(a.shape), _canon_dtype(a.dtype)
+
+
+def validate_inputs(manifest, arrays, where="load_stablehlo"):
+    """Check caller arrays against a manifest's input signature.
+
+    Raises a descriptive ``MXNetError`` on arity, dtype, rank, or
+    dimension mismatch — the serving-time guard that turns what would be
+    an opaque PJRT shape error into an actionable message.  ``null``
+    dimensions in the manifest (symbolic at export time) accept any
+    size; with ``dynamic_batch`` all leading dimensions must also agree
+    with each other (they were exported as one symbolic size).
+    """
+    sig = manifest["inputs"]
+    if len(arrays) != len(sig):
+        raise MXNetError(
+            f"{where}: expected {len(sig)} input(s) per the artifact "
+            f"manifest, got {len(arrays)}")
+    dynamic = bool(manifest.get("dynamic_batch"))
+    lead = None
+    for i, (spec, arr) in enumerate(zip(sig, arrays)):
+        shape, dtype = _shape_dtype(arr)
+        want_shape = list(spec["shape"])
+        if dynamic and want_shape:
+            want_shape[0] = None
+        want_dtype = _canon_dtype(spec["dtype"])
+        want_str = "x".join("?" if d is None else str(d)
+                            for d in want_shape)
+        got_str = "x".join(str(d) for d in shape)
+        if dtype != want_dtype:
+            raise MXNetError(
+                f"{where}: input {i} dtype mismatch — manifest declares "
+                f"{want_dtype}[{want_str}], got {dtype}[{got_str}]")
+        if len(shape) != len(want_shape):
+            raise MXNetError(
+                f"{where}: input {i} rank mismatch — manifest declares "
+                f"{want_dtype}[{want_str}] ({len(want_shape)}d), got "
+                f"{got_str} ({len(shape)}d)")
+        for ax, (got, want) in enumerate(zip(shape, want_shape)):
+            if want is not None and got != want:
+                raise MXNetError(
+                    f"{where}: input {i} shape mismatch at axis {ax} — "
+                    f"manifest declares {want_dtype}[{want_str}], got "
+                    f"{got_str}")
+        if dynamic and shape:
+            if lead is None:
+                lead = shape[0]
+            elif shape[0] != lead:
+                raise MXNetError(
+                    f"{where}: dynamic-batch inputs disagree on the "
+                    f"batch dimension ({lead} vs {shape[0]} at input "
+                    f"{i}) — it was exported as one shared size")
+
+
+class StableHLOModel:
+    """A reloaded artifact plus its serving signature.
+
+    ``call(*arrays)`` validates against the manifest (when the artifact
+    shipped one) and delegates to the deserialized ``jax.export``
+    module; attribute access falls through to it, so existing callers of
+    ``load_stablehlo(...)`` keep working unchanged.
+    """
+
+    def __init__(self, exported, manifest, path):
+        self.exported = exported
+        self.manifest = manifest
+        self.path = path
+
+    @property
+    def dynamic_batch(self):
+        return bool(self.manifest and self.manifest.get("dynamic_batch"))
+
+    def validate(self, arrays):
+        if self.manifest is not None:
+            validate_inputs(self.manifest, arrays,
+                            where=os.path.basename(
+                                _manifest_path(self.path)))
+
+    def call(self, *arrays):
+        self.validate(arrays)
+        raw = tuple(a._data if hasattr(a, "_data") else a for a in arrays)
+        return self.exported.call(*raw)
+
+    __call__ = call
+
+    def __getattr__(self, name):
+        return getattr(self.exported, name)
+
+
 def load_stablehlo(path):
     """Reload an exported artifact for in-process serving (the exporting
-    side of the round trip; serving-side consumers only need jax)."""
+    side of the round trip; serving-side consumers only need jax).
+
+    Returns a :class:`StableHLOModel`: ``.call`` validates inputs
+    against the ``.json`` manifest (shape/dtype mismatches raise a
+    clear ``MXNetError`` instead of an opaque PJRT failure) and the
+    manifest doubles as the serving signature for
+    ``mxnet_tpu.serving.ModelRepository``.
+    """
     from jax import export as jexport
     if not os.path.exists(path):
         raise MXNetError(f"no artifact at {path}")
     with open(path, "rb") as f:
-        return jexport.deserialize(bytearray(f.read()))
+        exported = jexport.deserialize(bytearray(f.read()))
+    return StableHLOModel(exported, load_manifest(path), path)

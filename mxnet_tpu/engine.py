@@ -25,7 +25,7 @@ from .base import get_env
 from . import runtime_metrics as _rm
 
 __all__ = ["Engine", "engine", "waitall", "is_naive", "set_bulk_size",
-           "bulk", "Var"]
+           "bulk", "Var", "sync_outputs"]
 
 
 class Var:
@@ -160,12 +160,45 @@ def waitall():
         _rm.ENGINE_WAITALL_SECONDS.observe(time.perf_counter() - t0)
 
 
+def sync_outputs(arrays, site="serving"):
+    """Bounded sync point: block until the given raw jax arrays are
+    ready, re-raising any async execution error here (the engine
+    rethrow-at-sync-point contract applied to ONE dispatched batch
+    instead of the whole pipeline — waitall's surgical sibling, used by
+    the serving worker pool around each batch dispatch)."""
+    import jax
+    if not _rm._ENABLED:
+        jax.block_until_ready(arrays)
+        return arrays
+    t0 = time.perf_counter()
+    try:
+        jax.block_until_ready(arrays)
+    finally:
+        _rm.ENGINE_SYNC_SECONDS.observe(time.perf_counter() - t0,
+                                        site=site)
+    return arrays
+
+
 def is_naive() -> bool:
     return Engine.get().is_naive
 
 
 def set_bulk_size(size: int) -> int:
     return Engine.get().set_bulk_size(size)
+
+
+def _refresh_tracked_gauge():
+    """Scrape-time refresh: the tracked-arrays gauge is written on
+    track(), so after a burst of arrays is garbage-collected it would
+    read stale-high until the next allocation — exporters re-sample the
+    WeakValueDictionary instead.  Never instantiates the engine."""
+    eng = Engine._instance
+    if eng is not None and _rm._ENABLED:
+        with eng._lock:
+            _rm.ENGINE_TRACKED.set(len(eng._live))
+
+
+_rm.register_collect_hook(_refresh_tracked_gauge)
 
 
 class bulk:

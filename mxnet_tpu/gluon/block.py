@@ -852,6 +852,20 @@ class HybridBlock(Block):
         nd.save(f"{path}-{epoch:04d}.params", params)
         return sym_file
 
+    def export_stablehlo(self, *example_inputs, path, emit_text=False,
+                         dynamic_batch=False, version=None):
+        """Export this block's inference forward as a self-contained
+        StableHLO artifact (``deploy.export_stablehlo``): weights baked
+        in, ``path.json`` serving-signature manifest alongside.  Pass
+        ``dynamic_batch=True`` to leave the batch dimension symbolic so
+        ``mxnet_tpu.serving`` can shape-bucket request batches over one
+        artifact; ``version`` tags the manifest for repository
+        hot-swap."""
+        from .. import deploy
+        return deploy.export_stablehlo(
+            self, *example_inputs, path=path, emit_text=emit_text,
+            dynamic_batch=dynamic_batch, version=version)
+
 
 class SymbolBlock(HybridBlock):
     """Wrap a Symbol graph as a Block (reference: gluon.SymbolBlock)."""

@@ -93,6 +93,7 @@ class Monitor:
         self._blocks = []
         self._modules = []
         self._executors = []
+        self._hooked = []       # (block, hook) pairs for uninstall()
 
     # ------------------------------------------------------------- install
     def install(self, target):
@@ -133,6 +134,22 @@ class Monitor:
 
         for blk in root._iter_blocks():
             blk.register_forward_hook(_hook)
+            self._hooked.append((blk, _hook))
+
+    def uninstall(self):
+        """Remove every forward hook this monitor registered and forget
+        the monitored targets, so a per-run Monitor does not leave stale
+        hook closures on long-lived blocks (and stays collectable)."""
+        for blk, hook in self._hooked:
+            try:
+                blk._forward_hooks.remove(hook)
+            except ValueError:
+                pass
+        self._hooked = []
+        self._blocks = []
+        self._modules = []
+        self._executors = []
+        return self
 
     # ------------------------------------------------------------ stepping
     def tic(self):

@@ -79,15 +79,20 @@ def initialize(coordinator_address: Optional[str] = None,
     # initialize XLA before jax.distributed.initialize.  Harmless on TPU:
     # the flag only affects CPU-client creation.
     jax.config.update("jax_cpu_collectives_implementation", "gloo")
-    jax.distributed.initialize(coordinator_address,
-                               num_processes=int(num_processes),
-                               process_id=int(process_id),
-                               initialization_timeout=timeout_s,
-                               # a crashing worker must EXIT, not block in
-                               # the shutdown barrier — the launcher's
-                               # failure detection relies on seeing the
-                               # exit code promptly (§5.3 clean abort)
-                               shutdown_timeout_seconds=15)
+    kwargs = dict(num_processes=int(num_processes),
+                  process_id=int(process_id),
+                  initialization_timeout=timeout_s)
+    # a crashing worker must EXIT, not block in the shutdown barrier —
+    # the launcher's failure detection relies on seeing the exit code
+    # promptly (§5.3 clean abort); older jax clients predate the knob
+    import inspect
+    try:
+        sig = inspect.signature(jax.distributed.initialize)
+        if "shutdown_timeout_seconds" in sig.parameters:
+            kwargs["shutdown_timeout_seconds"] = 15
+    except (TypeError, ValueError):     # builtins without a signature
+        pass
+    jax.distributed.initialize(coordinator_address, **kwargs)
     _state["initialized"] = True
     atexit.register(finalize)
 
@@ -96,10 +101,26 @@ def finalize():
     if not _state["initialized"]:
         return
     import jax
-    try:
-        jax.distributed.shutdown()
-    except Exception:
-        pass
+    # The shutdown barrier can block forever when a peer is gone (the
+    # crash path this atexit hook runs on).  Newer jax clients bound it
+    # via shutdown_timeout_seconds at initialize(); older ones lack the
+    # knob, so enforce the same 15s clean-abort budget here: run the
+    # barrier in a daemon thread and abandon it on timeout.  The process
+    # then exits with its ORIGINAL code (a crashed worker's rc reaches
+    # the launcher's failure detection, §5.3; a healthy-but-slow
+    # shutdown is abandoned, not turned into a failure).
+    import threading
+
+    def _shutdown():
+        try:
+            jax.distributed.shutdown()
+        except Exception:   # noqa: BLE001 — peers may already be gone
+            pass
+
+    t = threading.Thread(target=_shutdown, daemon=True,
+                         name="mxnet-dist-shutdown")
+    t.start()
+    t.join(15)
     _state["initialized"] = False
 
 

@@ -356,6 +356,7 @@ def reset():
 def snapshot() -> Dict[str, dict]:
     """Plain-dict view {name: {"type", "labels", "values"}} for tooling
     (tools/diagnose.py)."""
+    _run_collect_hooks()
     out = {}
     for m in REGISTRY.collect():
         if m.kind == "histogram":
@@ -373,17 +374,44 @@ def snapshot() -> Dict[str, dict]:
 # Exporters
 # ---------------------------------------------------------------------------
 
+def _escape_label(v: str) -> str:
+    """Prometheus exposition label-value escaping (backslash, quote,
+    newline) — label values are arbitrary user strings (model names)."""
+    return v.replace("\\", "\\\\").replace('"', '\\"') \
+            .replace("\n", "\\n")
+
+
 def _label_str(labelnames, key) -> str:
     if not labelnames:
         return ""
-    pairs = ",".join(f'{n}="{v}"' for n, v in zip(labelnames, key))
+    pairs = ",".join(f'{n}="{_escape_label(v)}"'
+                     for n, v in zip(labelnames, key))
     return "{" + pairs + "}"
+
+
+# Gauges that are cheapest to refresh at scrape time (rather than on
+# every mutation of the underlying structure) register a collect hook;
+# every exporter runs them first.
+_COLLECT_HOOKS: List = []
+
+
+def register_collect_hook(fn):
+    _COLLECT_HOOKS.append(fn)
+
+
+def _run_collect_hooks():
+    for fn in list(_COLLECT_HOOKS):
+        try:
+            fn()
+        except Exception:       # noqa: BLE001 — exporters must not die
+            pass
 
 
 def dump_prometheus() -> str:
     """Serialize every metric in the Prometheus text exposition format.
     Counters get the conventional ``_total`` suffix; histograms render
     cumulative ``_bucket{le=...}`` series plus ``_sum``/``_count``."""
+    _run_collect_hooks()
     lines = []
     for m in REGISTRY.collect():
         base = _sanitize(m.name)
@@ -424,6 +452,7 @@ def chrome_counter_events(t0_us: float = 0.0) -> List[dict]:
     (one event per metric; labeled series become one arg per label set).
     ``profiler.dumps()`` merges these into the host-span trace so
     counters share the timeline with op/user scopes."""
+    _run_collect_hooks()
     ts = time.perf_counter() * 1e6 - t0_us
     pid = os.getpid()
     events = []
@@ -529,6 +558,45 @@ MEMORY_LIVE_BYTES = gauge(
     "memory.live_bytes",
     "Live accelerator bytes per device (host RSS fallback when the "
     "backend reports no memory_stats).", labelnames=("device",))
+ENGINE_SYNC_SECONDS = histogram(
+    "engine.sync.seconds",
+    "Time blocked in bounded sync points (engine.sync_outputs: one "
+    "dispatched batch, not the whole pipeline), labeled by call site.",
+    labelnames=("site",))
+SERVING_REQUESTS = counter(
+    "serving.requests", "Requests admitted by ModelServer.predict.",
+    labelnames=("model",))
+SERVING_BATCHES = counter(
+    "serving.batches", "Coalesced batches dispatched by the serving "
+    "worker pool.", labelnames=("model",))
+SERVING_SHED = counter(
+    "serving.shed",
+    "Requests rejected with ServerOverloadedError because the bounded "
+    "queue sat at/above the load-shedding watermark.",
+    labelnames=("model",))
+SERVING_QUEUE_DEPTH = gauge(
+    "serving.queue.depth",
+    "Requests currently waiting in the ModelServer bounded queue "
+    "(all models), per server instance.", labelnames=("server",))
+SERVING_QUEUE_PEAK = gauge(
+    "serving.queue.depth.peak",
+    "High watermark of the serving queue depth, per server instance.",
+    labelnames=("server",))
+# occupancy = real rows / padded bucket rows — 1.0 means no padding waste
+SERVING_BATCH_OCCUPANCY = histogram(
+    "serving.batch.occupancy",
+    "Real rows divided by padded bucket rows per dispatched batch "
+    "(1.0 = no padding waste).",
+    buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0))
+SERVING_REQUEST_SECONDS = histogram(
+    "serving.request.seconds",
+    "End-to-end request latency inside ModelServer (enqueue to result "
+    "ready), per model.", labelnames=("model",))
+SERVING_BUCKET_CACHE = counter(
+    "serving.bucket.cache",
+    "Shape-bucket program-cache lookups by the serving batcher "
+    "(event=hit|miss; misses equal compiled programs).",
+    labelnames=("event",))
 
 
 def record_op_invoke(opname: str, seconds: float):

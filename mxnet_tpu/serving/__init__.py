@@ -1,0 +1,33 @@
+"""Inference serving subsystem (docs/serving.md).
+
+The reference line served non-Python consumers through the predict-only
+C ABI and MXNet Model Server; here the deployment boundary is the
+StableHLO artifact (``deploy.export_stablehlo``) and this package is
+the missing serving tier over it:
+
+- :class:`ModelRepository` — versioned artifacts/blocks, atomic
+  hot-swap;
+- :class:`DynamicBatcher` — shape-bucketed batch coalescing with a
+  per-bucket compiled-program cache (O(log N) programs for N request
+  shapes);
+- :class:`ModelServer` — bounded queues, worker pool, load shedding
+  (:class:`ServerOverloadedError` + retry-after), graceful drain;
+- first-class ``runtime_metrics`` instrumentation (queue depth, batch
+  occupancy, per-model latency, shed counter —
+  ``docs/observability.md``).
+
+>>> from mxnet_tpu import serving
+>>> repo = serving.ModelRepository()
+>>> repo.load_artifact("net", "model.shlo")
+>>> with serving.ModelServer(repo) as srv:
+...     y = srv.predict("net", x)          # coalesced + shape-bucketed
+"""
+from .batcher import DynamicBatcher, next_bucket, pad_batch, \
+    unpad_outputs
+from .config import ServingConfig
+from .repository import ModelEntry, ModelRepository
+from .server import ModelServer, ServerOverloadedError
+
+__all__ = ["ModelRepository", "ModelEntry", "ModelServer",
+           "DynamicBatcher", "ServingConfig", "ServerOverloadedError",
+           "next_bucket", "pad_batch", "unpad_outputs"]

@@ -1,0 +1,165 @@
+"""Shape-bucketed dynamic batching (docs/serving.md §3).
+
+Concurrent ``predict()`` calls of ragged batch sizes coalesce into one
+dispatched batch per model: request rows are concatenated along axis 0
+and padded up to the next power-of-two **bucket**, so any mix of N
+request shapes reaches the compiler as at most ``ceil(log2(max)) + 1``
+distinct program shapes (the Ragged-Paged-Attention / TPU-serving
+insight that compiled-program reuse, not the kernel, is where the win
+lives — PAPERS.md).  Each bucket's program is compiled once and cached;
+``serving.bucket.cache{event=hit|miss}`` counts lookups, with misses ==
+compiled programs.
+
+Outputs must be batch-major (axis 0 = rows, the manifest contract);
+padded rows are sliced off and per-request slices handed back, so a
+ragged final batch un-pads exactly.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from .. import engine, runtime_metrics as _rm
+from ..base import MXNetError
+
+__all__ = ["DynamicBatcher", "next_bucket", "pad_batch", "unpad_outputs"]
+
+
+def next_bucket(rows, max_batch):
+    """Smallest power of two >= rows, capped at max_batch (the cap
+    itself is the last bucket even when it is not a power of two), so
+    the bucket set is {1, 2, 4, ..., max_batch}."""
+    if rows < 1:
+        raise MXNetError(f"next_bucket: rows must be >= 1, got {rows}")
+    if rows >= max_batch:
+        return max_batch
+    b = 1
+    while b < rows:
+        b <<= 1
+    return min(b, max_batch)
+
+
+def pad_batch(request_inputs, bucket_rows):
+    """Concatenate per-request input tuples along axis 0 and zero-pad to
+    ``bucket_rows``.
+
+    ``request_inputs``: list of tuples of numpy arrays (one tuple per
+    request, batch-major).  Returns ``(padded_inputs, offsets)`` where
+    ``offsets[i]`` is the row offset of request i (``offsets[-1]`` is
+    the real row total).
+    """
+    n_in = len(request_inputs[0])
+    offsets = [0]
+    for req in request_inputs:
+        offsets.append(offsets[-1] + req[0].shape[0])
+    total = offsets[-1]
+    if total > bucket_rows:
+        raise MXNetError(
+            f"pad_batch: {total} rows exceed bucket of {bucket_rows}")
+    padded = []
+    for pos in range(n_in):
+        parts = [req[pos] for req in request_inputs]
+        cat = parts[0] if len(parts) == 1 else np.concatenate(parts, 0)
+        if total < bucket_rows:
+            pad = np.zeros((bucket_rows - total,) + cat.shape[1:],
+                           dtype=cat.dtype)
+            cat = np.concatenate([cat, pad], 0)
+        padded.append(cat)
+    return tuple(padded), offsets
+
+
+def unpad_outputs(outputs, offsets):
+    """Split batch-major outputs back into per-request tuples, dropping
+    padding rows (everything past ``offsets[-1]``)."""
+    total = offsets[-1]
+    # ONE device-to-host transfer per output, not one per request
+    host = []
+    for out in outputs:
+        arr = np.asarray(out)
+        if arr.ndim < 1 or arr.shape[0] < total:
+            raise MXNetError(
+                f"serving outputs must be batch-major: output of "
+                f"shape {arr.shape} cannot be split across "
+                f"{total} request rows")
+        host.append(arr)
+    return [tuple(arr[offsets[i]:offsets[i + 1]] for arr in host)
+            for i in range(len(offsets) - 1)]
+
+
+class DynamicBatcher:
+    """Executes coalesced batches through a per-(entry, bucket) program
+    cache.  Stateless with respect to queuing — the ModelServer worker
+    pool decides *what* to coalesce; this decides *how* it runs."""
+
+    def __init__(self, config):
+        self.config = config
+        self._lock = threading.Lock()
+        self._progs = {}            # (entry.uid, bucket) -> callable
+        self._retired = set()       # uids evicted; never re-cache these
+        self.bucket_hits = 0
+        self.bucket_misses = 0
+
+    # ------------------------------------------------------------- cache
+    def program_for(self, entry, bucket_rows):
+        key = (entry.uid, bucket_rows)
+        with self._lock:
+            prog = self._progs.get(key)
+            if prog is not None:
+                self.bucket_hits += 1
+                if _rm._ENABLED:
+                    _rm.SERVING_BUCKET_CACHE.inc(event="hit")
+                return prog
+            self.bucket_misses += 1
+            if _rm._ENABLED:
+                _rm.SERVING_BUCKET_CACHE.inc(event="miss")
+            prog = entry.make_program(bucket_rows)
+            # a batch admitted before unload can dispatch after evict():
+            # run it, but never re-cache under a retired uid (no future
+            # unload event would ever clear it again)
+            if entry.uid not in self._retired:
+                self._progs[key] = prog
+            return prog
+
+    def programs(self, entry=None):
+        """Cached program count (per entry, or total)."""
+        with self._lock:
+            if entry is None:
+                return len(self._progs)
+            return sum(1 for uid, _ in self._progs if uid == entry.uid)
+
+    def evict(self, entry):
+        """Drop cached programs of an unloaded entry and bar the uid
+        from re-caching (in-flight batches may still dispatch it once).
+        """
+        with self._lock:
+            self._retired.add(entry.uid)
+            for key in [k for k in self._progs if k[0] == entry.uid]:
+                del self._progs[key]
+
+    # ---------------------------------------------------------- dispatch
+    def bucket_for(self, entry, rows):
+        if entry.dynamic_batch:
+            return next_bucket(rows, self.config.max_batch_size)
+        # static artifact: every dispatch pads to the exported batch
+        if entry.fixed_batch is None:
+            raise MXNetError(
+                f"model {entry.name!r}: static signature without a "
+                f"batch dimension cannot be batch-served")
+        return entry.fixed_batch
+
+    def run_batch(self, entry, request_inputs):
+        """Pad, execute, sync, un-pad one coalesced batch.  Returns the
+        list of per-request output tuples."""
+        rows = sum(req[0].shape[0] for req in request_inputs)
+        bucket = self.bucket_for(entry, rows)
+        padded, offsets = pad_batch(request_inputs, bucket)
+        prog = self.program_for(entry, bucket)
+        outs = prog(*padded)
+        # bounded sync point: block on THIS batch (async errors surface
+        # here, engine rethrow-at-sync-point contract)
+        engine.sync_outputs(outs, site="serving")
+        if _rm._ENABLED:
+            _rm.SERVING_BATCHES.inc(model=entry.name)
+            _rm.SERVING_BATCH_OCCUPANCY.observe(rows / bucket)
+        return unpad_outputs(outs, offsets)

@@ -1,0 +1,77 @@
+"""Serving policy knobs (docs/serving.md).
+
+Defaults come from the ``MXNET_SERVING_*`` environment variables
+(declared in ``base.py``, documented in ``docs/env_vars.md``);
+constructor arguments override per server.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError, get_env
+
+__all__ = ["ServingConfig"]
+
+
+class ServingConfig:
+    """Batching + backpressure policy for one :class:`ModelServer`.
+
+    - ``max_batch_size``: row cap per coalesced batch; shape buckets are
+      powers of two up to it, so at most ``ceil(log2(max_batch))+1``
+      programs compile per model signature.
+    - ``max_latency_us``: how long the batcher holds the first request
+      of a forming batch waiting for more work (the latency half of the
+      batching policy).
+    - two-level backpressure: ``shed_watermark`` (<= queue_depth,
+      default equal to it) bounds the WAITING queue — at/above it
+      admission sheds with ``ServerOverloadedError(retry_after_ms)``;
+      ``queue_depth`` additionally bounds total outstanding work
+      (queued + dispatched-but-unfinished), so a slow model cannot
+      pile up unbounded in-flight batches.
+    - ``num_workers``: dispatch threads forming and executing batches.
+    """
+
+    def __init__(self, max_batch_size=None, max_latency_us=None,
+                 queue_depth=None, shed_watermark=None, num_workers=None,
+                 retry_after_ms=None):
+        def pick(value, env, typ=int):
+            if value is None:
+                value = get_env(env, typ=typ)
+            return None if value is None else typ(value)
+
+        self.max_batch_size = pick(max_batch_size,
+                                   "MXNET_SERVING_MAX_BATCH")
+        self.max_latency_us = pick(max_latency_us,
+                                   "MXNET_SERVING_MAX_LATENCY_US")
+        self.queue_depth = pick(queue_depth, "MXNET_SERVING_QUEUE_DEPTH")
+        self.shed_watermark = pick(shed_watermark,
+                                   "MXNET_SERVING_SHED_WATERMARK")
+        if self.shed_watermark is None:
+            self.shed_watermark = self.queue_depth
+        self.num_workers = pick(num_workers, "MXNET_SERVING_WORKERS")
+        self.retry_after_ms = pick(retry_after_ms,
+                                   "MXNET_SERVING_RETRY_AFTER_MS")
+
+        if self.max_batch_size < 1:
+            raise MXNetError("ServingConfig: max_batch_size must be >= 1")
+        if self.queue_depth < 1:
+            raise MXNetError("ServingConfig: queue_depth must be >= 1")
+        if not 1 <= self.shed_watermark <= self.queue_depth:
+            raise MXNetError(
+                f"ServingConfig: shed_watermark must be in "
+                f"[1, queue_depth={self.queue_depth}], "
+                f"got {self.shed_watermark}")
+        if self.num_workers < 1:
+            raise MXNetError("ServingConfig: num_workers must be >= 1")
+        if self.max_latency_us < 0:
+            raise MXNetError(
+                "ServingConfig: max_latency_us must be >= 0")
+        if self.retry_after_ms < 0:
+            raise MXNetError(
+                "ServingConfig: retry_after_ms must be >= 0")
+
+    def __repr__(self):
+        return (f"ServingConfig(max_batch_size={self.max_batch_size}, "
+                f"max_latency_us={self.max_latency_us}, "
+                f"queue_depth={self.queue_depth}, "
+                f"shed_watermark={self.shed_watermark}, "
+                f"num_workers={self.num_workers}, "
+                f"retry_after_ms={self.retry_after_ms})")

@@ -1,0 +1,300 @@
+"""Versioned model store for the serving subsystem (docs/serving.md §2).
+
+Reference analogue: MXNet Model Server's model store — named models,
+integer versions, atomic ``swap`` between them while traffic is in
+flight.  Three sources register:
+
+- ``load_artifact``: a StableHLO artifact exported by
+  ``deploy.export_stablehlo`` (the language-neutral path; the manifest
+  is the serving signature);
+- ``add_block``: a (hybridized) Gluon block served in-process through
+  ``parallel.functional.functionalize`` — weights snapshot at
+  registration, so later training does not mutate the served version;
+- ``add_function``: a raw python callable (testing / custom runners).
+
+Hot-swap contract: ``swap(name, version)`` atomically repoints the
+*current* entry.  Requests resolve their entry once at admission, so an
+in-flight batch completes on the version it was admitted under; only
+requests admitted after the swap see the new version.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from collections import OrderedDict
+
+from ..base import MXNetError
+
+__all__ = ["ModelEntry", "ModelRepository"]
+
+_UID = itertools.count(1)
+
+
+class ModelEntry:
+    """One immutable servable version of a model.
+
+    ``signature`` is manifest-style: ``[{"shape": [...], "dtype": ...}]``
+    with ``None`` dimensions free (``dynamic_batch`` additionally frees
+    every leading dimension).  ``make_program(bucket_rows)`` returns a
+    fresh compiled callable over raw arrays for one padded bucket size —
+    the DynamicBatcher caches these per bucket.
+    """
+
+    def __init__(self, name, version, kind, signature, dynamic_batch,
+                 make_program, fixed_batch=None):
+        self.name = name
+        self.version = version
+        self.kind = kind                    # "stablehlo" | "block" | "function"
+        self.signature = signature
+        self.dynamic_batch = bool(dynamic_batch)
+        self.fixed_batch = fixed_batch      # exported batch when static
+        self.make_program = make_program
+        self.uid = next(_UID)               # distinct across re-registrations
+
+    @property
+    def manifest(self):
+        # admission-time signature: the batch axis is always free here —
+        # static entries are padded up to their exported batch by the
+        # batcher before PJRT sees them (rows > fixed_batch is rejected
+        # separately via max_rows)
+        return {"dynamic_batch": True, "inputs": self.signature}
+
+    def max_rows(self, max_batch_size):
+        """Row capacity of one dispatched batch for this entry."""
+        if self.dynamic_batch:
+            return max_batch_size
+        return self.fixed_batch if self.fixed_batch else max_batch_size
+
+    def __repr__(self):
+        return (f"ModelEntry({self.name}:{self.version}, {self.kind}, "
+                f"dynamic_batch={self.dynamic_batch})")
+
+
+def _as_tuple(out):
+    if isinstance(out, tuple):
+        return out
+    if isinstance(out, list):
+        return tuple(out)
+    return (out,)
+
+
+def _block_signature(example_inputs, dynamic_batch):
+    sig = []
+    for x in example_inputs:
+        shape = list(x.shape)
+        if dynamic_batch:
+            shape[0] = None
+        sig.append({"shape": shape, "dtype": str(x._data.dtype)
+                    if hasattr(x, "_data") else str(x.dtype)})
+    return sig
+
+
+class ModelRepository:
+    """Thread-safe name -> versions -> :class:`ModelEntry` store with an
+    atomically swappable *current* pointer per name."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # name -> {"current": version, "versions": OrderedDict}
+        self._models = {}
+        self._unload_listeners = []
+
+    def subscribe_unload(self, callback):
+        """Register ``callback(entry)`` to run whenever a version is
+        unloaded — ModelServer wires its batcher's program-cache
+        eviction here so retired versions do not pin compiled programs.
+        """
+        with self._lock:
+            self._unload_listeners.append(callback)
+
+    def unsubscribe_unload(self, callback):
+        """Remove a listener added by :meth:`subscribe_unload` (a
+        stopped ModelServer must not stay pinned by the repository)."""
+        with self._lock:
+            try:
+                self._unload_listeners.remove(callback)
+            except ValueError:
+                pass
+
+    def _notify_unload(self, entries):
+        for cb in list(self._unload_listeners):
+            for entry in entries:
+                try:
+                    cb(entry)
+                except Exception:   # noqa: BLE001 — eviction best-effort
+                    pass
+
+    # ------------------------------------------------------------ register
+    def _register(self, entry, activate):
+        """Version assignment and registration under ONE lock hold, so
+        concurrent auto-versioned registrations cannot collide."""
+        with self._lock:
+            slot = self._models.setdefault(
+                entry.name, {"current": None, "versions": OrderedDict()})
+            if entry.version is None:
+                ints = [v for v in slot["versions"]
+                        if isinstance(v, int)]
+                entry.version = max(ints) + 1 if ints else 1
+            if entry.version in slot["versions"]:
+                raise MXNetError(
+                    f"model {entry.name!r} version {entry.version} "
+                    f"already registered; unload it or pick a new "
+                    f"version")
+            slot["versions"][entry.version] = entry
+            # activate=False stages even the FIRST version: an operator
+            # pre-loading a new model name must be able to validate it
+            # before swap() makes it live
+            if activate:
+                slot["current"] = entry.version
+        return entry
+
+    def load_artifact(self, name, path, version=None, activate=True):
+        """Register a StableHLO artifact (``deploy.export_stablehlo``
+        output).  ``path`` is the ``.shlo`` file or the bare prefix; the
+        ``.json`` manifest beside it becomes the serving signature."""
+        import jax
+
+        from .. import deploy
+        if not path.endswith(".shlo"):
+            path = path + ".shlo"
+        model = deploy.load_stablehlo(path)
+        manifest = model.manifest
+        if manifest is None:
+            raise MXNetError(
+                f"load_artifact({name!r}): no manifest next to {path} — "
+                f"serving needs the .json signature (re-export with "
+                f"deploy.export_stablehlo)")
+        dynamic = bool(manifest.get("dynamic_batch"))
+        sig = manifest["inputs"]
+        fixed = None if dynamic else (sig[0]["shape"][0] if sig else None)
+        if version is None:
+            version = manifest.get("version")
+        exported = model.exported
+
+        def make_program(bucket_rows):
+            # fresh jit wrapper per bucket: its cache holds exactly one
+            # program, so bucket-cache misses == compiled programs
+            return jax.jit(lambda *xs: _as_tuple(exported.call(*xs)))
+
+        entry = ModelEntry(name, version, "stablehlo", sig, dynamic,
+                           make_program, fixed_batch=fixed)
+        return self._register(entry, activate)
+
+    def add_block(self, name, block, *example_inputs, version=None,
+                  activate=True, dynamic_batch=True):
+        """Register a (hybridized) block for in-process serving.  The
+        inference forward is functionalized and the current parameter
+        values are snapshotted, so subsequent training does not mutate
+        this served version (export-then-swap to publish new weights)."""
+        import jax
+
+        from ..ndarray import NDArray
+        from ..parallel.functional import functionalize
+
+        nd_inputs = tuple(x if isinstance(x, NDArray) else NDArray(x)
+                          for x in example_inputs)
+        apply_fn, params = functionalize(block, *nd_inputs,
+                                         train_mode=False)
+        params = dict(params)               # snapshot of current values
+
+        def infer(*xs):
+            out, _aux = apply_fn(params, *xs)
+            return _as_tuple(out)
+
+        def make_program(bucket_rows):
+            return jax.jit(infer)
+
+        sig = _block_signature(nd_inputs, dynamic_batch)
+        entry = ModelEntry(name, version, "block", sig, dynamic_batch,
+                           make_program,
+                           fixed_batch=None if dynamic_batch
+                           else nd_inputs[0].shape[0])
+        return self._register(entry, activate)
+
+    def add_function(self, name, fn, signature, version=None,
+                     activate=True, dynamic_batch=True):
+        """Register a raw callable ``fn(*arrays) -> array|tuple``
+        (custom runners, tests).  ``signature`` is manifest-style."""
+        def make_program(bucket_rows):
+            return lambda *xs: _as_tuple(fn(*xs))
+
+        fixed = None
+        if not dynamic_batch and signature \
+                and signature[0].get("shape"):
+            fixed = signature[0]["shape"][0]
+        entry = ModelEntry(name, version, "function", signature,
+                           dynamic_batch, make_program,
+                           fixed_batch=fixed)
+        return self._register(entry, activate)
+
+    # ------------------------------------------------------------- resolve
+    def get(self, name):
+        """The current :class:`ModelEntry` for ``name`` (atomic read)."""
+        with self._lock:
+            slot = self._models.get(name)
+            if slot is None:
+                raise MXNetError(
+                    f"no model {name!r} in the repository "
+                    f"(known: {sorted(self._models)})")
+            if slot["current"] is None:
+                raise MXNetError(
+                    f"model {name!r} has no active version (staged: "
+                    f"{list(slot['versions'])}) — activate one with "
+                    f"swap({name!r}, version)")
+            return slot["versions"][slot["current"]]
+
+    def swap(self, name, version):
+        """Atomically repoint ``name`` to ``version``; returns the
+        previous current version.  In-flight requests finish on the
+        entry they were admitted under."""
+        with self._lock:
+            slot = self._models.get(name)
+            if slot is None:
+                raise MXNetError(f"no model {name!r} in the repository")
+            if version not in slot["versions"]:
+                raise MXNetError(
+                    f"model {name!r} has no version {version!r} "
+                    f"(have: {list(slot['versions'])})")
+            prev, slot["current"] = slot["current"], version
+            return prev
+
+    def versions(self, name):
+        with self._lock:
+            slot = self._models.get(name)
+            return list(slot["versions"]) if slot else []
+
+    def models(self):
+        with self._lock:
+            return sorted(self._models)
+
+    def current_version(self, name):
+        with self._lock:
+            slot = self._models.get(name)
+            return slot["current"] if slot else None
+
+    def unload(self, name, version=None):
+        """Drop one version (or the whole model when ``version`` is
+        None).  Refuses to drop the current version of a multi-version
+        model — swap first.  Unload listeners (program-cache eviction)
+        run after the lock is released."""
+        with self._lock:
+            slot = self._models.get(name)
+            if slot is None:
+                raise MXNetError(f"no model {name!r} in the repository")
+            if version is None:
+                removed = list(slot["versions"].values())
+                del self._models[name]
+            else:
+                if version not in slot["versions"]:
+                    raise MXNetError(
+                        f"model {name!r} has no version {version!r}")
+                if version == slot["current"] \
+                        and len(slot["versions"]) > 1:
+                    raise MXNetError(
+                        f"model {name!r} version {version!r} is "
+                        f"current — swap to another version before "
+                        f"unloading it")
+                removed = [slot["versions"].pop(version)]
+                if not slot["versions"]:
+                    del self._models[name]
+        self._notify_unload(removed)

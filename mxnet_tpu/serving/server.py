@@ -1,0 +1,354 @@
+"""In-process model server: bounded queues, worker pool, backpressure
+(docs/serving.md §4).
+
+``predict()`` is synchronous from the caller's side; underneath,
+admitted requests land in a bounded per-model queue, a worker pool
+coalesces them into shape-bucketed batches (``DynamicBatcher``) and the
+caller's thread wakes when its slice of the batch output is ready.
+Backpressure is explicit: when queue depth sits at/above the
+load-shedding watermark, admission fails *immediately* with
+:class:`ServerOverloadedError` carrying a retry-after hint — the
+serving-tier contract that callers see bounded latency or a cheap
+reject, never an unbounded queue (reference: MXNet Model Server's
+worker queues; the Gemma-on-TPU serving comparison's batching policy,
+PAPERS.md).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import OrderedDict, deque
+
+import numpy as np
+
+from .. import runtime_metrics as _rm
+from ..base import MXNetError
+from .batcher import DynamicBatcher
+from .config import ServingConfig
+from .repository import ModelRepository
+
+__all__ = ["ModelServer", "ServerOverloadedError"]
+
+_SERVER_SEQ = itertools.count(1)
+
+
+class ServerOverloadedError(MXNetError):
+    """Request shed by the backpressure bounds.  ``retry_after_ms`` is
+    the server's backoff hint (an HTTP frontend maps this to 429 +
+    Retry-After); the message names which bound actually tripped so
+    operators tune the right knob."""
+
+    def __init__(self, model, retry_after_ms, reason):
+        self.model = model
+        self.retry_after_ms = retry_after_ms
+        super().__init__(
+            f"server overloaded: {reason} for model {model!r}; "
+            f"retry after {retry_after_ms}ms")
+
+
+class _Request:
+    __slots__ = ("entry", "inputs", "rows", "event", "result", "error",
+                 "t_enq")
+
+    def __init__(self, entry, inputs, rows):
+        self.entry = entry
+        self.inputs = inputs
+        self.rows = rows
+        self.event = threading.Event()
+        self.result = None
+        self.error = None
+        self.t_enq = time.monotonic()
+
+
+class ModelServer:
+    """Dynamic-batching server over a :class:`ModelRepository`.
+
+    >>> repo = ModelRepository()
+    >>> repo.load_artifact("lenet", "lenet.shlo")
+    >>> with ModelServer(repo) as srv:
+    ...     out = srv.predict("lenet", batch_of_images)
+
+    Requests resolve their model entry at admission, so
+    ``repository.swap`` hot-swaps versions without draining: in-flight
+    requests finish on the old version, new admissions see the new one.
+    """
+
+    def __init__(self, repository=None, config=None, autostart=True,
+                 name=None):
+        self.repository = repository or ModelRepository()
+        self.config = config or ServingConfig()
+        self.batcher = DynamicBatcher(self.config)
+        self.name = name or f"server{next(_SERVER_SEQ)}"
+        self._evict_subscribed = False
+        self._cond = threading.Condition()
+        self._queues = OrderedDict()    # entry.uid -> (entry, deque)
+        self._depth = 0
+        self._inflight = 0              # admitted, popped, not finished
+        self._started = False
+        self._stopping = False
+        self._workers = []
+        self._stats = {"requests": 0, "completed": 0, "shed": 0,
+                       "batches": 0, "errors": 0}
+        if autostart:
+            self.start()
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self):
+        with self._cond:
+            if self._started:
+                return self
+            self._started = True
+            self._stopping = False
+        # retired versions must not pin compiled programs for the
+        # process lifetime (hot-swap deploy loops); unsubscribed again
+        # at stop() so the repository never pins a dead server
+        if not self._evict_subscribed:
+            self.repository.subscribe_unload(self.batcher.evict)
+            self._evict_subscribed = True
+        with self._cond:
+            self._workers = [
+                threading.Thread(target=self._worker_loop,
+                                 name=f"mxnet-serving-{i}", daemon=True)
+                for i in range(self.config.num_workers)]
+        for t in self._workers:
+            t.start()
+        return self
+
+    def stop(self, drain=True, timeout=None):
+        """Shut down the worker pool.  ``drain=True`` (default) stops
+        admission, lets workers finish every queued request, then joins;
+        ``drain=False`` fails queued requests immediately.
+
+        Returns True once the pool is down.  With a ``timeout``, a
+        worker stuck in a dispatch can outlive the join — then the
+        server STAYS in the stopping state (so a later ``start()``
+        cannot spawn a second pool next to the orphan) and stop()
+        returns False; call it again to finish the shutdown."""
+        with self._cond:
+            if not self._started:
+                return True
+            self._stopping = True
+            if not drain:
+                for _entry, q in self._queues.values():
+                    for req in q:
+                        req.error = MXNetError(
+                            "ModelServer stopped before this request "
+                            "was dispatched")
+                        req.event.set()
+                    q.clear()
+                self._set_depth(0)
+            self._cond.notify_all()
+        # one total budget, not one per worker
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for t in self._workers:
+            t.join(None if deadline is None
+                   else max(0.0, deadline - time.monotonic()))
+        alive = [t for t in self._workers if t.is_alive()]
+        if alive:
+            return False
+        with self._cond:
+            self._started = False
+            self._workers = []
+        if self._evict_subscribed:
+            self.repository.unsubscribe_unload(self.batcher.evict)
+            self._evict_subscribed = False
+        return True
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop(drain=exc == (None, None, None))
+        return False
+
+    @property
+    def started(self):
+        return self._started
+
+    # -------------------------------------------------------------- predict
+    def predict(self, model, *inputs, timeout=None):
+        """Run one inference request; blocks until its slice of a
+        coalesced batch is ready.  Inputs are batch-major NDArray /
+        numpy arrays validated against the model's serving signature;
+        returns numpy (one array, or a tuple for multi-output models).
+        """
+        from .. import deploy
+        entry = self.repository.get(model)
+        np_inputs = tuple(
+            np.asarray(x.asnumpy()) if hasattr(x, "asnumpy")
+            else np.asarray(x) for x in inputs)
+        deploy.validate_inputs(entry.manifest, np_inputs,
+                               where=f"serving predict({model!r})")
+        if not np_inputs or np_inputs[0].ndim < 1:
+            raise MXNetError(
+                f"serving predict({model!r}): inputs must be batch-major "
+                f"arrays with a leading batch dimension")
+        rows = np_inputs[0].shape[0]
+        cap = entry.max_rows(self.config.max_batch_size)
+        if rows < 1 or rows > cap:
+            raise MXNetError(
+                f"serving predict({model!r}): request batch of {rows} "
+                f"rows outside [1, {cap}] (max_batch_size="
+                f"{self.config.max_batch_size}, "
+                f"exported batch={entry.fixed_batch})")
+
+        req = _Request(entry, np_inputs, rows)
+        with self._cond:
+            if not self._started or self._stopping:
+                raise MXNetError(
+                    "ModelServer is not accepting requests "
+                    "(not started, or shutting down)")
+            # two-level backpressure: the watermark bounds the WAITING
+            # queue; queue_depth additionally bounds total outstanding
+            # work (queued + in-flight), so a slow model cannot pile up
+            # unbounded dispatched-but-unfinished requests
+            reason = None
+            if self._depth >= self.config.shed_watermark:
+                reason = (f"queue depth {self._depth} >= shed watermark "
+                          f"{self.config.shed_watermark}")
+            elif self._depth + self._inflight >= self.config.queue_depth:
+                reason = (f"outstanding work {self._depth} queued + "
+                          f"{self._inflight} in flight >= queue_depth "
+                          f"{self.config.queue_depth}")
+            if reason is not None:
+                self._stats["shed"] += 1
+                if _rm._ENABLED:
+                    _rm.SERVING_SHED.inc(model=model)
+                raise ServerOverloadedError(
+                    model, self.config.retry_after_ms, reason)
+            slot = self._queues.get(entry.uid)
+            if slot is None:
+                slot = (entry, deque())
+                self._queues[entry.uid] = slot
+            slot[1].append(req)
+            self._set_depth(self._depth + 1)
+            self._stats["requests"] += 1
+            if _rm._ENABLED:
+                _rm.SERVING_REQUESTS.inc(model=model)
+            self._cond.notify_all()
+
+        if not req.event.wait(timeout):
+            # withdraw an abandoned request so it neither occupies
+            # bounded-queue depth (pushing admissions into the shed
+            # watermark) nor burns device time computing a result
+            # nobody will read; if a worker popped it meanwhile, let
+            # that batch complete — the result is simply dropped
+            with self._cond:
+                slot = self._queues.get(entry.uid)
+                if slot is not None and req in slot[1]:
+                    slot[1].remove(req)
+                    if not slot[1]:
+                        self._queues.pop(entry.uid, None)
+                    self._set_depth(self._depth - 1)
+            raise MXNetError(
+                f"serving predict({model!r}): no result within "
+                f"{timeout}s (queue depth {self._depth})")
+        if req.error is not None:
+            raise req.error
+        return req.result if len(req.result) > 1 else req.result[0]
+
+    # ---------------------------------------------------------------- stats
+    def stats(self):
+        """Plain-dict serving counters (always on, independent of the
+        runtime-metrics switch)."""
+        with self._cond:
+            out = dict(self._stats)
+            out["queue_depth"] = self._depth
+            out["inflight"] = self._inflight
+        out["bucket_hits"] = self.batcher.bucket_hits
+        out["bucket_misses"] = self.batcher.bucket_misses
+        out["programs"] = self.batcher.programs()
+        return out
+
+    # -------------------------------------------------------------- workers
+    def _set_depth(self, depth):
+        # callers hold self._cond
+        self._depth = depth
+        if _rm._ENABLED:
+            _rm.SERVING_QUEUE_DEPTH.set(depth, server=self.name)
+            _rm.SERVING_QUEUE_PEAK.set_max(depth, server=self.name)
+
+    def _next_batch(self):
+        """Block until a batch is ready to dispatch (or shutdown drain
+        is complete).  Returns ``(entry, [requests])`` or None.
+
+        A queue is *ripe* once it holds a full batch or its head request
+        has aged past ``max_latency_us`` (always, during shutdown
+        drain).  The ripe queue with the oldest head dispatches first so
+        no model starves; when nothing is ripe yet, wait only until the
+        earliest forming-batch deadline — a full batch for one model
+        never sits behind another model's hold window.
+        """
+        max_latency_s = self.config.max_latency_us / 1e6
+        with self._cond:
+            while True:
+                ripe, earliest = None, None
+                for uid, (entry, q) in self._queues.items():
+                    if not q:
+                        continue
+                    cap = entry.max_rows(self.config.max_batch_size)
+                    deadline = q[0].t_enq + max_latency_s
+                    now = time.monotonic()
+                    if self._stopping or now >= deadline \
+                            or sum(r.rows for r in q) >= cap:
+                        if ripe is None or q[0].t_enq < ripe[1][0].t_enq:
+                            ripe = (entry, q)
+                    elif earliest is None or deadline < earliest:
+                        earliest = deadline
+                if ripe is None:
+                    if earliest is not None:
+                        # hold forming batches open for more work, then
+                        # re-evaluate (new arrivals notify)
+                        self._cond.wait(
+                            max(0.0, earliest - time.monotonic()))
+                        continue
+                    if self._stopping:
+                        return None
+                    # idle: block until an enqueue/stop notifies (every
+                    # state change that creates work calls notify_all)
+                    self._cond.wait()
+                    continue
+                entry, q = ripe
+                cap = entry.max_rows(self.config.max_batch_size)
+                reqs, rows = [], 0
+                while q and rows + q[0].rows <= cap:
+                    r = q.popleft()
+                    reqs.append(r)
+                    rows += r.rows
+                if not q:
+                    self._queues.pop(entry.uid, None)
+                self._set_depth(self._depth - len(reqs))
+                self._inflight += len(reqs)
+                return entry, reqs
+
+    def _worker_loop(self):
+        while True:
+            batch = self._next_batch()
+            if batch is None:
+                return
+            entry, reqs = batch
+            try:
+                results = self.batcher.run_batch(
+                    entry, [r.inputs for r in reqs])
+            except Exception as e:        # noqa: BLE001 — fail the batch
+                with self._cond:
+                    self._stats["errors"] += len(reqs)
+                    self._inflight -= len(reqs)
+                    self._cond.notify_all()
+                for r in reqs:
+                    r.error = e
+                    r.event.set()
+                continue
+            done = time.monotonic()
+            with self._cond:
+                self._stats["batches"] += 1
+                self._stats["completed"] += len(reqs)
+                self._inflight -= len(reqs)
+                self._cond.notify_all()
+            for r, out in zip(reqs, results):
+                r.result = out
+                if _rm._ENABLED:
+                    _rm.SERVING_REQUEST_SECONDS.observe(
+                        done - r.t_enq, model=entry.name)
+                r.event.set()

@@ -90,3 +90,99 @@ def test_load_stablehlo_roundtrip(tmp_path):
     from mxnet_tpu.base import MXNetError
     with pytest.raises(MXNetError, match="no artifact"):
         deploy.load_stablehlo(str(tmp_path / "missing.shlo"))
+
+
+def test_manifest_validation_roundtrip(tmp_path):
+    """load_stablehlo validates calls against the .json manifest: a
+    shape/dtype mistake raises a clear MXNetError naming the manifest,
+    not an opaque PJRT failure; matching inputs still round-trip."""
+    import pytest
+    from mxnet_tpu.base import MXNetError
+
+    net = _build_net()
+    x = nd.random.uniform(shape=(3, 8))
+    path = str(tmp_path / "m3")
+    deploy.export_stablehlo(net, x, path=path)
+    fn = deploy.load_stablehlo(path + ".shlo")
+    assert fn.manifest["inputs"] == [{"shape": [3, 8],
+                                     "dtype": "float32"}]
+    assert fn.manifest["outputs"][0]["shape"] == [3, 4]
+    assert not fn.dynamic_batch
+
+    # the good path still round-trips (NDArray or numpy)
+    np.testing.assert_allclose(np.asarray(fn.call(x)),
+                               net(x).asnumpy(), rtol=1e-5, atol=1e-5)
+    with pytest.raises(MXNetError, match="dtype mismatch"):
+        fn.call(x.asnumpy().astype(np.float64))
+    with pytest.raises(MXNetError, match="rank mismatch"):
+        fn.call(x.asnumpy()[0])
+    with pytest.raises(MXNetError, match="shape mismatch at axis 0"):
+        fn.call(np.ones((5, 8), np.float32))
+    with pytest.raises(MXNetError, match="expected 1 input"):
+        fn.call(x.asnumpy(), x.asnumpy())
+    # the error names the manifest file, so it is actionable
+    with pytest.raises(MXNetError, match="m3.json"):
+        fn.call(np.ones((3, 9), np.float32))
+
+    # an artifact without a manifest (pre-manifest export) stays loadable
+    os.remove(path + ".json")
+    fn2 = deploy.load_stablehlo(path + ".shlo")
+    assert fn2.manifest is None
+    np.testing.assert_allclose(np.asarray(fn2.call(x.asnumpy())),
+                               net(x).asnumpy(), rtol=1e-5, atol=1e-5)
+
+
+def test_dynamic_batch_export_serves_any_batch(tmp_path):
+    """dynamic_batch=True leaves the batch dimension symbolic: one
+    artifact answers every batch size (the serving subsystem's shape
+    buckets build on this), and the manifest records the dynamic axis
+    as null."""
+    import pytest
+    from mxnet_tpu.base import MXNetError
+
+    net = _build_net()
+    x = nd.random.uniform(shape=(5, 8))
+    path = str(tmp_path / "dyn")
+    deploy.export_stablehlo(net, x, path=path, dynamic_batch=True,
+                            version=3)
+    fn = deploy.load_stablehlo(path + ".shlo")
+    assert fn.dynamic_batch
+    assert fn.manifest["version"] == 3
+    assert fn.manifest["inputs"] == [{"shape": [None, 8],
+                                      "dtype": "float32"}]
+    assert fn.manifest["outputs"][0]["shape"] == [None, 4]
+    for n in (1, 3, 8):
+        xs = nd.random.uniform(shape=(n, 8))
+        np.testing.assert_allclose(np.asarray(fn.call(xs.asnumpy())),
+                                   net(xs).asnumpy(),
+                                   rtol=1e-5, atol=1e-5)
+    # the batch axis is free, every other dimension still validates
+    with pytest.raises(MXNetError, match="axis 1"):
+        fn.call(np.ones((4, 9), np.float32))
+
+
+def test_bfloat16_artifact_validates_not_crashes(tmp_path):
+    """Extension dtypes (bfloat16, the TPU-native default) must flow
+    through manifest validation: a mismatch raises MXNetError, and the
+    matching-dtype call serves — not a numpy TypeError on
+    np.dtype('bfloat16')."""
+    import pytest
+    from mxnet_tpu.base import MXNetError
+
+    mx.random.seed(11)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=8))
+    net.initialize(mx.init.Xavier())
+    net.cast("bfloat16")
+    net.hybridize()
+    x = nd.random.uniform(shape=(3, 8)).astype("bfloat16")
+    path = str(tmp_path / "bf16")
+    deploy.export_stablehlo(net, x, path=path)
+    fn = deploy.load_stablehlo(path + ".shlo")
+    assert fn.manifest["inputs"][0]["dtype"] == "bfloat16"
+    with pytest.raises(MXNetError, match="dtype mismatch"):
+        fn.call(np.ones((3, 8), np.float32))
+    got = np.asarray(fn.call(x.asnumpy())).astype(np.float32)
+    want = net(x).asnumpy().astype(np.float32)
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)

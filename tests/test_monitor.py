@@ -122,6 +122,28 @@ class TestMonitorGluon:
         names = [n for _s, n, _v in res]
         assert len(names) == len(set(names))    # no duplicated stats
 
+    def test_uninstall_removes_hooks(self):
+        """A per-run Monitor must not leave stale hook closures on a
+        long-lived net (Module.fit builds one Monitor per fit call)."""
+        net = _small_net()
+        n_hooks_before = sum(len(b._forward_hooks)
+                             for b in net._iter_blocks())
+        mon = Monitor(interval=1)
+        mon.install(net)
+        assert sum(len(b._forward_hooks)
+                   for b in net._iter_blocks()) > n_hooks_before
+        mon.uninstall()
+        assert sum(len(b._forward_hooks)
+                   for b in net._iter_blocks()) == n_hooks_before
+        # uninstalled monitor collects nothing, and reinstall works
+        mon.tic()
+        net(nd.ones((2, 5)))
+        assert mon.toc() == []
+        mon.install(net)
+        mon.tic()
+        net(nd.ones((2, 5)))
+        assert mon.toc()
+
     def test_default_stat(self):
         v = default_stat(nd.array(np.ones((4,), np.float32) * 3.0))
         assert v == pytest.approx(3.0)

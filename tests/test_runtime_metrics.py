@@ -143,6 +143,31 @@ class TestExporters:
         assert snap["t.snap"]["values"][""] == 2
 
 
+    def test_prometheus_label_value_escaping(self):
+        """Label values are arbitrary user strings (model names): quote,
+        backslash, and newline must be escaped per the exposition format
+        or a scraper rejects the whole scrape."""
+        c = rm.counter("t.prom.esc", labelnames=("model",))
+        c.inc(model='net"v2\\x\n')
+        txt = rm.dump_prometheus()
+        assert 't_prom_esc_total{model="net\\"v2\\\\x\\n"} 1' in txt
+
+    def test_tracked_gauge_resampled_at_export(self):
+        """engine.tracked_arrays re-samples the weak dict at scrape time
+        — after arrays die it must not keep reporting the stale high
+        value set at the last track()."""
+        import gc
+        arrays = [nd.ones((2,)) for _ in range(50)]
+        mx.waitall()
+        assert rm.ENGINE_TRACKED.value() >= 50
+        del arrays
+        gc.collect()
+        rm.dump_prometheus()                # runs collect hooks
+        from mxnet_tpu.engine import Engine
+        assert rm.ENGINE_TRACKED.value() == len(Engine.get()._live)
+        assert rm.ENGINE_TRACKED.value() < 50
+
+
 class TestInstrumentation:
     def test_op_invoke_counter_and_latency(self):
         a = nd.ones((8, 8))

@@ -1,0 +1,715 @@
+"""Serving subsystem: shape-bucketed dynamic batching, versioned
+repository hot-swap, bounded-queue backpressure (docs/serving.md).
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, runtime_metrics as rm, serving
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.serving import (ModelRepository, ModelServer,
+                               ServerOverloadedError, ServingConfig,
+                               next_bucket, pad_batch, unpad_outputs)
+
+
+@pytest.fixture(autouse=True)
+def _metrics_on():
+    rm.reset()
+    rm.enable()
+    yield
+    rm.disable()
+    rm.reset()
+
+
+def _mlp(seed=7, in_units=8, out_units=4):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu", in_units=in_units))
+        net.add(nn.Dense(out_units, in_units=16))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    return net
+
+
+def _export(net, tmp_path, name="m", dynamic_batch=True, batch=5,
+            version=None):
+    x = nd.random.uniform(shape=(batch, 8))
+    return net.export_stablehlo(x, path=str(tmp_path / name),
+                                dynamic_batch=dynamic_batch,
+                                version=version)
+
+
+def _cfg(**kw):
+    kw.setdefault("max_batch_size", 8)
+    kw.setdefault("max_latency_us", 20_000)
+    return ServingConfig(**kw)
+
+
+class TestBucketMath:
+    def test_next_bucket_powers_of_two(self):
+        assert [next_bucket(n, 8) for n in (1, 2, 3, 4, 5, 7, 8)] == \
+            [1, 2, 4, 4, 8, 8, 8]
+
+    def test_next_bucket_non_pow2_cap(self):
+        # the cap itself is the last bucket even when not a power of two
+        assert next_bucket(5, 6) == 6
+        assert next_bucket(6, 6) == 6
+        assert next_bucket(9, 6) == 6
+
+    def test_next_bucket_rejects_zero(self):
+        with pytest.raises(MXNetError):
+            next_bucket(0, 8)
+
+    def test_bucket_set_size_bound(self):
+        # any request mix reaches at most ceil(log2(max))+1 shapes
+        import math
+        for max_batch in (1, 2, 6, 8, 16):
+            buckets = {next_bucket(n, max_batch)
+                       for n in range(1, 3 * max_batch)}
+            assert len(buckets) <= math.ceil(math.log2(max_batch)) + 1
+
+    def test_pad_unpad_roundtrip_ragged(self):
+        reqs = [(np.arange(2 * 3, dtype=np.float32).reshape(2, 3),),
+                (np.ones((1, 3), np.float32),),
+                (np.full((2, 3), 7, np.float32),)]
+        padded, offsets = pad_batch(reqs, 8)        # 5 real + 3 pad rows
+        assert padded[0].shape == (8, 3)
+        assert offsets == [0, 2, 3, 5]
+        assert np.all(padded[0][5:] == 0)
+        outs = (padded[0] * 2,)                     # batch-major op
+        back = unpad_outputs(outs, offsets)
+        for req, out in zip(reqs, back):
+            np.testing.assert_allclose(out[0], req[0] * 2)
+
+    def test_pad_batch_overflow_raises(self):
+        with pytest.raises(MXNetError, match="exceed bucket"):
+            pad_batch([(np.ones((4, 2), np.float32),)], 2)
+
+    def test_unpad_rejects_non_batch_major(self):
+        with pytest.raises(MXNetError, match="batch-major"):
+            unpad_outputs((np.float32(3.0),), [0, 2, 4])
+
+
+class TestRepository:
+    def test_block_roundtrip_and_versioning(self):
+        repo = ModelRepository()
+        net = _mlp(1)
+        x = nd.random.uniform(shape=(4, 8))
+        e1 = repo.add_block("net", net, x)
+        assert repo.current_version("net") == e1.version == 1
+        e2 = repo.add_block("net", net, x)          # auto-increments
+        assert e2.version == 2
+        assert repo.current_version("net") == 2     # activate=True
+        assert repo.versions("net") == [1, 2]
+        assert repo.swap("net", 1) == 2
+        assert repo.get("net") is e1
+
+    def test_register_without_activate_keeps_current(self):
+        repo = ModelRepository()
+        net = _mlp(2)
+        x = nd.random.uniform(shape=(4, 8))
+        repo.add_block("net", net, x)
+        repo.add_block("net", net, x, activate=False)
+        assert repo.current_version("net") == 1
+
+    def test_first_version_staged_with_activate_false(self):
+        """activate=False stages even the first version of a new name:
+        nothing serves until an explicit swap() activates it."""
+        repo = ModelRepository()
+        net = _mlp(2)
+        x = nd.random.uniform(shape=(4, 8))
+        repo.add_block("net", net, x, activate=False)
+        assert repo.current_version("net") is None
+        with pytest.raises(MXNetError, match="no active version"):
+            repo.get("net")
+        repo.swap("net", 1)
+        assert repo.get("net").version == 1
+
+    def test_duplicate_version_rejected(self):
+        repo = ModelRepository()
+        net = _mlp(3)
+        x = nd.random.uniform(shape=(4, 8))
+        repo.add_block("net", net, x, version=5)
+        with pytest.raises(MXNetError, match="already registered"):
+            repo.add_block("net", net, x, version=5)
+
+    def test_unload_rules(self):
+        repo = ModelRepository()
+        net = _mlp(4)
+        x = nd.random.uniform(shape=(4, 8))
+        repo.add_block("net", net, x)
+        repo.add_block("net", net, x)
+        with pytest.raises(MXNetError, match="is current"):
+            repo.unload("net", 2)
+        repo.swap("net", 1)
+        repo.unload("net", 2)
+        assert repo.versions("net") == [1]
+        repo.unload("net")
+        with pytest.raises(MXNetError, match="no model"):
+            repo.get("net")
+
+    def test_missing_model_message_lists_known(self):
+        repo = ModelRepository()
+        with pytest.raises(MXNetError, match="no model 'ghost'"):
+            repo.get("ghost")
+
+    def test_block_weights_snapshot_at_registration(self, tmp_path):
+        """Training after add_block must not mutate the served version —
+        publish new weights by registering + swapping."""
+        repo = ModelRepository()
+        net = _mlp(5)
+        x = nd.random.uniform(shape=(3, 8))
+        want_v1 = net(x).asnumpy()
+        repo.add_block("net", net, x)
+        for p in net.collect_params().values():     # "training"
+            p.set_data(p.data() * 0.5)
+        want_v2 = net(x).asnumpy()
+        assert not np.allclose(want_v1, want_v2)
+        repo.add_block("net", net, x, activate=False)
+        with ModelServer(repo, _cfg()) as srv:
+            np.testing.assert_allclose(srv.predict("net", x.asnumpy()),
+                                       want_v1, rtol=1e-5, atol=1e-5)
+            repo.swap("net", 2)
+            np.testing.assert_allclose(srv.predict("net", x.asnumpy()),
+                                       want_v2, rtol=1e-5, atol=1e-5)
+
+    def test_concurrent_auto_versioning_never_collides(self):
+        """version=None registrations assign under one lock hold: two
+        racing add_block calls must get distinct versions, not a
+        spurious 'already registered' error."""
+        repo = ModelRepository()
+        net = _mlp(30)
+        x = nd.random.uniform(shape=(2, 8))
+        errors = []
+        barrier = threading.Barrier(4)
+
+        def register():
+            try:
+                barrier.wait(10)
+                repo.add_block("net", net, x)
+            except Exception as e:      # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=register) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert not errors, errors[:2]
+        assert sorted(repo.versions("net")) == [1, 2, 3, 4]
+
+    def test_unload_evicts_cached_programs(self):
+        """Retired versions must not pin compiled programs (hot-swap
+        deploy loops would otherwise grow memory without bound)."""
+        repo = ModelRepository()
+        net = _mlp(31)
+        x = nd.random.uniform(shape=(2, 8))
+        repo.add_block("net", net, x)
+        repo.add_block("net", net, x, activate=False)
+        with ModelServer(repo, _cfg()) as srv:
+            e1 = repo.get("net")
+            srv.predict("net", x.asnumpy(), timeout=60)
+            assert srv.batcher.programs(e1) == 1
+            repo.swap("net", 2)
+            repo.unload("net", 1)
+            assert srv.batcher.programs(e1) == 0
+            srv.predict("net", x.asnumpy(), timeout=60)  # v2 serves on
+            assert srv.batcher.programs() == 1
+            # a batch admitted pre-unload may still dispatch once, but
+            # must NOT re-cache under the retired uid
+            srv.batcher.run_batch(e1, [(x.asnumpy(),)])
+            assert srv.batcher.programs(e1) == 0
+
+    def test_load_artifact_auto_versions_default_exports(self, tmp_path):
+        """Exports without an explicit version (manifest version null)
+        auto-increment in the repository — the documented export ->
+        load_artifact -> swap loop must not collide on the second
+        default export."""
+        net = _mlp(32)
+        a1 = _export(net, tmp_path, name="a1")
+        a2 = _export(net, tmp_path, name="a2")
+        repo = ModelRepository()
+        repo.load_artifact("net", a1)
+        repo.load_artifact("net", a2)
+        assert repo.versions("net") == [1, 2]
+        assert repo.current_version("net") == 2
+
+    def test_stopped_server_unsubscribes_from_repository(self):
+        repo = ModelRepository()
+        srv = ModelServer(repo, _cfg())
+        assert len(repo._unload_listeners) == 1
+        srv.stop()
+        assert repo._unload_listeners == []
+        srv.start()                         # re-subscribes
+        assert len(repo._unload_listeners) == 1
+        srv.stop()
+
+    def test_load_artifact_requires_manifest(self, tmp_path):
+        net = _mlp(6)
+        art = _export(net, tmp_path)
+        (tmp_path / "m.json").unlink()
+        with pytest.raises(MXNetError, match="no manifest"):
+            ModelRepository().load_artifact("net", art)
+
+
+class TestValidation:
+    def test_predict_validates_dtype_and_shape(self, tmp_path):
+        net = _mlp(7)
+        repo = ModelRepository()
+        repo.load_artifact("net", _export(net, tmp_path))
+        with ModelServer(repo, _cfg()) as srv:
+            with pytest.raises(MXNetError, match="dtype mismatch"):
+                srv.predict("net", np.ones((2, 8), np.float64))
+            with pytest.raises(MXNetError, match="rank mismatch"):
+                srv.predict("net", np.ones((8,), np.float32))
+            with pytest.raises(MXNetError, match="axis 1"):
+                srv.predict("net", np.ones((2, 9), np.float32))
+            with pytest.raises(MXNetError, match="expected 1 input"):
+                srv.predict("net", np.ones((2, 8), np.float32),
+                            np.ones((2, 8), np.float32))
+
+    def test_request_rows_bounded_by_policy(self, tmp_path):
+        net = _mlp(8)
+        repo = ModelRepository()
+        repo.load_artifact("net", _export(net, tmp_path))
+        with ModelServer(repo, _cfg(max_batch_size=4)) as srv:
+            with pytest.raises(MXNetError, match="outside"):
+                srv.predict("net", np.ones((5, 8), np.float32))
+
+
+class TestDynamicBatching:
+    def test_concurrent_requests_coalesce_into_buckets(self, tmp_path):
+        """32 concurrent predict()s of 3 distinct batch sizes: results
+        exact, programs bounded by ceil(log2(max_batch))+1, cache-hit
+        counter moves, padded rows never leak (acceptance criteria)."""
+        net = _mlp(9)
+        repo = ModelRepository()
+        repo.load_artifact("net", _export(net, tmp_path))
+        cfg = _cfg(max_batch_size=8, max_latency_us=50_000)
+        refs = {}
+        for n in (1, 2, 3):
+            x = np.random.RandomState(n).randn(n, 8).astype(np.float32)
+            refs[n] = (x, net(nd.NDArray(x)).asnumpy())
+
+        errors = []
+        start = threading.Barrier(32 + 1)
+
+        with ModelServer(repo, cfg) as srv:
+            def one(i):
+                n = 1 + i % 3
+                try:
+                    start.wait(10)
+                    x, want = refs[n]
+                    got = srv.predict("net", x, timeout=60)
+                    np.testing.assert_allclose(got, want, rtol=1e-5,
+                                               atol=1e-5)
+                except Exception as e:      # noqa: BLE001
+                    errors.append(e)
+
+            threads = [threading.Thread(target=one, args=(i,))
+                       for i in range(32)]
+            for t in threads:
+                t.start()
+            start.wait(10)
+            for t in threads:
+                t.join(60)
+            stats = srv.stats()
+        assert not errors, errors[:3]
+        assert stats["completed"] == stats["requests"] == 32
+        # coalescing really happened, and cannot exceed one batch per
+        # request
+        assert stats["batches"] < 32
+        assert stats["batches"] >= 1
+        # O(log N) compiled programs: buckets are {1,2,4,8} at most
+        assert stats["programs"] <= 4
+        assert stats["bucket_misses"] == stats["programs"]
+        assert stats["bucket_hits"] == \
+            rm.SERVING_BUCKET_CACHE.value(event="hit")
+        assert stats["bucket_hits"] + stats["bucket_misses"] == \
+            stats["batches"]
+        assert stats["queue_depth"] == 0
+        # per-model latency histogram carries every request; p99 reads
+        p99 = rm.SERVING_REQUEST_SECONDS.quantile(0.99, model="net")
+        assert rm.SERVING_REQUEST_SECONDS.count(model="net") == 32
+        assert np.isfinite(p99) and p99 >= 0
+        # the bounded sync point around batch dispatch was exercised
+        assert rm.ENGINE_SYNC_SECONDS.count(site="serving") == \
+            stats["batches"]
+        # prometheus exporter carries the serving metrics
+        prom = rm.dump_prometheus()
+        assert 'serving_request_seconds_count{model="net"} 32' in prom
+        assert "serving_queue_depth" in prom
+        assert "serving_batch_occupancy_bucket" in prom
+
+    def test_single_request_no_server_needed(self, tmp_path):
+        """The batcher is usable standalone (no worker pool)."""
+        net = _mlp(10)
+        repo = ModelRepository()
+        entry = repo.load_artifact("net", _export(net, tmp_path))
+        b = serving.DynamicBatcher(_cfg())
+        x = np.random.RandomState(0).randn(3, 8).astype(np.float32)
+        [(out,)] = b.run_batch(entry, [(x,)])
+        np.testing.assert_allclose(out, net(nd.NDArray(x)).asnumpy(),
+                                   rtol=1e-5, atol=1e-5)
+        assert b.bucket_misses == 1
+        [(out2,)] = b.run_batch(entry, [(x,)])      # same bucket: hit
+        assert b.bucket_hits == 1
+        np.testing.assert_allclose(out, out2, rtol=1e-6)
+
+    def test_static_artifact_pads_to_exported_batch(self, tmp_path):
+        net = _mlp(11)
+        repo = ModelRepository()
+        repo.load_artifact(
+            "net", _export(net, tmp_path, dynamic_batch=False, batch=4))
+        entry = repo.get("net")
+        assert not entry.dynamic_batch and entry.fixed_batch == 4
+        with ModelServer(repo, _cfg()) as srv:
+            for n in (1, 2, 4):
+                x = np.random.RandomState(n).randn(n, 8) \
+                    .astype(np.float32)
+                got = srv.predict("net", x, timeout=60)
+                np.testing.assert_allclose(
+                    got, net(nd.NDArray(x)).asnumpy(),
+                    rtol=1e-5, atol=1e-5)
+            with pytest.raises(MXNetError, match="outside"):
+                srv.predict("net", np.ones((5, 8), np.float32))
+        # one program: every dispatch pads to the exported batch of 4
+        assert srv.stats()["programs"] == 1
+
+    def test_static_function_entry_pads_to_declared_batch(self):
+        """dynamic_batch=False function entries derive fixed_batch from
+        the signature and serve via padding, like static artifacts."""
+        repo = ModelRepository()
+        repo.add_function("f", lambda x: x * 2.0,
+                          [{"shape": [4, 2], "dtype": "float32"}],
+                          dynamic_batch=False)
+        assert repo.get("f").fixed_batch == 4
+        with ModelServer(repo, _cfg()) as srv:
+            x = np.arange(4, dtype=np.float32).reshape(2, 2)
+            np.testing.assert_allclose(
+                srv.predict("f", x, timeout=60), x * 2)
+            with pytest.raises(MXNetError, match="outside"):
+                srv.predict("f", np.ones((5, 2), np.float32))
+
+    def test_multi_output_model_returns_tuple(self):
+        repo = ModelRepository()
+        sig = [{"shape": [None, 3], "dtype": "float32"}]
+        repo.add_function("twin", lambda x: (x * 2.0, x + 1.0), sig)
+        with ModelServer(repo, _cfg()) as srv:
+            x = np.ones((2, 3), np.float32)
+            a, b = srv.predict("twin", x, timeout=60)
+            np.testing.assert_allclose(a, x * 2)
+            np.testing.assert_allclose(b, x + 1)
+
+
+class _GatedModel:
+    """Function entry whose batches block until released — makes queue
+    buildup deterministic for backpressure tests."""
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.entered = threading.Event()
+
+    def __call__(self, x):
+        self.entered.set()
+        assert self.release.wait(30), "test never released the gate"
+        return x * 2.0
+
+
+class TestBackpressure:
+    SIG = [{"shape": [None, 2], "dtype": "float32"}]
+
+    def _spawn_predicts(self, srv, n, results):
+        threads = []
+        for i in range(n):
+            def one():
+                try:
+                    results.append(srv.predict(
+                        "gated", np.ones((1, 2), np.float32),
+                        timeout=60))
+                except Exception as e:  # noqa: BLE001
+                    results.append(e)
+            t = threading.Thread(target=one)
+            t.start()
+            threads.append(t)
+        return threads
+
+    def test_load_shedding_at_watermark(self):
+        repo = ModelRepository()
+        gate = _GatedModel()
+        repo.add_function("gated", gate, self.SIG)
+        cfg = _cfg(max_batch_size=1, max_latency_us=1, queue_depth=4,
+                   shed_watermark=2, num_workers=1, retry_after_ms=17)
+        srv = ModelServer(repo, cfg)
+        try:
+            results = []
+            t1 = self._spawn_predicts(srv, 1, results)
+            # worker picks up request 1 and blocks inside the model
+            assert gate.entered.wait(30)
+            deadline = time.monotonic() + 30
+            while srv.stats()["queue_depth"] > 0:   # popped from queue
+                assert time.monotonic() < deadline
+                time.sleep(0.005)
+            t2 = self._spawn_predicts(srv, 2, results)  # fill to the mark
+            deadline = time.monotonic() + 30
+            while srv.stats()["queue_depth"] < 2:
+                assert time.monotonic() < deadline
+                time.sleep(0.005)
+            # depth == watermark: next request must shed immediately
+            with pytest.raises(ServerOverloadedError) as ei:
+                srv.predict("gated", np.ones((1, 2), np.float32))
+            assert ei.value.retry_after_ms == 17
+            assert "retry after 17ms" in str(ei.value)
+            assert srv.stats()["shed"] == 1
+            assert rm.SERVING_SHED.value(model="gated") == 1
+            gate.release.set()
+            for t in t1 + t2:
+                t.join(60)
+            assert all(isinstance(r, np.ndarray) for r in results), \
+                results
+        finally:
+            gate.release.set()
+            srv.stop()
+        assert srv.stats()["completed"] == 3
+
+    def test_inflight_counts_against_queue_depth(self):
+        """queue_depth bounds total outstanding work: with the waiting
+        queue below the watermark, dispatched-but-unfinished requests
+        still push admission into the shed path."""
+        repo = ModelRepository()
+        gate = _GatedModel()
+        repo.add_function("gated", gate, self.SIG)
+        cfg = _cfg(max_batch_size=1, max_latency_us=1, queue_depth=2,
+                   shed_watermark=2, num_workers=1)
+        srv = ModelServer(repo, cfg)
+        try:
+            results = []
+            t1 = self._spawn_predicts(srv, 1, results)
+            assert gate.entered.wait(30)        # in-flight, queue empty
+            t2 = self._spawn_predicts(srv, 1, results)  # queued: depth 1
+            deadline = time.monotonic() + 30
+            while srv.stats()["queue_depth"] < 1:
+                assert time.monotonic() < deadline
+                time.sleep(0.005)
+            # depth(1) < watermark(2), but depth + inflight == 2 ==
+            # queue_depth: total-outstanding bound sheds
+            with pytest.raises(ServerOverloadedError):
+                srv.predict("gated", np.ones((1, 2), np.float32))
+            gate.release.set()
+            for t in t1 + t2:
+                t.join(60)
+            assert all(isinstance(r, np.ndarray) for r in results)
+        finally:
+            gate.release.set()
+            srv.stop()
+
+    def test_graceful_drain_completes_queued_requests(self):
+        repo = ModelRepository()
+        gate = _GatedModel()
+        repo.add_function("gated", gate, self.SIG)
+        cfg = _cfg(max_batch_size=1, max_latency_us=1, queue_depth=8,
+                   num_workers=1)
+        srv = ModelServer(repo, cfg)
+        results = []
+        threads = self._spawn_predicts(srv, 4, results)
+        assert gate.entered.wait(30)
+        gate.release.set()
+        srv.stop(drain=True)                # waits for every request
+        for t in threads:
+            t.join(60)
+        assert len(results) == 4
+        assert all(isinstance(r, np.ndarray) for r in results), results
+        with pytest.raises(MXNetError, match="not accepting"):
+            srv.predict("gated", np.ones((1, 2), np.float32))
+
+    def test_hard_stop_fails_queued_requests(self):
+        repo = ModelRepository()
+        gate = _GatedModel()
+        repo.add_function("gated", gate, self.SIG)
+        cfg = _cfg(max_batch_size=1, max_latency_us=1, queue_depth=8,
+                   num_workers=1)
+        srv = ModelServer(repo, cfg)
+        results = []
+        threads = self._spawn_predicts(srv, 3, results)
+        assert gate.entered.wait(30)
+        deadline = time.monotonic() + 30
+        while srv.stats()["queue_depth"] < 2:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        gate.release.set()
+        srv.stop(drain=False)
+        for t in threads:
+            t.join(60)
+        assert len(results) == 3
+        stopped = [r for r in results if isinstance(r, MXNetError)]
+        served = [r for r in results if isinstance(r, np.ndarray)]
+        assert len(stopped) == 2 and len(served) == 1, results
+
+    def test_timed_out_request_is_withdrawn(self):
+        """An abandoned predict() must not occupy queue depth (pushing
+        later admissions into the shed watermark) nor be dispatched."""
+        repo = ModelRepository()
+        gate = _GatedModel()
+        repo.add_function("gated", gate, self.SIG)
+        cfg = _cfg(max_batch_size=1, max_latency_us=1, queue_depth=8,
+                   shed_watermark=2, num_workers=1)
+        srv = ModelServer(repo, cfg)
+        try:
+            results = []
+            t1 = self._spawn_predicts(srv, 1, results)
+            assert gate.entered.wait(30)        # worker holds request 1
+            with pytest.raises(MXNetError, match="no result within"):
+                srv.predict("gated", np.ones((1, 2), np.float32),
+                            timeout=0.05)
+            assert srv.stats()["queue_depth"] == 0      # withdrawn
+            # depth is back below the watermark: a fresh request admits
+            t2 = self._spawn_predicts(srv, 1, results)
+            gate.release.set()
+            for t in t1 + t2:
+                t.join(60)
+            assert all(isinstance(r, np.ndarray) for r in results)
+        finally:
+            gate.release.set()
+            srv.stop()
+        # the timed-out request was never dispatched
+        assert srv.stats()["completed"] == 2
+
+    def test_stop_timeout_keeps_stopping_state(self):
+        """A join timeout with a stuck worker must NOT mark the server
+        stopped — start() would spawn a second pool next to the orphan.
+        """
+        repo = ModelRepository()
+        gate = _GatedModel()
+        repo.add_function("gated", gate, self.SIG)
+        srv = ModelServer(repo, _cfg(max_batch_size=1, max_latency_us=1,
+                                     num_workers=1))
+        results = []
+        threads = self._spawn_predicts(srv, 1, results)
+        assert gate.entered.wait(30)            # worker stuck in model
+        assert srv.stop(drain=True, timeout=0.05) is False
+        assert srv.started                      # still owns the orphan
+        srv.start()                             # must be a no-op
+        assert len(srv._workers) == 1
+        gate.release.set()
+        assert srv.stop(drain=True) is True
+        for t in threads:
+            t.join(60)
+        assert all(isinstance(r, np.ndarray) for r in results)
+
+    def test_full_batch_not_blocked_by_other_models_hold_window(self):
+        """A ripe (full) batch for one model dispatches immediately even
+        while another model's forming batch sits in a long hold window.
+        """
+        repo = ModelRepository()
+        repo.add_function("slow_form", lambda x: x, self.SIG)
+        repo.add_function("fast", lambda x: x + 1.0, self.SIG)
+        cfg = _cfg(max_batch_size=2, max_latency_us=10_000_000,
+                   num_workers=1)
+        srv = ModelServer(repo, cfg)
+        try:
+            holder_out = []
+            holder = threading.Thread(
+                target=lambda: holder_out.append(srv.predict(
+                    "slow_form", np.ones((1, 2), np.float32),
+                    timeout=60)))
+            holder.start()                      # forms for 10s
+            done = []
+
+            def full_batch(results=done):
+                results.append(srv.predict(
+                    "fast", np.ones((1, 2), np.float32), timeout=60))
+            t0 = time.monotonic()
+            fast_threads = [threading.Thread(target=full_batch)
+                            for _ in range(2)]         # 2 rows == cap
+            for t in fast_threads:
+                t.start()
+            for t in fast_threads:
+                t.join(60)
+            elapsed = time.monotonic() - t0
+            assert len(done) == 2
+            # far below the 10s hold window of the forming model
+            assert elapsed < 5, elapsed
+        finally:
+            srv.stop(drain=True)                # drains the forming req
+        holder.join(60)
+        assert srv.stats()["completed"] == 3
+
+    def test_model_error_propagates_to_caller(self):
+        repo = ModelRepository()
+
+        def boom(x):
+            raise ValueError("synthetic model failure")
+
+        repo.add_function("boom", boom, self.SIG)
+        with ModelServer(repo, _cfg(max_latency_us=1)) as srv:
+            with pytest.raises(ValueError, match="synthetic"):
+                srv.predict("boom", np.ones((1, 2), np.float32),
+                            timeout=60)
+        assert srv.stats()["errors"] == 1
+
+
+class TestHotSwap:
+    def test_swap_under_concurrent_load_is_atomic(self, tmp_path):
+        """Every response matches exactly v1 or v2 — never a mix."""
+        net1, net2 = _mlp(20), _mlp(21)
+        x = np.random.RandomState(0).randn(2, 8).astype(np.float32)
+        want1 = net1(nd.NDArray(x)).asnumpy()
+        want2 = net2(nd.NDArray(x)).asnumpy()
+        assert not np.allclose(want1, want2)
+
+        repo = ModelRepository()
+        repo.add_block("net", net1, nd.NDArray(x), version=1)
+        repo.add_block("net", net2, nd.NDArray(x), version=2,
+                       activate=False)
+        errors, seen_v2 = [], threading.Event()
+
+        with ModelServer(repo, _cfg(max_latency_us=1000)) as srv:
+            def caller():
+                for _ in range(20):
+                    try:
+                        got = srv.predict("net", x, timeout=60)
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(e)
+                        return
+                    if np.allclose(got, want2, rtol=1e-5, atol=1e-5):
+                        seen_v2.set()
+                    elif not np.allclose(got, want1, rtol=1e-5,
+                                         atol=1e-5):
+                        errors.append(AssertionError(
+                            "response matches neither version"))
+                        return
+            threads = [threading.Thread(target=caller)
+                       for _ in range(4)]
+            for t in threads:
+                t.start()
+            time.sleep(0.02)
+            assert repo.swap("net", 2) == 1
+            for t in threads:
+                t.join(60)
+        assert not errors, errors[:3]
+        assert seen_v2.is_set()             # swap became visible
+
+
+class TestConfig:
+    def test_env_defaults(self, monkeypatch):
+        monkeypatch.setenv("MXNET_SERVING_MAX_BATCH", "16")
+        monkeypatch.setenv("MXNET_SERVING_SHED_WATERMARK", "9")
+        cfg = ServingConfig()
+        assert cfg.max_batch_size == 16
+        assert cfg.shed_watermark == 9
+        assert cfg.queue_depth == 128
+
+    def test_validation(self):
+        with pytest.raises(MXNetError, match="max_batch_size"):
+            ServingConfig(max_batch_size=0)
+        with pytest.raises(MXNetError, match="shed_watermark"):
+            ServingConfig(queue_depth=4, shed_watermark=9)
+        with pytest.raises(MXNetError, match="max_latency_us"):
+            ServingConfig(max_latency_us=-1)
+        with pytest.raises(MXNetError, match="retry_after_ms"):
+            ServingConfig(retry_after_ms=-1)
