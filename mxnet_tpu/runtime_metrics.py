@@ -125,10 +125,12 @@ class Counter(_Metric):
         self._values: Dict[Tuple[str, ...], float] = {}
 
     def inc(self, amount: float = 1, **labels):
-        if not _ENABLED:
-            return
+        # validate BEFORE the enabled check: a bad call site must fail
+        # the same way whether or not the registry is switched on
         if amount < 0:
             raise MXNetError(f"counter {self.name!r}: negative increment")
+        if not _ENABLED:
+            return
         key = self._key(labels)
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + amount
@@ -306,6 +308,12 @@ class MetricsRegistry:
             raise MXNetError(
                 f"metric {name!r} registered with labels {m.labelnames}, "
                 f"requested {tuple(labelnames)}")
+        if cls is Histogram and kwargs.get("buckets") is not None:
+            want = tuple(sorted(float(b) for b in kwargs["buckets"]))
+            if want != m.buckets:
+                raise MXNetError(
+                    f"histogram {name!r} registered with buckets "
+                    f"{m.buckets}, requested {want}")
         return m
 
     def counter(self, name, help="", labelnames=()) -> Counter:

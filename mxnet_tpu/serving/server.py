@@ -16,6 +16,7 @@ PAPERS.md).
 from __future__ import annotations
 
 import itertools
+import logging
 import threading
 import time
 from collections import OrderedDict, deque
@@ -30,6 +31,7 @@ from .repository import ModelRepository
 
 __all__ = ["ModelServer", "ServerOverloadedError"]
 
+_LOG = logging.getLogger("mxnet_tpu")
 _SERVER_SEQ = itertools.count(1)
 
 
@@ -332,6 +334,12 @@ class ModelServer:
                 results = self.batcher.run_batch(
                     entry, [r.inputs for r in reqs])
             except Exception as e:        # noqa: BLE001 — fail the batch
+                # also log it: a caller that already timed out will
+                # never read req.error, and a compile failure must not
+                # be diagnosable only as caller-side timeouts
+                _LOG.warning("serving: batch of %d request(s) for "
+                             "%s:%s failed: %s", len(reqs), entry.name,
+                             entry.version, e)
                 with self._cond:
                     self._stats["errors"] += len(reqs)
                     self._inflight -= len(reqs)

@@ -54,6 +54,20 @@ class TestPrimitives:
         c = rm.counter("t.neg")
         with pytest.raises(mx.MXNetError):
             c.inc(-1)
+        # validation is independent of the registry switch — a bad call
+        # site must not run clean in metrics-off environments
+        rm.disable()
+        with pytest.raises(mx.MXNetError):
+            c.inc(-1)
+
+    def test_histogram_bucket_conflict_rejected(self):
+        rm.histogram("t.bucket.conflict", buckets=(1.0, 2.0))
+        with pytest.raises(mx.MXNetError, match="buckets"):
+            rm.histogram("t.bucket.conflict", buckets=(5.0,))
+        # same buckets (any order) re-resolve fine
+        rm.histogram("t.bucket.conflict", buckets=(2.0, 1.0))
+        # omitting buckets returns the existing metric unchecked
+        assert rm.histogram("t.bucket.conflict").buckets == (1.0, 2.0)
 
     def test_gauge_set_max_and_incdec(self):
         g = rm.gauge("t.gauge")
