@@ -33,6 +33,18 @@ diagnose() {
     python tools/diagnose.py --metrics-smoke
 }
 
+sanity_lint() {
+    # codebase-specific static analysis must be clean
+    # (docs/static_analysis.md; suppressions carry their justification
+    # inline, so "clean" means every finding was fixed or argued)
+    python -m tools.mxlint mxnet_tpu/
+    # then the dynamic half: engine+serving tests double as race tests
+    # under the concurrency sanitizer (lock-order recording + tracked-
+    # array assertions)
+    MXNET_ENGINE_SANITIZE=1 python -m pytest tests/test_sanitizer.py \
+        tests/test_serving.py tests/test_ndarray.py -x -q
+}
+
 multichip_dryrun() {
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('multichip ok')"
 }

@@ -200,6 +200,17 @@ declare_env("MXNET_DEFAULT_DTYPE", "float32", "Default dtype for new arrays.")
 declare_env("MXNET_TPU_DISABLE_NATIVE", "0",
             "1 = skip building/loading the native C++ IO library and use "
             "the pure-python RecordIO tier.")
+declare_env("MXNET_ENGINE_SANITIZE", "0",
+            "1 = concurrency sanitizer: engine/serving locks record "
+            "per-thread acquisition order and raise MXNetError on a "
+            "cross-thread lock-order inversion (potential deadlock), and "
+            "in-place NDArray writes assert the array is engine-tracked. "
+            "Debug/CI knob (sanity_lint re-runs the serving+engine tests "
+            "under it); off by default, zero cost when off.")
+declare_env("MXNET_TEST_CTX", "cpu",
+            "Context for test_utils.default_context (the reference's "
+            "GPU-suite switch): 'cpu', 'tpu', ... — any mxnet_tpu.context "
+            "constructor name.")
 declare_env("MXNET_RUNTIME_METRICS", "0",
             "1 = enable the process-wide runtime metrics registry "
             "(mxnet_tpu.runtime_metrics): op dispatch counters/latency, "

@@ -14,6 +14,20 @@ What survives is the reference's **semantic contract**:
   ``WaitToRead`` — src/engine/threaded_engine.cc semantics);
 - ``MXNET_ENGINE_TYPE=NaiveEngine`` forces synchronous execution after every
   op for debugging/bisection, exactly like the reference env knob.
+
+**Concurrency sanitizer** (``MXNET_ENGINE_SANITIZE=1``): engine and
+serving locks are created through :func:`make_lock` /
+:func:`make_condition`; with the knob on they record per-thread
+lock-acquisition order into a process-wide graph and raise
+``MXNetError`` the moment two locks are ever taken in both orders (a
+potential deadlock — caught on the *second* order, before it can
+actually interleave into one), and in-place NDArray writes assert the
+array is engine-tracked (an untracked write is invisible to
+``waitall``/async error propagation).  Off (the default) the factories
+return plain ``threading`` primitives, so the production path pays
+nothing.  The existing serving/engine tests double as race tests when
+re-run under the knob — CI's ``sanity_lint`` job does exactly that
+(docs/static_analysis.md §sanitizer).
 """
 from __future__ import annotations
 
@@ -21,11 +35,192 @@ import threading
 import time
 import weakref
 
-from .base import get_env
+from .base import MXNetError, env_truthy, get_env
 from . import runtime_metrics as _rm
 
 __all__ = ["Engine", "engine", "waitall", "is_naive", "set_bulk_size",
-           "bulk", "Var", "sync_outputs"]
+           "bulk", "Var", "sync_outputs", "make_lock", "make_condition",
+           "sanitizer_active"]
+
+# ---------------------------------------------------------------------------
+# Concurrency sanitizer (MXNET_ENGINE_SANITIZE=1)
+# ---------------------------------------------------------------------------
+
+_SANITIZE = env_truthy("MXNET_ENGINE_SANITIZE", False)
+
+
+def sanitizer_active() -> bool:
+    """Whether lock-order recording + tracked-array assertions are on
+    for locks created from now on (tools/diagnose.py reports this)."""
+    return _SANITIZE
+
+
+class _LockOrders:
+    """Process-wide lock-acquisition-order graph.
+
+    Locks are identified by the *name* given to :func:`make_lock`, so
+    every instance of a class shares one ordering contract (the static
+    counterpart is mxlint's lock-discipline pass).  ``check(name)``
+    runs BEFORE blocking on the lock: an inversion raises instead of
+    deadlocking."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._edges = {}                # (held, acquiring) -> thread name
+        self._held = threading.local()  # per-thread acquisition stack
+
+    def _stack(self):
+        st = getattr(self._held, "stack", None)
+        if st is None:
+            st = self._held.stack = []
+        return st
+
+    def check_and_record(self, name: str):
+        """Run BEFORE blocking on a *blocking* acquire: record the
+        prospective held->name edges, then probe for the reverse order.
+        Recording before the block matters — two threads entering a
+        first-time ABBA simultaneously must see each other's edge and
+        raise instead of deadlocking inside the real acquire.  (A
+        timed-out blocking acquire leaves its edge behind: the ordering
+        intent was real and can deadlock for the timeout's duration, so
+        the conservative record is correct for a sanitizer.)  Trylocks
+        never call this: a non-blocking attempt cannot deadlock and
+        must not constrain blocking acquirers."""
+        st = self._stack()
+        me = threading.current_thread().name
+        for held in st:
+            if held == name:
+                continue
+            with self._mu:
+                self._edges.setdefault((held, name), me)
+                rev = self._edges.get((name, held))
+            if rev is not None:
+                raise MXNetError(
+                    f"MXNET_ENGINE_SANITIZE: lock-order inversion — "
+                    f"thread {me!r} acquires {name!r} while holding "
+                    f"{held!r}, but thread {rev!r} acquired them in the "
+                    f"reverse order; two such threads interleaving "
+                    f"deadlock.  Pick one global order "
+                    f"(docs/static_analysis.md)")
+
+    def push(self, name: str):
+        self._stack().append(name)
+
+    def pop(self, name: str):
+        st = self._stack()
+        for i in range(len(st) - 1, -1, -1):
+            if st[i] == name:
+                del st[i]
+                return
+
+    def reset(self):
+        """Forget every recorded edge (test isolation helper)."""
+        with self._mu:
+            self._edges.clear()
+
+
+_LOCK_ORDERS = _LockOrders()
+
+
+class _SanLock:
+    """``threading.Lock`` wrapper with acquisition-order recording."""
+
+    __slots__ = ("name", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+
+    def acquire(self, blocking=True, timeout=-1):
+        if blocking:
+            _LOCK_ORDERS.check_and_record(self.name)
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            _LOCK_ORDERS.push(self.name)
+        return got
+
+    def release(self):
+        _LOCK_ORDERS.pop(self.name)
+        self._lock.release()
+
+    def locked(self):
+        return self._lock.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class _SanCondition:
+    """``threading.Condition`` wrapper: order-records acquire/release;
+    ``wait`` pops the held record while the underlying lock is released
+    and re-pushes on wakeup (no false edge against locks taken by the
+    thread that woke us)."""
+
+    __slots__ = ("name", "_cond")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._cond = threading.Condition()
+
+    def acquire(self, *args):
+        blocking = args[0] if args else True
+        if blocking:
+            _LOCK_ORDERS.check_and_record(self.name)
+        got = self._cond.acquire(*args)
+        if got:
+            _LOCK_ORDERS.push(self.name)
+        return got
+
+    def release(self):
+        _LOCK_ORDERS.pop(self.name)
+        self._cond.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def wait(self, timeout=None):
+        _LOCK_ORDERS.pop(self.name)
+        try:
+            return self._cond.wait(timeout)
+        finally:
+            _LOCK_ORDERS.push(self.name)
+
+    def wait_for(self, predicate, timeout=None):
+        _LOCK_ORDERS.pop(self.name)
+        try:
+            return self._cond.wait_for(predicate, timeout)
+        finally:
+            _LOCK_ORDERS.push(self.name)
+
+    def notify(self, n=1):
+        self._cond.notify(n)
+
+    def notify_all(self):
+        self._cond.notify_all()
+
+
+def make_lock(name: str):
+    """A mutex for engine/serving shared state: plain ``threading.Lock``
+    normally, order-recording :class:`_SanLock` under
+    ``MXNET_ENGINE_SANITIZE=1``.  ``name`` is the lock's identity in the
+    order graph — use ``Class.attr`` so all instances share one
+    contract."""
+    return _SanLock(name) if _SANITIZE else threading.Lock()
+
+
+def make_condition(name: str):
+    """Condition-variable sibling of :func:`make_lock`."""
+    return _SanCondition(name) if _SANITIZE else threading.Condition()
 
 
 class Var:
@@ -57,6 +252,11 @@ class Var:
             raise exc
 
 
+# Engine.get() double-checked locking: plain primitive (make_lock reads
+# module state this lock may guard the first initialization of).
+_INSTANCE_LOCK = threading.Lock()
+
+
 class Engine:
     """Process-wide engine singleton (reference: Engine::Get())."""
 
@@ -74,12 +274,14 @@ class Engine:
         else:
             self._bulk_size = int(
                 get_env("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", 15))
-        self._lock = threading.Lock()
+        self._lock = make_lock("engine.Engine._lock")
 
     @classmethod
     def get(cls) -> "Engine":
         if cls._instance is None:
-            cls._instance = Engine()
+            with _INSTANCE_LOCK:
+                if cls._instance is None:
+                    cls._instance = Engine()
         return cls._instance
 
     # -- tracking ----------------------------------------------------------
@@ -122,6 +324,21 @@ class Engine:
     def wait_for_var(self, arr):
         arr.wait_to_read()
 
+    def _sanitize_check_registered(self, arr):
+        """MXNET_ENGINE_SANITIZE assertion: an in-place write to an
+        array the engine is not tracking is invisible to waitall() and
+        async error propagation (NDArray._set_data calls this before
+        bumping the var)."""
+        with self._lock:
+            ok = id(arr) in self._live
+        if not ok:
+            raise MXNetError(
+                "MXNET_ENGINE_SANITIZE: in-place write to an NDArray "
+                "the engine is not tracking — waitall()/async error "
+                "propagation cannot see this mutation; arrays must be "
+                "registered via engine().track() (every normal NDArray "
+                "construction path does this)")
+
     # -- modes -------------------------------------------------------------
     @property
     def is_naive(self) -> bool:
@@ -130,7 +347,8 @@ class Engine:
     def set_bulk_size(self, size: int) -> int:
         """Reference: mx.engine.set_bulk_size. Here it caps how many eager
         ops the bulking context may fuse into one jit segment."""
-        old, self._bulk_size = self._bulk_size, int(size)
+        with self._lock:
+            old, self._bulk_size = self._bulk_size, int(size)
         return old
 
     @property

@@ -17,6 +17,8 @@ import threading
 
 import numpy as np
 
+from ..base import env_truthy
+
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "src", "nativelib.cc")
 _SO = os.path.join(_DIR, "libmxnet_tpu_native.so")
@@ -48,7 +50,7 @@ def _load():
             return _lib
         _tried = True
         # '0'/'' = off, like every other boolean knob
-        if os.environ.get("MXNET_TPU_DISABLE_NATIVE") not in (None, "", "0"):
+        if env_truthy("MXNET_TPU_DISABLE_NATIVE"):
             return None
         stale = (not os.path.exists(_SO) or
                  os.path.getmtime(_SO) < os.path.getmtime(_SRC))

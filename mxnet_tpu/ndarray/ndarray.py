@@ -23,6 +23,7 @@ import jax.numpy as jnp
 
 from ..base import MXNetError, get_env
 from ..context import Context, current_context
+from .. import engine as _engine_mod
 from ..engine import Var, engine
 
 __all__ = ["NDArray"]
@@ -104,6 +105,8 @@ class NDArray:
     def _set_data(self, new_data):
         """In-place value replacement; bumps the engine var version
         (reference: write op on ThreadedVar)."""
+        if _engine_mod._SANITIZE:
+            engine()._sanitize_check_registered(self)
         self._data = new_data
         self._var.bump()
 

@@ -31,7 +31,12 @@ from ..base import MXNetError
 __all__ = ["initialize", "finalize", "is_initialized", "rank", "size",
            "barrier", "allreduce_host", "broadcast_host", "Watchdog"]
 
-_state = {"initialized": False}
+# _state is threading-reachable (atexit finalize vs. watchdog vs. user
+# threads); mutate only under _STATE_LOCK.  "finalizing" claims the
+# teardown without dropping "initialized" early: is_initialized() stays
+# true (and re-initialize stays a no-op) until the shutdown completes.
+_state = {"initialized": False, "finalizing": False}
+_STATE_LOCK = threading.Lock()
 
 
 def _env(*names, default=None):
@@ -53,8 +58,21 @@ def initialize(coordinator_address: Optional[str] = None,
     use (no env, no args) is a no-op so scripts run unchanged standalone.
     """
     import jax
-    if _state["initialized"]:
-        return
+    # whole check-and-init under the lock: two racing initialize()
+    # calls must not both reach jax.distributed.initialize (the second
+    # raises on double client init); the loser blocks, then no-ops
+    with _STATE_LOCK:
+        did_init = _initialize_locked(jax, coordinator_address,
+                                      num_processes, process_id,
+                                      timeout_s)
+    if did_init:
+        atexit.register(finalize)
+
+
+def _initialize_locked(jax, coordinator_address, num_processes,
+                       process_id, timeout_s):
+    if _state["initialized"] or _state["finalizing"]:
+        return False
     coordinator_address = coordinator_address or _env(
         "MXNET_TPU_COORDINATOR")
     if coordinator_address is None:
@@ -69,7 +87,7 @@ def initialize(coordinator_address: Optional[str] = None,
         v = _env("MXNET_TPU_PROC_ID", "DMLC_WORKER_ID")
         process_id = int(v) if v is not None else None
     if coordinator_address is None and num_processes is None:
-        return  # standalone run
+        return False  # standalone run
     if None in (coordinator_address, num_processes, process_id):
         raise MXNetError(
             "dist.initialize: coordinator_address, num_processes and "
@@ -93,13 +111,21 @@ def initialize(coordinator_address: Optional[str] = None,
     except (TypeError, ValueError):     # builtins without a signature
         pass
     jax.distributed.initialize(coordinator_address, **kwargs)
+    # mxlint: disable=lock-discipline (contract: sole caller is
+    # initialize(), which holds _STATE_LOCK around this helper)
     _state["initialized"] = True
-    atexit.register(finalize)
+    return True
 
 
 def finalize():
-    if not _state["initialized"]:
-        return
+    # atomically claim the teardown: a concurrent finalize (atexit vs.
+    # user thread) sees finalizing=True and returns; initialized is NOT
+    # dropped yet — a concurrent initialize() mid-teardown must no-op,
+    # not re-create the jax client while shutdown is in flight
+    with _STATE_LOCK:
+        if not _state["initialized"] or _state["finalizing"]:
+            return
+        _state["finalizing"] = True
     import jax
     # The shutdown barrier can block forever when a peer is gone (the
     # crash path this atexit hook runs on).  Newer jax clients bound it
@@ -121,7 +147,9 @@ def finalize():
                          name="mxnet-dist-shutdown")
     t.start()
     t.join(15)
-    _state["initialized"] = False
+    with _STATE_LOCK:
+        _state["initialized"] = False
+        _state["finalizing"] = False
 
 
 def is_initialized() -> bool:

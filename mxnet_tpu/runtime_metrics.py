@@ -401,10 +401,12 @@ def _label_str(labelnames, key) -> str:
 # every mutation of the underlying structure) register a collect hook;
 # every exporter runs them first.
 _COLLECT_HOOKS: List = []
+_COLLECT_HOOKS_LOCK = threading.Lock()
 
 
 def register_collect_hook(fn):
-    _COLLECT_HOOKS.append(fn)
+    with _COLLECT_HOOKS_LOCK:
+        _COLLECT_HOOKS.append(fn)
 
 
 def _run_collect_hooks():

@@ -16,8 +16,6 @@ ragged final batch un-pads exactly.
 """
 from __future__ import annotations
 
-import threading
-
 import numpy as np
 
 from .. import engine, runtime_metrics as _rm
@@ -94,7 +92,7 @@ class DynamicBatcher:
 
     def __init__(self, config):
         self.config = config
-        self._lock = threading.Lock()
+        self._lock = engine.make_lock("serving.DynamicBatcher._lock")
         self._progs = {}            # (entry.uid, bucket) -> callable
         self._retired = set()       # uids evicted; never re-cache these
         self.bucket_hits = 0

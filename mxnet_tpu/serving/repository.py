@@ -20,9 +20,9 @@ requests admitted after the swap see the new version.
 from __future__ import annotations
 
 import itertools
-import threading
 from collections import OrderedDict
 
+from .. import engine
 from ..base import MXNetError
 
 __all__ = ["ModelEntry", "ModelRepository"]
@@ -94,7 +94,7 @@ class ModelRepository:
     atomically swappable *current* pointer per name."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = engine.make_lock("serving.ModelRepository._lock")
         # name -> {"current": version, "versions": OrderedDict}
         self._models = {}
         self._unload_listeners = []

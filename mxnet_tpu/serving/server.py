@@ -23,7 +23,7 @@ from collections import OrderedDict, deque
 
 import numpy as np
 
-from .. import runtime_metrics as _rm
+from .. import engine, runtime_metrics as _rm
 from ..base import MXNetError
 from .batcher import DynamicBatcher
 from .config import ServingConfig
@@ -83,7 +83,10 @@ class ModelServer:
         self.batcher = DynamicBatcher(self.config)
         self.name = name or f"server{next(_SERVER_SEQ)}"
         self._evict_subscribed = False
-        self._cond = threading.Condition()
+        # engine.make_condition: plain Condition normally; lock-order
+        # recording under MXNET_ENGINE_SANITIZE=1 (the serving tests
+        # double as race tests in CI's sanity_lint job)
+        self._cond = engine.make_condition("serving.ModelServer._cond")
         self._queues = OrderedDict()    # entry.uid -> (entry, deque)
         self._depth = 0
         self._inflight = 0              # admitted, popped, not finished
@@ -102,12 +105,17 @@ class ModelServer:
                 return self
             self._started = True
             self._stopping = False
-        # retired versions must not pin compiled programs for the
-        # process lifetime (hot-swap deploy loops); unsubscribed again
-        # at stop() so the repository never pins a dead server
-        if not self._evict_subscribed:
-            self.repository.subscribe_unload(self.batcher.evict)
-            self._evict_subscribed = True
+            # retired versions must not pin compiled programs for the
+            # process lifetime (hot-swap deploy loops); unsubscribed at
+            # stop() so the repository never pins a dead server.  Flag
+            # and subscription flip atomically under _cond (a racing
+            # stop() must observe both or neither); the nested
+            # repository lock is safe — the server->repository
+            # acquisition order is one-way (the repository never calls
+            # back into the server)
+            if not self._evict_subscribed:
+                self.repository.subscribe_unload(self.batcher.evict)
+                self._evict_subscribed = True
         with self._cond:
             self._workers = [
                 threading.Thread(target=self._worker_loop,
@@ -152,9 +160,9 @@ class ModelServer:
         with self._cond:
             self._started = False
             self._workers = []
-        if self._evict_subscribed:
-            self.repository.unsubscribe_unload(self.batcher.evict)
-            self._evict_subscribed = False
+            if self._evict_subscribed:
+                self.repository.unsubscribe_unload(self.batcher.evict)
+                self._evict_subscribed = False
         return True
 
     def __enter__(self):
@@ -265,7 +273,8 @@ class ModelServer:
 
     # -------------------------------------------------------------- workers
     def _set_depth(self, depth):
-        # callers hold self._cond
+        # mxlint: disable=lock-discipline (contract: callers hold
+        # self._cond — every call site is inside `with self._cond`)
         self._depth = depth
         if _rm._ENABLED:
             _rm.SERVING_QUEUE_DEPTH.set(depth, server=self.name)

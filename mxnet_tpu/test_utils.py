@@ -9,7 +9,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from .base import MXNetError
+from .base import MXNetError, get_env
 from .context import Context, cpu
 
 __all__ = ["default_context", "set_default_context", "assert_almost_equal",
@@ -27,7 +27,7 @@ def default_context() -> Context:
     global _DEFAULT_CTX
     if _DEFAULT_CTX is not None:
         return _DEFAULT_CTX
-    name = os.environ.get("MXNET_TEST_CTX", "cpu")
+    name = get_env("MXNET_TEST_CTX", "cpu")
     from . import context as ctx_mod
     return getattr(ctx_mod, name.split("(")[0])(0)
 

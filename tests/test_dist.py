@@ -140,6 +140,24 @@ class TestWatchdog:
         dist.initialize()      # no env, no args: standalone no-op
         assert not dist.is_initialized()
 
+    def test_initialize_is_noop_while_finalizing(self, monkeypatch):
+        """A concurrent initialize() during teardown must not re-create
+        the jax distributed client while shutdown is in flight."""
+        import jax
+        from mxnet_tpu.parallel import dist
+
+        def boom(*a, **k):      # pragma: no cover
+            raise AssertionError(
+                "jax.distributed.initialize called mid-teardown")
+
+        monkeypatch.setattr(jax.distributed, "initialize", boom)
+        monkeypatch.setitem(dist._state, "finalizing", True)
+        dist.initialize(coordinator_address="127.0.0.1:1",
+                        num_processes=1, process_id=0)
+        assert not dist.is_initialized()
+        # and a concurrent finalize() returns immediately too
+        dist.finalize()
+
 
 class TestMultiHostSPMD:
     """The DCN-spanning codepath a v5p multi-slice job will actually

@@ -1,0 +1,419 @@
+"""mxlint fixture tests: each pass fires on its positive snippet, stays
+quiet on the negative, and honors the suppression comment — plus the
+acceptance gate that the real tree is clean (ISSUE-3).
+
+Pure-AST: no jax import, so this file costs milliseconds (tier-1 budget
+discipline — ROADMAP.md).
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.mxlint import PASSES, Project, lint_paths, lint_sources  # noqa: E402
+
+
+def run(src, path="mxnet_tpu/serving/fixture.py", select=None, **proj):
+    project = Project(**proj) if proj else None
+    return lint_sources({path: textwrap.dedent(src)}, select=select,
+                        project=project)
+
+
+def ids(issues):
+    return [i.pass_id for i in issues]
+
+
+def test_pass_catalogue_complete():
+    assert set(PASSES) == {"jit-retrace", "host-sync", "lock-discipline",
+                           "metrics-misuse", "env-registry"}
+
+
+# ---------------------------------------------------------------- jit-retrace
+def test_jit_retrace_fires_on_scalarized_traced_arg():
+    issues = run("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            s = float(x)
+            return x * s
+    """, select=["jit-retrace"])
+    assert ids(issues) == ["jit-retrace"]
+    assert "float()" in issues[0].message
+
+
+def test_jit_retrace_fires_on_asnumpy_and_np_asarray():
+    issues = run("""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            return np.asarray(x) + x.asnumpy()
+
+        class Net:
+            def hybrid_forward(self, F, x):
+                return x.asnumpy()
+    """, select=["jit-retrace"])
+    assert ids(issues) == ["jit-retrace"] * 3
+
+
+def test_jit_retrace_partial_decorator_and_nested_fn_params():
+    issues = run("""
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, static_argnums=(1,))
+        def f(x, n):
+            def inner(d):
+                return int(d)
+            return inner(x)
+    """, select=["jit-retrace"])
+    assert ids(issues) == ["jit-retrace"]
+
+
+def test_jit_retrace_nested_param_name_does_not_leak_to_outer_body():
+    issues = run("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            n = x.shape[0]
+
+            def body(n):
+                return n
+
+            return jnp.zeros(int(n)) + body(x)
+    """, select=["jit-retrace"])
+    assert issues == []
+
+
+def test_jit_retrace_negative_static_shape_and_unjitted():
+    issues = run("""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            return x.reshape(int(x.shape[0]), -1)
+
+        def host_fn(x):
+            return float(x) + np.asarray(x).sum()
+    """, select=["jit-retrace"])
+    assert issues == []
+
+
+def test_jit_retrace_suppression():
+    issues = run("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            s = float(x)  # mxlint: disable=jit-retrace
+            return x * s
+    """, select=["jit-retrace"])
+    assert issues == []
+
+
+# ------------------------------------------------------------------ host-sync
+def test_host_sync_fires_in_ops():
+    issues = run("""
+        import jax
+
+        def relu_impl(x):
+            jax.block_until_ready(x)
+            return x
+    """, path="mxnet_tpu/ops/fixture.py", select=["host-sync"])
+    assert ids(issues) == ["host-sync"]
+    assert "engine.sync_outputs" in issues[0].message
+
+
+def test_host_sync_fires_in_batcher_dispatch():
+    issues = run("""
+        class MyBatcher:
+            def run_batch(self, entry, reqs):
+                outs = entry.prog(*reqs)
+                return [o.asnumpy() for o in outs]
+    """, select=["host-sync"])
+    assert ids(issues) == ["host-sync"]
+
+
+def test_host_sync_quiet_on_admission_path_and_unscoped_files():
+    issues = run("""
+        class Server:
+            def predict(self, model, x):
+                return x.asnumpy()
+    """, select=["host-sync"])
+    assert issues == []
+    issues = run("""
+        import jax
+
+        def helper(x):
+            return jax.block_until_ready(x)
+    """, path="mxnet_tpu/gluon/fixture.py", select=["host-sync"])
+    assert issues == []
+
+
+def test_host_sync_suppression():
+    issues = run("""
+        def _worker_loop(self):
+            # mxlint: disable=host-sync (measured: cheaper than a queue)
+            self.out.asnumpy()
+    """, select=["host-sync"])
+    assert issues == []
+
+
+# ------------------------------------------------------------ lock-discipline
+def test_lock_module_state_fires_and_lock_silences():
+    pos = run("""
+        _CACHE = {}
+
+        def put(k, v):
+            _CACHE[k] = v
+    """, select=["lock-discipline"])
+    assert ids(pos) == ["lock-discipline"]
+    neg = run("""
+        import threading
+        _CACHE = {}
+        _LOCK = threading.Lock()
+
+        def put(k, v):
+            with _LOCK:
+                _CACHE[k] = v
+    """, select=["lock-discipline"])
+    assert neg == []
+
+
+def test_lock_instance_state_fires_outside_lock():
+    issues = run("""
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._jobs = []
+
+            def add(self, j):
+                self._jobs.append(j)
+
+            def rebind(self, js):
+                self._jobs = list(js)
+    """, select=["lock-discipline"])
+    assert ids(issues) == ["lock-discipline"] * 2
+
+
+def test_lock_instance_state_quiet_under_lock_and_unlocked_class():
+    issues = run("""
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._jobs = []
+
+            def add(self, j):
+                with self._lock:
+                    self._jobs.append(j)
+
+        class PlainBag:
+            def __init__(self):
+                self._items = []
+
+            def add(self, x):
+                self._items.append(x)
+    """, select=["lock-discipline"])
+    assert issues == []
+
+
+def test_lock_order_inversion_detected_across_functions():
+    issues = run("""
+        import threading
+        A_LOCK = threading.Lock()
+        B_LOCK = threading.Lock()
+
+        def forward():
+            with A_LOCK:
+                with B_LOCK:
+                    pass
+
+        def backward():
+            with B_LOCK:
+                with A_LOCK:
+                    pass
+    """, select=["lock-discipline"])
+    assert ids(issues) == ["lock-discipline"] * 2
+    assert "inversion" in issues[0].message
+
+
+def test_blocking_call_under_lock():
+    issues = run("""
+        import threading, time
+        _LOCK = threading.Lock()
+
+        def poll():
+            with _LOCK:
+                time.sleep(0.5)
+    """, select=["lock-discipline"])
+    assert ids(issues) == ["lock-discipline"]
+    assert "blocking" in issues[0].message
+
+
+def test_lock_suppression_directive_above_statement():
+    issues = run("""
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._depth = 0
+
+            def _set_depth(self, d):
+                # mxlint: disable=lock-discipline (callers hold lock)
+                self._depth = d
+    """, select=["lock-discipline"])
+    assert issues == []
+
+
+# ------------------------------------------------------------- metrics-misuse
+def test_counter_negative_inc_fires_gauge_quiet():
+    issues = run("""
+        from runtime_metrics import counter, gauge
+        REQS = counter("reqs")
+        DEPTH = gauge("depth")
+
+        def shed():
+            REQS.inc(-1)
+            DEPTH.inc(-1)
+            REQS.inc(2)
+    """, select=["metrics-misuse"])
+    assert ids(issues) == ["metrics-misuse"]
+    assert "monotonic" in issues[0].message
+
+
+def test_histogram_bucket_conflict_across_files():
+    srcs = {
+        "mxnet_tpu/a.py": "from m import histogram\n"
+                          "H1 = histogram('lat', buckets=(0.1, 1.0))\n",
+        "mxnet_tpu/b.py": "from m import histogram\n"
+                          "H2 = histogram('lat', buckets=(0.1, 2.0))\n",
+    }
+    issues = lint_sources(srcs, select=["metrics-misuse"])
+    assert ids(issues) == ["metrics-misuse"] * 2
+    same = dict(srcs)
+    same["mxnet_tpu/b.py"] = same["mxnet_tpu/a.py"].replace("H1", "H2")
+    assert lint_sources(same, select=["metrics-misuse"]) == []
+
+
+def test_histogram_suppressed_site_does_not_hide_conflict_elsewhere():
+    srcs = {
+        "mxnet_tpu/a.py": "from m import histogram\n"
+                          "H1 = histogram('lat', buckets=(0.1, 1.0))"
+                          "  # mxlint: disable=metrics-misuse\n",
+        "mxnet_tpu/b.py": "from m import histogram\n"
+                          "H2 = histogram('lat', buckets=(0.1, 2.0))\n",
+    }
+    issues = lint_sources(srcs, select=["metrics-misuse"])
+    assert [(i.pass_id, i.path) for i in issues] == \
+        [("metrics-misuse", "mxnet_tpu/b.py")]
+
+
+def test_metrics_suppression():
+    issues = run("""
+        from runtime_metrics import counter
+        N = counter("n")
+
+        def f():
+            N.inc(-1)  # mxlint: disable=metrics-misuse
+    """, select=["metrics-misuse"])
+    assert issues == []
+
+
+# --------------------------------------------------------------- env-registry
+def test_env_registry_fires_on_undeclared_read():
+    issues = run("""
+        import os
+
+        def f():
+            a = os.environ.get("MXNET_TOTALLY_UNDECLARED_KNOB")
+            b = os.environ["MXNET_ANOTHER_UNDECLARED_KNOB"]
+            return a, b
+    """, select=["env-registry"])
+    assert ids(issues) == ["env-registry"] * 2
+
+
+def test_env_registry_declared_or_documented_is_quiet():
+    src = """
+        import os
+        from base import declare_env, get_env
+        declare_env("MXNET_FIXTURE_KNOB", "0", "doc")
+
+        def f():
+            return (get_env("MXNET_FIXTURE_KNOB"),
+                    os.environ.get("MXNET_FIXTURE_DOC_ONLY"))
+    """
+    issues = run(src, select=["env-registry"],
+                 env_documented={"MXNET_FIXTURE_DOC_ONLY"})
+    assert issues == []
+
+
+def test_env_registry_suppression():
+    issues = run("""
+        import os
+
+        def f():
+            # mxlint: disable=env-registry (third-party launcher knob)
+            return os.environ.get("MXNET_FIXTURE_PRIVATE")
+    """, select=["env-registry"])
+    assert issues == []
+
+
+# ------------------------------------------------------------------ framework
+def test_disable_file_directive():
+    issues = run("""
+        # mxlint: disable-file=lock-discipline
+        _CACHE = {}
+
+        def put(k, v):
+            _CACHE[k] = v
+    """, select=["lock-discipline"])
+    assert issues == []
+
+
+def test_parse_error_reported_not_crashing():
+    issues = lint_sources({"mxnet_tpu/bad.py": "def broken(:\n"})
+    assert [i.pass_id for i in issues] == ["parse-error"]
+
+
+def test_repo_tree_is_clean():
+    """The ISSUE-3 acceptance gate: mxlint over mxnet_tpu/ exits 0."""
+    issues = lint_paths([os.path.join(REPO, "mxnet_tpu")])
+    assert issues == [], "\n".join(str(i) for i in issues)
+
+
+def test_cli_end_to_end(tmp_path):
+    bad = tmp_path / "serving" / "x.py"
+    bad.parent.mkdir()
+    bad.write_text("_STATE = {}\n\ndef f():\n    _STATE['k'] = 1\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.mxlint", str(tmp_path)],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 1
+    assert "lock-discipline" in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.mxlint", "--list-passes"],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 0
+    assert "env-registry" in proc.stdout
+
+
+def test_cli_nonexistent_path_is_an_error_not_clean():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.mxlint", "mxnte_tpu_typo/"],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 2
+    assert "not found" in proc.stderr
+    assert "clean" not in proc.stdout

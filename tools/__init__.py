@@ -1,0 +1,6 @@
+"""Repo tooling package (`python -m tools.mxlint`, diagnose, launch...).
+
+Script-style tools (diagnose.py, gen_env_docs.py, ...) keep working when
+run directly; this file only exists so `tools.mxlint` is importable as a
+module from the repo root.
+"""

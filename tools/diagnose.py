@@ -66,6 +66,12 @@ def diagnose(metrics_smoke=False):
     for k in extra:
         print(f"{k}={os.environ[k]}  (set, unregistered)")
 
+    _section("Concurrency Sanitizer")
+    from mxnet_tpu import engine
+    print(f"active       : {engine.sanitizer_active()}  "
+          f"(MXNET_ENGINE_SANITIZE=1 to enable lock-order recording + "
+          f"tracked-array assertions; docs/static_analysis.md)")
+
     _section("Runtime Metrics")
     from mxnet_tpu import runtime_metrics as rm
     print(f"enabled      : {rm.enabled()}")

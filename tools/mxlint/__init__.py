@@ -1,0 +1,24 @@
+"""mxlint: codebase-specific static analysis for mxnet_tpu.
+
+AST-only (never imports the code under analysis).  Five passes, each
+targeting a concurrency/retrace/observability bug class this repo has
+already shipped fixes for — see docs/static_analysis.md for the
+catalogue, suppression syntax, and the companion runtime sanitizer
+(``MXNET_ENGINE_SANITIZE=1``).
+
+CLI::
+
+    python -m tools.mxlint mxnet_tpu/            # lint the tree
+    python -m tools.mxlint --list-passes
+
+API (what tests/test_mxlint.py uses)::
+
+    from tools.mxlint import lint_paths, lint_sources, PASSES
+    issues = lint_sources({"pkg/serving/x.py": src}, select=["host-sync"])
+"""
+from .core import (Issue, LintPass, Project, SourceFile, PASSES,  # noqa: F401
+                   lint_paths, lint_sources, register_pass)
+from . import passes            # noqa: F401 — registers the built-ins
+
+__all__ = ["Issue", "LintPass", "Project", "SourceFile", "PASSES",
+           "lint_paths", "lint_sources", "register_pass"]

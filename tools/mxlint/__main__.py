@@ -1,0 +1,70 @@
+"""CLI: ``python -m tools.mxlint [paths...]``.
+
+Exit codes: 0 clean, 1 findings, 2 usage error.  CI runs
+``python -m tools.mxlint mxnet_tpu/`` as part of the ``sanity_lint``
+job (ci/runtime_functions.sh).
+"""
+import argparse
+import sys
+
+from . import PASSES, lint_paths
+from .core import iter_py_files
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.mxlint",
+        description="codebase-specific static analysis for mxnet_tpu "
+                    "(docs/static_analysis.md)")
+    ap.add_argument("paths", nargs="*", default=["mxnet_tpu"],
+                    help="files/directories to lint (default: mxnet_tpu)")
+    ap.add_argument("--select", metavar="PASS[,PASS...]",
+                    help="run only these passes")
+    ap.add_argument("--list-passes", action="store_true",
+                    help="print the pass catalogue and exit")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="suppress the per-issue lines, print the "
+                         "summary only")
+    args = ap.parse_args(argv)
+
+    if args.list_passes:
+        for pid in sorted(PASSES):
+            print(f"{pid:18s} {PASSES[pid].doc}")
+        return 0
+
+    select = None
+    if args.select:
+        select = [s.strip() for s in args.select.split(",") if s.strip()]
+        unknown = [s for s in select if s not in PASSES]
+        if unknown:
+            print(f"mxlint: unknown pass(es) {unknown}; "
+                  f"known: {sorted(PASSES)}", file=sys.stderr)
+            return 2
+
+    paths = args.paths or ["mxnet_tpu"]
+    try:
+        if not iter_py_files(paths):
+            print(f"mxlint: no python files under {', '.join(paths)}",
+                  file=sys.stderr)
+            return 2
+    except FileNotFoundError as e:
+        print(e, file=sys.stderr)
+        return 2
+    issues = lint_paths(paths, select=select)
+    if not args.quiet:
+        for issue in issues:
+            print(issue)
+    if issues:
+        by_pass = {}
+        for i in issues:
+            by_pass[i.pass_id] = by_pass.get(i.pass_id, 0) + 1
+        detail = ", ".join(f"{k}={v}" for k, v in sorted(by_pass.items()))
+        print(f"mxlint: {len(issues)} issue(s) ({detail})",
+              file=sys.stderr)
+        return 1
+    print("mxlint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

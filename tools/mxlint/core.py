@@ -1,0 +1,261 @@
+"""mxlint core: pass registry, suppression comments, file runner.
+
+A *pass* is a named checker over one parsed file (plus optional
+whole-project finalization for cross-file checks like histogram-bucket
+conflicts or the lock-order graph).  Passes are pure AST analyses — the
+linter never imports the code under analysis, so `python -m tools.mxlint`
+runs in milliseconds and works on broken trees.
+
+Suppression (docs/static_analysis.md):
+
+- ``# mxlint: disable=<pass>[,<pass>...]`` on a line suppresses those
+  passes' findings anchored to that line (``disable=all`` silences every
+  pass).  Prose after the pass list is allowed:
+  ``# mxlint: disable=lock-discipline (callers hold self._cond)``.
+- ``# mxlint: disable-file=<pass>[,...]`` anywhere in a file suppresses
+  the pass for the whole file.
+
+Suppressions anchor to the *logical* statement: a finding on a
+multi-line call is suppressed by a directive on any physical line the
+statement spans.  A directive on its own comment line also covers the
+next non-comment line, so long justifications can sit above the code:
+
+    # mxlint: disable=lock-discipline (contract: callers hold
+    # self._cond — every call site is inside `with self._cond`)
+    self._depth = depth
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+__all__ = ["Issue", "LintPass", "Project", "SourceFile", "PASSES",
+           "register_pass", "lint_sources", "lint_paths", "iter_py_files"]
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*mxlint:\s*(disable|disable-file)=([A-Za-z0-9_,\-]+)")
+
+
+class Issue:
+    """One finding: ``path:line:col: [pass-id] message``."""
+
+    __slots__ = ("pass_id", "path", "line", "col", "message")
+
+    def __init__(self, pass_id: str, path: str, line: int, col: int,
+                 message: str):
+        self.pass_id = pass_id
+        self.path = path
+        self.line = line
+        self.col = col
+        self.message = message
+
+    def sort_key(self):
+        return (self.path, self.line, self.col, self.pass_id)
+
+    def __repr__(self):
+        return f"Issue({self})"
+
+    def __str__(self):
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"[{self.pass_id}] {self.message}")
+
+
+class SourceFile:
+    """One parsed file handed to every pass: path (repo-relative where
+    possible), raw source, physical lines, AST, and the suppression
+    table parsed from ``# mxlint:`` directives."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # line -> set of suppressed pass ids ("all" wildcard included)
+        self.suppressions: Dict[int, set] = {}
+        self.file_suppressions: set = set()
+        for i, text in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            names = {n.strip() for n in m.group(2).split(",") if n.strip()}
+            if m.group(1) == "disable-file":
+                self.file_suppressions |= names
+                continue
+            self.suppressions.setdefault(i, set()).update(names)
+            if text.lstrip().startswith("#"):
+                # directive-only comment line: also cover the next
+                # non-comment line so justifications can sit above code
+                for j in range(i + 1, len(self.lines) + 1):
+                    nxt = self.lines[j - 1].strip()
+                    if nxt and not nxt.startswith("#"):
+                        self.suppressions.setdefault(
+                            j, set()).update(names)
+                        break
+
+    def suppressed(self, pass_id: str, node_or_line) -> bool:
+        if {"all", pass_id} & self.file_suppressions:
+            return True
+        if isinstance(node_or_line, int):
+            span = (node_or_line,)
+        else:
+            end = getattr(node_or_line, "end_lineno", None) \
+                or node_or_line.lineno
+            span = range(node_or_line.lineno, end + 1)
+        for line in span:
+            if {"all", pass_id} & self.suppressions.get(line, set()):
+                return True
+        return False
+
+
+class Project:
+    """Whole-run context shared by every pass.
+
+    ``env_declared``: MXNET_* names declared via ``declare_env`` anywhere
+    in the scanned tree; ``env_documented``: names appearing in
+    docs/env_vars.md (covers prose-documented test/launcher knobs).
+    Tests construct this directly to exercise passes against fixtures.
+    """
+
+    def __init__(self, env_declared=None, env_documented=None):
+        self.env_declared = set(env_declared or ())
+        self.env_documented = set(env_documented or ())
+        self.files: List[SourceFile] = []
+
+    @staticmethod
+    def _repo_root() -> str:
+        return os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+
+    def harvest(self, files: Iterable[SourceFile]):
+        """Collect project-wide facts (declare_env call sites) from the
+        scanned files, then fold in docs/env_vars.md if present."""
+        self.files = list(files)
+        for f in self.files:
+            for node in ast.walk(f.tree):
+                if isinstance(node, ast.Call) \
+                        and _call_name(node).endswith("declare_env") \
+                        and node.args \
+                        and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str):
+                    self.env_declared.add(node.args[0].value)
+        doc = os.path.join(self._repo_root(), "docs", "env_vars.md")
+        if os.path.exists(doc):
+            with open(doc) as fh:
+                text = fh.read()
+            self.env_documented.update(
+                re.findall(r"\bMXNET_[A-Z0-9_]+\b", text))
+
+
+def _call_name(node: ast.Call) -> str:
+    """Dotted name of a call target (``jax.block_until_ready`` ->
+    'jax.block_until_ready'); empty string for non-name callees."""
+    return dotted_name(node.func)
+
+
+def dotted_name(node) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif parts:
+        parts.append("")        # rooted at a call/subscript: '<x>.attr'
+    return ".".join(reversed(parts))
+
+
+PASSES: Dict[str, type] = {}
+
+
+def register_pass(cls):
+    """Class decorator adding a LintPass subclass to the registry."""
+    PASSES[cls.id] = cls
+    return cls
+
+
+class LintPass:
+    """Base pass.  Subclasses set ``id``/``doc`` and implement
+    ``check_file`` (yield Issues) and optionally ``finalize`` for
+    cross-file findings."""
+
+    id = "base"
+    doc = ""
+
+    def __init__(self, project: Project):
+        self.project = project
+
+    def check_file(self, src: SourceFile) -> Iterable[Issue]:
+        return ()
+
+    def finalize(self) -> Iterable[Issue]:
+        return ()
+
+    # Helper: issue anchored to a node, honoring suppressions.
+    def issue(self, src: SourceFile, node, message: str) -> Optional[Issue]:
+        if src.suppressed(self.id, node):
+            return None
+        return Issue(self.id, src.path, node.lineno, node.col_offset,
+                     message)
+
+
+def iter_py_files(paths: Iterable[str]) -> List[str]:
+    out = []
+    for p in paths:
+        if not os.path.exists(p):
+            # a typo'd path must not turn the lint gate into a silent
+            # no-op ("clean" over zero files)
+            raise FileNotFoundError(f"mxlint: path not found: {p}")
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for dirpath, dirs, files in os.walk(p):
+            dirs[:] = [d for d in dirs if d != "__pycache__"]
+            for fn in sorted(files):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return out
+
+
+def lint_sources(sources: Dict[str, str], select: Optional[List[str]] = None,
+                 project: Optional[Project] = None) -> List[Issue]:
+    """Lint {path: source} pairs.  The in-memory entry point the fixture
+    tests use; ``lint_paths`` wraps it for the CLI."""
+    from . import passes as _passes            # noqa: F401 — registers all
+    files = []
+    errors = []
+    for path, src in sorted(sources.items()):
+        try:
+            files.append(SourceFile(path, src))
+        except SyntaxError as e:
+            errors.append(Issue("parse-error", path, e.lineno or 1,
+                                e.offset or 0, f"syntax error: {e.msg}"))
+    if project is None:
+        project = Project()
+    project.harvest(files)
+    chosen = select or sorted(PASSES)
+    issues = list(errors)
+    for pid in chosen:
+        if pid not in PASSES:
+            raise KeyError(f"unknown mxlint pass {pid!r}; "
+                           f"known: {sorted(PASSES)}")
+        p = PASSES[pid](project)
+        for f in files:
+            issues.extend(i for i in p.check_file(f) if i is not None)
+        issues.extend(i for i in p.finalize() if i is not None)
+    issues.sort(key=Issue.sort_key)
+    return issues
+
+
+def lint_paths(paths: Iterable[str], select: Optional[List[str]] = None,
+               project: Optional[Project] = None) -> List[Issue]:
+    root = Project._repo_root()
+    sources = {}
+    for path in iter_py_files(paths):
+        with open(path) as fh:
+            src = fh.read()
+        rel = os.path.relpath(os.path.abspath(path), root)
+        key = rel if not rel.startswith("..") else path
+        sources[key] = src
+    return lint_sources(sources, select=select, project=project)

@@ -1,0 +1,12 @@
+"""mxlint pass catalogue (docs/static_analysis.md).
+
+Importing this package registers every built-in pass with
+``tools.mxlint.core.PASSES``.  Each module is one bug class this repo
+has already shipped fixes for — the passes keep those classes from
+regressing at lint time.
+"""
+from . import jit_retrace       # noqa: F401
+from . import host_sync         # noqa: F401
+from . import lock_discipline   # noqa: F401
+from . import metrics_misuse    # noqa: F401
+from . import env_registry      # noqa: F401

@@ -1,0 +1,59 @@
+"""env-registry: every MXNET_* env read must be a declared knob.
+
+The reference scattered ~100 ``dmlc::GetEnv`` calls; this repo declares
+every knob once (``mxnet_tpu.base.declare_env``) so the generated
+``docs/env_vars.md`` table stays complete (SURVEY.md §5.6,
+tests/test_env_docs.py).  This pass is the lint-time half: any
+``os.environ`` / ``os.getenv`` / ``get_env`` / ``env_truthy`` read of a
+``MXNET_*`` name that is neither declared via ``declare_env`` nor
+documented in docs/env_vars.md (prose-documented launcher/test knobs)
+is flagged where it is read, before the doc-drift test can even run.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import LintPass, dotted_name, register_pass
+
+_ENV_NAME = re.compile(r"^MXNET_[A-Z0-9_]+$")
+_READ_TERMS = {"get_env", "env_truthy", "getenv", "_env"}
+
+
+@register_pass
+class EnvRegistryPass(LintPass):
+    id = "env-registry"
+    doc = ("os.environ read of an MXNET_* name not declared via "
+           "declare_env nor documented in docs/env_vars.md")
+
+    def _flag(self, src, node, name):
+        if name in self.project.env_declared \
+                or name in self.project.env_documented:
+            return None
+        return self.issue(
+            src, node,
+            f"env knob {name!r} read here but never declared — add "
+            f"mx.base.declare_env({name!r}, <default>, <doc>) and run "
+            f"tools/gen_env_docs.py so docs/env_vars.md documents it")
+
+    def check_file(self, src):
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Subscript) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and dotted_name(node.value).endswith("environ") \
+                    and isinstance(node.slice, ast.Constant) \
+                    and isinstance(node.slice.value, str) \
+                    and _ENV_NAME.match(node.slice.value):
+                yield self._flag(src, node, node.slice.value)
+            elif isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                term = name.rsplit(".", 1)[-1]
+                if term not in _READ_TERMS \
+                        and not name.endswith("environ.get"):
+                    continue
+                # dist._env(*names) probes several aliases: check each
+                for arg in node.args:
+                    if isinstance(arg, ast.Constant) \
+                            and isinstance(arg.value, str) \
+                            and _ENV_NAME.match(arg.value):
+                        yield self._flag(src, node, arg.value)

@@ -1,0 +1,87 @@
+"""host-sync: untracked blocking device syncs in hot paths.
+
+``jax.block_until_ready`` / ``.asnumpy()`` stall the host until the
+device drains.  In op implementations (``mxnet_tpu/ops/``) and in the
+serving dispatch path (batcher, worker loop) every such stall is
+invisible to the engine's sync-point accounting and serializes the
+pipeline — the exact bug class ``engine.sync_outputs`` exists to bound
+and meter (``engine.sync.seconds{site}``).  Route batch-level syncs
+through ``engine.sync_outputs``; results leave the device in the
+un-padding step after that sync, not ad hoc.
+
+Scope: all code under an ``ops/`` directory; in ``serving/`` modules
+only the dispatch surfaces (``*Batcher`` methods and the worker-loop /
+batch-forming functions) — admission-side input conversion on the
+caller's thread is legitimate host work.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import LintPass, dotted_name, register_pass
+
+_HOT_FUNCS = {"_worker_loop", "_next_batch", "run_batch", "program_for"}
+
+
+def _path_parts(path: str):
+    return path.replace("\\", "/").split("/")
+
+
+@register_pass
+class HostSyncPass(LintPass):
+    id = "host-sync"
+    doc = ("jax.block_until_ready / .asnumpy() in op implementations or "
+           "the serving dispatch path — route through engine.sync_outputs")
+
+    def check_file(self, src):
+        parts = _path_parts(src.path)
+        in_ops = "ops" in parts[:-1]
+        in_serving = "serving" in parts[:-1]
+        if not (in_ops or in_serving):
+            return
+        for scope, node in self._calls_with_scope(src.tree):
+            if not in_ops and not self._serving_hot(scope):
+                continue
+            name = dotted_name(node.func)
+            term = name.rsplit(".", 1)[-1]
+            if term == "block_until_ready":
+                yield self.issue(
+                    src, node,
+                    f"{name or 'block_until_ready'}() is an untracked "
+                    f"host sync in a hot path — use engine.sync_outputs"
+                    f"(arrays, site=...) so the stall is bounded to one "
+                    f"batch and metered")
+            elif term == "asnumpy" and "." in name:
+                yield self.issue(
+                    src, node,
+                    ".asnumpy() blocks the worker on a device-to-host "
+                    "transfer — sync via engine.sync_outputs, then "
+                    "materialize outputs once in the un-padding step")
+
+    @staticmethod
+    def _calls_with_scope(tree):
+        """Yield (enclosing function stack, Call node) pairs."""
+        def walk(node, stack):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    yield from walk(child, stack + [child])
+                else:
+                    if isinstance(child, ast.Call):
+                        yield stack, child
+                    yield from walk(child, stack)
+        # Call nodes nest (call args containing calls): walk() above only
+        # yields the outermost per subtree, so recurse into Call children
+        # too — handled because walk recurses into every non-def child.
+        yield from walk(tree, [])
+
+    @staticmethod
+    def _serving_hot(scope) -> bool:
+        for node in scope:
+            if isinstance(node, ast.ClassDef) and "Batcher" in node.name:
+                return True
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name in _HOT_FUNCS:
+                return True
+        return False

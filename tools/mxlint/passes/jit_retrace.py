@@ -1,0 +1,147 @@
+"""jit-retrace: host-value escapes inside traced bodies.
+
+Inside a ``@jax.jit`` (or ``partial(jax.jit, ...)``) function or a
+``hybrid_forward`` body, pulling a traced value back to the host —
+``float(x)`` / ``int(x)`` / ``bool(x)``, ``x.asnumpy()`` / ``x.item()``,
+``np.asarray(x)`` / ``np.array(x)`` — either raises a tracer error at
+runtime or silently bakes the value into the compiled program, so every
+new value retraces and recompiles (the TF/Julia-to-TPU "retracing
+hazard" class; PAPERS.md).  Static shape metadata is exempt:
+``int(x.shape[0])`` / ``x.ndim`` / ``x.dtype`` are concrete on tracers.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import LintPass, dotted_name, register_pass
+
+# attributes that are concrete (host) metadata even on a tracer
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+_SCALARIZERS = {"float", "int", "bool", "complex"}
+_NP_CAPTURES = {"asarray", "array"}
+
+
+def _jit_decorated(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", ()):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = dotted_name(target)
+        if name.endswith("jit"):
+            return True
+        # functools.partial(jax.jit, static_argnums=...)
+        if isinstance(dec, ast.Call) and name.endswith("partial") \
+                and dec.args and dotted_name(dec.args[0]).endswith("jit"):
+            return True
+    return False
+
+
+def _params(fn) -> set:
+    a = fn.args
+    names = [p.arg for p in
+             list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    if names and names[0] == "self":
+        names = names[1:]
+    # hybrid_forward(self, F, x, ...): F is the symbolic namespace
+    if fn.name == "hybrid_forward" and names and names[0] == "F":
+        names = names[1:]
+    return set(names)
+
+
+def _root_and_attrs(node):
+    """Walk ``x.shape[0]`` / ``x.astype(f)`` chains down to the root
+    Name; returns (root_name_or_None, set_of_attrs_traversed)."""
+    attrs = set()
+    while True:
+        if isinstance(node, ast.Attribute):
+            attrs.add(node.attr)
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            node = node.value
+        elif isinstance(node, ast.Call):
+            node = node.func
+        elif isinstance(node, ast.Name):
+            return node.id, attrs
+        else:
+            return None, attrs
+
+
+@register_pass
+class JitRetracePass(LintPass):
+    id = "jit-retrace"
+    doc = ("host-value escape (float/int/.asnumpy()/.item()/np.asarray) "
+           "on a traced value inside a @jax.jit or hybrid_forward body")
+
+    def check_file(self, src):
+        yield from self._walk(src, src.tree, in_traced=False,
+                              traced=frozenset())
+
+    def _walk(self, src, node, in_traced, traced):
+        """Each function body is checked exactly once, with the traced
+        set scoped to it: a nested helper's params are traced only
+        inside the helper, not across the whole outer jit body (an
+        outer host value sharing a helper-param name must not flag)."""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                enters_trace = _jit_decorated(child) \
+                    or child.name == "hybrid_forward"
+                child_traced = (traced | _params(child)) \
+                    if (in_traced or enters_trace) else traced
+                if in_traced or enters_trace:
+                    yield from self._check_local(src, child, child_traced)
+                yield from self._walk(src, child,
+                                      in_traced or enters_trace,
+                                      child_traced)
+            else:
+                yield from self._walk(src, child, in_traced, traced)
+
+    def _check_local(self, src, fn, traced):
+        """Check statements belonging to ``fn`` itself (nested defs are
+        handled by their own _check_local call with their own set)."""
+        for node in self._iter_local(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            term = name.rsplit(".", 1)[-1]
+            if term in ("asnumpy", "item") and "." in name:
+                issue = self.issue(
+                    src, node,
+                    f".{term}() inside a traced body forces a host sync "
+                    f"per trace (or fails on a tracer) — compute on "
+                    f"device, read values outside the jit boundary")
+                if issue:
+                    yield issue
+                continue
+            arg = node.args[0] if node.args else None
+            if arg is None:
+                continue
+            root, attrs = _root_and_attrs(arg)
+            if root not in traced or attrs & _STATIC_ATTRS:
+                continue
+            if name in _SCALARIZERS:
+                yield self.issue(
+                    src, node,
+                    f"{name}() on traced argument {root!r} bakes a python "
+                    f"scalar into the compiled program — every new value "
+                    f"retraces/recompiles; keep it a traced array or pass "
+                    f"it as a static argument")
+            elif term in _NP_CAPTURES and name.split(".")[0] in (
+                    "np", "numpy", "onp"):
+                yield self.issue(
+                    src, node,
+                    f"{name}() on traced argument {root!r} materializes "
+                    f"the tracer to host numpy inside the jit body — use "
+                    f"jnp, or move the conversion outside the trace")
+
+    @staticmethod
+    def _iter_local(fn):
+        """Nodes of ``fn``'s body, not descending into nested defs."""
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield n
+            stack.extend(ast.iter_child_nodes(n))

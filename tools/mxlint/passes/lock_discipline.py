@@ -1,0 +1,317 @@
+"""lock-discipline: shared-state mutation, lock ordering, blocking calls.
+
+Scope: the threading-reachable modules (``engine``, ``serving/*``,
+``runtime_metrics``, ``parallel/dist`` — the surfaces where worker
+pools, the metrics registry, and multi-process shutdown already shipped
+race fixes).  Four checks:
+
+1. **module-state**: a module-level mutable container (dict/list/set/
+   deque/...) mutated inside a function without a held lock — the
+   histogram-registry / dist-shutdown bug shape.
+2. **instance-state**: in a class that owns a lock (``self._lock`` /
+   ``self._cond`` assigned in ``__init__``), an underscore attribute
+   mutated or rebound outside a ``with self._lock`` block.  Attributes
+   initialized as ``threading.local()`` are exempt (thread-confined).
+3. **lock-order** (cross-file): the static acquisition graph — ``with
+   B`` lexically inside ``with A`` adds edge A->B; any cycle is a
+   potential deadlock (flagged at every edge on the cycle).
+4. **blocking-under-lock**: ``time.sleep`` / ``subprocess.*`` /
+   ``os.system`` while lexically holding a lock (``Condition.wait``
+   releases the lock and is fine).
+
+A mutation whose caller holds the lock by contract (helper methods)
+is the intended use of the suppression comment — name the contract:
+``# mxlint: disable=lock-discipline (callers hold self._cond)``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from ..core import Issue, LintPass, dotted_name, register_pass
+
+_SCOPE_RES = [re.compile(p) for p in (
+    r"(^|/)engine\.py$",
+    r"(^|/)runtime_metrics\.py$",
+    r"(^|/)serving/[^/]+\.py$",
+    r"(^|/)parallel/dist\.py$",
+)]
+
+_LOCKISH = re.compile(r"lock|cond|mutex|_mu$", re.IGNORECASE)
+_MUTATORS = {"append", "appendleft", "add", "remove", "discard", "pop",
+             "popleft", "clear", "update", "extend", "insert",
+             "setdefault", "sort", "reverse"}
+_MUTABLE_CTORS = {"dict", "list", "set", "OrderedDict", "defaultdict",
+                  "deque", "WeakValueDictionary", "Counter"}
+_BLOCKING = re.compile(
+    r"^(time\.sleep|os\.system|subprocess\.\w+)$")
+
+
+def _in_scope(path: str) -> bool:
+    p = path.replace("\\", "/")
+    return any(r.search(p) for r in _SCOPE_RES)
+
+
+def _is_lockish(expr) -> bool:
+    return bool(_LOCKISH.search(dotted_name(expr) or ""))
+
+
+def _lock_key(expr, class_name: str, module: str) -> str:
+    """Canonical cross-file identity for a lock expression: instance
+    locks key on ``Class.attr`` (every instance shares the ordering
+    contract), module-level locks on ``module:name``."""
+    name = dotted_name(expr)
+    if name.startswith("self.") and class_name:
+        return f"{class_name}.{name[5:]}"
+    if "." not in name:
+        return f"{os.path.basename(module)}:{name}"
+    return name
+
+
+def _mutable_value(node) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        term = dotted_name(node.func).rsplit(".", 1)[-1]
+        return term in _MUTABLE_CTORS
+    return False
+
+
+def _root_name(node):
+    while isinstance(node, (ast.Attribute, ast.Subscript, ast.Call)):
+        node = getattr(node, "value", None) or getattr(node, "func", None)
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _self_attr(node):
+    """'x' for a ``self._x``-rooted expression (Attribute directly on
+    the name ``self``), else None."""
+    while isinstance(node, (ast.Subscript,)):
+        node = node.value
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+class _ClassInfo:
+    def __init__(self, name):
+        self.name = name
+        self.lock_attrs = set()
+        self.local_attrs = set()        # threading.local() — exempt
+
+
+def _scan_class(cls: ast.ClassDef) -> _ClassInfo:
+    info = _ClassInfo(cls.name)
+    for item in cls.body:
+        if isinstance(item, ast.FunctionDef) and item.name == "__init__":
+            for node in ast.walk(item):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for tgt in node.targets:
+                    attr = _self_attr(tgt)
+                    if attr is None:
+                        continue
+                    value_name = dotted_name(node.value.func) \
+                        if isinstance(node.value, ast.Call) else \
+                        dotted_name(node.value)
+                    if value_name.endswith("local"):
+                        info.local_attrs.add(attr)
+                    elif _LOCKISH.search(attr) or \
+                            re.search(r"Lock|Condition|Semaphore|"
+                                      r"make_lock|make_condition",
+                                      value_name):
+                        info.lock_attrs.add(attr)
+    return info
+
+
+@register_pass
+class LockDisciplinePass(LintPass):
+    id = "lock-discipline"
+    doc = ("shared state mutated without its lock, lock-order "
+           "inversions, and blocking calls under a held lock in "
+           "threading-reachable modules")
+
+    def __init__(self, project):
+        super().__init__(project)
+        # lock-order graph: (a, b) -> (src, node) of first observation
+        self._edges = {}
+
+    def check_file(self, src):
+        if not _in_scope(src.path):
+            return
+        module_mutables = set()
+        for stmt in src.tree.body:
+            if isinstance(stmt, ast.Assign):
+                targets = stmt.targets
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                targets = [stmt.target]
+            else:
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name) and _mutable_value(stmt.value):
+                    module_mutables.add(t.id)
+        yield from self._walk_scope(src, src.tree, module_mutables,
+                                    cls=None, fn_depth=0, locks=[])
+
+    # ------------------------------------------------------------ traversal
+    def _walk_scope(self, src, node, mutables, cls, fn_depth, locks):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                yield from self._walk_scope(
+                    src, child, mutables, _scan_class(child), fn_depth,
+                    locks)
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                if cls is not None and child.name == "__init__":
+                    continue        # construction is single-threaded
+                yield from self._walk_scope(
+                    src, child, mutables, cls, fn_depth + 1, locks)
+            elif isinstance(child, (ast.With, ast.AsyncWith)):
+                held = list(locks)
+                for item in child.items:
+                    expr = item.context_expr
+                    # `with lock:` or `with lock.acquire_timeout(..)`
+                    tgt = expr.func if isinstance(expr, ast.Call) else expr
+                    if _is_lockish(tgt):
+                        key = _lock_key(tgt, cls.name if cls else "",
+                                        src.path)
+                        if held:
+                            self._edge(held[-1], key, src, child)
+                        held = held + [key]
+                yield from self._walk_scope(src, child, mutables, cls,
+                                            fn_depth, held)
+            else:
+                if fn_depth > 0:
+                    yield from self._check_stmt(src, child, mutables,
+                                                cls, locks)
+                yield from self._walk_scope(src, child, mutables, cls,
+                                            fn_depth, locks)
+
+    # ------------------------------------------------------------- checks
+    def _check_stmt(self, src, node, mutables, cls, locks):
+        held = bool(locks)
+        # blocking call under a held lock
+        if isinstance(node, ast.Call) and held:
+            name = dotted_name(node.func)
+            if _BLOCKING.match(name):
+                yield self.issue(
+                    src, node,
+                    f"blocking call {name}() while holding "
+                    f"{locks[-1]!r} — every other thread contending on "
+                    f"the lock stalls for the full duration")
+        if held:
+            return      # mutations under a lock are fine
+        targets = ()
+        kind = None
+        if isinstance(node, ast.Assign):
+            targets, kind = node.targets, "assign"
+        elif isinstance(node, ast.AugAssign):
+            targets, kind = (node.target,), "augassign"
+        elif isinstance(node, ast.Delete):
+            targets, kind = node.targets, "del"
+        elif isinstance(node, ast.Call):
+            term = dotted_name(node.func).rsplit(".", 1)[-1]
+            if term in _MUTATORS and isinstance(node.func, ast.Attribute):
+                recv = node.func.value
+                yield from self._check_mutation(
+                    src, node, recv, mutables, cls,
+                    f".{term}() on", deref=False)
+            return
+        for tgt in targets:
+            # tuple targets: check each element
+            elts = tgt.elts if isinstance(tgt, ast.Tuple) else [tgt]
+            for t in elts:
+                yield from self._check_mutation(
+                    src, node, t, mutables, cls,
+                    {"assign": "assignment to", "augassign":
+                     "augmented assignment to",
+                     "del": "del of"}[kind],
+                    deref=(kind == "assign"))
+
+    def _check_mutation(self, src, node, target, mutables, cls, verb,
+                        deref):
+        # module-level mutable container mutated without a lock
+        if isinstance(target, (ast.Subscript, ast.Attribute)) or not deref:
+            root = _root_name(target)
+            if root in mutables and _self_attr(target) is None:
+                yield self.issue(
+                    src, node,
+                    f"{verb} module-level mutable {root!r} without a "
+                    f"held lock — threading-reachable module state needs "
+                    f"a module lock (or move it behind a class lock)")
+                return
+        # `cls._x` / `ClassName._x` shared class state
+        if isinstance(target, ast.Attribute) and \
+                isinstance(target.value, ast.Name) and \
+                target.value.id != "self" and \
+                target.attr.startswith("_") and \
+                (target.value.id == "cls" or
+                 target.value.id[:1].isupper()):
+            yield self.issue(
+                src, node,
+                f"{verb} class attribute "
+                f"{target.value.id}.{target.attr} without a held lock — "
+                f"class attributes are process-shared state")
+            return
+        # instance state in a lock-owning class
+        if cls is None or not cls.lock_attrs:
+            return
+        attr = _self_attr(target)
+        if attr is None or not attr.startswith("_") \
+                or attr.startswith("__") or attr in cls.lock_attrs \
+                or attr in cls.local_attrs:
+            return
+        if deref and isinstance(target, ast.Attribute):
+            # plain rebind `self._x = ...`
+            yield self.issue(
+                src, node,
+                f"{verb} self.{attr} outside `with self."
+                f"{sorted(cls.lock_attrs)[0]}` in lock-owning class "
+                f"{cls.name} — readers on other threads can observe "
+                f"torn/stale state")
+        elif not deref or isinstance(target, ast.Subscript):
+            yield self.issue(
+                src, node,
+                f"{verb} self.{attr} outside `with self."
+                f"{sorted(cls.lock_attrs)[0]}` in lock-owning class "
+                f"{cls.name}")
+
+    # --------------------------------------------------------- lock order
+    def _edge(self, a, b, src, node):
+        if a == b:
+            return
+        self._edges.setdefault((a, b), (src, node))
+
+    def finalize(self):
+        graph = {}
+        for (a, b) in self._edges:
+            graph.setdefault(a, set()).add(b)
+        # every edge that participates in a cycle is a potential
+        # inversion site; report each once, at its acquisition site
+        bad = set()
+        for (a, b), _site in self._edges.items():
+            # is `a` reachable from `b`?
+            stack, seen = [b], set()
+            while stack:
+                n = stack.pop()
+                if n == a:
+                    bad.add((a, b))
+                    break
+                if n in seen:
+                    continue
+                seen.add(n)
+                stack.extend(graph.get(n, ()))
+        for (a, b) in sorted(bad):
+            src, node = self._edges[(a, b)]
+            if src.suppressed(self.id, node):
+                continue
+            yield Issue(
+                self.id, src.path, node.lineno, node.col_offset,
+                f"lock-order inversion: {a!r} -> {b!r} here, but the "
+                f"reverse order is also acquired elsewhere — two "
+                f"threads taking the two orders deadlock; pick one "
+                f"global order (run with MXNET_ENGINE_SANITIZE=1 to "
+                f"catch the dynamic interleaving)")

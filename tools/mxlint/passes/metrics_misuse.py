@@ -1,0 +1,135 @@
+"""metrics-misuse: static counterparts of the runtime_metrics guards.
+
+Two findings, both bug classes the runtime registry already rejects at
+runtime (PR 2 hardening) — this pass moves the failure to lint time:
+
+1. ``Counter.inc`` with a negative literal: counters are monotonic;
+   ``inc(-n)`` raises ``MXNetError`` at the call site even with metrics
+   disabled.  Use a ``Gauge`` (``.dec()``) for values that go down.
+2. Histogram registrations of the same metric name with *different*
+   bucket literals at different call sites: the registry raises on the
+   second registration, but only on whichever site runs second — the
+   static check flags every conflicting site at once.
+
+Counter handles are recognized from module-level ``NAME = counter(...)``
+/ ``REGISTRY.counter(...)`` assignments anywhere in the scanned tree
+(``_rm.SERVING_SHED``-style uses resolve through the terminal name).
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Issue, LintPass, dotted_name, register_pass
+
+
+def _negative_literal(node):
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub) \
+            and isinstance(node.operand, ast.Constant) \
+            and isinstance(node.operand.value, (int, float)):
+        return -node.operand.value
+    if isinstance(node, ast.Constant) \
+            and isinstance(node.value, (int, float)) and node.value < 0:
+        return node.value
+    return None
+
+
+def _bucket_literal(node):
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) \
+                    and isinstance(e.value, (int, float)):
+                vals.append(float(e.value))
+            else:
+                return None         # dynamic element: not comparable
+        return tuple(vals)
+    return None
+
+
+@register_pass
+class MetricsMisusePass(LintPass):
+    id = "metrics-misuse"
+    doc = ("negative Counter.inc literals and histogram registrations "
+           "with conflicting bucket literals across call sites")
+
+    def __init__(self, project):
+        super().__init__(project)
+        self._counters = set()
+        self._gauges = set()
+        # histogram name -> [(buckets, src, node)]
+        self._hists = {}
+        self._scanned = False
+
+    def _scan_handles(self):
+        """Project-wide: module-level metric-handle assignments."""
+        if self._scanned:
+            return
+        self._scanned = True
+        for f in self.project.files:
+            for stmt in f.tree.body:
+                if not isinstance(stmt, ast.Assign) \
+                        or not isinstance(stmt.value, ast.Call):
+                    continue
+                term = dotted_name(stmt.value.func).rsplit(".", 1)[-1]
+                for tgt in stmt.targets:
+                    if not isinstance(tgt, ast.Name):
+                        continue
+                    if term == "counter":
+                        self._counters.add(tgt.id)
+                    elif term == "gauge":
+                        self._gauges.add(tgt.id)
+
+    def check_file(self, src):
+        self._scan_handles()
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            term = name.rsplit(".", 1)[-1]
+            if term == "inc" and isinstance(node.func, ast.Attribute):
+                recv = dotted_name(node.func.value).rsplit(".", 1)[-1]
+                if recv in self._counters and recv not in self._gauges:
+                    amt = node.args[0] if node.args else next(
+                        (kw.value for kw in node.keywords
+                         if kw.arg == "amount"), None)
+                    neg = _negative_literal(amt) if amt is not None \
+                        else None
+                    if neg is not None:
+                        yield self.issue(
+                            src, node,
+                            f"Counter {recv}.inc({neg}) — counters are "
+                            f"monotonic and raise MXNetError on negative "
+                            f"increments (even with metrics disabled); "
+                            f"use a Gauge with .dec() for values that "
+                            f"go down")
+            elif term == "histogram":
+                if node.args and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str):
+                    buckets = next(
+                        (_bucket_literal(kw.value) for kw in node.keywords
+                         if kw.arg == "buckets"), None)
+                    if buckets is not None:
+                        # suppressed sites still participate in conflict
+                        # DETECTION (suppressing one site must not hide
+                        # the conflict at the others) — the suppression
+                        # only silences reporting at that site, in
+                        # finalize()
+                        self._hists.setdefault(
+                            node.args[0].value, []).append(
+                                (buckets, src, node))
+
+    def finalize(self):
+        for name, sites in sorted(self._hists.items()):
+            distinct = {b for b, _s, _n in sites}
+            if len(distinct) <= 1:
+                continue
+            for buckets, src, node in sites:
+                if src.suppressed(self.id, node):
+                    continue
+                yield Issue(
+                    self.id, src.path, node.lineno, node.col_offset,
+                    f"histogram {name!r} registered here with buckets "
+                    f"{buckets} but other call sites use different "
+                    f"buckets ({len(distinct)} variants) — the registry "
+                    f"raises MXNetError at whichever site runs second; "
+                    f"declare the buckets once")
