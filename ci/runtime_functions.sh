@@ -36,8 +36,11 @@ diagnose() {
 sanity_lint() {
     # codebase-specific static analysis must be clean
     # (docs/static_analysis.md; suppressions carry their justification
-    # inline, so "clean" means every finding was fixed or argued)
-    python -m tools.mxlint mxnet_tpu/
+    # inline, so "clean" means every finding was fixed or argued).
+    # --format json: one finding object per line so CI can annotate the
+    # offending lines; any finding fails the job (exit 1).  tools/ is
+    # linted too — the linter holds itself to its own rules.
+    python -m tools.mxlint --format json mxnet_tpu/ tools/
     # then the dynamic half: engine+serving tests double as race tests
     # under the concurrency sanitizer (lock-order recording + tracked-
     # array assertions)

@@ -211,6 +211,10 @@ declare_env("MXNET_TEST_CTX", "cpu",
             "Context for test_utils.default_context (the reference's "
             "GPU-suite switch): 'cpu', 'tpu', ... — any mxnet_tpu.context "
             "constructor name.")
+declare_env("MXNET_TEST_PJRT_PLUGIN", None,
+            "Path to a PJRT plugin .so for the framework-free StableHLO "
+            "runner (tools/shlo_run.py, tests/test_shlo_runner.py); the "
+            "end-to-end artifact tests only run when set.")
 declare_env("MXNET_RUNTIME_METRICS", "0",
             "1 = enable the process-wide runtime metrics registry "
             "(mxnet_tpu.runtime_metrics): op dispatch counters/latency, "

@@ -78,6 +78,11 @@ def pipeline_apply(stage_fn, stage_params, micro_inputs, mesh: Mesh,
             # zero inactive ticks so garbage never propagates
             y = jnp.where(active, y, jnp.zeros_like(y))
             if n_stages > 1:
+                # mxlint: disable=collective-soundness (deliberately
+                # non-total: the GPipe hand-off sends stage i -> i+1 and
+                # must NOT wrap the last stage back to 0 — stage 0 reads
+                # fresh microbatches from xs, and ppermute zero-fills
+                # un-received buffers, which `active` masking discards)
                 sent = lax.ppermute(
                     y, axis,
                     perm=[(i, i + 1) for i in range(n_stages - 1)])

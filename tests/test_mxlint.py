@@ -28,7 +28,8 @@ def ids(issues):
 
 def test_pass_catalogue_complete():
     assert set(PASSES) == {"jit-retrace", "host-sync", "lock-discipline",
-                           "metrics-misuse", "env-registry"}
+                           "metrics-misuse", "env-registry",
+                           "collective-soundness", "resource-leak"}
 
 
 # ---------------------------------------------------------------- jit-retrace

@@ -1,0 +1,1906 @@
+"""Interprocedural mxlint (ISSUE-4): call graph, dataflow summaries,
+helper-hop upgrades of jit-retrace/host-sync, and the two new passes
+(collective-soundness, resource-leak).
+
+Pure-AST fixtures — no jax import, milliseconds per test (tier-1 budget
+discipline, ROADMAP.md).  The acceptance shapes pinned here:
+
+- jit-retrace / host-sync catch a violation routed through >= 1 helper
+  hop (same-file, two-hop, and cross-file via import);
+- collective-soundness flags a wrong axis name and a non-total
+  ppermute perm;
+- the repo tree (incl. tools/) stays clean under every pass.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.mxlint import Project, lint_paths, lint_sources  # noqa: E402
+from tools.mxlint.core import SourceFile                    # noqa: E402
+from tools.mxlint.callgraph import CallGraph                # noqa: E402
+from tools.mxlint.dataflow import build_summaries           # noqa: E402
+
+
+def run(src, path="mxnet_tpu/parallel/fixture.py", select=None):
+    return lint_sources({path: textwrap.dedent(src)}, select=select)
+
+
+def run_many(srcs, select=None):
+    return lint_sources({p: textwrap.dedent(s) for p, s in srcs.items()},
+                        select=select)
+
+
+def ids(issues):
+    return [i.pass_id for i in issues]
+
+
+def graph_of(srcs):
+    files = [SourceFile(p, textwrap.dedent(s))
+             for p, s in sorted(srcs.items())]
+    return CallGraph(files)
+
+
+# ------------------------------------------------------------- call graph
+def test_callgraph_resolves_nested_module_and_imported():
+    g = graph_of({
+        "pkg/a.py": """
+            def helper(x):
+                return x
+
+            def caller(x):
+                def inner(y):
+                    return y
+                inner(x)
+                return helper(x)
+        """,
+        "pkg/b.py": """
+            from pkg.a import helper
+
+            def cross(x):
+                return helper(x)
+        """,
+    })
+    callees = {s.callee.qname for s in g.calls["pkg.a.caller"]}
+    assert callees == {"pkg.a.caller.inner", "pkg.a.helper"}
+    assert {s.callee.qname for s in g.calls["pkg.b.cross"]} == \
+        {"pkg.a.helper"}
+
+
+def test_callgraph_method_resolution_via_class_attribute():
+    g = graph_of({
+        "pkg/m.py": """
+            class Worker:
+                def run(self, x):
+                    return x
+
+            class Pool:
+                def __init__(self):
+                    self._w = Worker()
+
+                def go(self, x):
+                    return self._w.run(x)
+
+                def go_local(self, x):
+                    w = Worker()
+                    return w.run(x)
+        """,
+    })
+    assert {s.callee.qname for s in g.calls["pkg.m.Pool.go"]} == \
+        {"pkg.m.Worker.run"}
+    assert "pkg.m.Worker.run" in {
+        s.callee.qname for s in g.calls["pkg.m.Pool.go_local"]}
+
+
+def test_callgraph_arg_map_accounts_for_bound_receiver():
+    g = graph_of({
+        "pkg/m.py": """
+            class C:
+                def m(self, a, b):
+                    return a
+
+            def f(c, x, y):
+                return c.m(x, b=y)
+        """,
+    })
+    # unresolvable receiver type -> no edge; bind explicitly instead
+    g2 = graph_of({
+        "pkg/m.py": """
+            class C:
+                def m(self, a, b):
+                    return a
+
+            def f(x, y):
+                c = C()
+                return c.m(x, b=y)
+        """,
+    })
+    (site,) = [s for s in g2.calls["pkg.m.f"]
+               if s.callee.qname == "pkg.m.C.m"]
+    # param 0 = the bound receiver (c), param 1 = a (positional after
+    # self), param 2 = b (keyword)
+    assert sorted(site.arg_map) == [0, 1, 2]
+    assert site.arg_map[0].id == "c"
+
+
+def test_callgraph_arg_map_classmethod_via_class_name_is_bound():
+    # C.helper(x) on a @classmethod binds cls via the descriptor: x
+    # maps to param 1 (a), not the cls slot — an unbound-style shift
+    # would silently drop the traced arg from every summary match
+    g = graph_of({
+        "pkg/m.py": """
+            class C:
+                @classmethod
+                def helper(cls, a):
+                    return float(a)
+
+            def f(x):
+                return C.helper(x)
+        """,
+    })
+    (site,) = [s for s in g.calls["pkg.m.f"]
+               if s.callee.qname == "pkg.m.C.helper"]
+    # x lands on param 1 (a); the cls slot maps the receiver expression
+    assert 1 in site.arg_map and site.arg_map[1].id == "x"
+    # plain self-methods called through the class stay unbound
+    g2 = graph_of({
+        "pkg/m.py": """
+            class C:
+                def m(self, a):
+                    return a
+
+            def f(obj, x):
+                return C.m(obj, x)
+        """,
+    })
+    (site,) = [s for s in g2.calls["pkg.m.f"]
+               if s.callee.qname == "pkg.m.C.m"]
+    assert sorted(site.arg_map) == [0, 1]
+
+
+# -------------------------------------------------------------- summaries
+def test_summary_fixpoint_on_mutual_recursion():
+    g = graph_of({
+        "pkg/m.py": """
+            def ping(x, n):
+                if n:
+                    return pong(x, n - 1)
+                return x
+
+            def pong(x, n):
+                float(x)
+                return ping(x, n)
+        """,
+    })
+    s = build_summaries(g)
+    # both sides of the cycle agree: param 0 reaches the scalarization
+    assert 0 in s["pkg.m.ping"].sync_params
+    assert 0 in s["pkg.m.pong"].sync_params
+    assert 0 in s["pkg.m.ping"].returns_params
+
+
+def test_summary_witness_names_the_chain():
+    g = graph_of({
+        "pkg/m.py": """
+            def leaf(v):
+                return v.asnumpy()
+
+            def mid(a):
+                return leaf(a)
+
+            def top(x):
+                return mid(x)
+        """,
+    })
+    s = build_summaries(g)
+    w = s["pkg.m.top"].sync_params[0][0].describe()
+    assert "mid" in w and "leaf" in w and "asnumpy" in w
+
+
+def test_summary_static_metadata_does_not_taint():
+    g = graph_of({
+        "pkg/m.py": """
+            def f(x):
+                n = x.shape[0]
+                m = len(x)
+                return int(n) + int(m)
+        """,
+    })
+    s = build_summaries(g)
+    assert s["pkg.m.f"].sync_params == {}
+
+
+# ------------------------------------------- jit-retrace through helpers
+def test_jit_retrace_one_helper_hop():
+    issues = run("""
+        import jax
+
+        def scalarize(v):
+            return float(v)
+
+        @jax.jit
+        def f(x):
+            return x * scalarize(x)
+    """, select=["jit-retrace"])
+    assert ids(issues) == ["jit-retrace"]
+    assert issues[0].line == 9          # the call site inside the jit
+    assert "scalarize" in issues[0].message
+
+
+def test_jit_retrace_two_hops_and_assignment_tracking():
+    issues = run("""
+        import jax
+
+        def leaf(v):
+            return v.asnumpy()
+
+        def mid(a):
+            return leaf(a)
+
+        @jax.jit
+        def f(x):
+            y = x + 1
+            return mid(y)
+    """, select=["jit-retrace"])
+    assert ids(issues) == ["jit-retrace"]
+    assert "mid" in issues[0].message and "leaf" in issues[0].message
+
+
+def test_jit_retrace_cross_file_helper():
+    issues = run_many({
+        "mxnet_tpu/helpers.py": """
+            def to_host(v):
+                return v.asnumpy()
+        """,
+        "mxnet_tpu/model.py": """
+            import jax
+            from mxnet_tpu.helpers import to_host
+
+            @jax.jit
+            def f(x):
+                return to_host(x)
+        """,
+    }, select=["jit-retrace"])
+    assert [(i.pass_id, i.path) for i in issues] == \
+        [("jit-retrace", "mxnet_tpu/model.py")]
+
+
+def test_jit_retrace_hybrid_forward_helper_hop():
+    issues = run("""
+        def peek(v):
+            return v.item()
+
+        class Net:
+            def hybrid_forward(self, F, x):
+                return peek(x)
+    """, select=["jit-retrace"])
+    assert ids(issues) == ["jit-retrace"]
+
+
+def test_jit_retrace_helper_on_host_value_is_quiet():
+    issues = run("""
+        import jax
+
+        def scalarize(v):
+            return float(v)
+
+        @jax.jit
+        def f(x):
+            n = x.shape[0]
+            return x * scalarize(n)
+
+        def host(y):
+            return scalarize(y)
+    """, select=["jit-retrace"])
+    assert issues == []
+
+
+def test_jit_retrace_helper_hop_suppression():
+    issues = run("""
+        import jax
+
+        def scalarize(v):
+            return float(v)
+
+        @jax.jit
+        def f(x):
+            # mxlint: disable=jit-retrace (static under vmap contract)
+            return x * scalarize(x)
+    """, select=["jit-retrace"])
+    assert issues == []
+
+
+# --------------------------------------------- host-sync through helpers
+def test_host_sync_helper_hop_in_ops():
+    issues = run_many({
+        "mxnet_tpu/util.py": """
+            def fetch(v):
+                return v.asnumpy()
+        """,
+        "mxnet_tpu/ops/nn.py": """
+            from mxnet_tpu.util import fetch
+
+            def relu_impl(x):
+                return fetch(x)
+        """,
+    }, select=["host-sync"])
+    assert [(i.pass_id, i.path) for i in issues] == \
+        [("host-sync", "mxnet_tpu/ops/nn.py")]
+    assert "fetch" in issues[0].message
+    assert "asnumpy" in issues[0].message
+
+
+def test_host_sync_helper_hop_in_batcher_dispatch():
+    issues = run_many({
+        "mxnet_tpu/serving/util.py": """
+            import jax
+
+            def drain(arrays):
+                jax.block_until_ready(arrays)
+                return arrays
+        """,
+        "mxnet_tpu/serving/batcher.py": """
+            from .util import drain
+
+            class MyBatcher:
+                def run_batch(self, outs):
+                    return drain(outs)
+        """,
+    }, select=["host-sync"])
+    assert [(i.pass_id, i.path) for i in issues] == \
+        [("host-sync", "mxnet_tpu/serving/batcher.py")]
+
+
+def test_host_sync_engine_sync_outputs_is_sanctioned():
+    issues = run_many({
+        "mxnet_tpu/engine.py": """
+            import jax
+
+            def sync_outputs(arrays, site="serving"):
+                jax.block_until_ready(arrays)
+                return arrays
+        """,
+        "mxnet_tpu/serving/batcher.py": """
+            from mxnet_tpu.engine import sync_outputs
+
+            class MyBatcher:
+                def run_batch(self, outs):
+                    return sync_outputs(outs, site="batch")
+        """,
+    }, select=["host-sync"])
+    assert issues == []
+
+
+def test_host_sync_helper_inside_scope_not_double_flagged():
+    # the helper lives in ops/ itself: its direct line is the finding,
+    # the call site is not repeated
+    issues = run("""
+        def fetch(v):
+            return v.asnumpy()
+
+        def relu_impl(x):
+            return fetch(x)
+    """, path="mxnet_tpu/ops/nn.py", select=["host-sync"])
+    assert len(issues) == 1
+    assert issues[0].line == 3          # fetch's own .asnumpy()
+
+
+def test_host_sync_nested_helper_in_batcher_not_double_flagged():
+    # a def nested inside a *Batcher method is itself a checked surface
+    # (same scope rule as the direct check): its own .asnumpy() line is
+    # the finding, the call into it must not add a second one
+    issues = run("""
+        class DynamicBatcher:
+            def run_batch(self, y):
+                def conv(x):
+                    return x.asnumpy()
+                return conv(y)
+    """, path="mxnet_tpu/serving/batcher.py", select=["host-sync"])
+    assert len(issues) == 1
+    assert issues[0].line == 5          # conv's own .asnumpy()
+
+
+def test_host_sync_chain_ending_in_checked_surface_not_double_flagged():
+    # hot serving site -> plain helper -> ops/ sink: the sink's own line
+    # carries the finding; the chained finding at the serving call site
+    # must not fire a second one
+    issues = run_many({
+        "mxnet_tpu/ops/math.py": """
+            def fetch(v):
+                return v.asnumpy()
+        """,
+        "mxnet_tpu/util.py": """
+            from mxnet_tpu.ops.math import fetch
+
+            def mid(v):
+                return fetch(v)
+        """,
+        "mxnet_tpu/serving/batcher.py": """
+            from mxnet_tpu.util import mid
+
+            class MyBatcher:
+                def run_batch(self, outs):
+                    return mid(outs)
+        """,
+    }, select=["host-sync"])
+    assert [(i.pass_id, i.path) for i in issues] == \
+        [("host-sync", "mxnet_tpu/ops/math.py")]
+
+
+def test_jit_retrace_sink_in_traced_helper_not_double_flagged():
+    # jit f -> plain mid -> jit deep with the .asnumpy(): deep's direct
+    # finding owns the bug; no chained finding at f's call into mid
+    issues = run("""
+        import jax
+
+        @jax.jit
+        def deep(v):
+            return v.asnumpy()
+
+        def mid(v):
+            return deep(v)
+
+        @jax.jit
+        def f(x):
+            return mid(x)
+    """, select=["jit-retrace"])
+    assert len(issues) == 1
+    assert issues[0].line == 6          # deep's own .asnumpy()
+
+
+def test_host_sync_second_unchecked_sink_not_masked():
+    # the helper's FIRST sink lives in ops/ (directly checked there),
+    # but its own .asnumpy() is in an unchecked plain module — the hot
+    # call site must still report that second sink
+    issues = run_many({
+        "mxnet_tpu/ops/math.py": """
+            def fetch(v):
+                return v.asnumpy()
+        """,
+        "mxnet_tpu/util.py": """
+            from mxnet_tpu.ops.math import fetch
+
+            def mid(v):
+                fetch(v)
+                return v.asnumpy()
+        """,
+        "mxnet_tpu/serving/batcher.py": """
+            from mxnet_tpu.util import mid
+
+            class MyBatcher:
+                def run_batch(self, outs):
+                    return mid(outs)
+        """,
+    }, select=["host-sync"])
+    paths = sorted(i.path for i in issues)
+    assert paths == ["mxnet_tpu/ops/math.py",
+                     "mxnet_tpu/serving/batcher.py"]
+    chained = [i for i in issues if "batcher" in i.path][0]
+    assert "util.py:6" in chained.message    # mid's own .asnumpy()
+
+
+def test_jit_retrace_second_unchecked_sink_not_masked():
+    # helper first routes through a jit-decorated sink (owned there),
+    # then does its own float(v) — the jit call site still reports
+    issues = run("""
+        import jax
+
+        @jax.jit
+        def deep(v):
+            return v.asnumpy()
+
+        def mid(v):
+            deep(v)
+            return float(v)
+
+        @jax.jit
+        def f(x):
+            return mid(x)
+    """, select=["jit-retrace"])
+    assert len(issues) == 2
+    assert issues[0].line == 6          # deep's own .asnumpy()
+    assert "float" in issues[1].message # chained finding at f's call
+
+
+def test_jit_retrace_taint_through_project_class():
+    # a traced value stored in a project object and read back through a
+    # method must stay tainted: resolving the class cannot make the
+    # analysis blinder than an opaque external class would be
+    issues = run("""
+        import jax
+
+        class Accum:
+            def __init__(self, v):
+                self._v = v
+
+            def total(self):
+                return self._v
+
+        @jax.jit
+        def f(x):
+            acc = Accum(x)
+            return float(acc.total())
+    """, select=["jit-retrace"])
+    assert ids(issues) == ["jit-retrace"]
+    assert "float" in issues[0].message
+
+
+def test_jit_retrace_clean_helper_return_not_flagged():
+    # the helper's summary proves its return does not derive from the
+    # traced argument — float() on that result is host math, not a
+    # tracer escape
+    issues = run("""
+        import jax
+
+        def scale_const(x):
+            return 2.0
+
+        @jax.jit
+        def f(x):
+            s = float(scale_const(x))
+            return x * s
+    """, select=["jit-retrace"])
+    assert issues == []
+
+
+# ---------------------------------------------------- collective-soundness
+def test_collective_wrong_axis_name_flagged():
+    issues = run("""
+        from jax import lax
+        from jax.sharding import Mesh
+        from jax.experimental.shard_map import shard_map
+
+        def f(x, devices):
+            mesh = Mesh(devices, axis_names=("dp", "tp"))
+
+            def body(x):
+                return lax.psum(x, "dpp")
+
+            return shard_map(body, mesh=mesh, in_specs=None,
+                             out_specs=None)(x)
+    """, select=["collective-soundness"])
+    assert ids(issues) == ["collective-soundness"]
+    assert "'dpp'" in issues[0].message
+    assert "['dp', 'tp']" in issues[0].message
+
+
+def test_collective_axis_default_param_resolved_and_quiet():
+    issues = run("""
+        from jax import lax
+        from jax.sharding import Mesh
+        from jax.experimental.shard_map import shard_map
+
+        def f(x, devices, axis_name="tp"):
+            mesh = Mesh(devices, axis_names=("dp", "tp"))
+
+            def body(x):
+                return lax.psum(x, axis_name)
+
+            return shard_map(body, mesh=mesh, in_specs=None,
+                             out_specs=None)(x)
+    """, select=["collective-soundness"])
+    assert issues == []
+
+
+def test_collective_axis_local_assignment_const_propagated():
+    # the axis variable is a straight-line local string assignment in
+    # the body scope — const-prop must resolve it (and flag the typo)
+    issues = run("""
+        from jax import lax
+        from jax.sharding import Mesh
+        from jax.experimental.shard_map import shard_map
+
+        def f(x, devices):
+            mesh = Mesh(devices, axis_names=("dp", "tp"))
+
+            def body(x):
+                axis = "dpp"
+                return lax.psum(x, axis)
+
+            return shard_map(body, mesh=mesh, in_specs=None,
+                             out_specs=None)(x)
+    """, select=["collective-soundness"])
+    assert ids(issues) == ["collective-soundness"]
+    assert "'dpp'" in issues[0].message
+
+
+def test_collective_partial_bound_constant_param_is_uniform():
+    # shard_map(partial(body, True), ...): the pre-bound literal is the
+    # same on every device — branching on it is not divergence
+    issues = run("""
+        from functools import partial
+        from jax import lax
+        from jax.sharding import Mesh
+        from jax.experimental.shard_map import shard_map
+
+        def body(use_sum, x):
+            if use_sum:
+                return lax.psum(x, "dp")
+            return x
+
+        def run(x, devices):
+            mesh = Mesh(devices, axis_names=("dp",))
+            return shard_map(partial(body, True), mesh=mesh,
+                             in_specs=None, out_specs=None)(x)
+    """, select=["collective-soundness"])
+    assert issues == []
+
+
+def test_collective_nested_def_under_tainted_if_not_flagged():
+    # defining a function under the if executes no collective
+    issues = run("""
+        from jax import lax
+        from jax.sharding import Mesh
+        from jax.experimental.shard_map import shard_map
+
+        def run(x, devices):
+            mesh = Mesh(devices, axis_names=("dp",))
+
+            def body(x):
+                if x.sum() > 0:
+                    def g(v):
+                        return lax.psum(v, "dp")
+                return x
+
+            return shard_map(body, mesh=mesh, in_specs=None,
+                             out_specs=None)(x)
+    """, select=["collective-soundness"])
+    assert issues == []
+
+
+def test_collective_module_scope_shard_map_site_checked():
+    # `apply = shard_map(body, mesh, ...)` at module level is a common
+    # JAX idiom — the body must be checked against that site's mesh
+    issues = run("""
+        from jax import lax
+        from jax.sharding import Mesh
+        from jax.experimental.shard_map import shard_map
+
+        def body(x):
+            return lax.psum(x, "WRONG_AXIS")
+
+        mesh = Mesh(None, axis_names=("dp",))
+        apply = shard_map(body, mesh, in_specs=None, out_specs=None)
+    """, select=["collective-soundness"])
+    assert ids(issues) == ["collective-soundness"]
+    assert "'WRONG_AXIS'" in issues[0].message
+    assert "['dp']" in issues[0].message
+
+
+def test_collective_mesh_param_not_bound_to_sibling_local():
+    # the shard_map site's `mesh` is a runtime PARAMETER; the same name
+    # assigned in a sibling nested def must not bind — the pass falls
+    # back to the project axis universe and "dp" is in it, so: quiet
+    issues = run("""
+        from jax import lax
+        from jax.sharding import Mesh
+        from jax.experimental.shard_map import shard_map
+
+        def outer(x, devices, mesh):
+            def unrelated():
+                mesh = Mesh(devices, axis_names=("tp",))
+                return mesh
+
+            def body(x):
+                return lax.psum(x, "dp")
+
+            return shard_map(body, mesh, in_specs=None,
+                             out_specs=None)(x)
+
+        def elsewhere(devices):
+            return Mesh(devices, axis_names=("dp",))
+    """, select=["collective-soundness"])
+    assert issues == []
+
+
+def test_collective_cond_keyword_branches_checked():
+    # lax.cond with true_fun=/false_fun= keywords is the same deadlock
+    # shape as the positional form
+    issues = run("""
+        from jax import lax
+        from jax.sharding import Mesh
+        from jax.experimental.shard_map import shard_map
+
+        def do_psum(v):
+            return lax.psum(v, "dp")
+
+        def run(x, devices):
+            mesh = Mesh(devices, axis_names=("dp",))
+
+            def body(x):
+                return lax.cond(x[0] > 0, true_fun=do_psum,
+                                false_fun=do_psum, operand=x)
+
+            return shard_map(body, mesh=mesh, in_specs=None,
+                             out_specs=None)(x)
+    """, select=["collective-soundness"])
+    assert ids(issues) == ["collective-soundness"]
+    assert "deadlock" in issues[0].message
+
+
+def test_collective_outside_shard_map_not_checked():
+    issues = run("""
+        from jax import lax
+
+        def host_helper(x):
+            return lax.psum(x, "totally_bogus_axis")
+    """, select=["collective-soundness"])
+    assert issues == []
+
+
+def test_ppermute_non_total_literal_and_comprehension():
+    lit = run("""
+        from jax import lax
+        from jax.sharding import Mesh
+        from jax.experimental.shard_map import shard_map
+
+        def f(x, devices):
+            mesh = Mesh(devices, axis_names=("pp",))
+
+            def body(x):
+                return lax.ppermute(x, "pp", [(0, 1), (1, 0), (2, 0)])
+
+            return shard_map(body, mesh=mesh, in_specs=None,
+                             out_specs=None)(x)
+    """, select=["collective-soundness"])
+    assert ids(lit) == ["collective-soundness"]
+    assert "total permutation" in lit[0].message
+    comp = run("""
+        from jax import lax
+        from jax.sharding import Mesh
+        from jax.experimental.shard_map import shard_map
+
+        def f(x, devices, n):
+            mesh = Mesh(devices, axis_names=("pp",))
+
+            def body(x):
+                return lax.ppermute(
+                    x, "pp", perm=[(i, i + 1) for i in range(n - 1)])
+
+            return shard_map(body, mesh=mesh, in_specs=None,
+                             out_specs=None)(x)
+    """, select=["collective-soundness"])
+    assert ids(comp) == ["collective-soundness"]
+
+
+def test_ppermute_total_ring_and_literal_are_quiet():
+    issues = run("""
+        from jax import lax
+        from jax.sharding import Mesh
+        from jax.experimental.shard_map import shard_map
+
+        def f(x, devices):
+            mesh = Mesh(devices, axis_names=("sp",))
+
+            def body(x):
+                size = lax.psum(1, "sp")
+                ring = [(j, (j + 1) % size) for j in range(size)]
+                x = lax.ppermute(x, "sp", ring)
+                return lax.ppermute(x, "sp", [(0, 1), (1, 0)])
+
+            return shard_map(body, mesh=mesh, in_specs=None,
+                             out_specs=None)(x)
+    """, select=["collective-soundness"])
+    assert issues == []
+
+
+def test_collective_under_per_device_if_flagged_through_helper():
+    issues = run("""
+        from jax import lax
+        from jax.sharding import Mesh
+        from jax.experimental.shard_map import shard_map
+
+        def reduce_it(v, ax):
+            return lax.psum(v, ax)
+
+        def f(x, devices):
+            mesh = Mesh(devices, axis_names=("dp",))
+
+            def body(x):
+                if x.sum() > 0:
+                    return reduce_it(x, "dp")
+                return x
+
+            return shard_map(body, mesh=mesh, in_specs=None,
+                             out_specs=None)(x)
+    """, select=["collective-soundness"])
+    assert ids(issues) == ["collective-soundness"]
+    assert "per-device" in issues[0].message
+
+
+def test_collective_under_cond_lambda_flagged_uniform_pred_quiet():
+    pos = run("""
+        from jax import lax
+        from jax.sharding import Mesh
+        from jax.experimental.shard_map import shard_map
+
+        def f(x, devices):
+            mesh = Mesh(devices, axis_names=("dp",))
+
+            def body(x):
+                return lax.cond(x.sum() > 0,
+                                lambda v: lax.psum(v, "dp"),
+                                lambda v: v, x)
+
+            return shard_map(body, mesh=mesh, in_specs=None,
+                             out_specs=None)(x)
+    """, select=["collective-soundness"])
+    assert ids(pos) == ["collective-soundness"]
+    neg = run("""
+        from jax import lax
+        from jax.sharding import Mesh
+        from jax.experimental.shard_map import shard_map
+
+        def f(x, n_steps, devices):
+            mesh = Mesh(devices, axis_names=("dp",))
+
+            def body(x):
+                # uniform predicate: collective result, not a shard
+                total = lax.psum(x.sum(), "dp")
+                return lax.cond(total > 0,
+                                lambda v: lax.psum(v, "dp"),
+                                lambda v: v, x)
+
+            return shard_map(body, mesh=mesh, in_specs=None,
+                             out_specs=None)(x)
+    """, select=["collective-soundness"])
+    assert neg == []
+
+
+def test_collective_soundness_suppression():
+    issues = run("""
+        from jax import lax
+        from jax.sharding import Mesh
+        from jax.experimental.shard_map import shard_map
+
+        def f(x, devices):
+            mesh = Mesh(devices, axis_names=("pp",))
+
+            def body(x):
+                # mxlint: disable=collective-soundness (fill-drain)
+                return lax.ppermute(x, "pp", [(0, 1)])
+
+            return shard_map(body, mesh=mesh, in_specs=None,
+                             out_specs=None)(x)
+    """, select=["collective-soundness"])
+    assert issues == []
+
+
+# ----------------------------------------------------------- resource-leak
+def test_resource_leak_never_closed_and_early_return():
+    issues = run("""
+        def leak(p):
+            f = open(p)
+            return f.read()
+
+        def early(p, flag):
+            f = open(p)
+            if flag:
+                return None
+            f.close()
+    """, path="mxnet_tpu/io/fixture.py", select=["resource-leak"])
+    assert ids(issues) == ["resource-leak"] * 2
+    assert "never closed" in issues[0].message
+    assert "exits first" in issues[1].message
+
+
+def test_resource_leak_inline_consumption():
+    issues = run("""
+        import json
+
+        def load(p):
+            return json.load(open(p))
+    """, path="mxnet_tpu/io/fixture.py", select=["resource-leak"])
+    assert ids(issues) == ["resource-leak"]
+    assert "inline" in issues[0].message
+
+
+def test_resource_leak_negatives():
+    issues = run("""
+        def ok_with(p):
+            with open(p) as f:
+                return f.read()
+
+        def ok_finally(p):
+            f = open(p)
+            try:
+                return f.read()
+            finally:
+                f.close()
+
+        def ok_straightline(p):
+            f = open(p)
+            data = f.read()
+            f.close()
+            return data
+
+        def ok_transfer(p):
+            f = open(p)
+            return f
+
+        class Holder:
+            def __init__(self, p):
+                self._fh = open(p)
+
+            def close(self):
+                self._fh.close()
+    """, path="mxnet_tpu/io/fixture.py", select=["resource-leak"])
+    assert issues == []
+
+
+def test_resource_leak_tuple_and_walrus_bindings_owned():
+    # tuple-bound and walrus-bound handles are named acquires, not
+    # inline consumption; properly closed ones stay quiet
+    issues = run("""
+        def ok_tuple(a, b):
+            f1, f2 = open(a), open(b)
+            try:
+                return f1.read() + f2.read()
+            finally:
+                f1.close()
+                f2.close()
+
+        def ok_walrus(p):
+            if (fh := open(p)):
+                data = fh.read()
+            fh.close()
+            return data
+    """, path="mxnet_tpu/io/fixture.py", select=["resource-leak"])
+    assert issues == []
+    # ...and an unclosed tuple-bound handle is still a finding
+    leak = run("""
+        def bad_tuple(a, b):
+            f1, f2 = open(a), open(b)
+            f1.close()
+            return f2
+    """, path="mxnet_tpu/io/fixture.py", select=["resource-leak"])
+    assert ids(leak) == []          # f2 escapes via return — exempt
+    leak = run("""
+        def bad_tuple(a, b):
+            f1, f2 = open(a), open(b)
+            f1.close()
+            return f1.name
+    """, path="mxnet_tpu/io/fixture.py", select=["resource-leak"])
+    assert ids(leak) == ["resource-leak"]
+    assert "'f2'" in leak[0].message
+
+
+def test_resource_leak_transfer_nested_in_return():
+    # return Reader(f) / return [f] hand the handle to a new owner —
+    # the documented RecordIO-style factory shape must stay quiet
+    issues = run("""
+        def factory(p):
+            f = open(p)
+            return Reader(f)
+
+        def pair(p, q):
+            f = open(p)
+            g = open(q)
+            return [f, g]
+    """, path="mxnet_tpu/io/fixture.py", select=["resource-leak"])
+    assert issues == []
+
+
+def test_resource_leak_lock_acquire_without_finally():
+    pos = run("""
+        def grab(lock):
+            lock.acquire()
+            do_work()
+            lock.release()
+    """, path="mxnet_tpu/serving/fixture.py", select=["resource-leak"])
+    assert ids(pos) == ["resource-leak"]
+    assert "finally" in pos[0].message
+    neg = run("""
+        def grab(lock):
+            lock.acquire()
+            try:
+                do_work()
+            finally:
+                lock.release()
+
+        class SanLock:
+            def acquire(self, blocking=True):
+                self._lock.acquire(blocking)
+
+            def __enter__(self):
+                self.acquire()
+                return self
+    """, path="mxnet_tpu/serving/fixture.py", select=["resource-leak"])
+    assert neg == []
+
+
+def test_resource_leak_suppression():
+    issues = run("""
+        def leak(p):
+            f = open(p)  # mxlint: disable=resource-leak (daemon-owned)
+            return f.read()
+    """, path="mxnet_tpu/io/fixture.py", select=["resource-leak"])
+    assert issues == []
+
+
+# --------------------------------------------- review-found regressions
+def test_two_shard_map_sites_in_one_function_both_checked():
+    """Probe-node id reuse must not alias two shard_map bodies (the
+    resolve cache only keys real tree nodes)."""
+    issues = run("""
+        from jax import lax
+        from jax.sharding import Mesh
+        from jax.experimental.shard_map import shard_map
+
+        def f(x, devices):
+            mesh_a = Mesh(devices, axis_names=("dp",))
+            mesh_b = Mesh(devices, axis_names=("tp",))
+
+            def body_a(x):
+                return lax.psum(x, "zz")
+
+            def body_b(x):
+                return lax.psum(x, "dp")
+
+            y = shard_map(body_a, mesh=mesh_a, in_specs=None,
+                          out_specs=None)(x)
+            return shard_map(body_b, mesh=mesh_b, in_specs=None,
+                             out_specs=None)(y)
+    """, select=["collective-soundness"])
+    msgs = [i.message for i in issues]
+    assert len(issues) == 2, msgs
+    assert any("'zz'" in m for m in msgs)       # body_a vs mesh_a
+    assert any("'dp'" in m for m in msgs)       # body_b vs tp-only mesh
+
+
+def test_jit_retrace_constructor_arg_mapping():
+    """Class(...) calls bind __init__'s implicit self: positional arg 0
+    must map to the first real parameter."""
+    issues = run("""
+        import jax
+
+        class Sink:
+            def __init__(self, cfg):
+                self.v = cfg.asnumpy()
+
+        @jax.jit
+        def f(x):
+            return Sink(x)
+    """, select=["jit-retrace"])
+    assert [(i.pass_id, i.line) for i in issues] == [("jit-retrace", 10)]
+
+
+def test_collective_under_while_loop_on_per_device_carry():
+    issues = run("""
+        from jax import lax
+        from jax.sharding import Mesh
+        from jax.experimental.shard_map import shard_map
+
+        def keep_going(c):
+            return c[0] > 0
+
+        def step(c):
+            return lax.psum(c, "dp")
+
+        def f(x, devices):
+            mesh = Mesh(devices, axis_names=("dp",))
+
+            def body(x):
+                return lax.while_loop(keep_going, step, x)
+
+            return shard_map(body, mesh=mesh, in_specs=None,
+                             out_specs=None)(x)
+    """, select=["collective-soundness"])
+    assert ids(issues) == ["collective-soundness"]
+    assert "while_loop" in issues[0].message
+
+
+def test_collective_while_loop_uniform_init_quiet():
+    issues = run("""
+        from jax import lax
+        from jax.sharding import Mesh
+        from jax.experimental.shard_map import shard_map
+
+        def keep_going(c):
+            return c < 8
+
+        def step(c):
+            return lax.psum(c, "dp")
+
+        def f(x, devices):
+            mesh = Mesh(devices, axis_names=("dp",))
+
+            def body(x):
+                total = lax.psum(x.sum(), "dp")     # uniform carry
+                return lax.while_loop(keep_going, step, total)
+
+            return shard_map(body, mesh=mesh, in_specs=None,
+                             out_specs=None)(x)
+    """, select=["collective-soundness"])
+    assert issues == []
+
+
+def test_jit_retrace_lambda_param_shadows_traced_name():
+    issues = run("""
+        import jax
+
+        @jax.jit
+        def f(x, ks):
+            order = sorted(ks, key=lambda x: float(x))
+            return x
+    """, select=["jit-retrace"])
+    # the lambda's own x shadows the traced param: no finding
+    assert [i for i in issues if "float" in i.message] == []
+
+
+def test_comprehension_target_keeps_iter_taint():
+    g = graph_of({
+        "pkg/m.py": """
+            def drain(outs):
+                return [o.asnumpy() for o in outs]
+        """,
+    })
+    s = build_summaries(g)
+    assert 0 in s["pkg.m.drain"].sync_params
+
+
+def test_subscript_index_and_enumerate_counter_do_not_taint():
+    """The FasterRCNN anchor-generator shape: host tables indexed by a
+    loop counter feeding np.array must not be blamed on the traced
+    input (indexing a host tuple by a tracer raises regardless)."""
+    issues = run("""
+        import jax
+        import numpy as np
+
+        class Anchors:
+            def level(self, lvl, H, W):
+                size = self.sizes[lvl]
+                return np.array([size * r for r in self.ratios])
+
+        class Net:
+            def _flat(self, levels):
+                return np.concatenate(
+                    [self.anchors.level(i, f.shape[2], f.shape[3])
+                     for i, f in enumerate(levels)])
+
+            def hybrid_forward(self, F, x):
+                levels = self.features(x)
+                return self._flat(levels)
+    """, select=["jit-retrace"])
+    assert issues == []
+
+
+def test_kwonly_param_summary_and_mapping():
+    issues = run("""
+        import jax
+
+        def send(*, arr):
+            return arr.asnumpy()
+
+        @jax.jit
+        def f(x):
+            return send(arr=x)
+    """, select=["jit-retrace"])
+    assert [(i.pass_id, i.line) for i in issues] == [("jit-retrace", 9)]
+
+
+def test_shape_based_predicate_is_uniform_not_divergent():
+    issues = run("""
+        from jax import lax
+        from jax.sharding import Mesh
+        from jax.experimental.shard_map import shard_map
+
+        def f(x, devices):
+            mesh = Mesh(devices, axis_names=("dp",))
+
+            def body(x):
+                n = x.shape[0]
+                if n > 1:
+                    return lax.psum(x, "dp")
+                return x
+
+            return shard_map(body, mesh=mesh, in_specs=None,
+                             out_specs=None)(x)
+    """, select=["collective-soundness"])
+    assert issues == []
+
+
+def test_mixed_collective_expression_keeps_shard_taint():
+    """`lax.psum(x, a) + x` still carries the raw shard: divergence
+    through it must flag (only an exact collective call is uniform)."""
+    issues = run("""
+        from jax import lax
+        from jax.sharding import Mesh
+        from jax.experimental.shard_map import shard_map
+
+        def f(x, devices):
+            mesh = Mesh(devices, axis_names=("dp",))
+
+            def body(x):
+                y = lax.psum(x, "dp") + x
+                flag = y.sum() > 0
+                if flag:
+                    return lax.psum(x, "dp")
+                return y
+
+            return shard_map(body, mesh=mesh, in_specs=None,
+                             out_specs=None)(x)
+    """, select=["collective-soundness"])
+    assert ids(issues) == ["collective-soundness"]
+
+
+def test_divergence_anchor_not_swallowed_by_inner_suppression():
+    """A suppression for a DIFFERENT finding inside the if-body must not
+    swallow the divergence finding (anchored to the collective, and the
+    suppressed line is another statement)."""
+    issues = run("""
+        from jax import lax
+        from jax.sharding import Mesh
+        from jax.experimental.shard_map import shard_map
+
+        def f(x, devices):
+            mesh = Mesh(devices, axis_names=("dp",))
+
+            def body(x):
+                if x.sum() > 0:
+                    # mxlint: disable=collective-soundness (fill-drain)
+                    x = lax.ppermute(x, "dp", [(0, 1)])
+                    return lax.psum(x, "dp")
+                return x
+
+            return shard_map(body, mesh=mesh, in_specs=None,
+                             out_specs=None)(x)
+    """, select=["collective-soundness"])
+    # the perm finding is suppressed; the divergence finding survives
+    assert len(issues) >= 1
+    assert all("per-device" in i.message for i in issues)
+
+
+def test_resource_leak_bare_name_with_statement_is_release():
+    issues = run("""
+        def g(p):
+            f = open(p)
+            with f:
+                return f.read()
+    """, path="mxnet_tpu/io/fixture.py", select=["resource-leak"])
+    assert issues == []
+
+
+def test_unbound_method_call_arg_mapping():
+    """Batcher.run(b, x) is unbound: arg 0 is the receiver, arg 1 the
+    payload — the escape must blame x, not b."""
+    issues = run("""
+        import jax
+
+        class Batcher:
+            def run(self, xs, tag):
+                return xs.asnumpy()
+
+        @jax.jit
+        def f(b, x, t):
+            return Batcher.run(b, x, t)
+    """, select=["jit-retrace"])
+    assert len(issues) == 1
+    assert "'x'" in issues[0].message
+
+
+def test_relative_import_in_package_init_resolves():
+    """A helper re-exported through ``pkg/__init__.py`` (relative
+    import) still resolves from a cross-package call site — the helper
+    itself is NOT a serving dispatch surface, so the only possible
+    finding is the interprocedural one at the ops call site."""
+    issues = run_many({
+        "mxnet_tpu/serving/convert.py": """
+            def collect_outs(outs):
+                return [o.asnumpy() for o in outs]
+        """,
+        "mxnet_tpu/serving/__init__.py": """
+            from .convert import collect_outs
+        """,
+        "mxnet_tpu/ops/impl.py": """
+            from mxnet_tpu.serving import collect_outs
+
+            def op_impl(x):
+                return collect_outs(x)
+        """,
+    }, select=["host-sync"])
+    assert [(i.pass_id, i.path) for i in issues] == \
+        [("host-sync", "mxnet_tpu/ops/impl.py")]
+
+
+def test_dotted_import_module_call_resolves():
+    g = graph_of({
+        "pkg/helpers.py": """
+            def f(x):
+                return x
+        """,
+        "pkg/user.py": """
+            import pkg.helpers
+
+            def g(x):
+                return pkg.helpers.f(x)
+        """,
+    })
+    assert {s.callee.qname for s in g.calls["pkg.user.g"]} == \
+        {"pkg.helpers.f"}
+
+
+def test_switch_with_branch_list_divergence():
+    issues = run("""
+        from jax import lax
+        from jax.sharding import Mesh
+        from jax.experimental.shard_map import shard_map
+
+        def reduce_it(v):
+            return lax.psum(v, "dp")
+
+        def keep(v):
+            return v
+
+        def f(x, devices):
+            mesh = Mesh(devices, axis_names=("dp",))
+
+            def body(x):
+                return lax.switch(lax.axis_index("dp"),
+                                  [reduce_it, keep], x)
+
+            return shard_map(body, mesh=mesh, in_specs=None,
+                             out_specs=None)(x)
+    """, select=["collective-soundness"])
+    assert ids(issues) == ["collective-soundness"]
+    assert "switch" in issues[0].message
+
+
+def test_nested_tainted_ifs_report_once():
+    issues = run("""
+        from jax import lax
+        from jax.sharding import Mesh
+        from jax.experimental.shard_map import shard_map
+
+        def f(x, devices):
+            mesh = Mesh(devices, axis_names=("dp",))
+
+            def body(x):
+                if x.sum() > 0:
+                    if x.sum() > 1:
+                        return lax.psum(x, "dp")
+                return x
+
+            return shard_map(body, mesh=mesh, in_specs=None,
+                             out_specs=None)(x)
+    """, select=["collective-soundness"])
+    assert len(issues) == 1
+
+
+def test_unbound_dotted_method_call_arg_mapping():
+    """m.Batcher.run(b, x) through an import alias is unbound too — the
+    dotted receiver must not shift the arg map and drop x."""
+    issues = run_many({
+        "pkg/helper.py": """
+            class Batcher:
+                def run(self, xs, tag):
+                    return xs.asnumpy()
+        """,
+        "pkg/main.py": """
+            import jax
+            from pkg import helper as m
+
+            @jax.jit
+            def f(b, x, t):
+                return m.Batcher.run(b, x, t)
+        """,
+    }, select=["jit-retrace"])
+    assert len(issues) == 1
+    assert "'x'" in issues[0].message
+
+
+def test_param_rebound_to_collective_result_is_uniform():
+    """x = lax.psum(x, axis) rebinds the shard param to the uniform
+    reduction — branching on it afterwards is not a divergence."""
+    issues = run("""
+        from jax import lax
+        from jax.sharding import Mesh
+        from jax.experimental.shard_map import shard_map
+
+        def f(x, y, devices):
+            mesh = Mesh(devices, axis_names=("dp",))
+
+            def body(x, y):
+                x = lax.psum(x, "dp")
+                if x[0] > 0:
+                    return lax.psum(y, "dp")
+                return y
+
+            return shard_map(body, mesh=mesh, in_specs=None,
+                             out_specs=None)(x, y)
+    """, select=["collective-soundness"])
+    assert issues == []
+
+
+def test_wash_is_line_bounded_not_retroactive():
+    """A straight-line uniform rebind AFTER a divergent `if` must not
+    retroactively un-taint the predicate — the `if` read the raw
+    shard, and the collective under it is a real deadlock."""
+    issues = run("""
+        from jax import lax
+        from jax.sharding import Mesh
+        from jax.experimental.shard_map import shard_map
+
+        def f(x, devices):
+            mesh = Mesh(devices, axis_names=("dp",))
+
+            def body(x):
+                if x.sum() > 0:
+                    k = lax.pmax(x, "dp")
+                x = lax.psum(x, "dp")
+                return x
+
+            return shard_map(body, mesh=mesh, in_specs=None,
+                             out_specs=None)(x)
+    """, select=["collective-soundness"])
+    assert len(issues) == 1
+    assert "per-device" in issues[0].message
+
+
+def test_axis_index_under_divergence_is_not_a_deadlock():
+    """lax.axis_index exchanges nothing — calling it under a per-device
+    branch cannot deadlock (its axis name is still validated)."""
+    issues = run("""
+        from jax import lax
+        from jax.sharding import Mesh
+        from jax.experimental.shard_map import shard_map
+
+        def f(x, devices):
+            mesh = Mesh(devices, axis_names=("dp",))
+
+            def body(x):
+                if x.sum() > 0:
+                    return x * lax.axis_index("dp")
+                return x
+
+            return shard_map(body, mesh=mesh, in_specs=None,
+                             out_specs=None)(x)
+    """, select=["collective-soundness"])
+    assert issues == []
+
+
+def test_axis_index_wrong_axis_name_still_flagged():
+    issues = run("""
+        from jax import lax
+        from jax.sharding import Mesh
+        from jax.experimental.shard_map import shard_map
+
+        def f(x, devices):
+            mesh = Mesh(devices, axis_names=("dp",))
+
+            def body(x):
+                return x * lax.axis_index("mp")
+
+            return shard_map(body, mesh=mesh, in_specs=None,
+                             out_specs=None)(x)
+    """, select=["collective-soundness"])
+    assert len(issues) == 1
+    assert "mp" in issues[0].message
+
+
+def test_param_and_local_shadowing_block_module_resolution():
+    """A name bound as a parameter or local must not resolve to a
+    same-named module-level function — calls through it stay opaque."""
+    issues = run("""
+        import jax
+        import numpy as np
+
+        def materialize(v):
+            return np.asarray(v)
+
+        @jax.jit
+        def f(x, materialize):
+            return materialize(x)
+
+        @jax.jit
+        def g(x):
+            materialize = lambda v: v * 2
+            return materialize(x)
+    """, select=["jit-retrace"])
+    assert issues == []
+
+
+def test_switch_operands_are_not_branches():
+    """lax.switch data operands (args[2:]) must not be scanned as
+    branch callables: an operand whose name collides with a collective-
+    calling module function is not a divergence."""
+    issues = run("""
+        from jax import lax
+        from jax.sharding import Mesh
+        from jax.experimental.shard_map import shard_map
+
+        def helper(v):
+            return lax.psum(v, "dp")
+
+        def f(x, devices):
+            mesh = Mesh(devices, axis_names=("dp",))
+
+            def body(x):
+                return lax.switch(x[0].astype(int),
+                                  [lambda o: o, lambda o: -o],
+                                  helper)
+
+            return shard_map(body, mesh=mesh, in_specs=None,
+                             out_specs=None)(x)
+    """, select=["collective-soundness"])
+    assert issues == []
+
+
+def test_external_import_is_opaque_not_unique_name_matched():
+    """`from external_lib import convert` binds convert to an external
+    module — the call must stay opaque, not resolve to an unrelated
+    same-named project function."""
+    issues = run_many({
+        "mxnet_tpu/utils.py": """
+            def convert(y):
+                return y.asnumpy()
+        """,
+        "mxnet_tpu/ops/impl.py": """
+            import jax
+            from external_lib import convert
+
+            @jax.jit
+            def op_impl(x):
+                return convert(x)
+        """,
+    }, select=["jit-retrace", "host-sync"])
+    assert issues == []
+
+
+def test_bare_project_function_named_item_is_not_a_sync():
+    """A project helper named `item` called bare is not `.item()` — the
+    method-style sinks need a receiver."""
+    issues = run_many({
+        "mxnet_tpu/util/fmt.py": """
+            def item(n):
+                return {"name": n}
+
+            def fmt(n):
+                return item(n)
+        """,
+        "mxnet_tpu/ops/impl.py": """
+            from mxnet_tpu.util.fmt import fmt
+
+            def op_impl(n):
+                return fmt(n)
+        """,
+    }, select=["host-sync"])
+    assert issues == []
+
+
+def test_constant_arg_param_is_uniform_not_divergent():
+    """helper(x, True): a literal config flag is identical on every
+    device — branching on it around a collective is not a divergence."""
+    issues = run("""
+        from jax import lax
+        from jax.sharding import Mesh
+        from jax.experimental.shard_map import shard_map
+
+        def helper(x, reduce_it):
+            if reduce_it:
+                return lax.psum(x, "dp")
+            return x
+
+        def f(x, devices):
+            mesh = Mesh(devices, axis_names=("dp",))
+
+            def body(x):
+                return helper(x, True)
+
+            return shard_map(body, mesh=mesh, in_specs=None,
+                             out_specs=None)(x)
+    """, select=["collective-soundness"])
+    assert issues == []
+
+
+def test_host_uniform_closure_scalar_arg_is_not_divergent():
+    """helper(x, n) where n is a host config int in the enclosing
+    scope: the predicate `n > 1` is identical on every device."""
+    issues = run("""
+        from jax import lax
+        from jax.sharding import Mesh
+        from jax.experimental.shard_map import shard_map
+
+        def helper(x, n):
+            if n > 1:
+                return lax.psum(x, "dp")
+            return x
+
+        def f(x, n_stages, devices):
+            mesh = Mesh(devices, axis_names=("dp",))
+
+            def body(x):
+                return helper(x, n_stages)
+
+            return shard_map(body, mesh=mesh, in_specs=None,
+                             out_specs=None)(x)
+    """, select=["collective-soundness"])
+    assert issues == []
+
+
+def test_shard_arg_to_helper_still_divergent():
+    """Negative control for the uniform-arg exemption: the shard itself
+    forwarded into the helper keeps the divergence finding."""
+    issues = run("""
+        from jax import lax
+        from jax.sharding import Mesh
+        from jax.experimental.shard_map import shard_map
+
+        def helper(x, g):
+            if g.sum() > 0:
+                return lax.psum(x, "dp")
+            return x
+
+        def f(x, devices):
+            mesh = Mesh(devices, axis_names=("dp",))
+
+            def body(x):
+                return helper(x, x)
+
+            return shard_map(body, mesh=mesh, in_specs=None,
+                             out_specs=None)(x)
+    """, select=["collective-soundness"])
+    assert len(issues) == 1
+    assert "per-device" in issues[0].message
+
+
+def test_raise_with_handler_close_is_not_an_early_exit():
+    """A raise inside a try whose except handler closes the handle
+    reaches the close on that path — no leak."""
+    issues = run("""
+        def g(p):
+            f = open(p)
+            try:
+                data = f.read()
+                if not data:
+                    raise ValueError("empty")
+            except ValueError:
+                f.close()
+                raise
+            f.close()
+            return data
+    """, path="mxnet_tpu/io/fixture.py", select=["resource-leak"])
+    assert issues == []
+
+
+def test_resource_leak_ifexp_opener_closed_in_finally_is_quiet():
+    issues = run("""
+        def g(p, cond):
+            f = open(p) if cond else None
+            try:
+                return f.read() if f else ""
+            finally:
+                if f:
+                    f.close()
+    """, path="mxnet_tpu/io/fixture.py", select=["resource-leak"])
+    assert issues == []
+
+
+# ------------------------------------------------------------ repo gates
+def test_tools_tree_is_clean():
+    """tools/ (the linter itself included) is clean under every pass —
+    the mxnet_tpu/ gate lives in test_mxlint.py; together they pin the
+    ISSUE-4 acceptance `python -m tools.mxlint mxnet_tpu/ tools/` == 0."""
+    issues = lint_paths([os.path.join(REPO, "tools")])
+    assert issues == [], "\n".join(str(i) for i in issues)
+
+
+def test_cli_json_format_and_bad_path():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.mxlint", "--format", "json",
+         "tools/mxlint/callgraph.py"],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 0
+    assert "mxlint: clean" not in proc.stdout      # machine-pure output
+    # a bad path mixed with a good one is still a hard error
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.mxlint", "--format", "json",
+         "tools/mxlint/callgraph.py", "definitely_not_here/"],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 2
+    assert "not found" in proc.stderr
+
+
+def test_cli_json_findings_parse(tmp_path):
+    bad = tmp_path / "ops" / "x.py"
+    bad.parent.mkdir()
+    bad.write_text("import jax\n\n"
+                   "def op_impl(x):\n"
+                   "    return jax.block_until_ready(x)\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.mxlint", "--format", "json",
+         str(tmp_path)],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 1
+    objs = [json.loads(line) for line in proc.stdout.splitlines()]
+    assert len(objs) == 1
+    assert objs[0]["pass"] == "host-sync"
+    assert objs[0]["line"] == 4
+    assert set(objs[0]) == {"pass", "file", "line", "col", "message"}
+
+
+def test_shuffling_collective_result_stays_per_device():
+    """A ppermute result differs on every device — a predicate derived
+    from it must keep the divergence check armed (only psum-family /
+    all_gather reductions are axis-uniform and wash the taint)."""
+    issues = run("""
+        from jax import lax
+        from jax.sharding import Mesh
+        from jax.experimental.shard_map import shard_map
+
+        def f(x, devices):
+            mesh = Mesh(devices, axis_names=("dp",))
+
+            def body(x):
+                r = lax.ppermute(
+                    x, "dp", perm=[(j, (j + 1) % 4) for j in range(4)])
+                if r.sum() > 0:
+                    return lax.psum(x, "dp")
+                return r
+
+            return shard_map(body, mesh=mesh, in_specs=None,
+                             out_specs=None)(x)
+    """, select=["collective-soundness"])
+    assert ids(issues) == ["collective-soundness"]
+
+
+def test_function_local_import_does_not_leak_to_module_scope():
+    """A `from x import f` inside one function must not shadow the
+    module-level import of the same name for every other function in
+    the file — and must still resolve inside its own function."""
+    g = graph_of({
+        "pkg/__init__.py": "",
+        "pkg/utils.py": """
+            def convert(v):
+                return v
+        """,
+        "pkg/other.py": """
+            def convert(v):
+                return v.asnumpy()
+        """,
+        "pkg/m.py": """
+            from pkg.utils import convert
+
+            def local_user(v):
+                from pkg.other import convert
+                return convert(v)
+
+            def module_user(v):
+                return convert(v)
+        """,
+    })
+    (site,) = g.calls["pkg.m.local_user"]
+    assert site.callee.qname == "pkg.other.convert"
+    (site,) = g.calls["pkg.m.module_user"]
+    assert site.callee.qname == "pkg.utils.convert"
+
+
+def test_match_statement_arms_are_analyzed():
+    """Sinks inside match-case arms must be visible to the dataflow
+    walk (jit-retrace) and to resource-leak's statement scan."""
+    issues = run("""
+        import jax
+
+        @jax.jit
+        def f(x, mode):
+            match mode:
+                case "a":
+                    return x.asnumpy()
+                case _:
+                    return x
+    """, select=["jit-retrace"])
+    assert [(i.pass_id, i.line) for i in issues] == [("jit-retrace", 8)]
+    issues = run("""
+        def g(p, mode):
+            match mode:
+                case "a":
+                    f = open(p)
+                    return f.read()
+                case _:
+                    return None
+    """, select=["resource-leak"])
+    assert ids(issues) == ["resource-leak"]
+
+
+def test_bare_project_helper_named_like_collective_not_misreported():
+    """A plain project function that happens to be NAMED psum is not a
+    lax collective: calling it under a per-device `if` must not yield a
+    divergence finding (its summary speaks for what it reaches)."""
+    issues = run("""
+        from jax import lax
+        from jax.sharding import Mesh
+        from jax.experimental.shard_map import shard_map
+
+        def psum(a):
+            return a + 1
+
+        def f(x, devices):
+            mesh = Mesh(devices, axis_names=("dp",))
+
+            def body(x):
+                if x[0] > 0:
+                    return psum(x)
+                return x
+
+            return shard_map(body, mesh=mesh, in_specs=None,
+                             out_specs=None)(x)
+    """, select=["collective-soundness"])
+    assert issues == []
+
+
+def test_while_loop_keyword_init_val_flagged():
+    """`lax.while_loop(cond, step, init_val=x)` with a shard-derived
+    init is the same deadlock shape as the positional form."""
+    issues = run("""
+        from jax import lax
+        from jax.sharding import Mesh
+        from jax.experimental.shard_map import shard_map
+
+        def f(x, devices):
+            mesh = Mesh(devices, axis_names=("dp",))
+
+            def body(x):
+                def cond(c):
+                    return c.sum() > 0
+
+                def step(c):
+                    return lax.psum(c, "dp")
+
+                return lax.while_loop(cond, step, init_val=x)
+
+            return shard_map(body, mesh=mesh, in_specs=None,
+                             out_specs=None)(x)
+    """, select=["collective-soundness"])
+    assert ids(issues) == ["collective-soundness"]
+
+
+def test_bare_helper_named_psum_does_not_wash_divergence_taint():
+    """`x = psum(x, "dp")` calling a bare project helper named psum is
+    NOT a uniform reduction — the per-device taint survives and the
+    following divergent collective is still flagged."""
+    issues = run("""
+        from jax import lax
+        from jax.sharding import Mesh
+        from jax.experimental.shard_map import shard_map
+
+        def psum(a, axis):
+            return a + 1
+
+        def f(x, devices):
+            mesh = Mesh(devices, axis_names=("dp",))
+
+            def body(x):
+                x = psum(x, "dp")
+                if x.sum() > 0:
+                    return lax.psum(x, "dp")
+                return x
+
+            return shard_map(body, mesh=mesh, in_specs=None,
+                             out_specs=None)(x)
+    """, select=["collective-soundness"])
+    assert ids(issues) == ["collective-soundness"]
+
+
+def test_host_sync_direct_item_flagged_like_helper_routed():
+    """Inlining a flagged `.item()` helper must not silence the
+    finding: the direct method call is the same untracked sync."""
+    direct = run_many({"mxnet_tpu/ops/x.py": """
+        def op_impl(arr):
+            return arr.item()
+    """}, select=["host-sync"])
+    assert ids(direct) == ["host-sync"]
+    routed = run_many({"mxnet_tpu/ops/x.py": """
+        def _get(arr):
+            return arr.item()
+    """, "mxnet_tpu/serving/batcher.py": """
+        from mxnet_tpu.ops.x import _get
+
+        class DynamicBatcher:
+            def _next_batch(self, arr):
+                return _get(arr)
+    """}, select=["host-sync"])
+    assert "host-sync" in ids(routed)

@@ -1,14 +1,20 @@
 """mxlint: codebase-specific static analysis for mxnet_tpu.
 
-AST-only (never imports the code under analysis).  Five passes, each
-targeting a concurrency/retrace/observability bug class this repo has
-already shipped fixes for — see docs/static_analysis.md for the
-catalogue, suppression syntax, and the companion runtime sanitizer
-(``MXNET_ENGINE_SANITIZE=1``).
+AST-only (never imports the code under analysis).  Seven passes, each
+targeting a concurrency/retrace/collective/observability bug class this
+repo has already shipped fixes for — see docs/static_analysis.md for
+the catalogue, suppression syntax, and the companion runtime sanitizer
+(``MXNET_ENGINE_SANITIZE=1``).  Since ISSUE-4 the suite is
+*interprocedural*: a project-wide call graph (``callgraph.py``) and
+per-function dataflow summaries iterated to fixpoint (``dataflow.py``)
+let ``jit-retrace``/``host-sync`` flag a ``.asnumpy()`` buried helpers
+deep at the jit/dispatch call site, and power the ``collective-
+soundness`` and ``resource-leak`` passes over the parallel layer.
 
 CLI::
 
-    python -m tools.mxlint mxnet_tpu/            # lint the tree
+    python -m tools.mxlint mxnet_tpu/ tools/     # lint the tree
+    python -m tools.mxlint --format json mxnet_tpu/   # CI annotation
     python -m tools.mxlint --list-passes
 
 API (what tests/test_mxlint.py uses)::

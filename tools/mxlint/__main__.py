@@ -1,10 +1,13 @@
 """CLI: ``python -m tools.mxlint [paths...]``.
 
 Exit codes: 0 clean, 1 findings, 2 usage error.  CI runs
-``python -m tools.mxlint mxnet_tpu/`` as part of the ``sanity_lint``
-job (ci/runtime_functions.sh).
+``python -m tools.mxlint --format json mxnet_tpu/ tools/`` as part of
+the ``sanity_lint`` job (ci/runtime_functions.sh): one JSON object per
+finding per line, so the CI harness can annotate changed lines without
+parsing the human format.
 """
 import argparse
+import json
 import sys
 
 from . import PASSES, lint_paths
@@ -25,6 +28,12 @@ def main(argv=None):
     ap.add_argument("-q", "--quiet", action="store_true",
                     help="suppress the per-issue lines, print the "
                          "summary only")
+    ap.add_argument("--format", choices=("human", "json"),
+                    default="human",
+                    help="output format: 'human' (default, "
+                         "path:line:col: [pass] message) or 'json' "
+                         "(one finding object per line for CI "
+                         "annotation)")
     args = ap.parse_args(argv)
 
     if args.list_passes:
@@ -43,17 +52,26 @@ def main(argv=None):
 
     paths = args.paths or ["mxnet_tpu"]
     try:
-        if not iter_py_files(paths):
-            print(f"mxlint: no python files under {', '.join(paths)}",
-                  file=sys.stderr)
-            return 2
+        files = iter_py_files(paths)
     except FileNotFoundError as e:
         print(e, file=sys.stderr)
         return 2
-    issues = lint_paths(paths, select=select)
+    if not files:
+        print(f"mxlint: no python files under {', '.join(paths)}",
+              file=sys.stderr)
+        return 2
+    # hand the expanded list through so the tree is walked once
+    issues = lint_paths(files, select=select)
     if not args.quiet:
         for issue in issues:
-            print(issue)
+            if args.format == "json":
+                print(json.dumps({"pass": issue.pass_id,
+                                  "file": issue.path,
+                                  "line": issue.line,
+                                  "col": issue.col,
+                                  "message": issue.message}))
+            else:
+                print(issue)
     if issues:
         by_pass = {}
         for i in issues:
@@ -62,7 +80,8 @@ def main(argv=None):
         print(f"mxlint: {len(issues)} issue(s) ({detail})",
               file=sys.stderr)
         return 1
-    print("mxlint: clean")
+    if args.format != "json":       # keep json output machine-pure
+        print("mxlint: clean")
     return 0
 
 

@@ -1,0 +1,519 @@
+"""Project-wide symbol table and call graph (docs/static_analysis.md §interprocedural).
+
+Per-function passes go blind the moment a bug routes through a helper;
+this module gives every pass the project's call structure so the
+dataflow summaries in :mod:`.dataflow` can be iterated to fixpoint and
+violations flagged at the *call site* that makes them wrong (the jit
+boundary, the dispatch loop) instead of only at the line that executes
+them.
+
+Resolution is deliberately simple and syntactic — no inheritance MRO,
+no duck typing — because the analyses built on top are "stay quiet when
+unsure" lints:
+
+- lexically nested defs (innermost enclosing scope first);
+- module-level functions of the same module;
+- ``from x import f`` / ``import x as m`` aliases (relative imports
+  resolved against the file's dotted path);
+- ``self.method()`` within the defining class, plus single-level base
+  classes resolvable in the project;
+- **class-attribute tracking**: ``self._batcher = DynamicBatcher(...)``
+  in any method makes ``self._batcher.run(...)`` resolve to
+  ``DynamicBatcher.run`` (the serving wiring shape);
+- local instance tracking: ``b = DynamicBatcher(...); b.run(...)``;
+- a project-unique bare name as the last resort (exactly one function
+  with that name in the whole scanned set).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from .core import SourceFile, dotted_name
+
+__all__ = ["FunctionInfo", "CallSite", "CallGraph"]
+
+
+def module_of(path: str) -> str:
+    p = path.replace("\\", "/")
+    if p.endswith(".py"):
+        p = p[:-3]
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    return p.lstrip("./").replace("/", ".")
+
+
+class FunctionInfo:
+    """One function or method definition."""
+
+    __slots__ = ("qname", "node", "src", "module", "cls", "params",
+                 "n_positional", "parent", "is_method")
+
+    def __init__(self, qname, node, src, module, cls, parent):
+        self.qname = qname
+        self.node = node
+        self.src = src
+        self.module = module
+        self.cls = cls                  # owning _ClassInfo or None
+        self.parent = parent            # enclosing FunctionInfo or None
+        a = node.args
+        positional = [p.arg for p in list(a.posonlyargs) + list(a.args)]
+        self.n_positional = len(positional)
+        self.params = positional + [p.arg for p in a.kwonlyargs]
+        self.is_method = cls is not None and bool(self.params) \
+            and self.params[0] in ("self", "cls")
+
+    def param_index(self, name: str) -> Optional[int]:
+        try:
+            return self.params.index(name)
+        except ValueError:
+            return None
+
+    def __repr__(self):
+        return f"FunctionInfo({self.qname})"
+
+
+class _ClassInfo:
+    __slots__ = ("qname", "name", "node", "module", "methods",
+                 "attr_types", "bases")
+
+    def __init__(self, qname, name, node, module):
+        self.qname = qname
+        self.name = name
+        self.node = node
+        self.module = module
+        self.methods: Dict[str, FunctionInfo] = {}
+        self.attr_types: Dict[str, str] = {}    # self.x -> class qname
+        self.bases: List[str] = []              # unresolved base names
+
+
+class CallSite:
+    """One resolved call edge: ``caller`` invokes ``callee`` at ``node``.
+
+    ``arg_map`` maps *callee* parameter index -> the argument AST node
+    supplied here (bound receiver accounted for; unmappable *args /
+    **kwargs positions are simply absent).
+    """
+
+    __slots__ = ("caller", "callee", "node", "arg_map")
+
+    def __init__(self, caller, callee, node, arg_map):
+        self.caller = caller
+        self.callee = callee
+        self.node = node
+        self.arg_map: Dict[int, ast.AST] = arg_map
+
+
+class CallGraph:
+    def __init__(self, files: List[SourceFile]):
+        self.files = files
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.classes: Dict[str, _ClassInfo] = {}
+        # module -> top-level name -> qname (functions and classes)
+        self.module_defs: Dict[str, Dict[str, str]] = {}
+        # module -> alias -> (module, name|None)
+        self.imports: Dict[str, Dict[str, tuple]] = {}
+        # function qname -> alias -> (module, name|None): a local
+        # `from x import f` binds only in that function (and its
+        # nested defs) — folding it into the module table would let it
+        # shadow a genuine module-level import for the whole file
+        self.fn_imports: Dict[str, Dict[str, tuple]] = {}
+        # bare function name -> [qnames]
+        self.by_name: Dict[str, List[str]] = {}
+        # caller qname -> [CallSite]
+        self.calls: Dict[str, List[CallSite]] = {}
+        self._by_node: Dict[int, FunctionInfo] = {}
+        self._resolve_cache: Dict[tuple, Optional[FunctionInfo]] = {}
+        self._local_types: Dict[str, Dict[str, _ClassInfo]] = {}
+        self._bound_cache: Dict[str, frozenset] = {}
+
+        for src in files:
+            self._index_file(src)
+        for cls in self.classes.values():
+            self._track_attr_types(cls)
+        for fn in list(self.functions.values()):
+            self.calls[fn.qname] = list(self._resolve_calls(fn))
+
+    # ----------------------------------------------------------- indexing
+    def _index_file(self, src: SourceFile):
+        module = module_of(src.path)
+        defs = self.module_defs.setdefault(module, {})
+        imports = self.imports.setdefault(module, {})
+
+        # module_of collapses pkg/__init__.py to pkg, so a relative
+        # import there strips one level fewer than in a plain module
+        is_pkg = src.path.replace("\\", "/").endswith("__init__.py")
+
+        def walk(node, scope_q, cls, parent_fn):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.Import, ast.ImportFrom)):
+                    # scope the alias: module level (incl. under
+                    # module-level if/try) vs function-local; a
+                    # class-body import binds a class attribute —
+                    # rare enough to stay quiet
+                    if parent_fn is not None:
+                        table = self.fn_imports.setdefault(
+                            parent_fn.qname, {})
+                    elif cls is None:
+                        table = imports
+                    else:
+                        continue
+                    self._record_import(child, table, module, is_pkg)
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    q = f"{scope_q}.{child.name}"
+                    info = FunctionInfo(q, child, src, module, cls,
+                                        parent_fn)
+                    self.functions[q] = info
+                    self._by_node[id(child)] = info
+                    self.by_name.setdefault(child.name, []).append(q)
+                    if cls is not None and parent_fn is None:
+                        cls.methods[child.name] = info
+                    if scope_q == module:
+                        defs[child.name] = q
+                    walk(child, q, None, info)
+                elif isinstance(child, ast.ClassDef):
+                    q = f"{scope_q}.{child.name}"
+                    cinfo = _ClassInfo(q, child.name, child, module)
+                    cinfo.bases = [dotted_name(b) for b in child.bases]
+                    self.classes[q] = cinfo
+                    if scope_q == module:
+                        defs[child.name] = q
+                    walk(child, q, cinfo, None)
+                else:
+                    walk(child, scope_q, cls, parent_fn)
+
+        walk(src.tree, module, None, None)
+
+    @staticmethod
+    def _record_import(stmt, table, module, is_pkg):
+        if isinstance(stmt, ast.Import):
+            for a in stmt.names:
+                if a.asname:
+                    table[a.asname] = (a.name, None)
+                else:
+                    # `import pkg.mod` binds the name `pkg`
+                    head = a.name.split(".")[0]
+                    table[head] = (head, None)
+            return
+        base = stmt.module or ""
+        if stmt.level:
+            strip = stmt.level - 1 if is_pkg else stmt.level
+            parts = module.split(".")
+            parts = parts[: len(parts) - strip] if strip else parts
+            base = ".".join(parts + ([stmt.module]
+                                     if stmt.module else []))
+        for a in stmt.names:
+            if a.name != "*":
+                table[a.asname or a.name] = (base, a.name)
+
+    def _track_attr_types(self, cls: _ClassInfo):
+        """``self.x = ClassName(...)`` anywhere in the class body binds
+        attribute ``x`` to ``ClassName`` for method resolution."""
+        for m in cls.methods.values():
+            for node in ast.walk(m.node):
+                if not (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)):
+                    continue
+                target_cls = self._class_of_ctor(node.value, cls.module)
+                if target_cls is None:
+                    continue
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute) \
+                            and isinstance(tgt.value, ast.Name) \
+                            and tgt.value.id == "self":
+                        cls.attr_types[tgt.attr] = target_cls.qname
+
+    def _class_of_ctor(self, call: ast.Call, module) -> Optional[_ClassInfo]:
+        q = self._lookup(dotted_name(call.func), module)
+        return self.classes.get(q) if q else None
+
+    # ---------------------------------------------------------- resolution
+    def _lookup(self, name: str, module: str,
+                _seen=None) -> Optional[str]:
+        """Resolve a possibly-dotted name in a module's namespace to a
+        project qname (function or class), chasing re-exports (``from
+        .batcher import run_batch`` in ``pkg/__init__.py`` makes
+        ``pkg.run_batch`` an alias for ``pkg.batcher.run_batch``)."""
+        if not name:
+            return None
+        if _seen is None:
+            _seen = set()
+        if (module, name) in _seen:     # circular re-export
+            return None
+        _seen.add((module, name))
+        head, _, rest = name.partition(".")
+        defs = self.module_defs.get(module, {})
+        imports = self.imports.get(module, {})
+        if head in defs:
+            q = defs[head]
+            return f"{q}.{rest}" if rest else q
+        if head in imports:
+            mod, orig = imports[head]
+            return self._resolve_alias(mod, orig, rest, _seen)
+        return None
+
+    def _resolve_alias(self, mod, orig, rest,
+                       _seen=None) -> Optional[str]:
+        """One import-table entry ``(mod, orig)`` + trailing attribute
+        path -> project qname (or None).  The single definition of
+        alias semantics, shared by module-level (_lookup) and
+        function-local (step 3.5) import resolution."""
+        if orig is None:                      # import x as m; m.f()
+            target = f"{mod}.{rest}" if rest else mod
+        else:
+            # covers both `from m import f` and `from pkg import
+            # helpers` followed by helpers.f(): pkg.helpers.f
+            base = f"{mod}.{orig}" if mod else orig
+            target = f"{base}.{rest}" if rest else base
+        q = self._qname_if_known(target)
+        if q:
+            return q
+        return self._chase(target, _seen if _seen is not None else set())
+
+    def _chase(self, dotted: str, _seen) -> Optional[str]:
+        """Resolve a dotted target whose literal qname is unknown by
+        finding its longest indexed-module prefix and resolving the
+        remainder in that module's namespace (re-export indirection)."""
+        parts = dotted.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            mod = ".".join(parts[:i])
+            if mod in self.module_defs:
+                return self._lookup(".".join(parts[i:]), mod, _seen)
+        return None
+
+    def _qname_if_known(self, q: str) -> Optional[str]:
+        if q in self.functions or q in self.classes:
+            return q
+        return None
+
+    def resolve_call(self, call: ast.Call,
+                     within: FunctionInfo) -> Optional[FunctionInfo]:
+        """Resolve ``call`` made inside ``within`` to a FunctionInfo, or
+        None when unknown (the analyses treat unknown as opaque).
+
+        Only real tree nodes may be cached: their ids are stable for the
+        life of the run, while a synthetic probe node's id can be reused
+        by the allocator — use :meth:`resolve_ref` for those.
+        """
+        key = (id(call), within.qname)
+        if key in self._resolve_cache:
+            return self._resolve_cache[key]
+        out = self._resolve_call_uncached(call, within)
+        self._resolve_cache[key] = out
+        return out
+
+    def resolve_ref(self, func_expr,
+                    within: FunctionInfo) -> Optional[FunctionInfo]:
+        """Resolve a bare function *reference* (a Name/Attribute passed
+        as a value, e.g. a shard_map body or a lax.cond branch) without
+        touching the id-keyed cache."""
+        probe = ast.Call(func=func_expr, args=[], keywords=[])
+        return self._resolve_call_uncached(probe, within)
+
+    def _resolve_call_uncached(self, call, within):
+        func = call.func
+        name = dotted_name(func)
+        if not name:
+            return None
+        head = name.split(".")[0]
+
+        # 1. lexically nested defs, innermost scope outward
+        scope = within
+        while scope is not None:
+            q = f"{scope.qname}.{head}"
+            info = self.functions.get(q)
+            if info is not None and "." not in name:
+                return info
+            scope = scope.parent
+
+        # 2. self.method() / cls.method() / self.attr.method()
+        if head in ("self", "cls") and within.cls is not None:
+            parts = name.split(".")
+            if len(parts) == 2:
+                return self._method_in(within.cls, parts[1])
+            if len(parts) == 3:
+                owner = self.classes.get(
+                    within.cls.attr_types.get(parts[1], ""))
+                if owner is not None:
+                    return self._method_in(owner, parts[2])
+            return None
+
+        # 3. local instance: b = ClassName(...); b.run()
+        if "." in name:
+            parts = name.split(".")
+            if len(parts) == 2:
+                owner = self._local_instance_type(parts[0], within)
+                if owner is not None:
+                    return self._method_in(owner, parts[1])
+
+        # 3.5 function-local import aliases, innermost scope outward —
+        # authoritative where bound: resolve to the project target or
+        # stay opaque (external import), never fall through to the
+        # module table or a bare-name match
+        scope = within
+        while scope is not None:
+            tab = self.fn_imports.get(scope.qname)
+            if tab and head in tab:
+                mod, orig = tab[head]
+                rest = name.partition(".")[2]
+                q = self._resolve_alias(mod, orig, rest)
+                if q in self.functions:
+                    return self.functions[q]
+                cinfo = self.classes.get(q)
+                if cinfo is not None:   # constructor call -> __init__
+                    return cinfo.methods.get("__init__")
+                return None
+            scope = scope.parent
+
+        # params and local assignments shadow the module namespace:
+        # `def f(x, materialize): materialize(x)` must NOT resolve to a
+        # same-named module-level function — unresolvable stays opaque
+        scope = within
+        while scope is not None:
+            if head in self._bound_names(scope):
+                return None
+            scope = scope.parent
+
+        # 4. module namespace (same module defs + import aliases)
+        q = self._lookup(name, within.module)
+        if q:
+            if q in self.functions:
+                return self.functions[q]
+            cinfo = self.classes.get(q)
+            if cinfo is not None:       # constructor call -> __init__
+                return cinfo.methods.get("__init__")
+
+        # an explicit module-level binding that did not resolve above —
+        # an import from an unindexed external module, or a module def
+        # that is not a project function — is authoritative: the call
+        # stays opaque rather than falling through to a name-match
+        if head in self.imports.get(within.module, {}) \
+                or head in self.module_defs.get(within.module, {}):
+            return None
+
+        # 5. project-unique bare name
+        if "." not in name:
+            cands = self.by_name.get(name, ())
+            if len(cands) == 1:
+                return self.functions[cands[0]]
+        return None
+
+    def _bound_names(self, fn: FunctionInfo) -> frozenset:
+        """Names bound inside ``fn``'s own body (params, assignment /
+        loop / with-as targets, except-handler names) — import aliases
+        excluded: those resolve through the module import table."""
+        names = self._bound_cache.get(fn.qname)
+        if names is None:
+            out, aliases = set(fn.params), set()
+            for n in self._local_nodes(fn.node):
+                if isinstance(n, ast.Name) \
+                        and isinstance(n.ctx, (ast.Store, ast.Del)):
+                    out.add(n.id)
+                elif isinstance(n, ast.ExceptHandler) and n.name:
+                    out.add(n.name)
+                elif isinstance(n, (ast.Import, ast.ImportFrom)):
+                    for a in n.names:
+                        aliases.add(a.asname or a.name.split(".")[0])
+            names = frozenset(out - aliases)
+            self._bound_cache[fn.qname] = names
+        return names
+
+    def _method_in(self, cls: _ClassInfo,
+                   meth: str) -> Optional[FunctionInfo]:
+        if meth in cls.methods:
+            return cls.methods[meth]
+        for base in cls.bases:          # single-level base resolution
+            bq = self._lookup(base, cls.module)
+            binfo = self.classes.get(bq) if bq else None
+            if binfo is not None and meth in binfo.methods:
+                return binfo.methods[meth]
+        return None
+
+    def _local_instance_type(self, var: str,
+                             within: FunctionInfo) -> Optional[_ClassInfo]:
+        types = self._local_types.get(within.qname)
+        if types is None:       # one walk per function, cached
+            types = {}
+            for node in ast.walk(within.node):
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call):
+                    cls = self._class_of_ctor(node.value, within.module)
+                    if cls is None:
+                        continue
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            types[t.id] = cls
+            self._local_types[within.qname] = types
+        return types.get(var)
+
+    # ----------------------------------------------------------- edges
+    def _resolve_calls(self, fn: FunctionInfo):
+        for node in self._local_nodes(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = self.resolve_call(node, fn)
+            if callee is None:
+                continue
+            yield CallSite(fn, callee, node,
+                           self.arg_map(node, callee))
+        return
+
+    @staticmethod
+    def _local_nodes(fn_node):
+        """Every node of a function's own body, not descending into
+        nested function/class definitions."""
+        stack = list(ast.iter_child_nodes(fn_node))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            yield n
+            stack.extend(ast.iter_child_nodes(n))
+
+    @staticmethod
+    def arg_map(call: ast.Call, callee: FunctionInfo) -> Dict[int, ast.AST]:
+        """Map callee param index -> argument node at this site."""
+        offset = 0
+        if callee.is_method and (isinstance(call.func, ast.Attribute)
+                                 or callee.node.name == "__init__"):
+            # bound receiver consumes param 0; Class(...) constructor
+            # calls bind self implicitly too
+            offset = 1
+            if isinstance(call.func, ast.Attribute) \
+                    and callee.cls is not None \
+                    and callee.params[0] != "cls" \
+                    and dotted_name(call.func.value).rsplit(
+                        ".", 1)[-1] == callee.cls.name:
+                # ClassName.method(obj, ...) / m.ClassName.method(obj,
+                # ...) are unbound — no implicit receiver.  A
+                # cls-first method is bound by the classmethod
+                # descriptor even through the class name.
+                offset = 0
+        out = {}
+        if offset == 1 and isinstance(call.func, ast.Attribute) \
+                and callee.node.name != "__init__":
+            # obj.method(...): the receiver IS param 0 — summaries about
+            # self (returns self._v, syncs self._v) must see its taint.
+            # Constructor calls have no receiver expression to map.
+            out[0] = call.func.value
+        for i, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred):
+                break
+            idx = i + offset
+            if idx < callee.n_positional:
+                out[idx] = arg
+        for kw in call.keywords:
+            if kw.arg is None:
+                continue
+            idx = callee.param_index(kw.arg)
+            if idx is not None:
+                out[idx] = kw.value
+        return out
+
+    def callees(self, qname: str) -> List[CallSite]:
+        return self.calls.get(qname, [])
+
+    def function_at(self, node) -> Optional[FunctionInfo]:
+        return self._by_node.get(id(node))

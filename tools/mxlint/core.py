@@ -4,7 +4,8 @@ A *pass* is a named checker over one parsed file (plus optional
 whole-project finalization for cross-file checks like histogram-bucket
 conflicts or the lock-order graph).  Passes are pure AST analyses — the
 linter never imports the code under analysis, so `python -m tools.mxlint`
-runs in milliseconds and works on broken trees.
+needs no jax/device setup (full tree in a few seconds) and works on
+broken trees.
 
 Suppression (docs/static_analysis.md):
 
@@ -122,6 +123,24 @@ class Project:
         self.env_declared = set(env_declared or ())
         self.env_documented = set(env_documented or ())
         self.files: List[SourceFile] = []
+        self._callgraph = None
+        self._summaries = None
+
+    def callgraph(self):
+        """Project-wide symbol table + call graph (callgraph.py), built
+        lazily on first use and shared by every pass in the run."""
+        if self._callgraph is None:
+            from .callgraph import CallGraph
+            self._callgraph = CallGraph(self.files)
+        return self._callgraph
+
+    def summaries(self):
+        """Per-function dataflow summaries at call-graph fixpoint
+        (dataflow.py), lazily built, shared by every pass."""
+        if self._summaries is None:
+            from .dataflow import build_summaries
+            self._summaries = build_summaries(self.callgraph())
+        return self._summaries
 
     @staticmethod
     def _repo_root() -> str:
@@ -132,6 +151,8 @@ class Project:
         """Collect project-wide facts (declare_env call sites) from the
         scanned files, then fold in docs/env_vars.md if present."""
         self.files = list(files)
+        self._callgraph = None          # rebuilt for the new file set
+        self._summaries = None
         for f in self.files:
             for node in ast.walk(f.tree):
                 if isinstance(node, ast.Call) \

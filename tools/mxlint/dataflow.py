@@ -1,0 +1,512 @@
+"""Intraprocedural forward dataflow + per-function summaries at fixpoint.
+
+The lattice is a small may-taint domain: for each local variable, the
+set of *parameter indices* its value may derive from.  One forward walk
+per function propagates taint through assignments (branches join, loop
+bodies run twice so back-edges converge), and the walk records three
+kinds of facts into a :class:`Summary`:
+
+- ``sync_params``: parameter *i* may reach a host-sync / scalarization
+  site (``.asnumpy()`` / ``.item()`` / ``jax.block_until_ready`` /
+  ``float()``-family / ``np.asarray``) — directly, or through a call to
+  a summarized function.  This is what lets ``jit-retrace`` flag a
+  ``float(x)`` two helpers deep at the jit-side call site.
+- ``syncs``: the function performs a *hard* host sync on anything
+  (``.asnumpy``/``.item``/``block_until_ready``), directly or
+  transitively — what ``host-sync`` consults for dispatch-path callees.
+  Syncs routed through a sanctioned wrapper (``engine.sync_outputs`` or
+  anything defined in ``engine.py`` — the bounded, metered sync point)
+  do not count.
+- ``returns_params`` / ``calls_collective``: return-value taint (so the
+  caller's walk can keep tracking through ``y = helper(x)``) and
+  transitive reachability of a ``lax`` collective (what the
+  ``collective-soundness`` divergence check asks about branch bodies).
+
+Summaries are iterated over the whole call graph until stable; facts
+only ever grow and the domain is finite, so mutual recursion converges.
+Every recorded fact carries a :class:`Witness` — the call chain down to
+the offending line — so findings can say *where* the buried sync lives.
+
+Attribute reads of static metadata (``x.shape`` / ``x.ndim`` /
+``x.dtype`` / ``x.size``) kill taint: they are concrete on tracers, the
+same exemption the intraprocedural jit-retrace check always had.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional, Set
+
+from .callgraph import CallGraph, FunctionInfo
+from .core import dotted_name
+
+__all__ = ["Witness", "Summary", "build_summaries",
+           "COLLECTIVES", "COMM_COLLECTIVES", "taint_of"]
+
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+_SCALARIZERS = {"float", "int", "bool", "complex"}
+_HARD_SYNCS = {"asnumpy", "item", "block_until_ready"}
+# np.asarray/np.array on a tracer materializes it to host numpy; one
+# definition shared with jit_retrace so the direct check and the
+# summary sink recorder can never drift
+_NP_CAPTURES = {"asarray", "array"}
+_NP_MODULES = {"np", "numpy", "onp"}
+COLLECTIVES = {"psum", "pmean", "pmax", "pmin", "ppermute", "pshuffle",
+               "all_gather", "all_to_all", "psum_scatter", "pbroadcast",
+               "axis_index"}
+# the subset that actually communicates: axis_index takes an axis name
+# (so its axis is validated) but exchanges nothing — it cannot deadlock
+# under divergent control flow
+COMM_COLLECTIVES = COLLECTIVES - {"axis_index"}
+# reductions whose result is identical on every device of the axis —
+# only these wash per-device taint; ppermute/all_to_all/psum_scatter/
+# pshuffle hand each device a DIFFERENT slice, so their results still
+# diverge
+UNIFORM_COLLECTIVES = {"psum", "pmean", "pmax", "pmin", "all_gather"}
+
+# summary fixpoint cap: deep call chains converge in O(depth) rounds
+_MAX_ROUNDS = 25
+
+
+class Witness:
+    """Chain of hops from a flagged call site down to the primitive
+    sink: ``[('helper_a', 'pkg/x.py', 12), ...]`` + sink description.
+    ``sink_fn`` is the qname of the function whose body holds the
+    primitive sink — passes use it to skip a chained finding when the
+    sink's own surface is already directly checked (one bug = one
+    issue, and a suppression on the sink line stays authoritative)."""
+
+    __slots__ = ("hops", "sink", "sink_fn")
+
+    def __init__(self, sink: str, hops=(), sink_fn: str = ""):
+        self.sink = sink
+        self.hops = tuple(hops)
+        self.sink_fn = sink_fn
+
+    def via(self, fn_name: str, path: str, line: int) -> "Witness":
+        return Witness(self.sink, ((fn_name, path, line),) + self.hops,
+                       self.sink_fn)
+
+    def describe(self) -> str:
+        if not self.hops:
+            return self.sink
+        chain = " -> ".join(f"{name} ({path}:{line})"
+                            for name, path, line in self.hops)
+        return f"via {chain}: {self.sink}"
+
+    def __repr__(self):
+        return f"Witness({self.describe()!r})"
+
+
+# distinct witnesses kept per fact: a helper can sync through several
+# independent sinks (one in a checked surface, one not) and a consuming
+# pass must be able to see past the first; capped so summaries stay
+# small and the fixpoint domain stays finite
+_MAX_WITNESSES = 4
+
+
+def _add_witness(ws: tuple, w: Witness) -> tuple:
+    key = (w.sink_fn, w.sink)
+    if len(ws) >= _MAX_WITNESSES \
+            or any((x.sink_fn, x.sink) == key for x in ws):
+        return ws
+    return ws + (w,)
+
+
+class Summary:
+    __slots__ = ("fn", "sync_params", "syncs", "returns_params",
+                 "calls_collective")
+
+    def __init__(self, fn: FunctionInfo):
+        self.fn = fn
+        # param index -> tuple of Witness (distinct sinks it reaches)
+        self.sync_params: Dict[int, tuple] = {}
+        # tuple of Witness, () = the function never hard-syncs
+        self.syncs: tuple = ()
+        self.returns_params: Set[int] = set()
+        self.calls_collective: Optional[Witness] = None
+
+    def add_sync(self, w: Witness):
+        self.syncs = _add_witness(self.syncs, w)
+
+    def add_sync_param(self, i: int, w: Witness):
+        self.sync_params[i] = _add_witness(
+            self.sync_params.get(i, ()), w)
+
+    def _key(self):
+        return (tuple(sorted((i, len(ws))
+                             for i, ws in self.sync_params.items())),
+                len(self.syncs),
+                frozenset(self.returns_params),
+                self.calls_collective is not None)
+
+
+def _sanctioned(fn: FunctionInfo) -> bool:
+    """Sync wrappers whose internal block_until_ready is the *fix*, not
+    the bug: engine.sync_outputs and the engine module generally."""
+    path = fn.src.path.replace("\\", "/")
+    return fn.node.name == "sync_outputs" or path.endswith("/engine.py") \
+        or path == "engine.py"
+
+
+def taint_of(expr, env: Dict[str, Set[int]],
+             analyzer: Optional["_FnAnalyzer"] = None) -> Set[int]:
+    """May-taint of an expression under ``env`` (var -> param indices).
+
+    Static-metadata attribute reads kill taint; calls propagate the
+    callee's ``returns_params`` when resolvable, else the union of
+    argument taints (a traced value stays traced through jnp ops)."""
+    if isinstance(expr, ast.Name):
+        return set(env.get(expr.id, ()))
+    if isinstance(expr, ast.Attribute):
+        if expr.attr in _STATIC_ATTRS:
+            return set()
+        return taint_of(expr.value, env, analyzer)
+    if isinstance(expr, ast.Subscript):
+        # contents-of: the index does not taint the element (indexing a
+        # host container by a tracer raises at trace time regardless)
+        return taint_of(expr.value, env, analyzer)
+    if isinstance(expr, ast.Call):
+        if dotted_name(expr.func) == "len":
+            return set()
+        if analyzer is not None:
+            return analyzer.call_return_taint(expr, env)
+        out: Set[int] = set()
+        for a in list(expr.args) + [kw.value for kw in expr.keywords]:
+            out |= taint_of(a, env, analyzer)
+        if isinstance(expr.func, ast.Attribute):
+            out |= taint_of(expr.func.value, env, analyzer)
+        return out
+    out = set()
+    for child in ast.iter_child_nodes(expr):
+        if isinstance(child, (ast.expr, ast.comprehension, ast.keyword)):
+            out |= taint_of(child, env, analyzer)
+    return out
+
+
+class _FnAnalyzer:
+    """One forward walk over one function, reading callee summaries and
+    (re)writing this function's summary.
+
+    Passes can ride the same walk: ``on_call(call_node, env)`` fires at
+    every visited Call with the live taint environment, and ``run(seed)``
+    lets the caller choose which names start tainted (jit-retrace seeds
+    only the *traced* names instead of all params)."""
+
+    def __init__(self, fn: FunctionInfo, graph: CallGraph,
+                 summaries: Dict[str, Summary], on_call=None):
+        self.fn = fn
+        self.graph = graph
+        self.summaries = summaries
+        self.on_call = on_call
+        self.out = Summary(fn)
+
+    # -------------------------------------------------------------- expr
+    def call_return_taint(self, call: ast.Call,
+                          env: Dict[str, Set[int]]) -> Set[int]:
+        if dotted_name(call.func) == "len":
+            return set()        # len(tracer) is static, like .shape[0]
+        callee = self.graph.resolve_call(call, self.fn)
+        if callee is not None:
+            if callee.node.name == "__init__":
+                # Class(x) constructs an object carrying its ctor args:
+                # a traced value stored in a project object must not be
+                # washed just because __init__ returns nothing
+                out = set()
+                for a in list(call.args) + [kw.value
+                                            for kw in call.keywords]:
+                    out |= taint_of(a, env, None)
+                return out
+            summ = self.summaries.get(callee.qname)
+            if summ is not None:
+                out = set()
+                for idx, arg in CallGraph.arg_map(call, callee).items():
+                    if idx in summ.returns_params:
+                        # None analyzer: argument subexpressions' own
+                        # calls were already visited by _eval
+                        out |= taint_of(arg, env, None)
+                return out
+        # opaque call: result may derive from any tainted operand
+        out = set()
+        for a in list(call.args) + [kw.value for kw in call.keywords]:
+            out |= taint_of(a, env, self)
+        # receiver of a bound call taints the result too (x.astype(...))
+        if isinstance(call.func, ast.Attribute):
+            out |= taint_of(call.func.value, env, self)
+        return out
+
+    # ------------------------------------------------------------- sinks
+    def _visit_call(self, call: ast.Call, env):
+        if self.on_call is not None:
+            self.on_call(call, env)
+        name = dotted_name(call.func)
+        term = name.rsplit(".", 1)[-1]
+
+        # item/asnumpy are method-style sinks — a bare project function
+        # that happens to share the name is not a sync; only
+        # block_until_ready is legitimately called bare
+        if term in _HARD_SYNCS and ("." in name
+                                    or term == "block_until_ready"):
+            sink = Witness(f"{term}() at {self.fn.src.path}:{call.lineno}",
+                           sink_fn=self.fn.qname)
+            self.out.add_sync(sink)
+            tainted = set()
+            if isinstance(call.func, ast.Attribute):   # x.asnumpy()
+                tainted |= taint_of(call.func.value, env, self)
+            for a in call.args:                        # block_until_ready(x)
+                tainted |= taint_of(a, env, self)
+            for i in tainted:
+                self.out.add_sync_param(i, sink)
+        elif name in _SCALARIZERS and call.args:
+            sink = Witness(f"{name}() at {self.fn.src.path}:{call.lineno}",
+                           sink_fn=self.fn.qname)
+            for i in taint_of(call.args[0], env, self):
+                self.out.add_sync_param(i, sink)
+        elif term in _NP_CAPTURES \
+                and name.split(".")[0] in _NP_MODULES \
+                and call.args:
+            sink = Witness(f"{name}() at {self.fn.src.path}:{call.lineno}",
+                           sink_fn=self.fn.qname)
+            for i in taint_of(call.args[0], env, self):
+                self.out.add_sync_param(i, sink)
+
+        if term in COMM_COLLECTIVES and "." in name:
+            if self.out.calls_collective is None:
+                self.out.calls_collective = Witness(
+                    f"lax.{term} at {self.fn.src.path}:{call.lineno}",
+                    sink_fn=self.fn.qname)
+
+        # fold in callee summary
+        callee = self.graph.resolve_call(call, self.fn)
+        if callee is None:
+            return
+        summ = self.summaries.get(callee.qname)
+        if summ is None:
+            return
+        here = (callee.node.name, self.fn.src.path, call.lineno)
+        if not _sanctioned(callee):
+            for w in summ.syncs:
+                self.out.add_sync(w.via(*here))
+            for idx, arg in CallGraph.arg_map(call, callee).items():
+                for w in summ.sync_params.get(idx, ()):
+                    for i in taint_of(arg, env, self):
+                        self.out.add_sync_param(i, w.via(*here))
+        if summ.calls_collective is not None \
+                and self.out.calls_collective is None:
+            self.out.calls_collective = summ.calls_collective.via(*here)
+
+    # --------------------------------------------------------- statements
+    def run(self, seed: Optional[Dict[str, Set[int]]] = None) -> Summary:
+        env: Dict[str, Set[int]] = dict(seed) if seed is not None else {
+            p: {i} for i, p in enumerate(self.fn.params)}
+        self._block(self.fn.node.body, env)
+        return self.out
+
+    def _block(self, stmts, env):
+        for stmt in stmts:
+            self._stmt(stmt, env)
+
+    def _join(self, a, b):
+        for k, v in b.items():
+            a[k] = a.get(k, set()) | v
+
+    def _stmt(self, stmt, env):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return                          # own summary covers it
+        if isinstance(stmt, ast.Assign):
+            self._eval(stmt.value, env)
+            if len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Tuple) \
+                    and isinstance(stmt.value, ast.Tuple) \
+                    and len(stmt.targets[0].elts) == len(stmt.value.elts):
+                for tgt, val in zip(stmt.targets[0].elts,
+                                    stmt.value.elts):
+                    self._bind(tgt, taint_of(val, env, self), env)
+                return
+            t = taint_of(stmt.value, env, self)
+            for tgt in stmt.targets:
+                self._bind(tgt, t, env)
+        elif isinstance(stmt, ast.AugAssign):
+            self._eval(stmt.value, env)
+            t = taint_of(stmt.value, env, self)
+            if isinstance(stmt.target, ast.Name):
+                env[stmt.target.id] = env.get(stmt.target.id, set()) | t
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._eval(stmt.value, env)
+            self._bind(stmt.target, taint_of(stmt.value, env, self), env)
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self._eval(stmt.value, env)
+                self.out.returns_params |= taint_of(stmt.value, env, self)
+        elif isinstance(stmt, ast.If):
+            self._eval(stmt.test, env)
+            e1, e2 = dict(env), dict(env)
+            self._block(stmt.body, e1)
+            self._block(stmt.orelse, e2)
+            env.clear()
+            env.update(e1)
+            self._join(env, e2)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._eval(stmt.iter, env)
+            self._bind_loop_target(stmt.target, stmt.iter, env)
+            for _ in range(2):              # loop-carried taint
+                self._block(stmt.body, env)
+            self._block(stmt.orelse, env)
+        elif isinstance(stmt, ast.While):
+            self._eval(stmt.test, env)
+            for _ in range(2):
+                self._block(stmt.body, env)
+            self._block(stmt.orelse, env)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._eval(item.context_expr, env)
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars,
+                               taint_of(item.context_expr, env, self), env)
+            self._block(stmt.body, env)
+        elif isinstance(stmt, ast.Try):
+            self._block(stmt.body, env)
+            for h in stmt.handlers:
+                eh = dict(env)
+                self._block(h.body, eh)
+                self._join(env, eh)
+            self._block(stmt.orelse, env)
+            self._block(stmt.finalbody, env)
+        elif isinstance(stmt, ast.Expr):
+            self._eval(stmt.value, env)
+        elif isinstance(stmt, ast.Match):
+            self._eval(stmt.subject, env)
+            subject_taint = taint_of(stmt.subject, env, self)
+            for case in stmt.cases:
+                ec = dict(env)
+                # capture patterns bind (slices of) the subject
+                for sub in ast.walk(case.pattern):
+                    nm = getattr(sub, "name", None)
+                    if isinstance(nm, str):
+                        ec[nm] = set(subject_taint)
+                if case.guard is not None:
+                    self._eval(case.guard, ec)
+                self._block(case.body, ec)
+                self._join(env, ec)
+        else:
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self._eval(child, env)
+
+    def _bind_loop_target(self, target, iter_expr, env):
+        """Bind a for/comprehension target to its iterable's taint; the
+        counter of ``enumerate(xs)`` is a plain int, never data."""
+        if isinstance(iter_expr, ast.Call) \
+                and dotted_name(iter_expr.func) == "enumerate" \
+                and isinstance(target, ast.Tuple) \
+                and len(target.elts) == 2 and iter_expr.args:
+            self._bind(target.elts[0], set(), env)
+            self._bind(target.elts[1],
+                       taint_of(iter_expr.args[0], env, self), env)
+            return
+        self._bind(target, taint_of(iter_expr, env, self), env)
+
+    def _bind(self, target, taint, env):
+        if isinstance(target, ast.Name):
+            env[target.id] = set(taint)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind(elt, taint, env)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, taint, env)
+        # attribute/subscript targets: receiver keeps its taint
+
+    def _eval(self, expr, env):
+        """Visit every Call in an expression tree (sink detection),
+        respecting the scopes expressions introduce: lambda parameters
+        shadow outer names (a `lambda x:` over host values must not
+        inherit a traced `x`), and comprehension targets are bound to
+        their iterable's taint (`[o.asnumpy() for o in outs]` keeps the
+        outs -> o flow)."""
+        self._eval_expr(expr, env)
+
+    def _eval_expr(self, node, env):
+        if isinstance(node, ast.Lambda):
+            a = node.args
+            shadowed = {p.arg for p in list(a.posonlyargs) + list(a.args)
+                        + list(a.kwonlyargs)}
+            if a.vararg:
+                shadowed.add(a.vararg.arg)
+            if a.kwarg:
+                shadowed.add(a.kwarg.arg)
+            inner = {k: v for k, v in env.items() if k not in shadowed}
+            self._eval_expr(node.body, inner)
+            return
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp)):
+            inner = dict(env)
+            for gen in node.generators:
+                self._eval_expr(gen.iter, env)
+                self._bind_loop_target(gen.target, gen.iter, inner)
+                for cond in gen.ifs:
+                    self._eval_expr(cond, inner)
+            if isinstance(node, ast.DictComp):
+                self._eval_expr(node.key, inner)
+                self._eval_expr(node.value, inner)
+            else:
+                self._eval_expr(node.elt, inner)
+            return
+        if isinstance(node, ast.Call):
+            self._visit_call(node, env)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.expr, ast.keyword)):
+                self._eval_expr(child, env)
+
+
+def build_summaries(graph: CallGraph) -> Dict[str, Summary]:
+    """Worklist fixpoint: analyze every function once, then re-analyze
+    only the callers of functions whose summary changed.  Facts only
+    grow over a finite domain, so mutual recursion converges; the
+    per-function round cap bounds pathological graphs."""
+    summaries: Dict[str, Summary] = {
+        q: Summary(fn) for q, fn in graph.functions.items()}
+    callers: Dict[str, Set[str]] = {}
+    for q, sites in graph.calls.items():
+        for site in sites:
+            callers.setdefault(site.callee.qname, set()).add(q)
+
+    # callees-first initial order (iterative post-order DFS over call
+    # edges) so most summaries are final on their first visit and the
+    # worklist only re-runs actual cycles
+    order, seen = [], set()
+    for root in graph.functions:
+        if root in seen:
+            continue
+        stack = [(root, False)]
+        while stack:
+            q, done = stack.pop()
+            if done:
+                order.append(q)
+                continue
+            if q in seen:
+                continue
+            seen.add(q)
+            stack.append((q, True))
+            for site in graph.calls.get(q, ()):
+                cq = site.callee.qname
+                if cq not in seen:
+                    stack.append((cq, False))
+    pending = list(reversed(order))     # pop() takes callees first
+    queued = set(pending)
+    rounds: Dict[str, int] = {}
+    while pending:
+        q = pending.pop()
+        queued.discard(q)
+        if rounds.get(q, 0) >= _MAX_ROUNDS:
+            continue
+        rounds[q] = rounds.get(q, 0) + 1
+        fn = graph.functions[q]
+        new = _FnAnalyzer(fn, graph, summaries).run()
+        changed = new._key() != summaries[q]._key()
+        summaries[q] = new
+        if changed:
+            for caller in callers.get(q, ()):
+                if caller not in queued:
+                    queued.add(caller)
+                    pending.append(caller)
+    return summaries

@@ -5,8 +5,10 @@ Importing this package registers every built-in pass with
 has already shipped fixes for — the passes keep those classes from
 regressing at lint time.
 """
-from . import jit_retrace       # noqa: F401
-from . import host_sync         # noqa: F401
-from . import lock_discipline   # noqa: F401
-from . import metrics_misuse    # noqa: F401
-from . import env_registry      # noqa: F401
+from . import jit_retrace            # noqa: F401
+from . import host_sync              # noqa: F401
+from . import lock_discipline        # noqa: F401
+from . import metrics_misuse         # noqa: F401
+from . import env_registry           # noqa: F401
+from . import collective_soundness  # noqa: F401
+from . import resource_leak         # noqa: F401

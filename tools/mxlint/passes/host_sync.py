@@ -1,4 +1,5 @@
-"""host-sync: untracked blocking device syncs in hot paths.
+"""host-sync: untracked blocking device syncs in hot paths — and their
+helper-routed escapes.
 
 ``jax.block_until_ready`` / ``.asnumpy()`` stall the host until the
 device drains.  In op implementations (``mxnet_tpu/ops/``) and in the
@@ -8,6 +9,15 @@ pipeline — the exact bug class ``engine.sync_outputs`` exists to bound
 and meter (``engine.sync.seconds{site}``).  Route batch-level syncs
 through ``engine.sync_outputs``; results leave the device in the
 un-padding step after that sync, not ad hoc.
+
+Interprocedural (docs/static_analysis.md §interprocedural): a hot-path
+call into a helper whose dataflow summary says it performs a host sync
+(directly or further down its own calls) is flagged *at the hot call
+site*, with the chain down to the buried ``.asnumpy()`` in the message.
+Syncs already routed through ``engine.sync_outputs`` are sanctioned and
+never propagate.  Helpers that live inside the scoped surfaces
+themselves (any ``ops/`` file, a serving dispatch function) are left to
+their own direct findings so one bug is one issue.
 
 Scope: all code under an ``ops/`` directory; in ``serving/`` modules
 only the dispatch surfaces (``*Batcher`` methods and the worker-loop /
@@ -19,6 +29,7 @@ from __future__ import annotations
 import ast
 
 from ..core import LintPass, dotted_name, register_pass
+from ..dataflow import _sanctioned
 
 _HOT_FUNCS = {"_worker_loop", "_next_batch", "run_batch", "program_for"}
 
@@ -27,16 +38,25 @@ def _path_parts(path: str):
     return path.replace("\\", "/").split("/")
 
 
+def _in_ops(path: str) -> bool:
+    return "ops" in _path_parts(path)[:-1]
+
+
+def _in_serving(path: str) -> bool:
+    return "serving" in _path_parts(path)[:-1]
+
+
 @register_pass
 class HostSyncPass(LintPass):
     id = "host-sync"
-    doc = ("jax.block_until_ready / .asnumpy() in op implementations or "
-           "the serving dispatch path — route through engine.sync_outputs")
+    doc = ("jax.block_until_ready / .asnumpy() / .item() in op "
+           "implementations or the serving dispatch path — including "
+           "buried inside called helpers — route through "
+           "engine.sync_outputs")
 
     def check_file(self, src):
-        parts = _path_parts(src.path)
-        in_ops = "ops" in parts[:-1]
-        in_serving = "serving" in parts[:-1]
+        in_ops = _in_ops(src.path)
+        in_serving = _in_serving(src.path)
         if not (in_ops or in_serving):
             return
         for scope, node in self._calls_with_scope(src.tree):
@@ -51,12 +71,69 @@ class HostSyncPass(LintPass):
                     f"host sync in a hot path — use engine.sync_outputs"
                     f"(arrays, site=...) so the stall is bounded to one "
                     f"batch and metered")
-            elif term == "asnumpy" and "." in name:
+            elif term in ("asnumpy", "item") and "." in name:
                 yield self.issue(
                     src, node,
-                    ".asnumpy() blocks the worker on a device-to-host "
-                    "transfer — sync via engine.sync_outputs, then "
-                    "materialize outputs once in the un-padding step")
+                    f".{term}() blocks the worker on a device-to-host "
+                    f"transfer — sync via engine.sync_outputs, then "
+                    f"materialize outputs once in the un-padding step")
+            else:
+                yield from self._check_helper(src, node, scope)
+
+    # ---------------------------------------------------- interprocedural
+    def _check_helper(self, src, call, scope):
+        """Hot-path call into a summarized helper that syncs somewhere
+        down its call tree."""
+        graph = self.project.callgraph()
+        fn_nodes = [n for n in scope
+                    if isinstance(n, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))]
+        enclosing = graph.function_at(fn_nodes[-1]) if fn_nodes else None
+        if enclosing is None:
+            return
+        callee = graph.resolve_call(call, enclosing)
+        if callee is None or _sanctioned(callee) \
+                or self._directly_checked(callee):
+            return
+        summ = self.project.summaries().get(callee.qname)
+        if summ is None or not summ.syncs:
+            return
+        for witness in summ.syncs:
+            sink_fn = graph.functions.get(witness.sink_fn)
+            if sink_fn is not None and self._directly_checked(sink_fn):
+                # the primitive sink's own line already carries the
+                # finding (and its suppression, if any) — but keep
+                # scanning: a second sink in an unchecked surface is
+                # still unreported anywhere else
+                continue
+            yield self.issue(
+                src, call,
+                f"{callee.node.name}() performs an untracked host sync "
+                f"{witness.describe()} — hot paths must route syncs "
+                f"through engine.sync_outputs(arrays, site=...)")
+            return
+
+    def _directly_checked(self, callee) -> bool:
+        """Callee's own body is already a scoped surface: any ops/ file,
+        or a serving dispatch function — its direct sites are flagged
+        there."""
+        path = callee.src.path
+        if _in_ops(path):
+            return True
+        if not _in_serving(path):
+            return False
+        # mirror _serving_hot's scope rule: a def nested anywhere under
+        # a *Batcher method or a hot function is itself a checked
+        # surface (its direct sites flag), so the call into it must not
+        # double-report
+        info = callee
+        while info is not None:
+            if info.node.name in _HOT_FUNCS:
+                return True
+            if info.cls is not None and "Batcher" in info.cls.name:
+                return True
+            info = info.parent
+        return False
 
     @staticmethod
     def _calls_with_scope(tree):

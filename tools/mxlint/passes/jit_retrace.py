@@ -1,4 +1,4 @@
-"""jit-retrace: host-value escapes inside traced bodies.
+"""jit-retrace: host-value escapes inside traced bodies — now through calls.
 
 Inside a ``@jax.jit`` (or ``partial(jax.jit, ...)``) function or a
 ``hybrid_forward`` body, pulling a traced value back to the host —
@@ -7,18 +7,28 @@ Inside a ``@jax.jit`` (or ``partial(jax.jit, ...)``) function or a
 runtime or silently bakes the value into the compiled program, so every
 new value retraces and recompiles (the TF/Julia-to-TPU "retracing
 hazard" class; PAPERS.md).  Static shape metadata is exempt:
-``int(x.shape[0])`` / ``x.ndim`` / ``x.dtype`` are concrete on tracers.
+``int(x.shape[0])`` / ``x.ndim`` / ``x.dtype`` / ``len(x)`` are
+concrete on tracers.
+
+Interprocedural (docs/static_analysis.md §interprocedural): traced
+values are tracked through local assignments, and a call into a helper
+whose dataflow summary says "param *i* reaches a host sync" is flagged
+at the *call site inside the jit body* — the place the trace boundary
+is crossed — with the full helper chain in the message.  Helpers whose
+bodies are themselves traced contexts (nested in a jit body, or jit-
+decorated) are left to their own direct findings, so one bug is one
+issue.
 """
 from __future__ import annotations
 
 import ast
 
 from ..core import LintPass, dotted_name, register_pass
+from ..dataflow import (_FnAnalyzer, _NP_CAPTURES, _NP_MODULES,
+                        _SCALARIZERS, taint_of)
 
-# attributes that are concrete (host) metadata even on a tracer
-_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
-_SCALARIZERS = {"float", "int", "bool", "complex"}
-_NP_CAPTURES = {"asarray", "array"}
+# the static-metadata exemption lives in dataflow._STATIC_ATTRS (taint_of)
+_TRACED = -1        # taint tag: "derives from a traced value"
 
 
 def _jit_decorated(fn: ast.AST) -> bool:
@@ -50,29 +60,47 @@ def _params(fn) -> set:
     return set(names)
 
 
-def _root_and_attrs(node):
-    """Walk ``x.shape[0]`` / ``x.astype(f)`` chains down to the root
-    Name; returns (root_name_or_None, set_of_attrs_traversed)."""
-    attrs = set()
-    while True:
-        if isinstance(node, ast.Attribute):
-            attrs.add(node.attr)
-            node = node.value
-        elif isinstance(node, ast.Subscript):
-            node = node.value
-        elif isinstance(node, ast.Call):
-            node = node.func
-        elif isinstance(node, ast.Name):
-            return node.id, attrs
-        else:
-            return None, attrs
+def _root_name(node):
+    while isinstance(node, (ast.Attribute, ast.Subscript, ast.Call)):
+        node = getattr(node, "value", None) or getattr(node, "func", None)
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _enters_trace(fn_node) -> bool:
+    """The single definition of 'this def opens a traced context' —
+    shared by the direct walk and by _directly_checked so the two can
+    never drift (drift = double reports or missed surfaces)."""
+    return _jit_decorated(fn_node) or fn_node.name == "hybrid_forward"
+
+
+def traced_fn_nodes(tree):
+    """id()s of every function lexically inside a traced context in this
+    tree (jit-decorated / hybrid_forward bodies and their nested defs)."""
+    out = set()
+
+    def walk(node, inside):
+        for child in ast.iter_child_nodes(node):
+            enters = inside
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                enters = inside or _enters_trace(child)
+                if enters:
+                    out.add(id(child))
+            walk(child, enters)
+
+    walk(tree, False)
+    return out
 
 
 @register_pass
 class JitRetracePass(LintPass):
     id = "jit-retrace"
     doc = ("host-value escape (float/int/.asnumpy()/.item()/np.asarray) "
-           "on a traced value inside a @jax.jit or hybrid_forward body")
+           "on a traced value inside a @jax.jit or hybrid_forward body, "
+           "including escapes routed through helper calls")
+
+    def __init__(self, project):
+        super().__init__(project)
+        self._traced_nodes_cache = {}       # src.path -> set of id(node)
 
     def check_file(self, src):
         yield from self._walk(src, src.tree, in_traced=False,
@@ -85,8 +113,7 @@ class JitRetracePass(LintPass):
         outer host value sharing a helper-param name must not flag)."""
         for child in ast.iter_child_nodes(node):
             if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                enters_trace = _jit_decorated(child) \
-                    or child.name == "hybrid_forward"
+                enters_trace = _enters_trace(child)
                 child_traced = (traced | _params(child)) \
                     if (in_traced or enters_trace) else traced
                 if in_traced or enters_trace:
@@ -98,50 +125,109 @@ class JitRetracePass(LintPass):
                 yield from self._walk(src, child, in_traced, traced)
 
     def _check_local(self, src, fn, traced):
-        """Check statements belonging to ``fn`` itself (nested defs are
-        handled by their own _check_local call with their own set)."""
-        for node in self._iter_local(fn):
-            if not isinstance(node, ast.Call):
+        """Run the dataflow walk over ``fn``'s own body (nested defs are
+        handled by their own _check_local with their own seed), checking
+        each visited call against the live taint environment."""
+        graph = self.project.callgraph()
+        summaries = self.project.summaries()
+        info = graph.function_at(fn)
+        if info is None:        # file outside the harvested project
+            from ..callgraph import FunctionInfo, module_of
+            info = FunctionInfo(f"<local>.{fn.name}", fn, src,
+                                module_of(src.path), None, None)
+        findings = []
+
+        def on_call(call, env):
+            findings.extend(self._check_call(src, call, env, info,
+                                             graph, summaries, analyzer))
+
+        analyzer = _FnAnalyzer(info, graph, summaries, on_call=on_call)
+        analyzer.run(seed={name: {_TRACED} for name in traced})
+        seen = set()        # loop bodies are walked twice — dedup
+        for iss in findings:
+            if iss is None:
                 continue
-            name = dotted_name(node.func)
-            term = name.rsplit(".", 1)[-1]
-            if term in ("asnumpy", "item") and "." in name:
-                issue = self.issue(
-                    src, node,
-                    f".{term}() inside a traced body forces a host sync "
-                    f"per trace (or fails on a tracer) — compute on "
-                    f"device, read values outside the jit boundary")
-                if issue:
-                    yield issue
-                continue
-            arg = node.args[0] if node.args else None
-            if arg is None:
-                continue
-            root, attrs = _root_and_attrs(arg)
-            if root not in traced or attrs & _STATIC_ATTRS:
-                continue
+            key = (iss.line, iss.col, iss.message)
+            if key not in seen:
+                seen.add(key)
+                yield iss
+
+    # ------------------------------------------------------------- checks
+    def _check_call(self, src, call, env, info, graph, summaries,
+                    analyzer):
+        name = dotted_name(call.func)
+        term = name.rsplit(".", 1)[-1]
+        if term in ("asnumpy", "item") and "." in name:
+            yield self.issue(
+                src, call,
+                f".{term}() inside a traced body forces a host sync "
+                f"per trace (or fails on a tracer) — compute on "
+                f"device, read values outside the jit boundary")
+            return
+        arg = call.args[0] if call.args else None
+        # taint through the analyzer so a helper whose summary proves an
+        # untainted return stays clean: float(scale_const(x)) where
+        # scale_const returns a host constant must not flag
+        arg_taint = taint_of(arg, env, analyzer) \
+            if arg is not None else set()
+        if arg is not None and arg_taint:
+            root = _root_name(arg) or "value"
             if name in _SCALARIZERS:
                 yield self.issue(
-                    src, node,
+                    src, call,
                     f"{name}() on traced argument {root!r} bakes a python "
                     f"scalar into the compiled program — every new value "
                     f"retraces/recompiles; keep it a traced array or pass "
                     f"it as a static argument")
-            elif term in _NP_CAPTURES and name.split(".")[0] in (
-                    "np", "numpy", "onp"):
+                return
+            if term in _NP_CAPTURES \
+                    and name.split(".")[0] in _NP_MODULES:
                 yield self.issue(
-                    src, node,
+                    src, call,
                     f"{name}() on traced argument {root!r} materializes "
                     f"the tracer to host numpy inside the jit body — use "
                     f"jnp, or move the conversion outside the trace")
-
-    @staticmethod
-    def _iter_local(fn):
-        """Nodes of ``fn``'s body, not descending into nested defs."""
-        stack = list(ast.iter_child_nodes(fn))
-        while stack:
-            n = stack.pop()
-            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return
+        # interprocedural: traced value handed to a helper that syncs it
+        callee = graph.resolve_call(call, info)
+        if callee is None or self._directly_checked(callee):
+            return
+        summ = summaries.get(callee.qname)
+        if summ is None or not summ.sync_params:
+            return
+        from ..callgraph import CallGraph
+        for idx, argnode in CallGraph.arg_map(call, callee).items():
+            witnesses = summ.sync_params.get(idx, ())
+            if not witnesses or not taint_of(argnode, env, analyzer):
                 continue
-            yield n
-            stack.extend(ast.iter_child_nodes(n))
+            for witness in witnesses:
+                sink_fn = graph.functions.get(witness.sink_fn)
+                if sink_fn is not None \
+                        and self._directly_checked(sink_fn):
+                    # the sink's body is itself a traced context: its
+                    # direct finding (and any suppression there) owns
+                    # it — but a second sink through an unchecked
+                    # helper still needs this call-site report
+                    continue
+                root = _root_name(argnode) or "value"
+                # hop convention matches the summary fold-in: (function
+                # entered, location of the call that enters it)
+                chain = witness.via(callee.node.name, src.path,
+                                    call.lineno)
+                yield self.issue(
+                    src, call,
+                    f"traced value {root!r} escapes to the host inside "
+                    f"this jit body {chain.describe()} — hoist the read "
+                    f"out of the traced region or keep the helper "
+                    f"device-side")
+                return      # one finding per call site is enough
+
+    def _directly_checked(self, callee) -> bool:
+        """True when the callee's own body is walked as a traced context
+        (nested in a jit body or itself jit-decorated), so its direct
+        findings already cover the bug."""
+        path = callee.src.path
+        if path not in self._traced_nodes_cache:
+            self._traced_nodes_cache[path] = traced_fn_nodes(
+                callee.src.tree)
+        return id(callee.node) in self._traced_nodes_cache[path]

@@ -1,0 +1,283 @@
+"""resource-leak: handles must be released on all paths or used via with.
+
+The serving/engine layer holds locks, files, and repository handles
+across threads; a handle that leaks on an early return or an exception
+path becomes a stuck worker (lock) or an fd leak that only shows up
+after days of traffic.  Two shapes, deliberately conservative so the
+findings that do fire are real:
+
+1. **file-like acquire** (``open`` / ``io.open`` / ``os.fdopen`` /
+   ``gzip.open`` / ...): the handle must be bound by a ``with``,
+   closed in a ``finally``, or closed with no ``return`` / ``raise``
+   in between.  Ownership transfers are exempt: returning the handle
+   (or the bare name), storing it on ``self``/an attribute, aliasing
+   it, or passing the bare name to another call (``RecordIO``-style
+   classes that close in their own ``close()``).  An opener consumed
+   inline (``json.load(open(p))``) leaks the fd on any exception in
+   the consumer and is flagged.
+2. **explicit lock acquire**: a ``x.acquire()`` statement needs its
+   ``x.release()`` inside a ``finally`` (or the function is itself a
+   lock-protocol method — ``__enter__`` / ``__exit__`` / ``acquire`` /
+   ``release`` wrappers like the engine sanitizer locks).  A paired
+   release in straight-line code still leaks if anything between
+   raises; ``with lock:`` is the fix.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import LintPass, dotted_name, register_pass
+
+_OPENERS = {"open", "io.open", "os.fdopen", "gzip.open", "bz2.open",
+            "lzma.open", "socket.socket"}
+_LOCK_METHODS = {"__enter__", "__exit__", "acquire", "release",
+                 "_acquire", "_release", "locked"}
+
+
+def _is_opener(call: ast.Call) -> bool:
+    return dotted_name(call.func) in _OPENERS
+
+
+def _local_stmts(fn):
+    """Every statement of ``fn``'s body at any nesting, not descending
+    into nested function/class definitions."""
+    def walk(stmts):
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            yield s
+            for field in ("body", "orelse", "finalbody"):
+                yield from walk(getattr(s, field, ()))
+            for h in getattr(s, "handlers", ()):
+                yield from walk(h.body)
+            for case in getattr(s, "cases", ()):    # match arms
+                yield from walk(case.body)
+    yield from walk(fn.body)
+
+
+@register_pass
+class ResourceLeakPass(LintPass):
+    id = "resource-leak"
+    doc = ("open()/.acquire() handles not released on all paths — use "
+           "`with`, or close/release in a `finally`")
+
+    def check_file(self, src):
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_fn(src, node)
+
+    def _check_fn(self, src, fn):
+        stmts = list(_local_stmts(fn))
+        finally_ids = set()
+        for stmt in stmts:
+            if isinstance(stmt, ast.Try):
+                for fs in stmt.finalbody:
+                    for sub in ast.walk(fs):
+                        finally_ids.add(id(sub))
+
+        owned = set()           # opener Call ids with a clear owner
+        acquires = {}           # local name -> acquire Assign stmt
+        for stmt in stmts:
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    for sub in ast.walk(item.context_expr):
+                        if isinstance(sub, ast.Call) and _is_opener(sub):
+                            owned.add(id(sub))
+            elif isinstance(stmt, ast.Assign):
+                value = stmt.value
+                openers = [value] if isinstance(value, ast.Call) \
+                    and _is_opener(value) else []
+                if isinstance(value, ast.IfExp):
+                    # f = open(p) if cond else None — still bound
+                    openers = [e for e in (value.body, value.orelse)
+                               if isinstance(e, ast.Call)
+                               and _is_opener(e)]
+                if isinstance(value, (ast.Tuple, ast.List)):
+                    # f1, f2 = open(a), open(b) — each element bound to
+                    # its own name; a non-matching target (a container)
+                    # owns its elements as a unit
+                    tgt = stmt.targets[0] if len(stmt.targets) == 1 \
+                        else None
+                    names = tgt.elts if isinstance(
+                        tgt, (ast.Tuple, ast.List)) \
+                        and len(tgt.elts) == len(value.elts) else None
+                    for i, e in enumerate(value.elts):
+                        if isinstance(e, ast.Call) and _is_opener(e):
+                            owned.add(id(e))
+                            if names is not None \
+                                    and isinstance(names[i], ast.Name):
+                                acquires[names[i].id] = stmt
+                    continue
+                if openers:
+                    owned.update(id(c) for c in openers)
+                    if len(stmt.targets) == 1 \
+                            and isinstance(stmt.targets[0], ast.Name):
+                        acquires[stmt.targets[0].id] = stmt
+                    # attribute/tuple target: stored — owner closes it
+            elif isinstance(stmt, ast.Return) and stmt.value is not None \
+                    and isinstance(stmt.value, ast.Call) \
+                    and _is_opener(stmt.value):
+                owned.add(id(stmt.value))       # caller takes ownership
+
+        # walrus binding: `if (fh := open(p)): ...` owns the handle and
+        # tracks it like any named acquire
+        for stmt in stmts:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.NamedExpr) \
+                        and isinstance(sub.value, ast.Call) \
+                        and _is_opener(sub.value):
+                    owned.add(id(sub.value))
+                    if isinstance(sub.target, ast.Name) \
+                            and sub.target.id not in acquires:
+                        acquires[sub.target.id] = sub
+
+        # inline-consumed openers: nobody can close them
+        for call in self._local_calls(fn):
+            if _is_opener(call) and id(call) not in owned:
+                iss = self.issue(
+                    src, call,
+                    f"{dotted_name(call.func)}() handle is consumed "
+                    f"inline and never closed — bind it with `with` so "
+                    f"an exception in the consumer cannot leak the fd")
+                if iss:
+                    yield iss
+
+        for name, stmt in acquires.items():
+            yield from self._check_handle(src, stmts, finally_ids, name,
+                                          stmt)
+        yield from self._check_lock_acquires(src, fn, stmts, finally_ids)
+
+    # ------------------------------------------------------------ handles
+    def _check_handle(self, src, stmts, finally_ids, name, acq_stmt):
+        closes, escapes = [], False
+        for stmt in stmts:
+            if stmt is acq_stmt:
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)) and any(
+                    isinstance(it.context_expr, ast.Name)
+                    and it.context_expr.id == name
+                    for it in stmt.items):
+                return      # `with f:` closes on every path
+            if self._stmt_escapes(stmt, name):
+                escapes = True
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call) \
+                        and isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr in ("close", "release") \
+                        and isinstance(sub.func.value, ast.Name) \
+                        and sub.func.value.id == name:
+                    closes.append(sub)
+        if escapes:
+            return
+        if not closes:
+            yield self.issue(
+                src, acq_stmt,
+                f"{name!r} acquired here is never closed on any path — "
+                f"use `with`, or close it in a `finally`")
+            return
+        if any(id(c) in finally_ids for c in closes):
+            return
+        # a raise inside a try whose except handler closes the handle
+        # is not an early exit: the handler runs on that path
+        guarded = set()
+        for stmt in stmts:
+            if isinstance(stmt, ast.Try) and any(
+                    self._closes_name(h, name) for h in stmt.handlers):
+                for s in stmt.body + stmt.orelse:
+                    for sub in ast.walk(s):
+                        if isinstance(sub, ast.Raise):
+                            guarded.add(id(sub))
+        first_close = min(c.lineno for c in closes)
+        for stmt in stmts:
+            if isinstance(stmt, ast.Raise) and id(stmt) in guarded:
+                continue
+            if isinstance(stmt, (ast.Return, ast.Raise)) \
+                    and acq_stmt.lineno < stmt.lineno < first_close:
+                kind = "return" if isinstance(stmt, ast.Return) \
+                    else "raise"
+                yield self.issue(
+                    src, acq_stmt,
+                    f"{name!r} is closed at line {first_close}, but the "
+                    f"{kind} at line {stmt.lineno} exits first and "
+                    f"leaks it — use `with`, or close in a `finally`")
+                return
+
+    @staticmethod
+    def _closes_name(node, name) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr in ("close", "release") \
+                    and isinstance(sub.func.value, ast.Name) \
+                    and sub.func.value.id == name:
+                return True
+        return False
+
+    @staticmethod
+    def _stmt_escapes(stmt, name) -> bool:
+        """Ownership transfer of the *bare name*: returned/yielded,
+        aliased, stored in an attribute/subscript/container, or passed
+        as an argument.  ``f.read()`` receiver uses do not count."""
+        def bare(expr):
+            return isinstance(expr, ast.Name) and expr.id == name
+
+        if isinstance(stmt, ast.Return) and stmt.value is not None \
+                and (bare(stmt.value) or (
+                    isinstance(stmt.value, (ast.Tuple, ast.List,
+                                            ast.Dict))
+                    and any(bare(e) for e in
+                            ast.iter_child_nodes(stmt.value)))):
+            return True
+        # transfers nested deeper in any statement — return Reader(f),
+        # yield f, wrap(f), d[k] = f — fall through to the walk
+        for sub in ast.walk(stmt):
+            if isinstance(sub, (ast.Yield, ast.YieldFrom)):
+                if sub.value is not None and bare(sub.value):
+                    return True
+            elif isinstance(sub, ast.Call):
+                if any(bare(a) for a in sub.args) \
+                        or any(bare(kw.value) for kw in sub.keywords):
+                    return True
+            elif isinstance(sub, ast.Assign):
+                if bare(sub.value) or (
+                        isinstance(sub.value, (ast.Tuple, ast.List,
+                                               ast.Dict))
+                        and any(bare(e) for e in
+                                ast.iter_child_nodes(sub.value))):
+                    return True
+        return False
+
+    # -------------------------------------------------------------- locks
+    def _check_lock_acquires(self, src, fn, stmts, finally_ids):
+        if fn.name in _LOCK_METHODS:
+            return
+        for stmt in stmts:
+            if not (isinstance(stmt, ast.Expr)
+                    and isinstance(stmt.value, ast.Call)
+                    and isinstance(stmt.value.func, ast.Attribute)
+                    and stmt.value.func.attr == "acquire"):
+                continue
+            recv = dotted_name(stmt.value.func.value)
+            released = False
+            for other in stmts:
+                for sub in ast.walk(other):
+                    if isinstance(sub, ast.Call) \
+                            and isinstance(sub.func, ast.Attribute) \
+                            and sub.func.attr == "release" \
+                            and dotted_name(sub.func.value) == recv \
+                            and id(sub) in finally_ids:
+                        released = True
+            if not released:
+                yield self.issue(
+                    src, stmt,
+                    f"{recv}.acquire() without a release() in a "
+                    f"`finally` — an exception before the release "
+                    f"leaves the lock held forever; use `with {recv}:`")
+
+    @staticmethod
+    def _local_calls(fn):
+        from ..callgraph import CallGraph
+        for node in CallGraph._local_nodes(fn):
+            if isinstance(node, ast.Call):
+                yield node
