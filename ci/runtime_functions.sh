@@ -40,7 +40,17 @@ sanity_lint() {
     # --format json: one finding object per line so CI can annotate the
     # offending lines; any finding fails the job (exit 1).  tools/ is
     # linted too — the linter holds itself to its own rules.
-    python -m tools.mxlint --format json mxnet_tpu/ tools/
+    # --baseline is the ratchet: committed findings don't fail, NEW
+    # ones do, so a strict new pass can land before a full-tree sweep.
+    python -m tools.mxlint --format json \
+        --baseline ci/mxlint_baseline.json mxnet_tpu/ tools/
+    # baseline drift check: re-record and require the committed file
+    # byte-identical — a fixed finding whose entry lingered (or a new
+    # one argued into the baseline but not committed) fails the job
+    python -m tools.mxlint --format json \
+        --baseline ci/mxlint_baseline.json --update-baseline \
+        mxnet_tpu/ tools/
+    git diff --exit-code -- ci/mxlint_baseline.json
     # then the dynamic half: engine+serving tests double as race tests
     # under the concurrency sanitizer (lock-order recording + tracked-
     # array assertions)

@@ -27,7 +27,8 @@ import numpy as np
 from .base import MXNetError
 
 __all__ = ["export_stablehlo", "load_stablehlo", "load_manifest",
-           "validate_inputs", "StableHLOModel"]
+           "validate_manifest", "validate_signature", "validate_inputs",
+           "StableHLOModel"]
 
 
 def _manifest_path(path):
@@ -93,8 +94,6 @@ def export_stablehlo(block, *example_inputs, path, emit_text=False,
     except Exception as e:
         raise MXNetError(f"export_stablehlo: lowering failed: {e}") from e
     blob = exported.serialize()
-    with open(path + ".shlo", "wb") as f:
-        f.write(bytes(blob))
     manifest = {
         "format": "jax.export/stablehlo",
         # null when the caller did not pick one, so the serving
@@ -107,6 +106,12 @@ def export_stablehlo(block, *example_inputs, path, emit_text=False,
                     for a in exported.out_avals],
         "block": type(block).__name__,
     }
+    # validate BEFORE anything touches disk: a rejected export must not
+    # leave an orphan .shlo that a later load_stablehlo would serve
+    # manifest-less (and therefore unchecked)
+    validate_manifest(manifest, where=f"export_stablehlo({path!r})")
+    with open(path + ".shlo", "wb") as f:
+        f.write(bytes(blob))
     with open(path + ".json", "w") as f:
         json.dump(manifest, f, indent=1)
     if emit_text:
@@ -128,6 +133,113 @@ def load_manifest(path):
     if not isinstance(manifest.get("inputs"), list):
         raise MXNetError(f"malformed artifact manifest {mpath}: "
                          f"missing 'inputs' signature")
+    validate_manifest(manifest, where=mpath)
+    return manifest
+
+
+def _check_sig_entries(entries, kind, where):
+    for i, spec in enumerate(entries):
+        if not isinstance(spec, dict) \
+                or not isinstance(spec.get("shape"), list) \
+                or "dtype" not in spec:
+            raise MXNetError(
+                f"{where}: manifest {kind} {i} is not a "
+                f"{{shape, dtype}} signature entry")
+        for d in spec["shape"]:
+            if d is not None and (not isinstance(d, int) or d < 0):
+                raise MXNetError(
+                    f"{where}: manifest {kind} {i} has dimension {d!r} — "
+                    f"dims are nonnegative ints or null (symbolic)")
+        if not _known_dtype(spec["dtype"]):
+            raise MXNetError(
+                f"{where}: manifest {kind} {i} declares unknown dtype "
+                f"{spec['dtype']!r}")
+
+
+def _known_dtype(d) -> bool:
+    """Whether ``d`` names a resolvable dtype.  ``np.dtype`` rejects
+    extension-dtype *names* ('bfloat16') with TypeError even though the
+    dtype objects themselves canonicalize, so those resolve through
+    ml_dtypes (always present — jax depends on it)."""
+    try:
+        np.dtype(d)
+        return True
+    except TypeError:
+        pass
+    except Exception:
+        return False
+    try:
+        import ml_dtypes
+        np.dtype(getattr(ml_dtypes, str(d)))
+        return True
+    except Exception:
+        return False
+
+
+def validate_signature(entries, where="signature", dynamic_batch=False):
+    """Structural check of a bare manifest-style signature list (what
+    ``serving.ModelRepository.add_function`` accepts): each entry is
+    ``{"shape": [int|null, ...], "dtype": name}``.  With
+    ``dynamic_batch`` the same batch-major rule a manifest gets applies:
+    every entry's leading dim must be symbolic (``None``)."""
+    if not isinstance(entries, (list, tuple)):
+        raise MXNetError(
+            f"{where}: signature must be a list of {{shape, dtype}} "
+            f"entries, got {type(entries).__name__}")
+    _check_sig_entries(list(entries), "input", where)
+    if dynamic_batch:
+        for i, spec in enumerate(entries):
+            if not spec["shape"] or spec["shape"][0] is not None:
+                raise MXNetError(
+                    f"{where}: dynamic_batch signature input {i} has a "
+                    f"concrete leading dimension "
+                    f"({spec['shape'] or 'scalar'}) — every input must "
+                    f"share the symbolic (null) batch dim, or register "
+                    f"with dynamic_batch=False")
+    return entries
+
+
+def validate_manifest(manifest, where="manifest"):
+    """Soundness-check a (v2) artifact manifest against what the serving
+    stack infers from it — the static half of ``validate_inputs``.
+
+    Beyond per-entry structure (dims are nonnegative ints or ``null``,
+    dtypes canonicalize), the load-bearing inference check: with
+    ``dynamic_batch`` every *output* must be batch-major with a symbolic
+    leading dimension.  The exported program was traced with one shared
+    symbolic batch size, so an output whose leading dim came out
+    concrete means the block collapsed the batch axis (a global reduce,
+    a transpose) — ``serving`` would mis-split that batch at un-pad
+    time, and the right moment to hear about it is export/load, not
+    mid-request.  Raises :class:`MXNetError`; returns the manifest.
+    """
+    if not isinstance(manifest.get("inputs"), list):
+        raise MXNetError(f"{where}: manifest missing 'inputs' signature")
+    outputs = manifest.get("outputs")
+    _check_sig_entries(manifest["inputs"], "input", where)
+    if isinstance(outputs, list):
+        _check_sig_entries(outputs, "output", where)
+    version = manifest.get("version")
+    if version is not None and not isinstance(version, int):
+        raise MXNetError(
+            f"{where}: manifest version must be an int or null, got "
+            f"{version!r}")
+    if bool(manifest.get("dynamic_batch")):
+        for i, spec in enumerate(manifest["inputs"]):
+            if not spec["shape"] or spec["shape"][0] is not None:
+                raise MXNetError(
+                    f"{where}: dynamic_batch manifest input {i} has a "
+                    f"concrete leading dimension "
+                    f"({spec['shape'] or 'scalar'}) — every input must "
+                    f"share the symbolic batch dim")
+        for i, spec in enumerate(outputs or ()):
+            if not spec["shape"] or spec["shape"][0] is not None:
+                raise MXNetError(
+                    f"{where}: dynamic_batch manifest output {i} is not "
+                    f"batch-major ({spec['shape'] or 'scalar'}): the "
+                    f"block collapses the batch axis, so serving could "
+                    f"not un-pad per-request rows — export with "
+                    f"dynamic_batch=False or keep axis 0 the batch")
     return manifest
 
 

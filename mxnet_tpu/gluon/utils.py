@@ -68,8 +68,11 @@ def clip_global_norm(arrays, max_norm, check_isfinite=True):
     if not arrays:
         raise MXNetError("clip_global_norm: empty array list")
     if _clip_global_norm_jit is None:
-        _clip_global_norm_jit = jax.jit(_clip_global_norm_impl,
-                                        static_argnums=(1,))
+        # max_norm is a TRACED scalar, not a static arg: a clipping
+        # schedule that varies the threshold per step must reuse ONE
+        # compiled program, not compile one per distinct value
+        # (recompile-churn: each static value is a new XLA program)
+        _clip_global_norm_jit = jax.jit(_clip_global_norm_impl)
     scaled, total = _clip_global_norm_jit([a._data for a in arrays],
                                           float(max_norm))
     for a, s in zip(arrays, scaled):

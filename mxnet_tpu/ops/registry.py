@@ -30,6 +30,7 @@ import inspect
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from ..base import MXNetError, Registry
+from . import shape_rules
 
 __all__ = ["OpDef", "LightOpDef", "register", "get_op", "list_ops",
            "invoke", "OP_REGISTRY", "alias"]
@@ -51,7 +52,7 @@ class OpDef:
 
     __slots__ = ("name", "fn", "num_inputs", "num_outputs", "differentiable",
                  "params", "doc", "aliases", "mutates_rng", "aux_update",
-                 "open_schema")
+                 "open_schema", "shape_rule")
 
     def __init__(self, name: str, fn: Callable, num_inputs, num_outputs,
                  differentiable: bool, mutates_rng: bool = False):
@@ -78,6 +79,36 @@ class OpDef:
         self.open_schema = any(p.kind == inspect.Parameter.VAR_KEYWORD
                                for p in sig.parameters.values())
         self.doc = inspect.getdoc(fn) or f"Operator {name}."
+        # declarative ahead-of-trace inference rule (shape_rules.py):
+        # the same metadata serves symbol-shape queries, deploy manifest
+        # checks, and tools/mxlint's abstract interpreter
+        self.shape_rule = shape_rules.rule_for(name)
+
+    def infer_signature(self, input_sigs, kwargs=None):
+        """Ahead-of-trace output signature: ``input_sigs`` is a list of
+        ``(shape, dtype)`` pairs (dims may be ints,
+        :class:`shape_rules.Dim` symbols, or None for unknown; dtype a
+        canonical name or None).  Returns ``(shape, dtype)`` — possibly
+        partially unknown — or ``None`` when the op carries no rule.
+        Raises :class:`MXNetError` on a provably infeasible signature,
+        before any tracing or device work happens.
+        """
+        if self.shape_rule is None:
+            return None
+        shapes, dtypes = [], []
+        for shape, dtype in input_sigs:
+            if shape is None:
+                shapes.append(None)
+            else:
+                shapes.append(tuple(
+                    shape_rules.lit(d) if isinstance(d, int)
+                    else d for d in shape))
+            dtypes.append(dtype)
+        try:
+            return self.shape_rule(shapes, dtypes, dict(kwargs or ()))
+        except shape_rules.ShapeError as e:
+            raise MXNetError(
+                f"operator {self.name}: infeasible signature: {e}") from e
 
     def n_outputs(self, kwargs) -> int:
         if callable(self.num_outputs):
@@ -116,6 +147,7 @@ class LightOpDef(OpDef):
         self.params = {}
         self.open_schema = False
         self.doc = f"Operator {name}."
+        self.shape_rule = None
 
 
 def register(name: str, num_inputs=1, num_outputs=1, differentiable=True,

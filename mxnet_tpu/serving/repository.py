@@ -215,6 +215,15 @@ class ModelRepository:
                      activate=True, dynamic_batch=True):
         """Register a raw callable ``fn(*arrays) -> array|tuple``
         (custom runners, tests).  ``signature`` is manifest-style."""
+        from .. import deploy
+        # a hand-written signature gets the same validation an exported
+        # manifest does — a malformed entry (or a concrete leading dim
+        # under dynamic_batch, which would mis-split rows at un-pad)
+        # would otherwise surface as an opaque failure mid-request
+        deploy.validate_signature(signature,
+                                  where=f"add_function({name!r})",
+                                  dynamic_batch=dynamic_batch)
+
         def make_program(bucket_rows):
             return lambda *xs: _as_tuple(fn(*xs))
 

@@ -147,6 +147,104 @@ def test_manifest_validation_roundtrip(static_art, tmp_path):
                                net(x).asnumpy(), rtol=1e-5, atol=1e-5)
 
 
+def test_validate_manifest_structural_checks():
+    """The ISSUE-5 static half: a manifest is soundness-checked at
+    export AND load time, so a malformed or batch-collapsing signature
+    fails with an actionable error instead of a mid-request failure."""
+    good = {"inputs": [{"shape": [None, 8], "dtype": "float32"}],
+            "outputs": [{"shape": [None, 4], "dtype": "float32"}],
+            "version": 3, "dynamic_batch": True}
+    assert deploy.validate_manifest(dict(good)) == good
+
+    with pytest.raises(MXNetError, match="missing 'inputs'"):
+        deploy.validate_manifest({"outputs": []})
+    bad = dict(good, inputs=[{"shape": [None, -2], "dtype": "float32"}])
+    with pytest.raises(MXNetError, match="nonnegative ints or null"):
+        deploy.validate_manifest(bad)
+    bad = dict(good, inputs=[{"shape": [None, 8], "dtype": "float99"}])
+    with pytest.raises(MXNetError, match="unknown dtype"):
+        deploy.validate_manifest(bad)
+    bad = dict(good, version="three")
+    with pytest.raises(MXNetError, match="version must be an int"):
+        deploy.validate_manifest(bad)
+    bad = dict(good, inputs=[{"shape": "nope", "dtype": "float32"}])
+    with pytest.raises(MXNetError, match="signature entry"):
+        deploy.validate_manifest(bad)
+
+
+def test_validate_manifest_dynamic_batch_inference_checks():
+    """With dynamic_batch, every input AND output must be batch-major
+    with a symbolic (null) leading dim — a concrete leading dim means
+    the block collapsed the batch axis and serving could not un-pad."""
+    m = {"inputs": [{"shape": [4, 8], "dtype": "float32"}],
+         "outputs": [{"shape": [None, 4], "dtype": "float32"}],
+         "dynamic_batch": True}
+    with pytest.raises(MXNetError, match="symbolic batch dim"):
+        deploy.validate_manifest(m)
+    m = {"inputs": [{"shape": [None, 8], "dtype": "float32"}],
+         "outputs": [{"shape": [4], "dtype": "float32"}],
+         "dynamic_batch": True}
+    with pytest.raises(MXNetError, match="not .*batch-major|batch-major"):
+        deploy.validate_manifest(m)
+    # a global reduce to a scalar output is the canonical collapse
+    m = {"inputs": [{"shape": [None, 8], "dtype": "float32"}],
+         "outputs": [{"shape": [], "dtype": "float32"}],
+         "dynamic_batch": True}
+    with pytest.raises(MXNetError, match="batch"):
+        deploy.validate_manifest(m)
+    # static manifests are free to have concrete leading dims
+    m = {"inputs": [{"shape": [4, 8], "dtype": "float32"}],
+         "outputs": [{"shape": [4], "dtype": "float32"}]}
+    deploy.validate_manifest(m)
+
+
+def test_validate_signature_guards_add_function():
+    """A hand-written serving signature gets the same structural check
+    an exported manifest does, at registration time."""
+    from mxnet_tpu.serving import ModelRepository
+
+    deploy.validate_signature([{"shape": [None, 8], "dtype": "float32"}])
+    with pytest.raises(MXNetError, match="list of .*entries"):
+        deploy.validate_signature({"shape": [8]})
+    with pytest.raises(MXNetError, match="unknown dtype"):
+        deploy.validate_signature([{"shape": [8], "dtype": "floatx"}])
+
+    repo = ModelRepository()
+    with pytest.raises(MXNetError, match="add_function\\('bad'\\)"):
+        repo.add_function("bad", lambda x: x,
+                          [{"shape": [None, "eight"], "dtype": "float32"}])
+    assert repo.models() == [] or "bad" not in repo.models()
+    # dynamic_batch (the default) demands a symbolic leading dim at
+    # registration — a concrete one would mis-split rows at un-pad time
+    with pytest.raises(MXNetError, match="concrete leading dimension"):
+        repo.add_function("batchy", lambda x: x,
+                          [{"shape": [4, 8], "dtype": "float32"}])
+    repo.add_function("batchy", lambda x: x,
+                      [{"shape": [4, 8], "dtype": "float32"}],
+                      dynamic_batch=False)        # static entries may
+
+
+def test_rejected_export_leaves_no_orphan_artifact(tmp_path):
+    """A dynamic_batch export whose block collapses the batch axis must
+    fail *before* anything is written: an orphan .shlo without its
+    manifest would later load with zero validation."""
+    from mxnet_tpu import gluon
+
+    class Collapse(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            return x.sum()
+
+    block = Collapse()
+    block.initialize()
+    x = nd.random.uniform(shape=(3, 8))
+    block(x)
+    path = str(tmp_path / "collapse")
+    with pytest.raises(MXNetError, match="batch"):
+        deploy.export_stablehlo(block, x, path=path, dynamic_batch=True)
+    assert not os.path.exists(path + ".shlo")
+    assert not os.path.exists(path + ".json")
+
+
 def test_dynamic_batch_export_serves_any_batch(dynamic_art):
     """dynamic_batch=True leaves the batch dimension symbolic: one
     artifact answers every batch size (the serving subsystem's shape

@@ -222,6 +222,25 @@ def test_fused_update_ineligible_falls_back():
     tr.step(1)          # per-param path still works
 
 
+def test_clip_global_norm_one_program_across_thresholds():
+    """Regression for the ISSUE-5 recompile-churn sweep finding:
+    max_norm used to ride in static_argnums, so a clipping *schedule*
+    (a new threshold every step) compiled a new XLA program per value.
+    It is traced now — distinct thresholds must share one program."""
+    def clip(max_norm):
+        arrays = [nd.ones((2, 2)) * 3, nd.ones((3,)) * 4]
+        gluon.utils.clip_global_norm(arrays, max_norm)
+
+    clip(1.0)       # may genuinely compile (first time for these shapes)
+    baseline = gluon.utils._clip_global_norm_jit._cache_size()
+    clip(2.0)
+    clip(3.5)
+    after = gluon.utils._clip_global_norm_jit._cache_size()
+    assert after == baseline, (
+        f"{after - baseline} extra program(s) compiled for "
+        f"threshold-only changes")
+
+
 def test_clip_global_norm_nan_preserves_arrays():
     a = nd.array([1.0, np.nan])
     b = nd.array([2.0, 3.0])

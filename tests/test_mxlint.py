@@ -29,7 +29,9 @@ def ids(issues):
 def test_pass_catalogue_complete():
     assert set(PASSES) == {"jit-retrace", "host-sync", "lock-discipline",
                            "metrics-misuse", "env-registry",
-                           "collective-soundness", "resource-leak"}
+                           "collective-soundness", "resource-leak",
+                           "shape-soundness", "dtype-promotion",
+                           "recompile-churn"}
 
 
 # ---------------------------------------------------------------- jit-retrace

@@ -1,0 +1,807 @@
+"""mxshape tests: the symbolic shape/dtype lattice, the three passes it
+powers (shape-soundness, dtype-promotion, recompile-churn), the
+interprocedural witness chains, and the baseline/--changed CLI modes
+(ISSUE-5).
+
+Pure-AST + stdlib: no jax import, so the whole file costs a few seconds
+(tier-1 budget discipline — ROADMAP.md; the <15s satellite bound).
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.mxlint import lint_sources                        # noqa: E402
+from tools.mxlint.baseline import (                          # noqa: E402
+    apply_baseline, key_of, load_baseline, record, save_baseline)
+from tools.mxlint.shapes import rules, _join, Arr, DimV, ShapeV  # noqa: E402
+
+R = rules()
+
+
+def run(src, select=None, path="mxnet_tpu/fixture.py", extra=None):
+    sources = {path: textwrap.dedent(src)}
+    for p, s in (extra or {}).items():
+        sources[p] = textwrap.dedent(s)
+    return lint_sources(sources, select=select)
+
+
+def ids(issues):
+    return [i.pass_id for i in issues]
+
+
+# ================================================== the dim lattice itself
+def test_dim_literals_and_symbols():
+    assert R.lit(4).concrete == 4
+    assert R.sym("B").concrete is None
+    assert R.lit(3) == R.lit(3)
+    assert R.lit(3) != R.sym("B")
+    assert R.fmt_dim(R.sym("B")) == "B"
+    assert R.fmt_dim(None) == "?"
+
+
+def test_dim_mul_div_cancellation():
+    B, H = R.sym("B"), R.sym("H")
+    prod = R.dim_mul(B, H)
+    assert R.fmt_dim(prod) == "B*H"
+    # (B*H) / H == B — exact symbolic division
+    assert R.dim_eq(R.dim_div(prod, H), B) is True
+    # (4*B) / 2 == 2*B
+    four_b = R.dim_mul(R.lit(4), B)
+    assert R.dim_eq(R.dim_div(four_b, R.lit(2)),
+                    R.dim_mul(R.lit(2), B)) is True
+    assert R.dim_mul(None, B) is None
+
+
+def test_dim_eq_is_three_valued():
+    B, L = R.sym("B"), R.sym("L")
+    assert R.dim_eq(B, B) is True
+    # symbols are >= 1, so 2*B == 3*B is PROVABLY false…
+    assert R.dim_eq(R.dim_mul(R.lit(2), B),
+                    R.dim_mul(R.lit(3), B)) is False
+    # …but B vs L is simply unknown
+    assert R.dim_eq(B, L) is None
+    assert R.dim_eq(B, None) is None
+    assert R.dim_eq(R.lit(0), R.lit(0)) is True
+    assert R.dim_eq(R.lit(0), B) is False
+
+
+def test_dim_add_only_concrete():
+    assert R.dim_add(R.lit(2), R.lit(3)).concrete == 5
+    assert R.dim_add(R.sym("B"), R.lit(1)) is None
+
+
+def test_product_and_fmt_shape():
+    B = R.sym("B")
+    p = R.product((R.lit(2), B, R.lit(3)))
+    assert R.dim_eq(p, R.dim_mul(R.lit(6), B)) is True
+    assert R.product((B, None)) is None
+    assert R.fmt_shape((R.lit(2), B, None)) == "(2, B, ?)"
+    assert R.fmt_shape(None) == "(?)"
+
+
+def test_abstract_value_join():
+    """The interpreter's join (control-flow merge): equal dims survive,
+    differing dims widen to ?, dtype mismatches widen to unknown."""
+    B = R.sym("B")
+    a = _join(Arr((B, R.lit(4)), "float32"), Arr((B, R.lit(8)), "float32"))
+    assert a.shape == (B, None) and a.dtype == "float32"
+    a = _join(Arr((B,), "float32"), Arr((B,), "bfloat16"))
+    assert a.dtype is None
+    d = _join(DimV(R.lit(3)), DimV(R.lit(3)))
+    assert d.dim.concrete == 3
+    d = _join(DimV(R.lit(3)), DimV(R.lit(4)))
+    assert d.dim is None
+    s = _join(ShapeV((B, R.lit(2))), ShapeV((B, R.lit(3))))
+    assert s.dims == (B, None)
+    # rank mismatch / unrelated kinds go to top
+    assert _join(Arr((B,), "float32"), Arr((B, B), "float32")).shape is None
+
+
+# ===================================================== the shape checkers
+def test_check_reshape_symbolic_feasible_and_infeasible():
+    B, L = R.sym("B"), R.sym("L")
+    HnD = R.dim_mul(R.lit(8), B)
+    # (L, 8*B) -> (L, B, 8): products cancel, feasible
+    out = R.check_reshape((L, HnD), [L, B, R.lit(8)])
+    assert out == (L, B, R.lit(8))
+    # (L, B) -> (L, B, 2): ratio is 2, provably infeasible
+    with pytest.raises(R.ShapeError):
+        R.check_reshape((L, B), [L, B, R.lit(2)])
+    with pytest.raises(R.ShapeError):
+        R.check_reshape((R.lit(3), R.lit(4)), [R.lit(5), R.lit(2)])
+    # unknown operand stays quiet
+    assert R.check_reshape(None, [R.lit(5), R.lit(2)]) == (R.lit(5),
+                                                          R.lit(2))
+
+
+def test_check_reshape_minus_one_inference():
+    out = R.check_reshape((R.lit(6), R.lit(4)), [R.lit(3), -1])
+    assert out == (R.lit(3), R.lit(8))
+    # -1 binds a clean symbolic factor too
+    B = R.sym("B")
+    out = R.check_reshape((B, R.lit(4)), [-1, R.lit(2)])
+    assert R.dim_eq(out[0], R.dim_mul(R.lit(2), B)) is True
+    with pytest.raises(R.ShapeError):     # 12 / 5 is not an integer
+        R.check_reshape((R.lit(3), R.lit(4)), [R.lit(5), -1])
+    with pytest.raises(R.ShapeError):     # two -1s
+        R.check_reshape((R.lit(8),), [-1, -1])
+
+
+def test_check_transpose():
+    B = R.sym("B")
+    assert R.check_transpose((B, R.lit(4)), None) == (R.lit(4), B)
+    assert R.check_transpose((B, R.lit(4), R.lit(2)), (2, 0, 1)) == \
+        (R.lit(2), B, R.lit(4))
+    with pytest.raises(R.ShapeError):
+        R.check_transpose((B, R.lit(4)), (0, 0))
+    with pytest.raises(R.ShapeError):
+        R.check_transpose((B, R.lit(4)), (0, 1, 2))
+    with pytest.raises(R.ShapeError):
+        R.check_transpose((B, R.lit(4)), (0, 5))
+
+
+def test_broadcast_join():
+    B = R.sym("B")
+    assert R.broadcast((B, R.lit(1)), (B, R.lit(4))) == (B, R.lit(4))
+    assert R.broadcast((R.lit(4),), (B, R.lit(4))) == (B, R.lit(4))
+    with pytest.raises(R.ShapeError):
+        R.broadcast((R.lit(3),), (R.lit(5),))
+    # a symbol could still be 1: unknown, not an error
+    out = R.broadcast((B,), (R.lit(5),))
+    assert out == (None,)
+
+
+def test_check_matmul_and_einsum():
+    B, K = R.sym("B"), R.sym("K")
+    out = R.check_matmul((B, R.lit(3), K), (K, R.lit(7)))
+    assert out == (B, R.lit(3), R.lit(7))
+    with pytest.raises(R.ShapeError):
+        R.check_matmul((R.lit(3), R.lit(5)), (R.lit(4), R.lit(2)))
+    out = R.check_einsum("bij,bjk->bik",
+                         [(B, R.lit(2), K), (B, K, R.lit(5))])
+    assert out == (B, R.lit(2), R.lit(5))
+    with pytest.raises(R.ShapeError):
+        R.check_einsum("ij,jk->ik",
+                       [(R.lit(2), R.lit(3)), (R.lit(4), R.lit(5))])
+    with pytest.raises(R.ShapeError):     # rank mismatch
+        R.check_einsum("ijk->ik", [(R.lit(2), R.lit(3))])
+    assert R.check_einsum("b...->b", [(B, R.lit(2))]) is None  # quiet
+
+
+def test_reduce_and_concat_shapes():
+    B = R.sym("B")
+    assert R.reduce_shape((B, R.lit(4)), 1) == (B,)
+    assert R.reduce_shape((B, R.lit(4)), 1, keepdims=True) == \
+        (B, R.lit(1))
+    with pytest.raises(R.ShapeError):
+        R.reduce_shape((B, R.lit(4)), 5)
+    out = R.concat_shapes([(B, R.lit(2)), (B, R.lit(3))], 1)
+    assert out == (B, R.lit(5))
+    with pytest.raises(R.ShapeError):
+        R.concat_shapes([(R.lit(2), R.lit(2)), (R.lit(3), R.lit(2))], 1)
+
+
+# ==================================================== the dtype lattice
+def test_promote_follows_jax_lattice():
+    assert R.promote("float32", "float32") == "float32"
+    assert R.promote("float32", "float64") == "float64"
+    assert R.promote("bfloat16", "float16") == "float32"
+    assert R.promote("int32", "int64") == "int64"
+    assert R.promote("bool", "int32") == "int32"
+    # weak python scalars stay weak against arrays
+    assert R.promote("float", "float32") == "float32"
+    assert R.promote("float", "bfloat16") == "bfloat16"
+    assert R.promote("int", "uint8") == "uint8"
+    assert R.promote("int64", "float") == "float"
+    assert R.promote(None, "float32") is None
+    assert R.promote("float32", "not_a_dtype") is None
+
+
+# ============================================== shape-soundness fixtures
+def test_shape_soundness_infeasible_reshape_in_jit():
+    issues = run("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            a = jnp.zeros((3, 4))
+            return a.reshape(5, 2)
+    """, select=["shape-soundness"])
+    assert ids(issues) == ["shape-soundness"]
+    assert "cannot tile the input" in issues[0].message
+
+
+def test_shape_soundness_seeding_trick_in_hybrid_forward():
+    """`L, B, HnD = x.shape` refines an unknown-rank input to named
+    symbols; the infeasible extra factor is then provable."""
+    issues = run("""
+        class Net:
+            def hybrid_forward(self, F, x):
+                L, B, HnD = x.shape
+                return x.reshape(L, B, 4, HnD)
+    """, select=["shape-soundness"])
+    assert ids(issues) == ["shape-soundness"]
+    assert "4*B*HnD*L" in issues[0].message
+
+
+def test_shape_soundness_feasible_symbolic_juggling_is_quiet():
+    """The ops/contrib.py interleaved-attention pattern: symbolic
+    factors cancel, so nothing fires."""
+    issues = run("""
+        import jax
+
+        @jax.jit
+        def attn(x, heads=4):
+            L, B, HnD = x.shape
+            D = HnD // (heads * 2)
+            y = x.reshape(L, B, heads, 2, D)
+            return y.transpose(1, 2, 0, 3, 4)
+    """, select=["shape-soundness"])
+    assert issues == []
+
+
+def test_shape_soundness_transpose_matmul_einsum_broadcast_unpack():
+    issues = run("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            y = x.reshape(4, 8)
+            t = y.transpose(0, 0)
+            m = jnp.ones((3, 5)) @ jnp.ones((4, 2))
+            e = jnp.einsum("ij,jk->ik", jnp.ones((2, 3)), jnp.ones((4, 5)))
+            b = jnp.ones((3, 4)) + jnp.ones((3, 5))
+            a, bb, c = y.shape
+            return t, m, e, b, a
+    """, select=["shape-soundness"])
+    assert ids(issues) == ["shape-soundness"] * 5
+    msgs = " | ".join(i.message for i in issues)
+    assert "not a permutation" in msgs
+    assert "matmul contraction mismatch" in msgs
+    assert "einsum axis 'j'" in msgs
+    assert "broadcast-compatible" in msgs
+    assert "unpacking the rank-2 shape" in msgs
+
+
+def test_shape_soundness_registry_op_body_is_a_surface():
+    issues = run("""
+        from .registry import register
+
+        @register("bad_op", num_inputs=1)
+        def bad_op(x):
+            L, B = x.shape
+            return x.reshape(L, B, 2)
+    """, select=["shape-soundness"], path="mxnet_tpu/ops/fixture.py")
+    assert ids(issues) == ["shape-soundness"]
+
+
+def test_shape_soundness_suppression():
+    issues = run("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            a = jnp.zeros((3, 4))
+            return a.reshape(5, 2)  # mxlint: disable=shape-soundness (demo)
+    """, select=["shape-soundness"])
+    assert issues == []
+
+
+def test_shape_soundness_interprocedural_witness_chain():
+    """A reshape broken only by the caller's facts anchors at the
+    traced call site with a `via helper (...)` chain."""
+    issues = run("""
+        import jax
+
+        def _merge(y, h):
+            return y.reshape(y.shape[0], h * 2)
+
+        @jax.jit
+        def f(x):
+            a, b = x.shape
+            return _merge(x, b)
+    """, select=["shape-soundness"])
+    assert ids(issues) == ["shape-soundness"]
+    assert issues[0].message.startswith("via _merge (mxnet_tpu/fixture.py:")
+    assert issues[0].line == 10      # the call site, not the helper body
+
+
+def test_shape_soundness_checked_helper_owns_its_own_finding():
+    """A helper that is itself a traced surface keeps its direct
+    finding; the caller does not duplicate it (one bug = one issue)."""
+    issues = run("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def broken():
+            return jnp.zeros((3, 4)).reshape(5, 2)
+
+        @jax.jit
+        def f(x):
+            return broken() + x
+    """, select=["shape-soundness"])
+    assert len(issues) == 1
+    assert issues[0].line == 7
+
+
+# ============================================== dtype-promotion fixtures
+def test_dtype_promotion_silent_float64():
+    issues = run("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            y = x.astype(jnp.float32)
+            scale = jnp.ones((3,), dtype=jnp.float64)
+            return y * scale
+    """, select=["dtype-promotion"])
+    assert ids(issues) == ["dtype-promotion"]
+    assert "silent float64 promotion" in issues[0].message
+
+
+def test_dtype_promotion_weak_python_scalar_is_quiet():
+    issues = run("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            y = x.astype("float32")
+            return y * 2.0 + 1.0
+    """, select=["dtype-promotion"])
+    assert issues == []
+
+
+def test_dtype_promotion_int64_upcast():
+    issues = run("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            idx = x.astype(jnp.int32)
+            big = jnp.ones((3,), dtype=jnp.int64)
+            return idx + big
+    """, select=["dtype-promotion"])
+    assert ids(issues) == ["dtype-promotion"]
+    assert "silent int64 upcast" in issues[0].message
+
+
+def test_dtype_promotion_bf16_accumulation():
+    issues = run("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            y = x.astype(jnp.bfloat16)
+            return jnp.sum(y, axis=0)
+    """, select=["dtype-promotion"])
+    assert ids(issues) == ["dtype-promotion"]
+    assert "accumulates in bfloat16" in issues[0].message
+
+
+def test_dtype_promotion_explicit_accum_dtype_is_quiet():
+    issues = run("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            y = x.astype(jnp.bfloat16)
+            wide = jnp.sum(y, axis=0, dtype=jnp.float32)
+            dot = y @ y.T                 # MXU accumulates dots in f32
+            mx = jnp.max(y, axis=0)       # compare, not accumulate
+            return wide, dot, mx
+    """, select=["dtype-promotion"])
+    assert issues == []
+
+
+def test_dtype_promotion_witness_chain_and_suppression():
+    issues = run("""
+        import jax
+        import jax.numpy as jnp
+
+        def _scale(y):
+            return y * jnp.ones((3,), dtype=jnp.float64)
+
+        @jax.jit
+        def f(x):
+            return _scale(x.astype(jnp.float32))
+
+        @jax.jit
+        def g(x):
+            # mxlint: disable=dtype-promotion (f64 demanded by checkpoint)
+            return _scale(x.astype(jnp.float32))
+    """, select=["dtype-promotion"])
+    assert ids(issues) == ["dtype-promotion"]
+    assert issues[0].message.startswith("via _scale (")
+
+
+# ============================================== recompile-churn fixtures
+def test_recompile_churn_static_arg_from_request():
+    issues = run("""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnums=(1,))
+        def kernel(x, n):
+            return x[:n]
+
+        def handle(request, x):
+            n = int(request)
+            return kernel(x, n)
+    """, select=["recompile-churn"])
+    assert ids(issues) == ["recompile-churn"]
+    assert "static argument 'n'" in issues[0].message
+    assert "request-scoped parameter 'request'" in issues[0].message
+
+
+def test_recompile_churn_data_dependent_dimension():
+    issues = run("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kernel(x):
+            return x * 2
+
+        def handle(request):
+            n = len(request)
+            pad = jnp.zeros((n, 4))
+            return kernel(pad)
+    """, select=["recompile-churn"])
+    assert ids(issues) == ["recompile-churn"]
+    assert "new trace signature" in issues[0].message
+
+
+def test_recompile_churn_bucketed_dimension_is_washed():
+    issues = run("""
+        import jax
+        import jax.numpy as jnp
+        from mxnet_tpu.serving.batcher import next_bucket
+
+        @jax.jit
+        def kernel(x):
+            return x * 2
+
+        def handle(request):
+            n = next_bucket(len(request))
+            pad = jnp.zeros((n, 4))
+            return kernel(pad)
+    """, select=["recompile-churn"])
+    assert issues == []
+
+
+def test_recompile_churn_self_config_is_bounded():
+    issues = run("""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnums=(1,))
+        def kernel(x, n):
+            return x[:n]
+
+        class Model:
+            def predict(self, x):
+                return kernel(x, self.max_len)
+    """, select=["recompile-churn"])
+    assert issues == []
+
+
+def test_recompile_churn_witness_chain_through_helper():
+    issues = run("""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnums=(1,))
+        def kernel(x, n):
+            return x[:n]
+
+        def _prep(req):
+            return int(req) + 1
+
+        def handle(request, x):
+            n = _prep(request)
+            return kernel(x, n)
+    """, select=["recompile-churn"])
+    assert ids(issues) == ["recompile-churn"]
+    assert "via _prep (mxnet_tpu/fixture.py:13)" in issues[0].message
+
+
+def test_recompile_churn_suppression_and_nonliteral_statics():
+    issues = run("""
+        import jax
+        from functools import partial
+
+        _NUMS = (1,)
+
+        @partial(jax.jit, static_argnums=_NUMS)
+        def kernel(x, n):
+            return x[:n]
+
+        @partial(jax.jit, static_argnums=(1,))
+        def kernel2(x, n):
+            return x[:n]
+
+        def handle(request, x):
+            a = kernel(x, int(request))   # statics unknown: stay quiet
+            # mxlint: disable=recompile-churn (request len is an enum of 2)
+            b = kernel2(x, int(request))
+            return a, b
+    """, select=["recompile-churn"])
+    assert issues == []
+
+
+# ============================================ the ISSUE-5 acceptance gate
+def test_acceptance_fixture_one_finding_each_with_witness():
+    """One fixture with an infeasible reshape, a silent dtype promotion
+    and an unbounded-signature jit call site: exactly one finding per
+    pass, each carrying a witness chain."""
+    issues = run("""
+        import jax
+        import jax.numpy as jnp
+        from functools import partial
+
+        def _reshape_helper(y, b):
+            return y.reshape(y.shape[0], 2 * b)
+
+        def _widen_helper(y):
+            return y + jnp.ones((4,), dtype=jnp.float64)
+
+        def _count_helper(request):
+            return len(request)
+
+        @jax.jit
+        def traced(x):
+            a, b = x.shape
+            bad_shape = _reshape_helper(x, b)
+            bad_dtype = _widen_helper(x.astype(jnp.float32))
+            return bad_shape, bad_dtype
+
+        @partial(jax.jit, static_argnums=(1,))
+        def kernel(x, n):
+            return x[:n]
+
+        def serve(request, x):
+            return kernel(x, _count_helper(request))
+    """)
+    by_pass = {i.pass_id: i for i in issues}
+    assert sorted(by_pass) == ["dtype-promotion", "recompile-churn",
+                               "shape-soundness"]
+    assert len(issues) == 3
+    assert "via _reshape_helper (" in by_pass["shape-soundness"].message
+    assert "via _widen_helper (" in by_pass["dtype-promotion"].message
+    assert "via _count_helper (" in by_pass["recompile-churn"].message
+
+
+# ======================================================= baseline ratchet
+def _mkissues(*keys):
+    """Fabricate sorted issues from (pass, path, msg) triples."""
+    from tools.mxlint.core import Issue
+    out = [Issue(p, f, i + 1, 0, m)
+           for i, (p, f, m) in enumerate(keys)]
+    out.sort(key=lambda i: i.sort_key())
+    return out
+
+
+def test_baseline_record_and_apply():
+    issues = _mkissues(("p", "a.py", "msg1"), ("p", "a.py", "msg1"),
+                       ("q", "b.py", "msg2"))
+    counts = record(issues)
+    assert counts == {"p|a.py|msg1": 2, "q|b.py|msg2": 1}
+    new, baselined, stale = apply_baseline(issues, counts)
+    assert new == [] and baselined == 3 and stale == []
+    # one extra occurrence of a baselined key IS a new finding
+    extra = _mkissues(("p", "a.py", "msg1"), ("p", "a.py", "msg1"),
+                      ("p", "a.py", "msg1"), ("q", "b.py", "msg2"))
+    new, baselined, stale = apply_baseline(extra, counts)
+    assert len(new) == 1 and key_of(new[0]) == "p|a.py|msg1"
+    # a fixed finding leaves a stale key
+    new, baselined, stale = apply_baseline(
+        _mkissues(("p", "a.py", "msg1"), ("p", "a.py", "msg1")), counts)
+    assert new == [] and stale == ["q|b.py|mssg2".replace("ss", "s")]
+
+
+def test_baseline_roundtrip_is_byte_stable(tmp_path):
+    """Re-recording an unchanged tree must be byte-identical — the CI
+    drift check diffs the file."""
+    path = str(tmp_path / "base.json")
+    issues = _mkissues(("q", "b.py", "m2"), ("p", "a.py", "m1"))
+    save_baseline(path, issues)
+    first = open(path).read()
+    assert load_baseline(path) == record(issues)
+    save_baseline(path, issues)
+    assert open(path).read() == first
+
+
+def test_baseline_malformed_is_hard_error(tmp_path):
+    path = tmp_path / "base.json"
+    with pytest.raises(FileNotFoundError):
+        load_baseline(str(path))
+    path.write_text('{"version": 99, "findings": {}}')
+    with pytest.raises(ValueError):
+        load_baseline(str(path))
+    path.write_text('{"version": 1, "findings": {"k": 0}}')
+    with pytest.raises(ValueError):
+        load_baseline(str(path))
+
+
+# ===================================================== CLI: ratchet mode
+BAD_FIXTURE = """\
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def f(x):
+    a = jnp.zeros((3, 4))
+    return a.reshape(5, 2)
+"""
+
+
+def mxlint(*argv, cwd=REPO):
+    env = dict(os.environ, PYTHONPATH=REPO)
+    return subprocess.run(
+        [sys.executable, "-m", "tools.mxlint"] + list(argv),
+        cwd=cwd, capture_output=True, text=True, env=env)
+
+
+def test_cli_baseline_ratchet(tmp_path):
+    bad = tmp_path / "fix" / "bad.py"
+    bad.parent.mkdir()
+    bad.write_text(BAD_FIXTURE)
+    base = str(tmp_path / "base.json")
+    # without a baseline: the finding fails the run
+    proc = mxlint(str(bad.parent))
+    assert proc.returncode == 1 and "shape-soundness" in proc.stdout
+    # record, then the same tree is clean
+    proc = mxlint("--baseline", base, "--update-baseline",
+                  str(bad.parent))
+    assert proc.returncode == 0, proc.stderr
+    proc = mxlint("--baseline", base, str(bad.parent))
+    assert proc.returncode == 0
+    assert "baselined" in proc.stdout
+    # a NEW finding still fails, and only it is printed
+    bad.write_text(BAD_FIXTURE +
+                   "\n@jax.jit\ndef g(x):\n"
+                   "    return jnp.ones((2, 2)).reshape(3, 3)\n")
+    proc = mxlint("--baseline", base, "--format", "json",
+                  str(bad.parent))
+    assert proc.returncode == 1
+    lines = [json.loads(l) for l in proc.stdout.splitlines()]
+    assert len(lines) == 1 and lines[0]["line"] == 11
+    # fixing everything leaves stale keys -> warning, still rc 0
+    bad.write_text("x = 1\n")
+    proc = mxlint("--baseline", base, str(bad.parent))
+    assert proc.returncode == 0
+    assert "stale baseline" in proc.stderr
+
+
+def test_cli_update_baseline_requires_file_and_full_run(tmp_path):
+    proc = mxlint("--update-baseline", "tools/mxlint/baseline.py")
+    assert proc.returncode == 2
+    assert "--baseline" in proc.stderr
+    proc = mxlint("--baseline", str(tmp_path / "b.json"),
+                  "--update-baseline", "--changed",
+                  "tools/mxlint/baseline.py")
+    assert proc.returncode == 2
+    assert "partial" in proc.stderr
+    # --select is just as partial: recording it would wipe every
+    # baselined finding of the unselected passes
+    proc = mxlint("--baseline", str(tmp_path / "b.json"),
+                  "--update-baseline", "--select", "env-registry",
+                  "tools/mxlint/baseline.py")
+    assert proc.returncode == 2
+    assert "partial" in proc.stderr
+
+
+# ===================================================== CLI: changed mode
+def _git(cwd, *argv):
+    proc = subprocess.run(
+        ["git"] + list(argv), cwd=cwd, capture_output=True, text=True,
+        env=dict(os.environ, GIT_AUTHOR_NAME="t", GIT_AUTHOR_EMAIL="t@t",
+                 GIT_COMMITTER_NAME="t", GIT_COMMITTER_EMAIL="t@t",
+                 HOME=str(cwd)))
+    assert proc.returncode == 0, proc.stderr
+    return proc.stdout
+
+
+HELPER_SRC = """\
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnums=(1,))
+def hkernel(x, n):
+    return x[:n]
+
+def helper_bug(x, request):
+    return hkernel(x, int(request))
+
+def prep(req):
+    return int(req) + 1
+"""
+
+CALLER_V1 = """\
+def handle(x, request):
+    return None
+"""
+
+CALLER_V2 = """\
+import jax
+from functools import partial
+
+from .helper import prep
+
+@partial(jax.jit, static_argnums=(1,))
+def ckernel(x, n):
+    return x[:n]
+
+def handle(x, request):
+    n = prep(request)
+    return ckernel(x, n)
+"""
+
+
+def test_cli_changed_filters_reporting_but_not_the_callgraph(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "helper.py").write_text(HELPER_SRC)
+    (pkg / "caller.py").write_text(CALLER_V1)
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", ".")
+    _git(tmp_path, "commit", "-qm", "seed")
+    # nothing changed: clean no-op (paths before the bare flag — an
+    # nargs="?" REF would otherwise swallow the path)
+    proc = mxlint("pkg", "--changed", cwd=tmp_path)
+    assert proc.returncode == 0
+    assert "no linted files changed" in proc.stdout
+    # a full run sees BOTH bugs (helper's own + nothing in caller yet)
+    proc = mxlint("pkg", cwd=tmp_path)
+    assert proc.returncode == 1 and "helper.py" in proc.stdout
+    # modify only caller.py: its cross-file finding (through the
+    # UNCHANGED helper) is reported, helper's own bug is not
+    (pkg / "caller.py").write_text(CALLER_V2)
+    proc = mxlint("pkg", "--changed", "--format", "json", cwd=tmp_path)
+    assert proc.returncode == 1, proc.stderr
+    findings = [json.loads(l) for l in proc.stdout.splitlines()]
+    assert [f["file"] for f in findings] == [os.path.join("pkg",
+                                                          "caller.py")]
+    assert findings[0]["pass"] == "recompile-churn"
+    assert "via prep" in findings[0]["message"]
+    # explicit REF works too
+    proc = mxlint("--changed", "HEAD", "pkg", cwd=tmp_path)
+    assert proc.returncode == 1 and "caller.py" in proc.stdout
+    # an UNTRACKED file counts as changed even when mxlint runs from a
+    # subdirectory (ls-files is cwd-scoped; mxlint pins it to the root)
+    (tmp_path / "pkg" / "caller.py").write_text(CALLER_V1)   # revert
+    (tmp_path / "pkg" / "fresh.py").write_text(BAD_FIXTURE)
+    proc = mxlint(".", "--changed", cwd=tmp_path / "pkg")
+    assert proc.returncode == 1, proc.stderr
+    assert "fresh.py" in proc.stdout and "helper.py" not in proc.stdout
+    # a path mistakenly consumed as the REF is a hard error, never a
+    # silent "nothing changed"
+    proc = mxlint("--changed", "pkg", cwd=tmp_path)
+    assert proc.returncode == 2
+    assert "clean" not in proc.stdout
+
+
+def test_cli_changed_bad_ref_is_a_hard_error(tmp_path):
+    pkg = tmp_path / "p"
+    pkg.mkdir()
+    (pkg / "a.py").write_text("x = 1\n")
+    _git(tmp_path, "init", "-q")
+    proc = mxlint("--changed", "no_such_ref", "p", cwd=tmp_path)
+    assert proc.returncode == 2
+    assert "git" in proc.stderr

@@ -1455,3 +1455,70 @@ def test_sweep_budget():
         "sample_normal", "sample_uniform", "Custom"}
     missing = {n for n in CANONICAL if n not in ORACLES}
     assert missing <= allowed_no_oracle, missing - allowed_no_oracle
+
+
+# ------------------------------------------------- declarative shape rules
+# ISSUE-5: ops with a rule in ops/shape_rules.py answer "what comes
+# out?" without tracing (OpDef.infer_signature) — the same algebra the
+# mxlint abstract interpreter and deploy manifest checks consume.  The
+# sweep holds every rule to the real forward pass: a concrete predicted
+# dim must match the actual output.
+RULED = [n for n in CANONICAL
+         if OP_REGISTRY[n].shape_rule is not None and n not in FWD_SKIP]
+
+
+def test_shape_rules_cover_the_juggling_core():
+    # the reshape/transpose/reduce/matmul family the serving and lint
+    # layers reason about must stay covered as the registry grows
+    assert {"Reshape", "transpose", "expand_dims", "dot", "batch_dot",
+            "sum", "Concat"} <= set(RULED)
+
+
+@pytest.mark.parametrize("name", RULED)
+def test_infer_signature_agrees_with_forward(name):
+    od = OP_REGISTRY[name]
+    np_inputs, kwargs, _wrt, _gr, _rtol, _atol = _get_spec(name, od)
+    out = _first(_run(name, np_inputs, kwargs))
+    sig = od.infer_signature(
+        [(x.shape, str(x.dtype)) for x in np_inputs], kwargs)
+    assert sig is not None
+    shape, dtype = sig
+    actual = out.asnumpy()
+    if shape is not None:
+        assert len(shape) == actual.ndim, \
+            f"{name}: predicted rank {len(shape)} vs {actual.ndim}"
+        for i, d in enumerate(shape):
+            if d is not None and d.concrete is not None:
+                assert d.concrete == actual.shape[i], \
+                    f"{name}: axis {i} predicted {d.concrete}, " \
+                    f"got {actual.shape[i]}"
+    if dtype is not None:
+        assert dtype == str(actual.dtype), \
+            f"{name}: predicted dtype {dtype}, got {actual.dtype}"
+
+
+def test_infer_signature_symbolic_and_infeasible():
+    """The registry rule answers symbolic queries (serving's dynamic
+    batch dim) and raises MXNetError on provable infeasibility before
+    any tracing happens."""
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.ops import shape_rules as SR
+
+    od = OP_REGISTRY["reshape"]
+    B = SR.sym("B")
+    shape, dtype = od.infer_signature([((B, 8), "float32")],
+                                      {"shape": (-1, 4)})
+    assert SR.dim_eq(shape[0], SR.dim_mul(SR.lit(2), B)) is True
+    assert SR.dim_eq(shape[1], SR.lit(4)) is True
+    assert dtype == "float32"
+    with pytest.raises(MXNetError, match="infeasible"):
+        od.infer_signature([((3, 4), "float32")], {"shape": (5, 2)})
+    # int dims in the query are lifted to Dim literals
+    shape, _ = od.infer_signature([((6, 4), "float32")],
+                                  {"shape": (3, -1)})
+    assert shape == (SR.lit(3), SR.lit(8))
+    # an op without a rule degrades to None, never to a guess
+    no_rule = next(n for n in CANONICAL
+                   if OP_REGISTRY[n].shape_rule is None)
+    assert OP_REGISTRY[no_rule].infer_signature(
+        [((2, 2), "float32")], {}) is None

@@ -1,17 +1,58 @@
 """CLI: ``python -m tools.mxlint [paths...]``.
 
 Exit codes: 0 clean, 1 findings, 2 usage error.  CI runs
-``python -m tools.mxlint --format json mxnet_tpu/ tools/`` as part of
-the ``sanity_lint`` job (ci/runtime_functions.sh): one JSON object per
-finding per line, so the CI harness can annotate changed lines without
-parsing the human format.
+``python -m tools.mxlint --format json --baseline ci/mxlint_baseline.json
+mxnet_tpu/ tools/`` as part of the ``sanity_lint`` job
+(ci/runtime_functions.sh): one JSON object per finding per line, so the
+CI harness can annotate changed lines without parsing the human format.
+
+Ratchet mode (``--baseline``, docs/static_analysis.md): findings
+recorded in the baseline file don't fail the run — only *new* ones do —
+so a new pass can land strict without blocking on a full-tree sweep.
+``--update-baseline`` re-records; CI then re-records and
+``git diff --exit-code``s the file, so a drifted baseline fails the job.
+
+Fast pre-commit loop (``--changed [REF]``): lint only files modified vs
+``REF`` (default HEAD, staged + unstaged + untracked).  The whole
+project is still parsed and the call graph built project-wide, so
+interprocedural facts stay sound — only *reporting* is filtered.
 """
 import argparse
 import json
+import os
+import subprocess
 import sys
 
 from . import PASSES, lint_paths
-from .core import iter_py_files
+from .baseline import apply_baseline, load_baseline, save_baseline
+from .core import iter_py_files, path_key
+
+
+def _changed_abspaths(ref):
+    """Absolute paths of python files modified vs ``ref`` (plus
+    untracked), per git.  Raises RuntimeError with the git message on a
+    bad ref / not-a-repo."""
+    def git(*argv, cwd=None):
+        proc = subprocess.run(["git"] + list(argv), capture_output=True,
+                              text=True, cwd=cwd)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"mxlint: git {' '.join(argv)} failed: "
+                f"{proc.stderr.strip() or proc.stdout.strip()}")
+        return proc.stdout
+
+    root = git("rev-parse", "--show-toplevel").strip()
+    # the trailing "--" forces REF to parse as a revision: without it a
+    # path accidentally consumed by the nargs="?" flag would become a
+    # pathspec and silently lint nothing
+    names = git("diff", "--name-only", ref, "--").splitlines()
+    # ls-files output is cwd-relative and cwd-scoped (diff names are
+    # always root-relative) — run it from the root or untracked files
+    # outside a subdirectory invocation's cwd would be silently missed
+    names += git("ls-files", "--others", "--exclude-standard",
+                 cwd=root).splitlines()
+    return {os.path.abspath(os.path.join(root, n))
+            for n in names if n.endswith(".py")}
 
 
 def main(argv=None):
@@ -34,12 +75,36 @@ def main(argv=None):
                          "path:line:col: [pass] message) or 'json' "
                          "(one finding object per line for CI "
                          "annotation)")
+    ap.add_argument("--baseline", metavar="FILE",
+                    help="ratchet mode: subtract findings recorded in "
+                         "FILE; only new findings fail the run")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="re-record the current findings into the "
+                         "--baseline file and exit 0")
+    ap.add_argument("--changed", nargs="?", const="HEAD", metavar="REF",
+                    help="report findings only for files modified vs "
+                         "REF (default HEAD; staged+unstaged+untracked)."
+                         "  The call graph is still built project-wide,"
+                         " so interprocedural findings stay sound")
     args = ap.parse_args(argv)
 
     if args.list_passes:
         for pid in sorted(PASSES):
             print(f"{pid:18s} {PASSES[pid].doc}")
         return 0
+    if args.update_baseline and not args.baseline:
+        print("mxlint: --update-baseline requires --baseline FILE",
+              file=sys.stderr)
+        return 2
+    if args.update_baseline and (args.changed is not None or args.select):
+        # a partial run sees a subset of findings; recording it would
+        # silently drop every baselined finding outside the change/pass
+        # set (narrowed *paths* are the caller's contract: a baseline
+        # belongs to the path set it is always linted with, as in CI)
+        which = "--changed" if args.changed is not None else "--select"
+        print(f"mxlint: refusing to record a baseline from a partial "
+              f"({which}) run", file=sys.stderr)
+        return 2
 
     select = None
     if args.select:
@@ -60,8 +125,48 @@ def main(argv=None):
         print(f"mxlint: no python files under {', '.join(paths)}",
               file=sys.stderr)
         return 2
+
+    report = None
+    if args.changed is not None:
+        try:
+            changed = _changed_abspaths(args.changed)
+        except RuntimeError as e:
+            print(e, file=sys.stderr)
+            return 2
+        report = {path_key(f) for f in files
+                  if os.path.abspath(f) in changed}
+        if not report:
+            if args.format != "json":
+                print(f"mxlint: no linted files changed vs "
+                      f"{args.changed}")
+            return 0
+
     # hand the expanded list through so the tree is walked once
-    issues = lint_paths(files, select=select)
+    issues = lint_paths(files, select=select, report=report)
+
+    if args.update_baseline:
+        counts = save_baseline(args.baseline, issues)
+        print(f"mxlint: baseline recorded: {len(issues)} finding(s), "
+              f"{len(counts)} key(s) -> {args.baseline}",
+              file=sys.stderr)
+        return 0
+
+    baselined = 0
+    if args.baseline:
+        try:
+            base = load_baseline(args.baseline)
+        except (FileNotFoundError, ValueError) as e:
+            print(e, file=sys.stderr)
+            return 2
+        issues, baselined, stale = apply_baseline(issues, base)
+        if stale and args.changed is None:
+            # fixed findings whose entries linger; the CI drift check
+            # turns this warning into a failure
+            print(f"mxlint: {len(stale)} stale baseline entr"
+                  f"{'y' if len(stale) == 1 else 'ies'} (fixed "
+                  f"findings) — re-record with --update-baseline",
+                  file=sys.stderr)
+
     if not args.quiet:
         for issue in issues:
             if args.format == "json":
@@ -77,11 +182,16 @@ def main(argv=None):
         for i in issues:
             by_pass[i.pass_id] = by_pass.get(i.pass_id, 0) + 1
         detail = ", ".join(f"{k}={v}" for k, v in sorted(by_pass.items()))
-        print(f"mxlint: {len(issues)} issue(s) ({detail})",
+        new = "new " if args.baseline else ""
+        print(f"mxlint: {len(issues)} {new}issue(s) ({detail})"
+              + (f", {baselined} baselined" if baselined else ""),
               file=sys.stderr)
         return 1
     if args.format != "json":       # keep json output machine-pure
-        print("mxlint: clean")
+        msg = "mxlint: clean"
+        if baselined:
+            msg += f" ({baselined} baselined finding(s) remain)"
+        print(msg)
     return 0
 
 

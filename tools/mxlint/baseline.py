@@ -1,0 +1,99 @@
+"""Finding baseline: the ratchet that lets new passes land strict.
+
+A baseline file records the current findings as ``pass|file|message``
+keys with occurrence counts (line numbers are deliberately NOT part of
+the key — unrelated edits move lines, and a moved finding is not a new
+finding).  With ``--baseline <file>`` mxlint subtracts baselined
+occurrences and fails only on *new* ones; ``--update-baseline``
+re-records.  CI pairs the two: lint against the committed baseline,
+then re-record and ``git diff --exit-code`` it, so a drifted baseline
+(fixed findings not removed, new ones not argued) fails the job.
+
+The committed baseline lives at ``ci/mxlint_baseline.json`` and is
+empty today — the tree is clean — but the mechanism is what allows the
+next pass to ship strict without blocking on a full sweep.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Tuple
+
+__all__ = ["key_of", "record", "load_baseline", "save_baseline",
+           "apply_baseline"]
+
+_VERSION = 1
+
+
+def key_of(issue) -> str:
+    return f"{issue.pass_id}|{issue.path}|{issue.message}"
+
+
+def record(issues) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for i in issues:
+        k = key_of(i)
+        counts[k] = counts.get(k, 0) + 1
+    return counts
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    """Parse a baseline file.  Raises FileNotFoundError / ValueError —
+    a missing or malformed baseline must be a hard error, never a
+    silently-empty ratchet."""
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"mxlint: baseline file not found: {path} (record one with "
+            f"--update-baseline)")
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or data.get("version") != _VERSION \
+            or not isinstance(data.get("findings"), dict):
+        raise ValueError(
+            f"mxlint: malformed baseline {path}: expected "
+            f'{{"version": {_VERSION}, "findings": {{...}}}}')
+    out = {}
+    for k, v in data["findings"].items():
+        if not isinstance(v, int) or v < 1:
+            raise ValueError(
+                f"mxlint: malformed baseline {path}: count for {k!r} "
+                f"must be a positive int")
+        out[k] = v
+    return out
+
+
+def save_baseline(path: str, issues) -> Dict[str, int]:
+    """Write the findings as a baseline (sorted keys, stable layout, so
+    re-recording an unchanged tree is byte-identical — the CI drift
+    check depends on that)."""
+    counts = record(issues)
+    data = {"version": _VERSION,
+            "findings": {k: counts[k] for k in sorted(counts)}}
+    with open(path, "w") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return counts
+
+
+def apply_baseline(issues, baseline: Dict[str, int]
+                   ) -> Tuple[List, int, List[str]]:
+    """Split findings against a baseline.
+
+    Returns ``(new_issues, baselined_count, stale_keys)``: occurrences
+    beyond a key's baselined count are new (issues arrive sorted, so
+    the earliest occurrences are the baselined ones); ``stale_keys``
+    are baseline entries the tree no longer produces — fixed findings
+    whose entry should be dropped via ``--update-baseline``.
+    """
+    remaining = dict(baseline)
+    new = []
+    baselined = 0
+    for i in issues:
+        k = key_of(i)
+        if remaining.get(k, 0) > 0:
+            remaining[k] -= 1
+            baselined += 1
+        else:
+            new.append(i)
+    stale = sorted(k for k, v in remaining.items() if v > 0)
+    return new, baselined, stale

@@ -33,7 +33,8 @@ import re
 from typing import Dict, Iterable, List, Optional, Tuple
 
 __all__ = ["Issue", "LintPass", "Project", "SourceFile", "PASSES",
-           "register_pass", "lint_sources", "lint_paths", "iter_py_files"]
+           "register_pass", "lint_sources", "lint_paths", "iter_py_files",
+           "path_key"]
 
 _SUPPRESS_RE = re.compile(
     r"#\s*mxlint:\s*(disable|disable-file)=([A-Za-z0-9_,\-]+)")
@@ -240,18 +241,29 @@ def iter_py_files(paths: Iterable[str]) -> List[str]:
 
 
 def lint_sources(sources: Dict[str, str], select: Optional[List[str]] = None,
-                 project: Optional[Project] = None) -> List[Issue]:
+                 project: Optional[Project] = None,
+                 report: Optional[Iterable[str]] = None) -> List[Issue]:
     """Lint {path: source} pairs.  The in-memory entry point the fixture
-    tests use; ``lint_paths`` wraps it for the CLI."""
+    tests use; ``lint_paths`` wraps it for the CLI.
+
+    ``report`` restricts which files *findings are reported for*
+    (``--changed`` mode): every file still feeds the project harvest,
+    the call graph, and the dataflow summaries, so interprocedural
+    facts stay sound — only per-file checking and cross-file finalize
+    findings are filtered to the report set.
+    """
     from . import passes as _passes            # noqa: F401 — registers all
+    report_set = None if report is None else set(report)
     files = []
     errors = []
     for path, src in sorted(sources.items()):
         try:
             files.append(SourceFile(path, src))
         except SyntaxError as e:
-            errors.append(Issue("parse-error", path, e.lineno or 1,
-                                e.offset or 0, f"syntax error: {e.msg}"))
+            if report_set is None or path in report_set:
+                errors.append(Issue("parse-error", path, e.lineno or 1,
+                                    e.offset or 0,
+                                    f"syntax error: {e.msg}"))
     if project is None:
         project = Project()
     project.harvest(files)
@@ -263,20 +275,33 @@ def lint_sources(sources: Dict[str, str], select: Optional[List[str]] = None,
                            f"known: {sorted(PASSES)}")
         p = PASSES[pid](project)
         for f in files:
+            if report_set is not None and f.path not in report_set:
+                continue
             issues.extend(i for i in p.check_file(f) if i is not None)
-        issues.extend(i for i in p.finalize() if i is not None)
+        issues.extend(
+            i for i in p.finalize()
+            if i is not None
+            and (report_set is None or i.path in report_set))
     issues.sort(key=Issue.sort_key)
     return issues
 
 
+def path_key(path: str) -> str:
+    """The key a file gets in ``lint_sources`` / in reported findings:
+    repo-relative where the file lives under the repo, the path as
+    given otherwise.  Exposed so ``--changed`` can map git's file list
+    onto finding paths."""
+    rel = os.path.relpath(os.path.abspath(path), Project._repo_root())
+    return rel if not rel.startswith("..") else path
+
+
 def lint_paths(paths: Iterable[str], select: Optional[List[str]] = None,
-               project: Optional[Project] = None) -> List[Issue]:
-    root = Project._repo_root()
+               project: Optional[Project] = None,
+               report: Optional[Iterable[str]] = None) -> List[Issue]:
     sources = {}
     for path in iter_py_files(paths):
         with open(path) as fh:
             src = fh.read()
-        rel = os.path.relpath(os.path.abspath(path), root)
-        key = rel if not rel.startswith("..") else path
-        sources[key] = src
-    return lint_sources(sources, select=select, project=project)
+        sources[path_key(path)] = src
+    return lint_sources(sources, select=select, project=project,
+                        report=report)

@@ -12,3 +12,6 @@ from . import metrics_misuse         # noqa: F401
 from . import env_registry           # noqa: F401
 from . import collective_soundness  # noqa: F401
 from . import resource_leak         # noqa: F401
+from . import shape_soundness       # noqa: F401
+from . import dtype_promotion       # noqa: F401
+from . import recompile_churn       # noqa: F401

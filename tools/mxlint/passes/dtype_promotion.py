@@ -1,0 +1,39 @@
+"""dtype-promotion: silent widening / narrow accumulation in traced code.
+
+Same engine as ``shape-soundness`` (the mxshape abstract interpreter),
+different event stream.  Three bug shapes, all invisible until a TPU
+profile or a numerics report:
+
+- **silent float64**: an expression joining float32/bf16/f16 with a
+  strong float64 operand widens everything to f64 — on TPU that is an
+  x64 demotion or a 2x-slower path that the author never asked for.
+  Weak python floats (``x * 2.0``) do NOT flag: the JAX lattice keeps
+  them at the array's dtype, and so does the interpreter.
+- **silent int64 upcast**: int{8,16,32}/uint joined with a strong int64
+  — index math on TPU wants int32.
+- **narrow accumulation**: a sum-family reduction over bf16/f16 without
+  an explicit ``dtype=``/``preferred_element_type=`` accumulates in the
+  16-bit type, losing precision linearly in the reduction length.
+  ``matmul``/``einsum`` are exempt (the MXU accumulates dots in f32);
+  max/min/any compare and are exempt too.
+
+Findings inherit the interpreter's witness chains: a promotion buried
+in a helper is flagged at the traced call site with the ``via`` chain.
+"""
+from __future__ import annotations
+
+from ..core import LintPass, register_pass
+from ..shapes import file_findings
+
+
+@register_pass
+class DtypePromotionPass(LintPass):
+    id = "dtype-promotion"
+    doc = ("silent float64/int64 promotion and bf16/f16 accumulation "
+           "inside traced bodies, inferred over the JAX dtype "
+           "promotion lattice (weak python scalars exempt)")
+
+    def check_file(self, src):
+        for f in file_findings(self.project, src):
+            if f.kind == "dtype":
+                yield self.issue(src, f.node, f.message)

@@ -1,0 +1,500 @@
+"""recompile-churn: unbounded trace signatures at jit/dispatch sites.
+
+Every distinct ``(static args, input shapes)`` signature at a
+``jax.jit`` call site compiles and caches a **new XLA program**.  The
+serving layer spent PR 2 bounding its program cache to
+``ceil(log2(max_batch)) + 1`` entries by routing every batch through
+power-of-two shape buckets (``mxnet_tpu/serving/batcher.py``); one
+host-side call site that feeds a request-scoped value into a static
+argument — or dispatches an array whose *dimension* came from
+request data — silently undoes that bound, one compile at a time.
+
+This pass walks host-side code (anything *not* inside a traced body —
+the in-trace half is ``jit-retrace``'s job) with a forward
+"unbounded-value" taint:
+
+- seeds: the enclosing function's parameters (request-scoped by
+  construction; ``self``/``cls`` are exempt — instance config is
+  bounded per model);
+- propagates through names, attributes (``x.shape[0]`` of data *is*
+  data-dependent), ``len()``/``int()``/``float()``, arithmetic, and
+  calls — resolved project calls add a witness hop, so the chain names
+  the helper that carried the value;
+- **washed** by the serving shape buckets: a value routed through
+  ``next_bucket`` / ``bucket_for`` (or any resolved helper defined in
+  ``serving/batcher.py``) is bounded to O(log max_batch) values and is
+  clean.
+
+At an identified jit call site — ``jax.jit(f, ...)(...)`` inline, an
+alias ``g = jax.jit(f, static_argnums=...)``, or a call to a
+``@jax.jit`` / ``@partial(jax.jit, static_argnums=...)``-decorated
+project function — it flags (one finding per site):
+
+- a *static* argument carrying unbounded taint (each distinct value =
+  one program), and
+- an argument *constructed with an unbounded dimension*
+  (``jnp.zeros((n, ...))``, ``x.reshape(rows, -1)``, ``pad``/
+  ``broadcast_to``/``tile``/``arange``) — each distinct shape = one
+  program.
+
+Suppress with ``# mxlint: disable=recompile-churn (<why bounded>)``
+when the value set is provably small (an enum, a config constant).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from ..callgraph import CallGraph, FunctionInfo, module_of
+from ..core import LintPass, dotted_name, register_pass
+from ..dataflow import Witness
+from .jit_retrace import _jit_decorated, traced_fn_nodes
+
+_MAX_ORIGINS = 4
+_CONSTRUCTORS = {"zeros", "ones", "full", "empty", "arange", "linspace",
+                 "tile", "repeat", "broadcast_to", "pad", "reshape",
+                 "resize"}
+_NP_ROOTS = {"jnp", "np", "numpy", "onp"}
+_BUCKET_NAMES = {"next_bucket", "bucket_for"}
+
+
+def _add(origins: tuple, more) -> tuple:
+    for w in more:
+        if len(origins) >= _MAX_ORIGINS:
+            break
+        if w not in origins:
+            origins = origins + (w,)
+    return origins
+
+
+class _JitSite:
+    """Static-arg info for one identified jit target."""
+
+    __slots__ = ("static_nums", "static_names", "callee", "statics_known")
+
+    def __init__(self, static_nums, static_names, callee, statics_known):
+        self.static_nums = static_nums          # frozenset of positions
+        self.static_names = static_names        # tuple of param names
+        self.callee = callee                    # FunctionInfo or None
+        self.statics_known = statics_known
+
+
+def _literal_statics(jit_call: ast.Call):
+    """(positions, names, known) from a ``jax.jit(...)`` call's keywords
+    (or a ``partial(jax.jit, ...)`` decorator's).  Non-literal spec ->
+    known=False: the static half stays quiet rather than guessing."""
+    nums, names, known = frozenset(), (), True
+    for kw in jit_call.keywords:
+        if kw.arg == "static_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                nums = frozenset({v.value})
+            elif isinstance(v, (ast.Tuple, ast.List)) and all(
+                    isinstance(e, ast.Constant)
+                    and isinstance(e.value, int) for e in v.elts):
+                nums = frozenset(e.value for e in v.elts)
+            else:
+                known = False
+        elif kw.arg == "static_argnames":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                names = (v.value,)
+            elif isinstance(v, (ast.Tuple, ast.List)) and all(
+                    isinstance(e, ast.Constant)
+                    and isinstance(e.value, str) for e in v.elts):
+                names = tuple(e.value for e in v.elts)
+            else:
+                known = False
+    return nums, names, known
+
+
+def _decorator_statics(fn_node):
+    """Statics of a ``@jax.jit`` / ``@partial(jax.jit, ...)`` decorator."""
+    for dec in fn_node.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = dotted_name(target)
+        if name.endswith("jit") and not isinstance(dec, ast.Call):
+            return frozenset(), (), True
+        if isinstance(dec, ast.Call):
+            if name.endswith("jit"):
+                return _literal_statics(dec)
+            if name.endswith("partial") and dec.args \
+                    and dotted_name(dec.args[0]).endswith("jit"):
+                return _literal_statics(dec)
+    return None
+
+
+@register_pass
+class RecompileChurnPass(LintPass):
+    id = "recompile-churn"
+    doc = ("host-side jit/dispatch call site whose trace signature "
+           "depends on an unbounded runtime value — a python scalar in "
+           "static args or a data-dependent dimension not routed "
+           "through the serving shape buckets; each distinct signature "
+           "compiles a new XLA program")
+
+    def check_file(self, src):
+        graph = self.project.callgraph()
+        traced = traced_fn_nodes(src.tree)
+        aliases = self._jit_aliases(src, graph)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if id(node) in traced:
+                continue        # in-trace escapes are jit-retrace's job
+            info = graph.function_at(node)
+            if info is None:
+                info = FunctionInfo(f"<local>.{node.name}", node, src,
+                                    module_of(src.path), None, None)
+            walker = _ChurnWalker(self, src, info, graph, aliases)
+            yield from walker.run()
+
+    # -------------------------------------------------------- jit aliases
+    def _jit_aliases(self, src, graph) -> Dict[str, _JitSite]:
+        """``g = jax.jit(f, static_argnums=...)`` bindings anywhere in
+        the file (name-keyed; last writer wins — good enough for a
+        stay-quiet lint)."""
+        out: Dict[str, _JitSite] = {}
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and dotted_name(node.value.func).rsplit(
+                        ".", 1)[-1] == "jit"):
+                continue
+            nums, names, known = _literal_statics(node.value)
+            callee = None
+            if node.value.args:
+                callee = self._resolve_ref(graph, node.value.args[0],
+                                           node, src)
+            site = _JitSite(nums, names, callee, known)
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = site
+        return out
+
+    @staticmethod
+    def _resolve_ref(graph, func_expr, at_node, src):
+        """Best-effort resolution of a function reference to a project
+        FunctionInfo (module scope included)."""
+        name = dotted_name(func_expr)
+        if not name:
+            return None
+        q = graph._lookup(name, module_of(src.path))
+        if q and q in graph.functions:
+            return graph.functions[q]
+        cands = graph.by_name.get(name, ())
+        if "." not in name and len(cands) == 1:
+            return graph.functions[cands[0]]
+        return None
+
+
+class _ChurnWalker:
+    """Forward unbounded-taint walk over one host-side function."""
+
+    def __init__(self, lint_pass, src, info, graph, aliases):
+        self.p = lint_pass
+        self.src = src
+        self.info = info
+        self.graph = graph
+        self.aliases = aliases
+        self.issues: List = []
+        self._flagged = set()       # call-node ids already reported
+        # var -> origins of its *value* / of its *shape*
+        self.env: Dict[str, tuple] = {}
+        self.shape_env: Dict[str, tuple] = {}
+
+    def run(self):
+        node = self.info.node
+        # cheap pre-scan: the walker can only report at a jit site, and
+        # almost no host function contains one — skip the whole taint
+        # walk otherwise (resolution is memoized, so re-resolving the
+        # sites in the real walk costs nothing)
+        if not any(isinstance(n, ast.Call) and self._site_of(n) is not None
+                   for n in ast.walk(node)):
+            return []
+        params = [p for p in self.info.params if p not in ("self", "cls")]
+        for p in params:
+            self.env[p] = (Witness(
+                f"request-scoped parameter {p!r} of "
+                f"{node.name}() at {self.src.path}:{node.lineno}"),)
+        self._block(node.body)
+        return [i for i in self.issues if i is not None]
+
+    # ------------------------------------------------------------- taint
+    def taint(self, expr) -> tuple:
+        if isinstance(expr, ast.Constant):
+            return ()
+        if isinstance(expr, ast.Name):
+            return self.env.get(expr.id, ())
+        if isinstance(expr, ast.Attribute):
+            return self.taint(expr.value)
+        if isinstance(expr, ast.Subscript):
+            return _add(self.taint(expr.value), self.taint(expr.slice))
+        if isinstance(expr, ast.Call):
+            return self._call_taint(expr)
+        if isinstance(expr, ast.Lambda):
+            return ()
+        out: tuple = ()
+        for child in ast.iter_child_nodes(expr):
+            if isinstance(child, (ast.expr, ast.keyword,
+                                  ast.comprehension)):
+                out = _add(out, self.taint(child))
+        return out
+
+    def _call_taint(self, call: ast.Call) -> tuple:
+        name = dotted_name(call.func)
+        term = name.rsplit(".", 1)[-1]
+        callee = self.graph.resolve_call(call, self.info) \
+            if self.info is not None else None
+        if self._bucket_sanctioned(term, callee):
+            # the serving shape buckets bound the value set to
+            # O(log max_batch): taint is washed here by design
+            for a in call.args:
+                self.taint(a)
+            return ()
+        out: tuple = ()
+        for a in list(call.args) + [kw.value for kw in call.keywords]:
+            out = _add(out, self.taint(a))
+        if isinstance(call.func, ast.Attribute):
+            out = _add(out, self.taint(call.func.value))
+        if callee is not None and out:
+            here = (callee.node.name, self.src.path, call.lineno)
+            out = tuple(w.via(*here) for w in out[:_MAX_ORIGINS])
+        return out
+
+    @staticmethod
+    def _bucket_sanctioned(term, callee) -> bool:
+        if callee is not None:
+            path = callee.src.path.replace("\\", "/")
+            if path.endswith("serving/batcher.py"):
+                return True
+            return "bucket" in callee.node.name
+        return term in _BUCKET_NAMES or "bucket" in term
+
+    def shape_taint(self, expr) -> tuple:
+        """Origins of an expression's *shape*: set where an array is
+        constructed with a tainted dimension, copied through names and
+        pass-through calls."""
+        if isinstance(expr, ast.Name):
+            return self.shape_env.get(expr.id, ())
+        if isinstance(expr, ast.Call):
+            t = self._constructed_shape_taint(expr)
+            if t:
+                return t
+            out: tuple = ()
+            for a in list(expr.args) + [kw.value for kw in expr.keywords]:
+                out = _add(out, self.shape_taint(a))
+            if isinstance(expr.func, ast.Attribute):
+                out = _add(out, self.shape_taint(expr.func.value))
+            return out
+        if isinstance(expr, (ast.Attribute, ast.Subscript)):
+            return self.shape_taint(expr.value)
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            out = ()
+            for e in expr.elts:
+                out = _add(out, self.shape_taint(e))
+            return out
+        if isinstance(expr, ast.BinOp):
+            return _add(self.shape_taint(expr.left),
+                        self.shape_taint(expr.right))
+        return ()
+
+    def _constructed_shape_taint(self, call: ast.Call) -> tuple:
+        """Dim-operand taint of a shape-constructing call."""
+        name = dotted_name(call.func)
+        term = name.rsplit(".", 1)[-1]
+        if term not in _CONSTRUCTORS:
+            return ()
+        is_method = isinstance(call.func, ast.Attribute) \
+            and name.split(".", 1)[0] not in _NP_ROOTS \
+            and not name.startswith("jax.numpy.")
+        if is_method and term not in ("reshape", "broadcast_to",
+                                      "repeat", "resize"):
+            return ()
+        # dim operands: every positional arg past the data arg (or all
+        # args for method/creator forms), plus shape=/reps= keywords
+        if is_method:
+            dim_args = list(call.args)
+        elif term in ("zeros", "ones", "full", "empty", "arange",
+                      "linspace"):
+            dim_args = list(call.args[:1]) if term not in (
+                "arange", "linspace") else list(call.args)
+        else:
+            dim_args = list(call.args[1:])
+        for kw in call.keywords:
+            if kw.arg in ("shape", "reps", "repeats", "pad_width",
+                          "total_repeat_length"):
+                dim_args.append(kw.value)
+        out: tuple = ()
+        for a in dim_args:
+            out = _add(out, self.taint(a))
+        return out
+
+    # -------------------------------------------------------- statements
+    def _block(self, stmts):
+        for s in stmts:
+            self._stmt(s)
+
+    def _stmt(self, stmt):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(stmt, ast.Assign):
+            self._visit_expr(stmt.value)
+            t = self.taint(stmt.value)
+            st = self.shape_taint(stmt.value)
+            for tgt in stmt.targets:
+                self._bind(tgt, t, st)
+        elif isinstance(stmt, ast.AugAssign):
+            self._visit_expr(stmt.value)
+            if isinstance(stmt.target, ast.Name):
+                k = stmt.target.id
+                self.env[k] = _add(self.env.get(k, ()),
+                                   self.taint(stmt.value))
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._visit_expr(stmt.value)
+            self._bind(stmt.target, self.taint(stmt.value),
+                       self.shape_taint(stmt.value))
+        elif isinstance(stmt, ast.If):
+            self._visit_expr(stmt.test)
+            e1, s1 = dict(self.env), dict(self.shape_env)
+            self._block(stmt.body)
+            e_body, s_body = self.env, self.shape_env
+            self.env, self.shape_env = e1, s1
+            self._block(stmt.orelse)
+            for k, v in e_body.items():
+                self.env[k] = _add(self.env.get(k, ()), v)
+            for k, v in s_body.items():
+                self.shape_env[k] = _add(self.shape_env.get(k, ()), v)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._visit_expr(stmt.iter)
+            self._bind(stmt.target, self.taint(stmt.iter),
+                       self.shape_taint(stmt.iter))
+            for _ in range(2):
+                self._block(stmt.body)
+            self._block(stmt.orelse)
+        elif isinstance(stmt, ast.While):
+            self._visit_expr(stmt.test)
+            for _ in range(2):
+                self._block(stmt.body)
+            self._block(stmt.orelse)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._visit_expr(item.context_expr)
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars,
+                               self.taint(item.context_expr), ())
+            self._block(stmt.body)
+        elif isinstance(stmt, ast.Try):
+            self._block(stmt.body)
+            for h in stmt.handlers:
+                self._block(h.body)
+            self._block(stmt.orelse)
+            self._block(stmt.finalbody)
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self._visit_expr(stmt.value)
+        elif isinstance(stmt, ast.Expr):
+            self._visit_expr(stmt.value)
+        else:
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self._visit_expr(child)
+
+    def _bind(self, target, taint, shape_taint):
+        if isinstance(target, ast.Name):
+            self.env[target.id] = taint
+            self.shape_env[target.id] = shape_taint
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._bind(e.value if isinstance(e, ast.Starred) else e,
+                           taint, shape_taint)
+
+    # -------------------------------------------------------- jit sites
+    def _visit_expr(self, expr):
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                self._check_site(node)
+
+    def _site_of(self, call: ast.Call) -> Optional[_JitSite]:
+        # jax.jit(f, ...)(args) inline
+        if isinstance(call.func, ast.Call) \
+                and dotted_name(call.func.func).rsplit(
+                    ".", 1)[-1] == "jit":
+            nums, names, known = _literal_statics(call.func)
+            callee = None
+            if call.func.args:
+                callee = RecompileChurnPass._resolve_ref(
+                    self.graph, call.func.args[0], call, self.src)
+            return _JitSite(nums, names, callee, known)
+        name = dotted_name(call.func)
+        if name in self.aliases:
+            return self.aliases[name]
+        callee = self.graph.resolve_call(call, self.info)
+        if callee is not None and _jit_decorated(callee.node):
+            spec = _decorator_statics(callee.node)
+            if spec is not None:
+                nums, names, known = spec
+                return _JitSite(nums, names, callee, known)
+        return None
+
+    def _check_site(self, call: ast.Call):
+        if id(call) in self._flagged:
+            return
+        site = self._site_of(call)
+        if site is None:
+            return
+        self._flagged.add(id(call))
+        statics: List[Tuple[str, ast.AST]] = []
+        if site.statics_known:
+            names = set(site.static_names)
+            positions = set(site.static_nums)
+            if site.callee is not None:
+                for n in names:
+                    idx = site.callee.param_index(n)
+                    if idx is not None:
+                        positions.add(idx)
+            for i, a in enumerate(call.args):
+                if isinstance(a, ast.Starred):
+                    break
+                if i in positions:
+                    label = (site.callee.params[i]
+                             if site.callee is not None
+                             and i < len(site.callee.params) else str(i))
+                    statics.append((label, a))
+            for kw in call.keywords:
+                if kw.arg is None:
+                    continue
+                if kw.arg in names or (
+                        site.callee is not None
+                        and site.callee.param_index(kw.arg) is not None
+                        and site.callee.param_index(kw.arg) in positions):
+                    statics.append((kw.arg, kw.value))
+        for label, argnode in statics:
+            t = self.taint(argnode)
+            if t:
+                self.issues.append(self.p.issue(
+                    self.src, call,
+                    f"jit static argument {label!r} is fed an unbounded "
+                    f"runtime value ({t[0].describe()}) — every "
+                    f"distinct value compiles and caches a new XLA "
+                    f"program, unbounding the serving program cache; "
+                    f"bound it (serving shape buckets: "
+                    f"serving.batcher.next_bucket) or pass it traced"))
+                return
+        static_ids = {id(a) for _, a in statics}
+        for a in list(call.args) + [kw.value for kw in call.keywords]:
+            if id(a) in static_ids or isinstance(a, ast.Starred):
+                continue
+            st = self.shape_taint(a)
+            if st:
+                self.issues.append(self.p.issue(
+                    self.src, call,
+                    f"argument shape at this jit call site depends on "
+                    f"an unbounded value ({st[0].describe()}) — every "
+                    f"distinct shape is a new trace signature and a new "
+                    f"XLA program; route the dimension through the "
+                    f"serving shape buckets (power-of-two padding, "
+                    f"serving.batcher.next_bucket) before dispatch"))
+                return

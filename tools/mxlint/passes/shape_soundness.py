@@ -1,0 +1,36 @@
+"""shape-soundness: statically infeasible shape algebra in traced code.
+
+Rides the mxshape abstract interpreter (``tools/mxlint/shapes.py``):
+``@jax.jit`` / ``hybrid_forward`` / registry-op bodies are interpreted
+over the symbolic shape lattice, and a finding is emitted only when the
+violation is *provable* — a reshape whose target factors cannot tile
+the input element count (symbol-free product ratio != 1), a transpose
+whose axes are not a permutation, a broadcast of concretely
+incompatible extents, a matmul/einsum contracting provably different
+dims, a rank-N shape unpacked into M names.  Everything unknown stays
+⊤ and silent.
+
+Helpers reached through the PR-4 call graph are inlined with the
+caller's symbolic facts, so a broken reshape inside a shared reshape
+helper is flagged at the op-body call site with a witness chain
+(``via _split_interleaved (mxnet_tpu/ops/contrib.py:49): reshape ...``)
+— the line whose arguments actually make it infeasible.
+"""
+from __future__ import annotations
+
+from ..core import LintPass, register_pass
+from ..shapes import file_findings
+
+
+@register_pass
+class ShapeSoundnessPass(LintPass):
+    id = "shape-soundness"
+    doc = ("statically infeasible reshape/transpose/broadcast/matmul/"
+           "einsum in @jax.jit / hybrid_forward / op bodies, proven "
+           "over the symbolic shape lattice (helper-routed cases "
+           "flagged at the call site with a witness chain)")
+
+    def check_file(self, src):
+        for f in file_findings(self.project, src):
+            if f.kind == "shape":
+                yield self.issue(src, f.node, f.message)

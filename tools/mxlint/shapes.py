@@ -1,0 +1,1466 @@
+"""mxshape: symbolic shape/dtype abstract interpretation over traced code.
+
+The dominant bug class mxlint could not see until now is the one that
+only surfaces at trace time: a reshape whose factors cannot tile the
+input, an einsum/transpose with broken axis algebra, a silent
+float64/int64 promotion, a bf16 reduction accumulating in bf16.  This
+module interprets ``@jax.jit`` / ``hybrid_forward`` / registry-op
+bodies over a symbolic shape lattice — dims are literals, named symbols
+(``B``, ``L``), or ⊤ — and a JAX-faithful dtype promotion lattice, and
+records *provable* violations as findings for the ``shape-soundness``
+and ``dtype-promotion`` passes.
+
+The algebra itself lives in ``mxnet_tpu/ops/shape_rules.py`` (the same
+declarative rules the op registry exposes as runtime metadata); this
+module loads that file **standalone by path**, so the linter still
+never imports the code under analysis and needs no jax.
+
+Key mechanics:
+
+- ``L, B, HnD = x.shape`` on an unknown-rank array *refines* ``x`` to a
+  rank-3 symbolic shape and binds each name to its symbol — the seeding
+  trick that makes the ``ops/contrib.py`` interleaved-attention reshape
+  juggling checkable with zero annotations.
+- Unbound scalars used in dim positions become stable per-frame
+  symbols, so ``x.reshape(L, B, heads, n, D)`` with
+  ``D = HnD // (heads * n)`` cancels symbolically; infeasibility is
+  only reported when the element-count ratio is symbol-free and != 1
+  (no false positives — unknown degrades to ⊤).
+- Calls that resolve through the PR-4 call graph are *inlined* (depth-
+  capped, cycle-guarded) with the caller's abstract values, and any
+  finding inside carries a witness chain and anchors at the top-level
+  call site, where the suppression comment belongs.  Helpers that are
+  themselves traced surfaces keep their own direct findings (one bug =
+  one issue).
+"""
+from __future__ import annotations
+
+import ast
+import importlib.util
+import os
+from typing import Dict, List, Optional, Tuple
+
+from .callgraph import FunctionInfo, module_of
+from .core import Project, SourceFile, dotted_name
+
+__all__ = ["file_findings", "ShapeFinding", "rules"]
+
+_RULES = None
+
+
+def rules():
+    """The shape/dtype algebra module (mxnet_tpu/ops/shape_rules.py),
+    loaded standalone by path so no mxnet_tpu/jax import happens."""
+    global _RULES
+    if _RULES is None:
+        path = os.path.join(Project._repo_root(),
+                            "mxnet_tpu", "ops", "shape_rules.py")
+        spec = importlib.util.spec_from_file_location(
+            "_mxshape_rules", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _RULES = mod
+    return _RULES
+
+
+class ShapeFinding:
+    """One provable violation: ``kind`` is ``"shape"`` or ``"dtype"``,
+    ``node`` anchors in the analyzed file (for inlined findings, the
+    top-level call site), ``message`` carries the witness chain."""
+
+    __slots__ = ("kind", "node", "message")
+
+    def __init__(self, kind, node, message):
+        self.kind = kind
+        self.node = node
+        self.message = message
+
+
+# ------------------------------------------------------- abstract values
+class Arr:
+    """Array: ``shape`` is None (rank unknown) or a tuple of Dim/None;
+    ``dtype`` a lattice name or None."""
+
+    __slots__ = ("shape", "dtype")
+
+    def __init__(self, shape, dtype):
+        self.shape = shape
+        self.dtype = dtype
+
+
+class DimV:
+    """Host integer scalar usable as a dimension (Dim or None)."""
+
+    __slots__ = ("dim",)
+
+    def __init__(self, dim):
+        self.dim = dim
+
+
+class ShapeV:
+    """The ``.shape`` tuple of an array (tuple of Dim/None)."""
+
+    __slots__ = ("dims",)
+
+    def __init__(self, dims):
+        self.dims = tuple(dims)
+
+
+class TupleV:
+    __slots__ = ("items",)
+
+    def __init__(self, items):
+        self.items = tuple(items)
+
+
+class SeqV:
+    """Homogeneous sequence of unknown length (list comp of arrays)."""
+
+    __slots__ = ("elem",)
+
+    def __init__(self, elem):
+        self.elem = elem
+
+
+TOP = object()      # unknown value
+FNS = object()      # hybrid_forward's F namespace
+
+
+_NP_MODULES = {"jnp", "np", "numpy", "onp", "jax.numpy"}
+_ND_MODULES = {"nd", "mx.nd", "F", "sym", "mx.sym"}
+_ELEMWISE = {
+    "sqrt", "exp", "log", "log1p", "expm1", "abs", "absolute", "square",
+    "tanh", "sin", "cos", "sign", "negative", "reciprocal", "rsqrt",
+    "floor", "ceil", "round", "clip", "relu", "gelu", "sigmoid", "silu",
+    "swish", "softmax", "log_softmax", "erf", "logical_not", "nan_to_num",
+    "real", "conj", "copy",
+}
+_BINARY_ELEMWISE = {"add", "subtract", "multiply", "divide",
+                    "true_divide", "power", "maximum", "minimum",
+                    "mod", "remainder", "arctan2", "hypot",
+                    "logical_and", "logical_or", "where"}
+_REDUCTIONS = {"sum", "mean", "prod", "nansum", "nanprod", "cumsum",
+               "cumprod", "max", "min", "amax", "amin", "all", "any",
+               "std", "var"}
+# the subset that actually *accumulates* — max/min/any compare, they do
+# not lose precision in bf16
+_ACCUM_REDUCTIONS = {"sum", "mean", "prod", "nansum", "nanprod",
+                     "cumsum", "cumprod", "std", "var"}
+_CREATORS = {"zeros", "ones", "empty", "full"}
+_MAX_INLINE_DEPTH = 4
+
+
+def _jit_decorated(fn_node):
+    from .passes.jit_retrace import _jit_decorated as impl
+    return impl(fn_node)
+
+
+def _enters_trace(fn_node):
+    from .passes.jit_retrace import _enters_trace as impl
+    return impl(fn_node)
+
+
+def _is_op_body(fn_node) -> bool:
+    """``@register("name", ...)`` from ops/registry.py — the body is a
+    pure JAX function traced under jit by every consumer."""
+    for dec in getattr(fn_node, "decorator_list", ()):
+        if isinstance(dec, ast.Call):
+            name = dotted_name(dec.func)
+            if (name == "register" or name.endswith(".register")) \
+                    and dec.args \
+                    and isinstance(dec.args[0], ast.Constant) \
+                    and isinstance(dec.args[0].value, str):
+                return True
+    return False
+
+
+def analyzed_surface(fn_node) -> bool:
+    return _enters_trace(fn_node) or _is_op_body(fn_node)
+
+
+class _Ctx:
+    """Per-run shared state: call graph, the findings sink, and the
+    run-global symbol namespace (fresh names stay readable, collisions
+    across inline frames get a ``#n`` suffix so they can never falsely
+    cancel)."""
+
+    def __init__(self, project, src):
+        self.project = project
+        self.src = src
+        self.graph = project.callgraph() if project is not None else None
+        self.findings: List[ShapeFinding] = []
+        self._sym_counts: Dict[str, int] = {}
+        self._seen = set()          # dedup (line, col, kind, message)
+
+    def fresh_sym(self, name):
+        R = rules()
+        n = self._sym_counts.get(name, 0)
+        self._sym_counts[name] = n + 1
+        return R.sym(name if n == 0 else f"{name}#{n}")
+
+    def report(self, kind, node, message, mute):
+        if mute:
+            return
+        key = (getattr(node, "lineno", 0), getattr(node, "col_offset", 0),
+               kind, message)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.findings.append(ShapeFinding(kind, node, message))
+
+
+def _chain_text(hops) -> str:
+    if not hops:
+        return ""
+    return "via " + " -> ".join(f"{n} ({p}:{ln})"
+                                for n, p, ln in hops) + ": "
+
+
+class _Interp:
+    """One forward walk over one function body with an abstract-value
+    environment.  ``hops``/``anchor`` implement inlining: findings in an
+    inlined callee anchor at the top-level call site with the chain."""
+
+    def __init__(self, ctx: _Ctx, info: FunctionInfo, depth=0, hops=(),
+                 anchor=None, stack=frozenset()):
+        self.ctx = ctx
+        self.info = info
+        self.depth = depth
+        self.hops = tuple(hops)
+        self.anchor = anchor
+        self.stack = stack
+        self.mute = False
+        self.returns: List[object] = []
+
+    # ------------------------------------------------------------ report
+    def report(self, kind, node, base):
+        anchor = self.anchor if self.anchor is not None else node
+        self.ctx.report(kind, anchor,
+                        _chain_text(self.hops) + base, self.mute)
+
+    # -------------------------------------------------------------- run
+    def run(self, env):
+        self._block(self.info.node.body, env)
+        out = TOP
+        for r in self.returns:
+            out = r if out is TOP else _join(out, r)
+        return out
+
+    # --------------------------------------------------------- dim utils
+    def _dim_of(self, expr, env):
+        """Dim | None | -1 of an expression in dim position."""
+        R = rules()
+        if isinstance(expr, ast.Constant):
+            if isinstance(expr.value, bool) \
+                    or not isinstance(expr.value, int):
+                return None
+            return -1 if expr.value == -1 else (
+                R.lit(expr.value) if expr.value >= 0 else None)
+        if isinstance(expr, ast.UnaryOp) \
+                and isinstance(expr.op, ast.USub) \
+                and isinstance(expr.operand, ast.Constant) \
+                and isinstance(expr.operand.value, int):
+            return -1 if expr.operand.value == 1 else None
+        if isinstance(expr, ast.Name):
+            if expr.id in env:
+                v = env[expr.id]
+                if isinstance(v, DimV):
+                    return v.dim
+                if v is TOP:
+                    return self._name_sym(expr.id, env)
+                return None
+            return self._name_sym(expr.id, env)
+        if isinstance(expr, ast.BinOp):
+            left = self._dim_of(expr.left, env)
+            right = self._dim_of(expr.right, env)
+            if left == -1 or right == -1:
+                return None
+            if isinstance(expr.op, ast.Mult):
+                return R.dim_mul(left, right)
+            if isinstance(expr.op, ast.FloorDiv):
+                return R.dim_div(left, right)
+            if isinstance(expr.op, ast.Add):
+                return R.dim_add(left, right)
+            if isinstance(expr.op, ast.Sub):
+                if left is not None and right is not None \
+                        and left.concrete is not None \
+                        and right.concrete is not None:
+                    return R.lit(left.concrete - right.concrete) \
+                        if left.concrete >= right.concrete else None
+                return None
+            return None
+        if isinstance(expr, ast.Subscript):
+            v = self._eval(expr, env)
+            if isinstance(v, DimV):
+                return v.dim
+            return None
+        if isinstance(expr, ast.Call) \
+                and dotted_name(expr.func) == "len" and expr.args:
+            v = self._eval(expr.args[0], env)
+            if isinstance(v, Arr) and v.shape is not None and v.shape:
+                return v.shape[0]
+            if isinstance(v, (ShapeV, TupleV)):
+                items = v.dims if isinstance(v, ShapeV) else v.items
+                return rules().lit(len(items))
+            return None
+        v = self._eval(expr, env)
+        if isinstance(v, DimV):
+            return v.dim
+        return None
+
+    def _name_sym(self, name, env):
+        """Stable per-frame symbol for an unbound/unknown scalar name:
+        one runtime execution sees one value, so every dim use of the
+        same name may share a symbol."""
+        syms = env.setdefault("__syms__", {})
+        if name not in syms:
+            syms[name] = self.ctx.fresh_sym(name)
+        return syms[name]
+
+    def _shape_arg(self, expr, env):
+        """A shape-tuple argument: list of Dim/None/-1, or None."""
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return [self._dim_of(e, env) for e in expr.elts]
+        v = self._eval(expr, env)
+        if isinstance(v, ShapeV):
+            return list(v.dims)
+        if isinstance(v, TupleV):
+            out = []
+            for it in v.items:
+                out.append(it.dim if isinstance(it, DimV) else None)
+            return out
+        d = self._dim_of(expr, env)
+        if d is not None:
+            return [d]
+        return None
+
+    def _dtype_const(self, expr, env):
+        R = rules()
+        if expr is None:
+            return None
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return expr.value if expr.value in R.DTYPES else None
+        name = dotted_name(expr)
+        term = name.rsplit(".", 1)[-1]
+        if term in R.DTYPES:
+            return term
+        if term == "bool_":
+            return "bool"
+        return None
+
+    # -------------------------------------------------------- statements
+    def _block(self, stmts, env):
+        for s in stmts:
+            self._stmt(s, env)
+
+    def _stmt(self, stmt, env):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(stmt, ast.Assign):
+            self._do_assign(stmt.targets, stmt.value, env)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._do_assign([stmt.target], stmt.value, env)
+        elif isinstance(stmt, ast.AugAssign):
+            v = self._eval(ast.BinOp(left=_loadify(stmt.target),
+                                     op=stmt.op, right=stmt.value), env) \
+                if isinstance(stmt.target, ast.Name) else \
+                self._eval(stmt.value, env)
+            if isinstance(stmt.target, ast.Name):
+                env[stmt.target.id] = v
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self.returns.append(self._eval(stmt.value, env))
+            else:
+                self.returns.append(TOP)
+        elif isinstance(stmt, ast.If):
+            self._eval(stmt.test, env)
+            e1, e2 = dict(env), dict(env)
+            self._block(stmt.body, e1)
+            self._block(stmt.orelse, e2)
+            joined = _join_env(e1, e2)
+            env.clear()
+            env.update(joined)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            it = self._eval(stmt.iter, env)
+            self._bind_loop(stmt.target, stmt.iter, it, env)
+            self._loop_body(stmt.body, env)
+            self._block(stmt.orelse, env)
+        elif isinstance(stmt, ast.While):
+            self._eval(stmt.test, env)
+            self._loop_body(stmt.body, env)
+            self._block(stmt.orelse, env)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._eval(item.context_expr, env)
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars, TOP, env)
+            self._block(stmt.body, env)
+        elif isinstance(stmt, ast.Try):
+            self._block(stmt.body, env)
+            for h in stmt.handlers:
+                eh = dict(env)
+                self._block(h.body, eh)
+                env.update(_join_env(env, eh))
+            self._block(stmt.orelse, env)
+            self._block(stmt.finalbody, env)
+        elif isinstance(stmt, ast.Expr):
+            self._eval(stmt.value, env)
+        elif isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    env.pop(t.id, None)
+        else:
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self._eval(child, env)
+
+    def _loop_body(self, body, env):
+        """First pass reports (iteration 1 is real); the muted second
+        pass converges loop-carried shape changes to their join so later
+        uses see widened values, not iteration-1 artifacts."""
+        pre = dict(env)
+        self._block(body, env)
+        env.update(_join_env(env, pre))
+        prev, self.mute = self.mute, True
+        self._block(body, env)
+        self.mute = prev
+        env.update(_join_env(env, pre))
+
+    def _do_assign(self, targets, value, env):
+        # the seeding trick: tuple-unpacking `.shape` of an unknown-rank
+        # array refines the array to named symbolic dims
+        if len(targets) == 1 and isinstance(targets[0], ast.Tuple) \
+                and all(isinstance(e, ast.Name) for e in targets[0].elts) \
+                and isinstance(value, ast.Attribute) \
+                and value.attr == "shape" \
+                and isinstance(value.value, ast.Name):
+            root = value.value.id
+            arr = env.get(root)
+            names = [e.id for e in targets[0].elts]
+            if isinstance(arr, Arr):
+                if arr.shape is None:
+                    dims = tuple(self.ctx.fresh_sym(n) for n in names)
+                    env[root] = Arr(dims, arr.dtype)
+                    for n, d in zip(names, dims):
+                        env[n] = DimV(d)
+                    return
+                if len(arr.shape) != len(names):
+                    self.report(
+                        "shape", value,
+                        f"unpacking the rank-{len(arr.shape)} shape "
+                        f"{rules().fmt_shape(arr.shape)} of {root!r} "
+                        f"into {len(names)} names")
+                    for n in names:
+                        env[n] = TOP
+                    return
+                for n, d in zip(names, arr.shape):
+                    env[n] = DimV(d)
+                return
+        v = self._eval(value, env)
+        for t in targets:
+            self._bind(t, v, env)
+
+    def _bind(self, target, value, env):
+        if isinstance(target, ast.Name):
+            env[target.id] = value
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            elts = target.elts
+            if any(isinstance(e, ast.Starred) for e in elts):
+                for e in elts:
+                    self._bind(e.value if isinstance(e, ast.Starred)
+                               else e, TOP, env)
+                return
+            items = None
+            if isinstance(value, TupleV) and len(value.items) == len(elts):
+                items = value.items
+            elif isinstance(value, ShapeV) and len(value.dims) == len(elts):
+                items = [DimV(d) for d in value.dims]
+            elif isinstance(value, SeqV):
+                items = [value.elem] * len(elts)
+            elif isinstance(value, Arr) and value.shape:
+                lead = value.shape[0]
+                if lead is not None and lead.concrete == len(elts):
+                    items = [Arr(value.shape[1:], value.dtype)] * len(elts)
+            for e, it in zip(elts, items or [TOP] * len(elts)):
+                self._bind(e, it, env)
+        # attribute/subscript targets: no tracking
+
+    def _bind_loop(self, target, iter_expr, it, env):
+        if isinstance(iter_expr, ast.Call):
+            fname = dotted_name(iter_expr.func)
+            if fname == "range":
+                self._bind(target, DimV(None), env)
+                return
+            if fname == "enumerate" and isinstance(target, ast.Tuple) \
+                    and len(target.elts) == 2 and iter_expr.args:
+                inner = self._eval(iter_expr.args[0], env)
+                self._bind(target.elts[0], DimV(None), env)
+                self._bind(target.elts[1], _elem_of(inner), env)
+                return
+        self._bind(target, _elem_of(it), env)
+
+    # ------------------------------------------------------- expressions
+    def _eval(self, expr, env):
+        R = rules()
+        if isinstance(expr, ast.Constant):
+            v = expr.value
+            if isinstance(v, bool):
+                return Arr((), "bool")
+            if isinstance(v, int):
+                return DimV(R.lit(v) if v >= 0 else None)
+            if isinstance(v, float):
+                return Arr((), "float")
+            if isinstance(v, complex):
+                return Arr((), "complex")
+            return TOP
+        if isinstance(expr, ast.Name):
+            return env.get(expr.id, TOP)
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return TupleV([self._eval(e, env) for e in expr.elts])
+        if isinstance(expr, ast.Attribute):
+            return self._attribute(expr, env)
+        if isinstance(expr, ast.Subscript):
+            return self._subscript(expr, env)
+        if isinstance(expr, ast.Call):
+            return self._call(expr, env)
+        if isinstance(expr, ast.BinOp):
+            return self._binop(expr, env)
+        if isinstance(expr, ast.UnaryOp):
+            v = self._eval(expr.operand, env)
+            if isinstance(expr.op, ast.USub) and isinstance(v, DimV):
+                return DimV(None)       # negative: out of the dim domain
+            return v
+        if isinstance(expr, ast.Compare):
+            self._eval(expr.left, env)
+            for c in expr.comparators:
+                self._eval(c, env)
+            return Arr(None, "bool")
+        if isinstance(expr, ast.BoolOp):
+            vals = [self._eval(v, env) for v in expr.values]
+            out = vals[0]
+            for v in vals[1:]:
+                out = _join(out, v)
+            return out
+        if isinstance(expr, ast.IfExp):
+            self._eval(expr.test, env)
+            return _join(self._eval(expr.body, env),
+                         self._eval(expr.orelse, env))
+        if isinstance(expr, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            inner = dict(env)
+            for gen in expr.generators:
+                it = self._eval(gen.iter, inner)
+                self._bind_loop(gen.target, gen.iter, it, inner)
+                for cond in gen.ifs:
+                    self._eval(cond, inner)
+            return SeqV(self._eval(expr.elt, inner))
+        if isinstance(expr, ast.DictComp):
+            inner = dict(env)
+            for gen in expr.generators:
+                it = self._eval(gen.iter, inner)
+                self._bind_loop(gen.target, gen.iter, it, inner)
+            self._eval(expr.key, inner)
+            self._eval(expr.value, inner)
+            return TOP
+        if isinstance(expr, ast.Lambda):
+            return TOP
+        if isinstance(expr, ast.Starred):
+            return self._eval(expr.value, env)
+        for child in ast.iter_child_nodes(expr):
+            if isinstance(child, ast.expr):
+                self._eval(child, env)
+        return TOP
+
+    def _attribute(self, expr, env):
+        v = self._eval(expr.value, env)
+        if isinstance(v, Arr):
+            if expr.attr == "shape":
+                return ShapeV(v.shape) if v.shape is not None else TOP
+            if expr.attr == "T":
+                if v.shape is not None:
+                    return Arr(tuple(reversed(v.shape)), v.dtype)
+                return Arr(None, v.dtype)
+            if expr.attr == "ndim":
+                return DimV(rules().lit(len(v.shape))
+                            if v.shape is not None else None)
+            if expr.attr == "size":
+                return DimV(rules().product(v.shape)
+                            if v.shape is not None else None)
+            if expr.attr == "dtype":
+                return TOP
+        return TOP
+
+    def _subscript(self, expr, env):
+        R = rules()
+        v = self._eval(expr.value, env)
+        idx = expr.slice
+
+        def const_index(node):
+            if isinstance(node, ast.Constant) \
+                    and isinstance(node.value, int) \
+                    and not isinstance(node.value, bool):
+                return node.value
+            if isinstance(node, ast.UnaryOp) \
+                    and isinstance(node.op, ast.USub) \
+                    and isinstance(node.operand, ast.Constant) \
+                    and isinstance(node.operand.value, int):
+                return -node.operand.value
+            return None
+
+        if isinstance(v, (ShapeV, TupleV)):
+            items = list(v.dims) if isinstance(v, ShapeV) else \
+                list(v.items)
+            i = const_index(idx)
+            if i is not None and -len(items) <= i < len(items):
+                got = items[i]
+                return DimV(got) if isinstance(v, ShapeV) else got
+            if isinstance(idx, ast.Slice):
+                lo = const_index(idx.lower) if idx.lower else None
+                hi = const_index(idx.upper) if idx.upper else None
+                if idx.step is None and (idx.lower is None or lo is not None) \
+                        and (idx.upper is None or hi is not None):
+                    sub = items[lo:hi]
+                    return ShapeV(sub) if isinstance(v, ShapeV) \
+                        else TupleV(sub)
+            self._eval_index(idx, env)
+            return TOP
+        if isinstance(v, SeqV):
+            self._eval_index(idx, env)
+            if isinstance(idx, ast.Slice):
+                return v
+            return v.elem
+        if isinstance(v, Arr):
+            if v.shape is None:
+                self._eval_index(idx, env)
+                return Arr(None, v.dtype)
+            entries = idx.elts if isinstance(idx, ast.Tuple) else [idx]
+            out: List = []
+            pos = 0
+            rank = len(v.shape)
+            explicit = sum(
+                0 if (isinstance(e, ast.Constant)
+                      and e.value in (None, Ellipsis)) else 1
+                for e in entries)
+            for e in entries:
+                if isinstance(e, ast.Constant) and e.value is None:
+                    out.append(R.lit(1))        # newaxis
+                    continue
+                if isinstance(e, ast.Constant) and e.value is Ellipsis:
+                    fill = rank - explicit
+                    for _ in range(max(fill, 0)):
+                        if pos < rank:
+                            out.append(v.shape[pos])
+                            pos += 1
+                    continue
+                if pos >= rank:
+                    return Arr(None, v.dtype)
+                if isinstance(e, ast.Slice):
+                    if e.lower is None and e.upper is None \
+                            and e.step is None:
+                        out.append(v.shape[pos])
+                    else:
+                        self._eval_index(e, env)
+                        out.append(None)
+                    pos += 1
+                    continue
+                ev = self._eval(e, env)
+                if isinstance(ev, DimV) or (
+                        isinstance(ev, Arr) and ev.shape == ()):
+                    pos += 1                    # integer index: drop axis
+                    continue
+                # array / unknown index: advanced indexing — give up
+                return Arr(None, v.dtype)
+            out.extend(v.shape[pos:])
+            return Arr(tuple(out), v.dtype)
+        self._eval_index(idx, env)
+        return TOP
+
+    def _eval_index(self, idx, env):
+        for child in ast.walk(idx):
+            if isinstance(child, ast.Call):
+                self._eval(child, env)
+                break
+
+    # ------------------------------------------------------------ binops
+    def _binop(self, expr, env):
+        R = rules()
+        left = self._eval(expr.left, env)
+        right = self._eval(expr.right, env)
+        if isinstance(left, DimV) and isinstance(right, DimV):
+            if isinstance(expr.op, ast.Mult):
+                return DimV(R.dim_mul(left.dim, right.dim))
+            if isinstance(expr.op, ast.FloorDiv):
+                return DimV(R.dim_div(left.dim, right.dim))
+            if isinstance(expr.op, ast.Add):
+                return DimV(R.dim_add(left.dim, right.dim))
+            if isinstance(expr.op, ast.Sub):
+                a = left.dim.concrete if left.dim is not None else None
+                b = right.dim.concrete if right.dim is not None else None
+                if a is not None and b is not None and a >= b:
+                    return DimV(R.lit(a - b))
+                return DimV(None)
+            if isinstance(expr.op, ast.Div):
+                return Arr((), "float")
+            return DimV(None)
+        la, ra = _as_arr(left), _as_arr(right)
+        if la is None or ra is None:
+            return TOP
+        if isinstance(expr.op, ast.MatMult):
+            return self._matmul(expr, la, ra)
+        shape = self._broadcast(expr, la.shape, ra.shape)
+        if isinstance(expr.op, ast.Div):
+            dtype = self._promote(expr, la.dtype, ra.dtype, division=True)
+        else:
+            dtype = self._promote(expr, la.dtype, ra.dtype)
+        return Arr(shape, dtype)
+
+    def _broadcast(self, node, s1, s2):
+        R = rules()
+        try:
+            return R.broadcast(s1, s2)
+        except R.ShapeError as e:
+            self.report("shape", node, str(e))
+            return None
+
+    def _matmul(self, node, la, ra):
+        R = rules()
+        try:
+            shape = R.check_matmul(la.shape, ra.shape)
+        except R.ShapeError as e:
+            self.report("shape", node, str(e))
+            shape = None
+        return Arr(shape, self._promote(node, la.dtype, ra.dtype))
+
+    def _promote(self, node, a, b, division=False):
+        R = rules()
+        out = R.promote(a, b)
+        if division and out is not None and out in R.INT_DTYPES | {
+                "int", "bool"}:
+            out = R.promote(out, "float")
+        if out == "float64" and "float64" in (a, b) \
+                and (a in ("float32", "bfloat16", "float16")
+                     or b in ("float32", "bfloat16", "float16")):
+            small = a if a != "float64" else b
+            self.report(
+                "dtype", node,
+                f"silent float64 promotion: {small} op float64 widens "
+                f"the whole expression to float64 — on TPU that means "
+                f"an x64 demotion or a 2x-slower path; cast the "
+                f"float64 operand down explicitly")
+        if out == "int64" and "int64" in (a, b):
+            small = a if a != "int64" else b
+            if small in ("int8", "int16", "int32",
+                         "uint8", "uint16", "uint32"):
+                self.report(
+                    "dtype", node,
+                    f"silent int64 upcast: {small} op int64 widens the "
+                    f"expression to int64 — index/iota math on TPU "
+                    f"wants int32; cast the int64 operand down "
+                    f"explicitly")
+        return out
+
+    # ------------------------------------------------------------- calls
+    def _call(self, call, env):
+        R = rules()
+        func = call.func
+        name = dotted_name(func)
+        term = name.rsplit(".", 1)[-1]
+
+        # F.op(...) / nd.op(...): registry shape rules
+        if isinstance(func, ast.Attribute) \
+                and isinstance(func.value, ast.Name) \
+                and (env.get(func.value.id) is FNS
+                     or name.rsplit(".", 1)[0] in _ND_MODULES):
+            return self._op_rule_call(call, term, env)
+
+        # jnp./np. family
+        root = name.split(".", 1)[0]
+        if root in ("jnp", "np", "numpy", "onp") \
+                or name.startswith("jax.numpy."):
+            return self._np_call(call, term, env)
+        if name.startswith("jax.nn.") or root == "nn":
+            if term in _ELEMWISE:
+                args = [self._eval(a, env) for a in call.args]
+                first = _as_arr(args[0]) if args else None
+                for kw in call.keywords:
+                    self._eval(kw.value, env)
+                return first if first is not None else TOP
+            self._eval_args(call, env)
+            return TOP
+
+        # x.at[i].set(v): functional update preserves the base shape
+        if isinstance(func, ast.Attribute) \
+                and func.attr in ("set", "add", "multiply", "divide",
+                                  "max", "min", "get") \
+                and isinstance(func.value, ast.Subscript) \
+                and isinstance(func.value.value, ast.Attribute) \
+                and func.value.value.attr == "at":
+            base = self._eval(func.value.value.value, env)
+            self._eval_args(call, env)
+            if isinstance(base, Arr):
+                return base if func.attr != "get" else Arr(None, base.dtype)
+            return TOP
+
+        # array-method calls
+        if isinstance(func, ast.Attribute):
+            recv = self._eval(func.value, env)
+            if isinstance(recv, Arr):
+                return self._array_method(call, recv, func.attr, env)
+
+        if name == "len" and call.args:
+            v = self._eval(call.args[0], env)
+            if isinstance(v, Arr) and v.shape is not None and v.shape:
+                return DimV(v.shape[0])
+            if isinstance(v, (ShapeV, TupleV)):
+                n = len(v.dims if isinstance(v, ShapeV) else v.items)
+                return DimV(R.lit(n))
+            return DimV(None)
+        if name in ("tuple", "list") and len(call.args) == 1:
+            v = self._eval(call.args[0], env)
+            if isinstance(v, (ShapeV, TupleV, SeqV)):
+                return v
+            return TOP
+        if name in ("int", "float", "bool", "abs", "min", "max", "sum"):
+            self._eval_args(call, env)
+            return TOP if name != "int" else DimV(None)
+
+        # project-resolvable call: inline with the caller's facts
+        return self._project_call(call, env)
+
+    def _eval_args(self, call, env):
+        for a in call.args:
+            self._eval(a, env)
+        for kw in call.keywords:
+            self._eval(kw.value, env)
+
+    def _kwargs(self, call, env, skip=0):
+        out = {}
+        for kw in call.keywords:
+            if kw.arg is not None:
+                out[kw.arg] = kw.value
+        return out
+
+    def _const_of(self, expr, env):
+        """Python literal | Dim | tuple-of | None for rule kwargs."""
+        if expr is None:
+            return None
+        if isinstance(expr, ast.Constant):
+            return expr.value
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return tuple(self._const_of(e, env) for e in expr.elts)
+        if isinstance(expr, ast.UnaryOp) \
+                and isinstance(expr.op, ast.USub) \
+                and isinstance(expr.operand, ast.Constant) \
+                and isinstance(expr.operand.value, (int, float)):
+            return -expr.operand.value
+        d = self._dim_of(expr, env)
+        if d == -1:
+            return -1
+        return d
+
+    def _op_rule_call(self, call, opname, env):
+        R = rules()
+        rule = R.rule_for(opname)
+        avs = [self._eval(a, env) for a in call.args]
+        kwnodes = self._kwargs(call, env)
+        for kw in kwnodes.values():
+            self._eval(kw, env)
+        if rule is None:
+            return TOP
+        shapes = [_as_arr(a).shape if _as_arr(a) is not None else None
+                  for a in avs]
+        dtypes = [_as_arr(a).dtype if _as_arr(a) is not None else None
+                  for a in avs]
+        kw = {k: self._const_of(v, env) for k, v in kwnodes.items()}
+        try:
+            shape, dtype = rule(shapes, dtypes, kw)
+        except R.ShapeError as e:
+            self.report("shape", call, str(e))
+            return Arr(None, None)
+        return Arr(shape, dtype)
+
+    # ------------------------------------------------- jnp / np functions
+    def _np_call(self, call, term, env):
+        R = rules()
+        kwn = self._kwargs(call, env)
+
+        def arg_av(i):
+            return self._eval(call.args[i], env) \
+                if len(call.args) > i else TOP
+
+        if term == "reshape" and call.args:
+            base = _as_arr(arg_av(0))
+            target = self._shape_arg(call.args[1], env) \
+                if len(call.args) > 1 else None
+            return self._do_reshape(call, base, target)
+        if term in ("transpose", "permute_dims") and call.args:
+            base = _as_arr(arg_av(0))
+            axes = None
+            if len(call.args) > 1:
+                axes = self._const_of(call.args[1], env)
+            elif "axes" in kwn:
+                axes = self._const_of(kwn["axes"], env)
+            return self._do_transpose(call, base, axes)
+        if term in ("swapaxes", "moveaxis") and len(call.args) >= 3:
+            base = _as_arr(arg_av(0))
+            a = self._const_of(call.args[1], env)
+            b = self._const_of(call.args[2], env)
+            if base is None or base.shape is None \
+                    or not isinstance(a, int) or not isinstance(b, int):
+                return Arr(None, base.dtype if base else None)
+            rank = len(base.shape)
+            if not (-rank <= a < rank and -rank <= b < rank):
+                self.report("shape", call,
+                            f"{term} axes ({a}, {b}) out of range for "
+                            f"rank-{rank} input "
+                            f"{R.fmt_shape(base.shape)}")
+                return Arr(None, base.dtype)
+            a %= rank
+            b %= rank
+            dims = list(base.shape)
+            if term == "swapaxes":
+                dims[a], dims[b] = dims[b], dims[a]
+            else:
+                d = dims.pop(a)
+                dims.insert(b, d)
+            return Arr(tuple(dims), base.dtype)
+        if term == "einsum" and call.args \
+                and isinstance(call.args[0], ast.Constant) \
+                and isinstance(call.args[0].value, str):
+            ops = [_as_arr(self._eval(a, env)) for a in call.args[1:]]
+            shapes = [o.shape if o is not None else None for o in ops]
+            dtype = None
+            for o in ops:
+                if o is not None:
+                    dtype = o.dtype if dtype is None \
+                        else R.promote(dtype, o.dtype)
+            try:
+                shape = R.check_einsum(call.args[0].value, shapes)
+            except R.ShapeError as e:
+                self.report("shape", call, str(e))
+                shape = None
+            self._check_accum(call, "einsum", ops, kwn)
+            return Arr(shape, dtype)
+        if term in ("matmul", "dot") and len(call.args) >= 2:
+            la = _as_arr(arg_av(0))
+            ra = _as_arr(arg_av(1))
+            if la is None or ra is None:
+                return TOP
+            if term == "dot" and (
+                    (la.shape is not None and len(la.shape) > 2)
+                    or (ra.shape is not None and len(ra.shape) > 2)):
+                # np.dot N-d semantics differ from matmul: stay quiet
+                return Arr(None, R.promote(la.dtype, ra.dtype))
+            self._check_accum(call, term, [la, ra], kwn)
+            return self._matmul(call, la, ra)
+        if term in _CREATORS:
+            shape = self._shape_arg(call.args[0], env) if call.args \
+                else None
+            dtype = self._dtype_const(kwn.get("dtype"), env)
+            if dtype is None and term != "full":
+                dtype = "float32"
+            if term == "full" and dtype is None and len(call.args) > 1:
+                fill = self._eval(call.args[1], env)
+                fa = _as_arr(fill)
+                dtype = fa.dtype if fa is not None else None
+            if shape is not None and all(
+                    isinstance(d, R.Dim) or d is None for d in shape):
+                return Arr(tuple(d if isinstance(d, R.Dim) else None
+                                 for d in shape), dtype)
+            return Arr(None, dtype)
+        if term in ("zeros_like", "ones_like", "empty_like", "full_like") \
+                and call.args:
+            base = _as_arr(arg_av(0))
+            dtype = self._dtype_const(kwn.get("dtype"), env)
+            if base is not None:
+                return Arr(base.shape, dtype or base.dtype)
+            return Arr(None, dtype)
+        if term in ("asarray", "array") and call.args:
+            v = arg_av(0)
+            dtype = self._dtype_const(kwn.get("dtype"), env)
+            base = _as_arr(v)
+            if base is not None:
+                return Arr(base.shape, dtype or base.dtype)
+            if isinstance(v, (TupleV, SeqV)):
+                return Arr(None, dtype)
+            return Arr(None, dtype)
+        if term == "arange":
+            for a in call.args:
+                self._eval(a, env)
+            dtype = self._dtype_const(kwn.get("dtype"), env)
+            if len(call.args) == 1:
+                d = self._dim_of(call.args[0], env)
+                if d != -1 and d is not None:
+                    return Arr((d,), dtype)
+            return Arr(None, dtype)
+        if term == "linspace":
+            self._eval_args(call, env)
+            return Arr(None,
+                       self._dtype_const(kwn.get("dtype"), env))
+        if term == "broadcast_to" and len(call.args) >= 2:
+            base = _as_arr(arg_av(0))
+            target = self._shape_arg(call.args[1], env)
+            if target is not None and all(
+                    isinstance(d, R.Dim) or d is None for d in target):
+                tshape = tuple(d if isinstance(d, R.Dim) else None
+                               for d in target)
+                if base is not None and base.shape is not None:
+                    self._broadcast(call, base.shape, tshape)
+                return Arr(tshape, base.dtype if base else None)
+            return Arr(None, base.dtype if base else None)
+        if term in ("concatenate", "concat") and call.args:
+            return self._do_concat(call, env, kwn, stacked=False)
+        if term == "stack" and call.args:
+            return self._do_concat(call, env, kwn, stacked=True)
+        if term == "expand_dims" and len(call.args) >= 1:
+            base = _as_arr(arg_av(0))
+            axis = self._const_of(call.args[1], env) \
+                if len(call.args) > 1 else self._const_of(
+                    kwn.get("axis"), env)
+            if base is not None and base.shape is not None \
+                    and isinstance(axis, int):
+                rank = len(base.shape)
+                if -rank - 1 <= axis <= rank:
+                    axis %= (rank + 1)
+                    return Arr(base.shape[:axis] + (R.lit(1),)
+                               + base.shape[axis:], base.dtype)
+            return Arr(None, base.dtype if base else None)
+        if term == "where" and len(call.args) == 3:
+            c = _as_arr(arg_av(0))
+            a = _as_arr(arg_av(1))
+            b = _as_arr(arg_av(2))
+            if a is None or b is None:
+                return TOP
+            shape = self._broadcast(call, a.shape, b.shape)
+            if c is not None and shape is not None:
+                shape = self._broadcast(call, shape, c.shape)
+            return Arr(shape, self._promote(call, a.dtype, b.dtype))
+        if term in _REDUCTIONS:
+            return self._do_reduction(call, term, env, kwn)
+        if term in _BINARY_ELEMWISE and len(call.args) >= 2:
+            la = _as_arr(arg_av(0))
+            ra = _as_arr(arg_av(1))
+            if la is None or ra is None:
+                return TOP
+            shape = self._broadcast(call, la.shape, ra.shape)
+            division = term in ("divide", "true_divide")
+            return Arr(shape, self._promote(call, la.dtype, ra.dtype,
+                                            division=division))
+        if term in _ELEMWISE and call.args:
+            base = _as_arr(arg_av(0))
+            for a in call.args[1:]:
+                self._eval(a, env)
+            for kw in kwn.values():
+                self._eval(kw, env)
+            return base if base is not None else TOP
+        if term in rules().DTYPES and call.args:
+            base = _as_arr(arg_av(0))
+            return Arr(base.shape if base is not None else (), term)
+        if term == "pad" and call.args:
+            base = _as_arr(arg_av(0))
+            self._eval_args(call, env)
+            if base is not None and base.shape is not None:
+                return Arr((None,) * len(base.shape), base.dtype)
+            return Arr(None, base.dtype if base else None)
+        if term == "squeeze" and call.args:
+            base = _as_arr(arg_av(0))
+            self._eval_args(call, env)
+            return Arr(None, base.dtype if base else None)
+        self._eval_args(call, env)
+        return TOP
+
+    # --------------------------------------------------- shared handlers
+    def _do_reshape(self, node, base, target):
+        R = rules()
+        if base is None:
+            return TOP
+        if target is None or any(d is None for d in target):
+            return Arr(None, base.dtype)
+        try:
+            shape = R.check_reshape(base.shape, list(target))
+        except R.ShapeError as e:
+            self.report("shape", node, str(e))
+            return Arr(None, base.dtype)
+        return Arr(shape, base.dtype)
+
+    def _do_transpose(self, node, base, axes):
+        R = rules()
+        if base is None:
+            return TOP
+        if axes is not None and (not isinstance(axes, tuple)
+                                 or not all(isinstance(a, int)
+                                            for a in axes)):
+            return Arr(None, base.dtype)
+        try:
+            shape = R.check_transpose(base.shape, axes)
+        except R.ShapeError as e:
+            self.report("shape", node, str(e))
+            shape = None
+        return Arr(shape, base.dtype)
+
+    def _do_concat(self, call, env, kwn, stacked):
+        R = rules()
+        axis = self._const_of(kwn.get("axis"), env)
+        if axis is None and len(call.args) > 1:
+            axis = self._const_of(call.args[1], env)
+        if axis is None:
+            axis = 0
+        seq = self._eval(call.args[0], env)
+        parts: Optional[List] = None
+        if isinstance(seq, TupleV):
+            parts = [_as_arr(p) for p in seq.items]
+        elif isinstance(seq, SeqV):
+            elem = _as_arr(seq.elem)
+            if elem is not None and elem.shape is not None \
+                    and not stacked:
+                shape = tuple(
+                    None if isinstance(axis, int)
+                    and -len(elem.shape) <= axis < len(elem.shape)
+                    and i == axis % len(elem.shape) else d
+                    for i, d in enumerate(elem.shape))
+                return Arr(shape, elem.dtype)
+            return Arr(None, elem.dtype if elem else None)
+        if not parts or any(p is None for p in parts):
+            return TOP
+        dtype = None
+        for p in parts:
+            dtype = p.dtype if dtype is None else R.promote(dtype, p.dtype)
+        if stacked:
+            shapes = [p.shape for p in parts]
+            if all(s is not None for s in shapes):
+                base = shapes[0]
+                for s in shapes[1:]:
+                    if len(s) != len(base):
+                        self.report(
+                            "shape", call,
+                            f"stack operands disagree on rank: "
+                            f"{R.fmt_shape(base)} vs {R.fmt_shape(s)}")
+                        return Arr(None, dtype)
+                joined = tuple(
+                    d if all(R.dim_eq(d, s[i]) is True for s in shapes)
+                    else None for i, d in enumerate(base))
+                if isinstance(axis, int) and -len(base) - 1 <= axis \
+                        <= len(base):
+                    ax = axis % (len(base) + 1)
+                    return Arr(joined[:ax] + (R.lit(len(parts)),)
+                               + joined[ax:], dtype)
+            return Arr(None, dtype)
+        if not isinstance(axis, int):
+            return Arr(None, dtype)
+        try:
+            shape = R.concat_shapes([p.shape for p in parts], axis)
+        except R.ShapeError as e:
+            self.report("shape", call, str(e))
+            shape = None
+        return Arr(shape, dtype)
+
+    def _do_reduction(self, call, term, env, kwn, recv=None):
+        R = rules()
+        if recv is None:
+            if not call.args:
+                return TOP
+            recv = _as_arr(self._eval(call.args[0], env))
+            axis_node = call.args[1] if len(call.args) > 1 \
+                else kwn.get("axis")
+        else:
+            axis_node = call.args[0] if call.args else kwn.get("axis")
+        if recv is None:
+            return TOP
+        axis = self._const_of(axis_node, env) \
+            if axis_node is not None else None
+        keep = self._const_of(kwn.get("keepdims"), env) or False
+        out_dtype = self._dtype_const(kwn.get("dtype"), env)
+        if term in _ACCUM_REDUCTIONS:
+            self._check_accum(call, term, [recv], kwn)
+        if term in ("argmax", "argmin", "all", "any"):
+            out_dtype = out_dtype or (
+                "bool" if term in ("all", "any") else "int32")
+        elif out_dtype is None:
+            out_dtype = recv.dtype
+        if term in ("cumsum", "cumprod"):
+            return Arr(recv.shape, out_dtype)
+        if not (axis is None or isinstance(axis, int)
+                or (isinstance(axis, tuple)
+                    and all(isinstance(a, int) for a in axis))) \
+                or not isinstance(keep, bool):
+            return Arr(None, out_dtype)
+        try:
+            shape = R.reduce_shape(recv.shape, axis, keep)
+        except R.ShapeError as e:
+            self.report("shape", call, str(e))
+            shape = None
+        return Arr(shape, out_dtype)
+
+    def _check_accum(self, call, term, operands, kwn):
+        """bf16/f16 accumulation: a sum-family reduction (or a dot
+        routed without preferred_element_type) over a 16-bit float
+        accumulates in that 16-bit type — relative error grows with the
+        reduction length."""
+        if "dtype" in kwn or "preferred_element_type" in kwn:
+            return
+        if term in ("matmul", "dot", "einsum"):
+            return      # the MXU accumulates dot products in f32
+        small = [o for o in operands
+                 if o is not None and o.dtype in ("bfloat16", "float16")]
+        if small and all(o is not None and o.dtype in
+                         ("bfloat16", "float16") for o in operands):
+            self.report(
+                "dtype", call,
+                f"{term}() over {small[0].dtype} accumulates in "
+                f"{small[0].dtype}: a long reduction loses precision "
+                f"linearly — pass dtype=jnp.float32 (accumulate wide, "
+                f"then cast back if needed)")
+
+    def _array_method(self, call, recv, meth, env):
+        R = rules()
+        kwn = self._kwargs(call, env)
+        if meth == "reshape":
+            if len(call.args) == 1 and isinstance(
+                    call.args[0], (ast.Tuple, ast.List)):
+                target = self._shape_arg(call.args[0], env)
+            elif "shape" in kwn:
+                target = self._shape_arg(kwn["shape"], env)
+            else:
+                target = [self._dim_of(a, env) for a in call.args]
+            return self._do_reshape(call, recv, target)
+        if meth == "transpose":
+            if not call.args and "axes" not in kwn:
+                axes = None
+            elif len(call.args) == 1 and isinstance(
+                    call.args[0], (ast.Tuple, ast.List)):
+                axes = self._const_of(call.args[0], env)
+            elif "axes" in kwn:
+                axes = self._const_of(kwn["axes"], env)
+            else:
+                axes = tuple(self._const_of(a, env) for a in call.args)
+            if axes is not None and (not isinstance(axes, tuple)
+                                     or not all(isinstance(a, int)
+                                                for a in axes)):
+                self._eval_args(call, env)
+                return Arr(None, recv.dtype)
+            return self._do_transpose(call, recv, axes)
+        if meth == "astype":
+            dtype = self._dtype_const(
+                call.args[0] if call.args else kwn.get("dtype"), env)
+            return Arr(recv.shape, dtype)
+        if meth in _REDUCTIONS:
+            return self._do_reduction(call, meth, env, kwn, recv=recv)
+        if meth in ("ravel", "flatten"):
+            if recv.shape is not None:
+                return Arr((R.product(recv.shape),), recv.dtype)
+            return Arr(None, recv.dtype)
+        if meth in ("copy", "block_until_ready", "clip", "round"):
+            self._eval_args(call, env)
+            return recv
+        if meth == "item":
+            return TOP
+        if meth == "swapaxes" and len(call.args) == 2:
+            a = self._const_of(call.args[0], env)
+            b = self._const_of(call.args[1], env)
+            if recv.shape is not None and isinstance(a, int) \
+                    and isinstance(b, int):
+                rank = len(recv.shape)
+                if -rank <= a < rank and -rank <= b < rank:
+                    dims = list(recv.shape)
+                    dims[a % rank], dims[b % rank] = \
+                        dims[b % rank], dims[a % rank]
+                    return Arr(tuple(dims), recv.dtype)
+            return Arr(None, recv.dtype)
+        self._eval_args(call, env)
+        return TOP
+
+    # ----------------------------------------------------- project calls
+    def _project_call(self, call, env):
+        graph = self.ctx.graph
+        if graph is None:
+            self._eval_args(call, env)
+            return TOP
+        callee = graph.resolve_call(call, self.info)
+        if callee is None or callee.node.name == "__init__":
+            self._eval_args(call, env)
+            return TOP
+        if analyzed_surface(callee.node):
+            # the callee is its own checked surface: direct findings
+            # (and suppressions there) own its bugs
+            self._eval_args(call, env)
+            return TOP
+        if self.depth >= _MAX_INLINE_DEPTH \
+                or callee.qname in self.stack:
+            self._eval_args(call, env)
+            return TOP
+        from .callgraph import CallGraph
+        arg_map = CallGraph.arg_map(call, callee)
+        callee_env: Dict[str, object] = {}
+        for i, p in enumerate(callee.params):
+            node = arg_map.get(i)
+            if node is not None:
+                callee_env[p] = self._eval(node, env)
+            else:
+                callee_env[p] = self._default_av(callee, p)
+        # evaluate un-mapped argument expressions too (side findings)
+        mapped = {id(n) for n in arg_map.values()}
+        for a in call.args:
+            if id(a) not in mapped and not isinstance(a, ast.Starred):
+                self._eval(a, env)
+        for kw in call.keywords:
+            if id(kw.value) not in mapped:
+                self._eval(kw.value, env)
+        sub = _Interp(
+            self.ctx, callee, depth=self.depth + 1,
+            hops=self.hops + ((callee.node.name,
+                               self.info.src.path, call.lineno),),
+            anchor=self.anchor if self.anchor is not None else call,
+            stack=self.stack | {callee.qname})
+        sub.mute = self.mute
+        return sub.run(callee_env)
+
+    def _default_av(self, callee, param):
+        """Abstract value of an unmapped callee parameter, taken from
+        its default when that is a literal."""
+        node = callee.node
+        a = node.args
+        pos = list(a.posonlyargs) + list(a.args)
+        defaults = {}
+        for p, d in zip(reversed(pos), reversed(a.defaults)):
+            defaults[p.arg] = d
+        for p, d in zip(a.kwonlyargs, a.kw_defaults):
+            if d is not None:
+                defaults[p.arg] = d
+        d = defaults.get(param)
+        if d is None:
+            return TOP
+        return self._eval(d, {})
+
+
+def _loadify(target):
+    """A Store-context Name reused as a Load expression (AugAssign)."""
+    return ast.copy_location(
+        ast.Name(id=target.id, ctx=ast.Load()), target)
+
+
+def _as_arr(v) -> Optional[Arr]:
+    if isinstance(v, Arr):
+        return v
+    if isinstance(v, DimV):
+        return Arr((), "int")
+    if v is TOP:
+        return Arr(None, None)
+    return None
+
+
+def _elem_of(v):
+    if isinstance(v, SeqV):
+        return v.elem
+    if isinstance(v, TupleV):
+        out = TOP
+        for it in v.items:
+            out = it if out is TOP else _join(out, it)
+        return out
+    if isinstance(v, ShapeV):
+        return DimV(None)
+    if isinstance(v, Arr) and v.shape:
+        return Arr(v.shape[1:], v.dtype)
+    return TOP
+
+
+def _join(a, b):
+    R = rules()
+    if a is b:
+        return a
+    if isinstance(a, Arr) and isinstance(b, Arr):
+        if a.shape is not None and b.shape is not None \
+                and len(a.shape) == len(b.shape):
+            shape = tuple(
+                d1 if R.dim_eq(d1, d2) is True else None
+                for d1, d2 in zip(a.shape, b.shape))
+        else:
+            shape = None
+        return Arr(shape, a.dtype if a.dtype == b.dtype else None)
+    if isinstance(a, DimV) and isinstance(b, DimV):
+        return a if R.dim_eq(a.dim, b.dim) is True else DimV(None)
+    if isinstance(a, TupleV) and isinstance(b, TupleV) \
+            and len(a.items) == len(b.items):
+        return TupleV([_join(x, y) for x, y in zip(a.items, b.items)])
+    if isinstance(a, ShapeV) and isinstance(b, ShapeV) \
+            and len(a.dims) == len(b.dims):
+        return ShapeV(tuple(
+            d1 if R.dim_eq(d1, d2) is True else None
+            for d1, d2 in zip(a.dims, b.dims)))
+    if isinstance(a, SeqV) and isinstance(b, SeqV):
+        return SeqV(_join(a.elem, b.elem))
+    return TOP
+
+
+def _join_env(a, b):
+    out = {}
+    for k in set(a) | set(b):
+        if k == "__syms__":
+            merged = dict(b.get(k, {}))
+            merged.update(a.get(k, {}))
+            out[k] = merged
+            continue
+        if k in a and k in b:
+            out[k] = _join(a[k], b[k])
+        else:
+            out[k] = a.get(k, b.get(k))
+    return out
+
+
+def _seed_env(ctx, info: FunctionInfo) -> Dict[str, object]:
+    """Parameter seeding: positional params are arrays of unknown rank;
+    keyword-only params are host scalars (symbols when int-like);
+    ``hybrid_forward``'s ``F`` is the op namespace."""
+    node = info.node
+    env: Dict[str, object] = {}
+    a = node.args
+    kwonly = {p.arg for p in a.kwonlyargs}
+    params = [p.arg for p in list(a.posonlyargs) + list(a.args)] \
+        + sorted(kwonly)
+    kw_defaults = {p.arg: d for p, d in zip(a.kwonlyargs, a.kw_defaults)}
+    for i, p in enumerate(params):
+        if p in ("self", "cls"):
+            env[p] = TOP
+        elif node.name == "hybrid_forward" and p == "F":
+            env[p] = FNS
+        elif p in kwonly:
+            d = kw_defaults.get(p)
+            if d is None or (isinstance(d, ast.Constant)
+                             and isinstance(d.value, int)
+                             and not isinstance(d.value, bool)):
+                env[p] = DimV(ctx.fresh_sym(p))
+            else:
+                env[p] = TOP
+        else:
+            env[p] = Arr(None, None)
+    if a.vararg:
+        env[a.vararg.arg] = SeqV(Arr(None, None))
+    if a.kwarg:
+        env[a.kwarg.arg] = TOP
+    return env
+
+
+def file_findings(project: Project, src: SourceFile) -> List[ShapeFinding]:
+    """All mxshape findings for one file, cached on the Project (the
+    shape-soundness and dtype-promotion passes share one interpretation
+    per file)."""
+    cache = getattr(project, "_mxshape_cache", None)
+    if cache is None:
+        cache = project._mxshape_cache = {}
+    if src.path in cache:
+        return cache[src.path]
+    ctx = _Ctx(project, src)
+    graph = ctx.graph
+    for node in ast.walk(src.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not analyzed_surface(node):
+            continue
+        info = graph.function_at(node) if graph is not None else None
+        if info is None:
+            info = FunctionInfo(f"<local>.{node.name}", node, src,
+                                module_of(src.path), None, None)
+        interp = _Interp(ctx, info)
+        interp.run(_seed_env(ctx, info))
+    cache[src.path] = ctx.findings
+    return ctx.findings
