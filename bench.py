@@ -37,7 +37,14 @@ figure), BENCH_RESNET / BENCH_HYBRID / BENCH_SAMEBATCH / BENCH_FUSED /
 BENCH_FLASH / BENCH_FLASH2048 / BENCH_NMT / BENCH_PIPELINE ("0"
 disables the phase), BENCH_RESNET_BATCH (512), BENCH_NMT_BATCH (32),
 BENCH_FLASH_BATCH (default 8), BENCH_PHASE_TIMEOUT (seconds, 600),
-BENCH_TOTAL_BUDGET (seconds, 3000 — hard deadline for the whole run).
+BENCH_TOTAL_BUDGET (seconds, 3000 — hard deadline for the whole run),
+BENCH_COMPILE_CACHE_DIR (persistent XLA compilation cache shared by
+every phase subprocess AND reused across bench rounds — r03/r05 hit
+rc=124 largely on recompiles, so a warm cache is what makes the suite
+fit its budget; default: a stable per-host dir under $TMPDIR;
+BENCH_COMPILE_CACHE=0 disables).  Each phase reports
+compile_cache_hits/misses from jax's cache events; the orchestrator
+sums them across phases into the merged JSON.
 """
 import gc
 import json
@@ -119,6 +126,15 @@ class _Env:
         # same dance as tests/conftest.py)
         if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
             jax.config.update("jax_platforms", "cpu")
+        # persistent compilation cache BEFORE any jit compiles: every
+        # phase subprocess (and every bench round) reuses the same dir,
+        # so only the first-ever visit of a program pays the compile
+        self.cache_stats = None
+        cache_dir = os.environ.get("BENCH_COMPILE_CACHE_DIR")
+        if cache_dir:
+            from mxnet_tpu import compile_cache
+            self.cache_stats = compile_cache.enable_jax_persistent_cache(
+                cache_dir)
         import jax.numpy as jnp
         import mxnet_tpu as mx
         from mxnet_tpu import nd, models, parallel
@@ -496,6 +512,11 @@ def run_phase(name):
            "fused": phase_fused, "flash": phase_flash,
            "flash2048": phase_flash2048, "nmt": phase_nmt,
            "pipeline": phase_pipeline}[name](env)
+    if env.cache_stats is not None:
+        # per-phase persistent-cache accounting; the orchestrator SUMS
+        # these across phases (they are deltas, not totals)
+        out["compile_cache_hits"] = env.cache_stats["hits"]
+        out["compile_cache_misses"] = env.cache_stats["misses"]
     print(json.dumps(out))
 
 
@@ -574,7 +595,9 @@ def _finalize(merged):
              "nmt_train_mfu", "nmt_batch", "nmt_buckets",
              "nmt_compiled_programs", "nmt_params",
              "pipeline_imgs_per_sec", "pipeline_vs_step",
-             "pipeline_threads", "pipeline_step_imgs_per_sec"]
+             "pipeline_threads", "pipeline_step_imgs_per_sec",
+             "compile_cache_hits", "compile_cache_misses",
+             "compile_cache_dir"]
     out = {k: out_src[k] for k in order if k in out_src}
     out.update({k: v for k, v in out_src.items() if k not in out})
     return out
@@ -594,6 +617,21 @@ def _orchestrate():
     timeout = int(os.environ.get("BENCH_PHASE_TIMEOUT", 600))
     budget = float(os.environ.get("BENCH_TOTAL_BUDGET", 3000))
     deadline = time.monotonic() + budget
+    # warm-compile-cache discipline: one stable dir shared by all phase
+    # subprocesses and REUSED across bench rounds (rc=124 in r03/r05 was
+    # mostly recompile time) — children inherit it via the environment
+    cache_dir = os.environ.get("BENCH_COMPILE_CACHE_DIR")
+    if cache_dir is None and os.environ.get(
+            "BENCH_COMPILE_CACHE", "1") != "0":
+        import tempfile
+        # per-user default: a shared fixed path in /tmp would be owned
+        # by whichever user benched first, silently disabling cache
+        # writes (and the warm-round speedup) for everyone else
+        uid = os.getuid() if hasattr(os, "getuid") else "u"
+        cache_dir = os.path.join(
+            tempfile.gettempdir(),
+            f"mxnet_tpu_bench_compile_cache_{uid}")
+        os.environ["BENCH_COMPILE_CACHE_DIR"] = cache_dir
     attempts = {
         "headline": [{}, {"BENCH_BATCH": "24"}, {"BENCH_BATCH": "16"}],
         "resnet": [{}, {"BENCH_RESNET_BATCH": "256"},
@@ -621,6 +659,8 @@ def _orchestrate():
         "pipeline": os.environ.get("BENCH_PIPELINE", "1") != "0",
     }
     merged = {}
+    if cache_dir:
+        merged["compile_cache_dir"] = cache_dir
 
     def emit():
         if merged:
@@ -675,6 +715,10 @@ def _orchestrate():
         if pb is not None and ("batch" not in merged
                                or merged["batch"] != pb):
             got[f"{phase}_batch"] = pb
+        # per-phase cache counts are deltas: sum across phases
+        for k in ("compile_cache_hits", "compile_cache_misses"):
+            if k in got:
+                got[k] = merged.get(k, 0) + got[k]
         merged.update(got)
         emit()
 

@@ -110,6 +110,11 @@ serving_smoke() {
     # a recorded p99, and load shedding on a saturated bounded queue
     # (docs/serving.md; ISSUE-2 acceptance criteria)
     python benchmark/bench_serving.py --smoke
+    # persistent-compile-cache round trip (ISSUE-6 acceptance): start a
+    # server, kill the process, restart against the SAME cache dir —
+    # the warm restart must compile ZERO new XLA programs (asserted via
+    # the compile-cache miss counter; every bucket deserializes)
+    python benchmark/bench_serving.py --cache-roundtrip
 }
 
 bench_cpu() {

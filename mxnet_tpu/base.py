@@ -252,3 +252,15 @@ declare_env("MXNET_SERVING_WORKERS", 1,
 declare_env("MXNET_SERVING_RETRY_AFTER_MS", 50,
             "Serving: retry-after hint (milliseconds) attached to "
             "ServerOverloadedError when a request is shed.")
+declare_env("MXNET_COMPILE_CACHE_DIR", None,
+            "Persistent AOT compiled-executable cache directory "
+            "(mxnet_tpu.compile_cache): serving bucket programs are "
+            "content-addressed on (StableHLO hash, shape bucket, "
+            "dtypes, device topology, jax version) and reloaded via "
+            "PJRT executable deserialization instead of recompiling — "
+            "a warm server restart compiles ZERO new XLA programs. "
+            "Unset (default) = disabled.")
+declare_env("MXNET_COMPILE_CACHE_MAX_BYTES", 1073741824,
+            "Size bound on the compile-cache directory; least-recently-"
+            "used entries are evicted beyond it (hits refresh recency). "
+            "0 = unbounded.")

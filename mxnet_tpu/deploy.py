@@ -19,6 +19,7 @@ validates calls against it so a shape/dtype mistake raises a clear
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 
@@ -42,14 +43,53 @@ def _sig_entry(shape, dtype):
             "dtype": str(dtype)}
 
 
+def _aot_buckets(precompile, dynamic_batch, fixed_batch):
+    """Normalize ``export_stablehlo(precompile=...)`` into a bucket
+    list.  ``True`` means the serving default bucket set (powers of two
+    up to ``MXNET_SERVING_MAX_BATCH``) for dynamic exports, or the one
+    exported shape for static ones."""
+    from .base import get_env
+    from .serving.batcher import bucket_set
+    if not dynamic_batch and fixed_batch is None:
+        raise MXNetError(
+            "export_stablehlo: precompile needs a leading batch "
+            "dimension (or dynamic_batch=True)")
+    if precompile is True:
+        if not dynamic_batch:
+            return [fixed_batch]
+        return bucket_set(int(get_env("MXNET_SERVING_MAX_BATCH")))
+    buckets = sorted({int(b) for b in precompile})
+    if any(b < 1 for b in buckets):
+        raise MXNetError("export_stablehlo: precompile buckets must be "
+                         ">= 1")
+    if not dynamic_batch and buckets != [fixed_batch]:
+        raise MXNetError(
+            f"export_stablehlo: a static export can only precompile its "
+            f"exported batch ({fixed_batch}), got buckets {buckets} — "
+            f"export with dynamic_batch=True for a bucket set")
+    return buckets
+
+
 def export_stablehlo(block, *example_inputs, path, emit_text=False,
-                     dynamic_batch=False, version=None):
+                     dynamic_batch=False, version=None, precompile=()):
     """Export ``block``'s inference forward as a StableHLO artifact.
 
     Writes ``path.shlo`` (serialized module, weights embedded as
     constants) and ``path.json`` (input/output signature manifest).
     With ``emit_text=True`` also writes ``path.stablehlo.txt`` (the MLIR
     module, for inspection / non-JAX StableHLO consumers).
+
+    ``precompile`` ships ahead-of-time compiled executables next to the
+    manifest (manifest v3 ``precompiled`` field): pass an iterable of
+    shape buckets (dynamic exports) or ``True`` (the serving default
+    bucket set; for static exports, the one exported shape).  Each
+    bucket's executable is serialized into ``path.aot/<key>.bin`` keyed
+    exactly as the serving compile cache
+    (``mxnet_tpu.compile_cache.cache_key``), so a server loading the
+    artifact on the SAME device topology and jax version starts with
+    zero XLA compiles.  A replica on a different topology silently
+    falls back to compiling (the key will not match) — precompiled
+    blobs are an optimization, never a compatibility constraint.
 
     ``dynamic_batch=True`` exports the leading dimension of every input
     as ONE shared symbolic size, so the same artifact serves any batch
@@ -93,9 +133,10 @@ def export_stablehlo(block, *example_inputs, path, emit_text=False,
         exported = jexport.export(jax.jit(infer))(*args)
     except Exception as e:
         raise MXNetError(f"export_stablehlo: lowering failed: {e}") from e
-    blob = exported.serialize()
+    blob = bytes(exported.serialize())
     manifest = {
         "format": "jax.export/stablehlo",
+        "manifest_version": 3,
         # null when the caller did not pick one, so the serving
         # repository's auto-increment stays in charge (a hard-coded 1
         # would collide on the second default export of a model)
@@ -106,12 +147,64 @@ def export_stablehlo(block, *example_inputs, path, emit_text=False,
                     for a in exported.out_avals],
         "block": type(block).__name__,
     }
+    aot_blobs = []
+    if precompile:
+        from . import compile_cache as _cc
+        fixed = None if dynamic_batch else \
+            (args[0].shape[0] if args and args[0].shape else None)
+        buckets = _aot_buckets(precompile, dynamic_batch, fixed)
+        program_hash = hashlib.sha256(blob).hexdigest()
+        dtypes = [str(a.dtype) for a in args]
+        aot_dirname = os.path.basename(path) + ".aot"
+        entries = []
+        for b in buckets:
+            if dynamic_batch:
+                avals = tuple(
+                    jax.ShapeDtypeStruct((b,) + tuple(a.shape[1:]),
+                                         a.dtype) for a in args)
+            else:
+                avals = args
+            try:
+                compiled = jax.jit(
+                    lambda *xs: exported.call(*xs)).lower(*avals).compile()
+                body = _cc._serialize_compiled(compiled)
+            except Exception as e:
+                raise MXNetError(
+                    f"export_stablehlo: precompile of bucket {b} "
+                    f"failed: {e}") from e
+            key = _cc.cache_key(program_hash, b, dtypes)
+            aot_blobs.append((key, body))
+            entries.append({"bucket": int(b),
+                            "file": f"{aot_dirname}/{key}.bin",
+                            "key": key})
+        manifest["precompiled"] = entries
     # validate BEFORE anything touches disk: a rejected export must not
     # leave an orphan .shlo that a later load_stablehlo would serve
-    # manifest-less (and therefore unchecked)
+    # manifest-less (and therefore unchecked) — precompiled executables
+    # are likewise built in memory above so a failed bucket compile
+    # leaves no partial artifact behind
     validate_manifest(manifest, where=f"export_stablehlo({path!r})")
     with open(path + ".shlo", "wb") as f:
-        f.write(bytes(blob))
+        f.write(blob)
+    # sweep executables from a PREVIOUS export to this path: new weights
+    # mean new keys, and stale unreferenced blobs would otherwise ride
+    # along with the artifact forever (one full executable per bucket
+    # per re-export)
+    aot_dir = path + ".aot"
+    keep = {key + ".bin" for key, _body in aot_blobs}
+    if os.path.isdir(aot_dir):
+        for name in os.listdir(aot_dir):
+            if name.endswith(".bin") and name not in keep:
+                try:
+                    os.unlink(os.path.join(aot_dir, name))
+                except OSError:
+                    pass
+    if aot_blobs:
+        from . import compile_cache as _cc
+        os.makedirs(aot_dir, exist_ok=True)
+        for key, body in aot_blobs:
+            _cc.write_payload_file(os.path.join(aot_dir, key + ".bin"),
+                                   body)
     with open(path + ".json", "w") as f:
         json.dump(manifest, f, indent=1)
     if emit_text:
@@ -224,6 +317,34 @@ def validate_manifest(manifest, where="manifest"):
         raise MXNetError(
             f"{where}: manifest version must be an int or null, got "
             f"{version!r}")
+    mver = manifest.get("manifest_version")
+    if mver is not None and (not isinstance(mver, int)
+                             or not 2 <= mver <= 3):
+        raise MXNetError(
+            f"{where}: unsupported manifest_version {mver!r} "
+            f"(this loader understands 2..3)")
+    pre = manifest.get("precompiled")
+    if pre is not None:
+        # v3: shipped AOT executables; entries must be loadable without
+        # trusting the manifest (relative file under the artifact dir,
+        # hex key matching the compile-cache addressing)
+        if not isinstance(pre, list):
+            raise MXNetError(
+                f"{where}: manifest 'precompiled' must be a list")
+        for i, e in enumerate(pre):
+            if not isinstance(e, dict) \
+                    or not isinstance(e.get("bucket"), int) \
+                    or e["bucket"] < 1 \
+                    or not isinstance(e.get("file"), str) \
+                    or not isinstance(e.get("key"), str):
+                raise MXNetError(
+                    f"{where}: precompiled entry {i} is not a "
+                    f"{{bucket>=1, file, key}} record")
+            f = e["file"]
+            if os.path.isabs(f) or ".." in f.split("/"):
+                raise MXNetError(
+                    f"{where}: precompiled entry {i} file {f!r} must "
+                    f"be a relative path inside the artifact directory")
     if bool(manifest.get("dynamic_batch")):
         for i, spec in enumerate(manifest["inputs"]):
             if not spec["shape"] or spec["shape"][0] is not None:
@@ -251,6 +372,17 @@ def _canon_dtype(d):
         return np.dtype(d).name
     except TypeError:
         return str(d)
+
+
+def _resolve_dtype(name):
+    """Manifest dtype NAME -> numpy dtype object (extension dtypes via
+    ml_dtypes) — the inverse of ``_sig_entry`` for building concrete
+    avals out of a signature."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, str(name)))
 
 
 def _shape_dtype(x):
@@ -322,14 +454,86 @@ class StableHLOModel:
     ``load_stablehlo(...)`` keep working unchanged.
     """
 
-    def __init__(self, exported, manifest, path):
+    def __init__(self, exported, manifest, path, content_hash=None):
         self.exported = exported
         self.manifest = manifest
         self.path = path
+        # content address of the serialized module — the program-identity
+        # half of every compile-cache key
+        self.content_hash = content_hash
 
     @property
     def dynamic_batch(self):
         return bool(self.manifest and self.manifest.get("dynamic_batch"))
+
+    def _shipped_payload(self, key):
+        """Path of a precompiled executable shipped next to the manifest
+        (``export_stablehlo(precompile=...)``), or None."""
+        if self.manifest is None:
+            return None
+        for e in self.manifest.get("precompiled") or ():
+            if e.get("key") == key:
+                path = os.path.join(os.path.dirname(os.path.abspath(
+                    self.path)), e["file"])
+                if os.path.exists(path):
+                    return path
+        return None
+
+    def aot_program(self, rows=None, cache=None):
+        """Bucket-concrete compiled callable, checked against the
+        persistent compile cache BEFORE compiling (docs/serving.md §5).
+
+        Resolution order: compile-cache entry (deserialize, zero XLA
+        compiles) -> executable shipped inside the artifact by
+        ``export_stablehlo(precompile=...)`` (ingested into the cache
+        when one is configured) -> fresh AOT compile (stored back into
+        the cache).  ``rows`` is the concrete leading dimension for
+        dynamic-batch artifacts (the serving shape bucket); static
+        artifacts compile their exported shapes.  The returned callable
+        carries ``_mx_from_disk_cache`` so the serving batcher can
+        label disk hits vs compiles.
+        """
+        import jax
+
+        from . import compile_cache as _cc
+        if self.manifest is None:
+            raise MXNetError(
+                f"aot_program({self.path}): the artifact has no "
+                f"manifest — re-export with deploy.export_stablehlo")
+        sig = self.manifest["inputs"]
+        dynamic = self.dynamic_batch
+        if dynamic and rows is None:
+            raise MXNetError(
+                f"aot_program({self.path}): a dynamic-batch artifact "
+                f"needs concrete rows= to compile")
+        avals, dtypes = [], []
+        for i, spec in enumerate(sig):
+            shape = list(spec["shape"])
+            if dynamic and shape:
+                shape[0] = int(rows)
+            if any(d is None for d in shape):
+                raise MXNetError(
+                    f"aot_program({self.path}): input {i} has a "
+                    f"symbolic non-batch dimension {spec['shape']} — "
+                    f"cannot pick a concrete compile shape")
+            avals.append(jax.ShapeDtypeStruct(tuple(shape),
+                                              _resolve_dtype(spec["dtype"])))
+            dtypes.append(spec["dtype"])
+        bucket = int(rows) if dynamic else \
+            (sig[0]["shape"][0] if sig and sig[0]["shape"] else 0)
+        if self.content_hash is None:
+            raise MXNetError(
+                f"aot_program({self.path}): no content hash (load the "
+                f"artifact via deploy.load_stablehlo)")
+        cache = _cc.get_default() if cache is None else cache
+        key = _cc.cache_key(self.content_hash, bucket, dtypes)
+        shipped = self._shipped_payload(key)
+        if shipped is not None and cache.enabled:
+            cache.ingest(key, shipped)          # then served as a hit
+        prog, _source = _cc.aot_program(
+            lambda *xs: self.exported.call(*xs), avals, key, cache,
+            shipped_path=shipped)
+        return prog
 
     def validate(self, arrays):
         if self.manifest is not None:
@@ -362,5 +566,7 @@ def load_stablehlo(path):
     if not os.path.exists(path):
         raise MXNetError(f"no artifact at {path}")
     with open(path, "rb") as f:
-        exported = jexport.deserialize(bytearray(f.read()))
-    return StableHLOModel(exported, load_manifest(path), path)
+        raw = f.read()
+    exported = jexport.deserialize(bytearray(raw))
+    return StableHLOModel(exported, load_manifest(path), path,
+                          content_hash=hashlib.sha256(raw).hexdigest())

@@ -853,18 +853,22 @@ class HybridBlock(Block):
         return sym_file
 
     def export_stablehlo(self, *example_inputs, path, emit_text=False,
-                         dynamic_batch=False, version=None):
+                         dynamic_batch=False, version=None,
+                         precompile=()):
         """Export this block's inference forward as a self-contained
         StableHLO artifact (``deploy.export_stablehlo``): weights baked
         in, ``path.json`` serving-signature manifest alongside.  Pass
         ``dynamic_batch=True`` to leave the batch dimension symbolic so
         ``mxnet_tpu.serving`` can shape-bucket request batches over one
         artifact; ``version`` tags the manifest for repository
-        hot-swap."""
+        hot-swap; ``precompile`` (bucket list, or True for the serving
+        defaults) ships AOT-compiled executables next to the manifest
+        so a matching-topology server starts with zero XLA compiles."""
         from .. import deploy
         return deploy.export_stablehlo(
             self, *example_inputs, path=path, emit_text=emit_text,
-            dynamic_batch=dynamic_batch, version=version)
+            dynamic_batch=dynamic_batch, version=version,
+            precompile=precompile)
 
 
 class SymbolBlock(HybridBlock):

@@ -605,8 +605,23 @@ SERVING_REQUEST_SECONDS = histogram(
 SERVING_BUCKET_CACHE = counter(
     "serving.bucket.cache",
     "Shape-bucket program-cache lookups by the serving batcher "
-    "(event=hit|miss; misses equal compiled programs).",
+    "(event=mem_hit|disk_hit|miss; misses equal freshly COMPILED "
+    "programs, disk hits are executables deserialized from the "
+    "persistent compile cache, and mem_hit+disk_hit+miss equals "
+    "lookups — so in-memory programs == misses + disk hits).",
     labelnames=("event",))
+COMPILE_CACHE = counter(
+    "compile.cache",
+    "Persistent compiled-executable cache events "
+    "(mxnet_tpu.compile_cache): event=hit|miss|corrupt|store|evict for "
+    "the serving executable store, jax_hit|jax_miss for jax's own "
+    "persistent compilation cache when routed via "
+    "enable_jax_persistent_cache.",
+    labelnames=("event",))
+COMPILE_CACHE_DESERIALIZE_SECONDS = histogram(
+    "compile.cache.deserialize.seconds",
+    "Time to deserialize + load one cached executable onto the current "
+    "devices (the disk-hit replacement for an XLA compile).")
 
 
 def record_op_invoke(opname: str, seconds: float):

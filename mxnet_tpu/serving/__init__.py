@@ -11,10 +11,14 @@ the missing serving tier over it:
   per-bucket compiled-program cache (O(log N) programs for N request
   shapes);
 - :class:`ModelServer` — bounded queues, worker pool, load shedding
-  (:class:`ServerOverloadedError` + retry-after), graceful drain;
+  (:class:`ServerOverloadedError` + retry-after), graceful drain, and
+  ``prewarm()`` (compile/load every bucket BEFORE a hot-swap admits
+  traffic — with the persistent compile cache
+  (``mxnet_tpu.compile_cache``, ``MXNET_COMPILE_CACHE_DIR``) a warm
+  restart compiles zero new XLA programs);
 - first-class ``runtime_metrics`` instrumentation (queue depth, batch
-  occupancy, per-model latency, shed counter —
-  ``docs/observability.md``).
+  occupancy, per-model latency, shed counter, bucket-cache
+  mem/disk/miss counter — ``docs/observability.md``).
 
 >>> from mxnet_tpu import serving
 >>> repo = serving.ModelRepository()

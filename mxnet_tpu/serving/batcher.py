@@ -6,9 +6,12 @@ and padded up to the next power-of-two **bucket**, so any mix of N
 request shapes reaches the compiler as at most ``ceil(log2(max)) + 1``
 distinct program shapes (the Ragged-Paged-Attention / TPU-serving
 insight that compiled-program reuse, not the kernel, is where the win
-lives — PAPERS.md).  Each bucket's program is compiled once and cached;
-``serving.bucket.cache{event=hit|miss}`` counts lookups, with misses ==
-compiled programs.
+lives — PAPERS.md).  Each bucket's program is built once and cached;
+``serving.bucket.cache{event=mem_hit|disk_hit|miss}`` counts lookups.
+The invariant: **misses == freshly COMPILED programs** — a disk_hit is
+an executable deserialized from the persistent compile cache
+(``mxnet_tpu.compile_cache``), so the in-memory program count equals
+misses + disk hits, and a warm-cache server restart shows zero misses.
 
 Outputs must be batch-major (axis 0 = rows, the manifest contract);
 padded rows are sliced off and per-request slices handed back, so a
@@ -16,12 +19,15 @@ ragged final batch un-pads exactly.
 """
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from .. import engine, runtime_metrics as _rm
 from ..base import MXNetError
 
-__all__ = ["DynamicBatcher", "next_bucket", "pad_batch", "unpad_outputs"]
+__all__ = ["DynamicBatcher", "next_bucket", "bucket_set", "pad_batch",
+           "unpad_outputs"]
 
 
 def next_bucket(rows, max_batch):
@@ -36,6 +42,20 @@ def next_bucket(rows, max_batch):
     while b < rows:
         b <<= 1
     return min(b, max_batch)
+
+
+def bucket_set(max_batch):
+    """Every bucket :func:`next_bucket` can produce for ``max_batch``,
+    ascending — the ONE definition of the bucket policy shared by
+    prewarm (all-buckets warm-up) and ``export_stablehlo(precompile=)``
+    (shipped executables), so neither can drift from what serving
+    actually dispatches."""
+    buckets, b = [], 1
+    while b < max_batch:
+        buckets.append(b)
+        b <<= 1
+    buckets.append(max_batch)       # the cap is always the last bucket
+    return buckets
 
 
 def pad_batch(request_inputs, bucket_rows):
@@ -94,30 +114,61 @@ class DynamicBatcher:
         self.config = config
         self._lock = engine.make_lock("serving.DynamicBatcher._lock")
         self._progs = {}            # (entry.uid, bucket) -> callable
+        self._building = {}         # key -> Event (in-flight builds)
         self._retired = set()       # uids evicted; never re-cache these
-        self.bucket_hits = 0
-        self.bucket_misses = 0
+        self.bucket_hits = 0        # in-memory program reused
+        self.bucket_disk_hits = 0   # deserialized from the compile cache
+        self.bucket_misses = 0      # freshly compiled
 
     # ------------------------------------------------------------- cache
     def program_for(self, entry, bucket_rows):
+        """The cached program for one (entry, bucket) — built (compiled
+        or deserialized from the persistent compile cache) on first
+        lookup.  The build runs OUTSIDE the batcher lock: an XLA
+        compile can take seconds, and holding the lock through it would
+        stall every other model's mem-hit lookups.  Concurrent lookups
+        of the SAME key wait on the builder instead of compiling twice,
+        so misses stay == compiled programs."""
         key = (entry.uid, bucket_rows)
-        with self._lock:
-            prog = self._progs.get(key)
-            if prog is not None:
-                self.bucket_hits += 1
-                if _rm._ENABLED:
-                    _rm.SERVING_BUCKET_CACHE.inc(event="hit")
-                return prog
-            self.bucket_misses += 1
-            if _rm._ENABLED:
-                _rm.SERVING_BUCKET_CACHE.inc(event="miss")
+        while True:
+            with self._lock:
+                prog = self._progs.get(key)
+                if prog is not None:
+                    self.bucket_hits += 1
+                    if _rm._ENABLED:
+                        _rm.SERVING_BUCKET_CACHE.inc(event="mem_hit")
+                    return prog
+                pending = self._building.get(key)
+                if pending is None:
+                    self._building[key] = threading.Event()
+                    break               # this thread builds
+            pending.wait()              # builder done (or failed): recheck
+        try:
             prog = entry.make_program(bucket_rows)
+        except BaseException:
+            # wake waiters so one of them retries as the next builder
+            with self._lock:
+                self._building.pop(key).set()
+            raise
+        with self._lock:
+            # three-way label: a program deserialized from the
+            # persistent compile cache (entry.make_program marks it) is
+            # a disk_hit, not a miss — misses stay == compiled programs
+            if getattr(prog, "_mx_from_disk_cache", False):
+                self.bucket_disk_hits += 1
+                event = "disk_hit"
+            else:
+                self.bucket_misses += 1
+                event = "miss"
+            if _rm._ENABLED:
+                _rm.SERVING_BUCKET_CACHE.inc(event=event)
             # a batch admitted before unload can dispatch after evict():
             # run it, but never re-cache under a retired uid (no future
             # unload event would ever clear it again)
             if entry.uid not in self._retired:
                 self._progs[key] = prog
-            return prog
+            self._building.pop(key).set()
+        return prog
 
     def programs(self, entry=None):
         """Cached program count (per entry, or total)."""

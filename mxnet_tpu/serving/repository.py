@@ -20,12 +20,17 @@ requests admitted after the swap see the new version.
 from __future__ import annotations
 
 import itertools
+import logging
 from collections import OrderedDict
+
+import numpy as np
 
 from .. import engine
 from ..base import MXNetError
 
 __all__ = ["ModelEntry", "ModelRepository"]
+
+_LOG = logging.getLogger("mxnet_tpu")
 
 _UID = itertools.count(1)
 
@@ -172,8 +177,29 @@ class ModelRepository:
         exported = model.exported
 
         def make_program(bucket_rows):
-            # fresh jit wrapper per bucket: its cache holds exactly one
-            # program, so bucket-cache misses == compiled programs
+            # persistent-cache path first: an AOT executable keyed on
+            # (artifact hash, bucket, dtypes, topology) deserializes in
+            # milliseconds instead of recompiling — a warm server
+            # restart compiles ZERO new XLA programs.  Any failure falls
+            # back to the plain jit wrapper (fresh wrapper per bucket:
+            # its cache holds exactly one program, so bucket-cache
+            # misses == compiled programs either way).
+            from .. import compile_cache as _cc
+            if _cc.get_default().enabled \
+                    or (model.manifest or {}).get("precompiled"):
+                try:
+                    prog = model.aot_program(rows=bucket_rows)
+
+                    def wrapped(*xs):
+                        return _as_tuple(prog(*xs))
+                    wrapped._mx_from_disk_cache = getattr(
+                        prog, "_mx_from_disk_cache", False)
+                    return wrapped
+                except Exception as e:      # noqa: BLE001 — degrade
+                    _LOG.warning(
+                        "serving: compile-cache path failed for "
+                        "%s bucket %s (%s); falling back to jit",
+                        name, bucket_rows, e)
             return jax.jit(lambda *xs: _as_tuple(exported.call(*xs)))
 
         entry = ModelEntry(name, version, "stablehlo", sig, dynamic,
@@ -239,18 +265,94 @@ class ModelRepository:
     # ------------------------------------------------------------- resolve
     def get(self, name):
         """The current :class:`ModelEntry` for ``name`` (atomic read)."""
+        return self._resolve(name)
+
+    def _resolve(self, name, version=None):
+        """The entry for (name, version); version=None means current."""
         with self._lock:
             slot = self._models.get(name)
             if slot is None:
                 raise MXNetError(
                     f"no model {name!r} in the repository "
                     f"(known: {sorted(self._models)})")
-            if slot["current"] is None:
+            v = slot["current"] if version is None else version
+            if v is None:
                 raise MXNetError(
                     f"model {name!r} has no active version (staged: "
                     f"{list(slot['versions'])}) — activate one with "
-                    f"swap({name!r}, version)")
-            return slot["versions"][slot["current"]]
+                    f"swap({name!r}, version), or address it directly "
+                    f"with version=")
+            if v not in slot["versions"]:
+                raise MXNetError(
+                    f"model {name!r} has no version {v!r} "
+                    f"(have: {list(slot['versions'])})")
+            return slot["versions"][v]
+
+    def prewarm(self, name, version=None, *, batcher, max_batch_size=None):
+        """Compile/load EVERY shape bucket of (name, version) through
+        ``batcher``'s program cache and execute each program once, so an
+        atomic hot-swap admits traffic with zero compiles left on the
+        request path (docs/serving.md §5).  The deploy loop is::
+
+            repo.load_artifact("m", path, activate=False)   # stage v2
+            srv.prewarm("m", version=2)                     # compile all
+            repo.swap("m", 2)                               # cutover
+
+        ``version=None`` prewarms the current version (cold-start path:
+        prewarm before admitting any traffic).  Programs backed by the
+        persistent compile cache deserialize instead of compiling;
+        jit-backed programs are forced through their first (compiling)
+        call here with zero-filled inputs.  Returns a summary dict
+        (buckets warmed, compile/disk-hit counts from the batcher
+        delta).
+        """
+        from ..deploy import _resolve_dtype
+        from .batcher import bucket_set
+        entry = self._resolve(name, version)
+        if max_batch_size is None:
+            max_batch_size = batcher.config.max_batch_size
+        if entry.dynamic_batch:
+            buckets = bucket_set(max_batch_size)
+        else:
+            if entry.fixed_batch is None:
+                raise MXNetError(
+                    f"prewarm({name!r}): static signature without a "
+                    f"batch dimension cannot be batch-served")
+            buckets = [entry.fixed_batch]
+        compiled = disk_hits = 0
+        for rows in buckets:
+            # attribute builds to THIS entry (the global batcher
+            # counters also move for concurrent traffic on other
+            # models/versions — the documented prewarm-under-load flow)
+            before = batcher.programs(entry)
+            prog = batcher.program_for(entry, rows)
+            if batcher.programs(entry) > before:
+                if getattr(prog, "_mx_from_disk_cache", False):
+                    disk_hits += 1
+                else:
+                    compiled += 1
+            # force the XLA compile (or executable load) NOW: a
+            # jit-backed program otherwise compiles lazily on the first
+            # real request — exactly the cliff prewarm exists to remove
+            inputs = []
+            for spec in entry.signature:
+                shape = [1 if d is None else d for d in spec["shape"]]
+                if entry.dynamic_batch and shape:
+                    shape[0] = rows
+                inputs.append(np.zeros(tuple(shape),
+                                       _resolve_dtype(spec["dtype"])))
+            try:
+                outs = prog(*inputs)
+                engine.sync_outputs(
+                    outs if isinstance(outs, (tuple, list)) else (outs,),
+                    site="serving.prewarm")
+            except Exception as e:
+                raise MXNetError(
+                    f"prewarm({name!r}:{entry.version}): bucket {rows} "
+                    f"failed: {e}") from e
+        return {"model": name, "version": entry.version,
+                "buckets": buckets,
+                "compiled": compiled, "disk_hits": disk_hits}
 
     def swap(self, name, version):
         """Atomically repoint ``name`` to ``version``; returns the

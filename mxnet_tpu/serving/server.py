@@ -258,6 +258,26 @@ class ModelServer:
             raise req.error
         return req.result if len(req.result) > 1 else req.result[0]
 
+    # -------------------------------------------------------------- prewarm
+    def prewarm(self, model, version=None):
+        """Compile/load ALL shape buckets of (model, version) through
+        this server's program cache before they can meet traffic — the
+        zero-cold-start half of the hot-swap contract
+        (docs/serving.md §5)::
+
+            repo.load_artifact("m", path, activate=False)   # stage
+            srv.prewarm("m", version=2)                     # warm
+            repo.swap("m", 2)                               # cutover
+
+        After a prewarmed swap no request ever waits on an XLA compile:
+        every bucket's program is already in the batcher's memory cache
+        (deserialized from the persistent compile cache when
+        ``MXNET_COMPILE_CACHE_DIR`` is set, freshly compiled otherwise).
+        Returns the repository's summary dict."""
+        return self.repository.prewarm(
+            model, version, batcher=self.batcher,
+            max_batch_size=self.config.max_batch_size)
+
     # ---------------------------------------------------------------- stats
     def stats(self):
         """Plain-dict serving counters (always on, independent of the
@@ -267,6 +287,7 @@ class ModelServer:
             out["queue_depth"] = self._depth
             out["inflight"] = self._inflight
         out["bucket_hits"] = self.batcher.bucket_hits
+        out["bucket_disk_hits"] = self.batcher.bucket_disk_hits
         out["bucket_misses"] = self.batcher.bucket_misses
         out["programs"] = self.batcher.programs()
         return out

@@ -327,9 +327,19 @@ class TestDynamicBatching:
         assert stats["batches"] >= 1
         # O(log N) compiled programs: buckets are {1,2,4,8} at most
         assert stats["programs"] <= 4
+        # the documented invariant under the mem_hit|disk_hit|miss
+        # split: misses == freshly compiled programs, and in-memory
+        # programs == misses + disk hits (no disk cache here, so the
+        # disk_hit series stays zero)
         assert stats["bucket_misses"] == stats["programs"]
+        assert stats["programs"] == \
+            stats["bucket_misses"] + stats["bucket_disk_hits"]
+        assert stats["bucket_disk_hits"] == 0
+        assert rm.SERVING_BUCKET_CACHE.value(event="disk_hit") == 0
         assert stats["bucket_hits"] == \
-            rm.SERVING_BUCKET_CACHE.value(event="hit")
+            rm.SERVING_BUCKET_CACHE.value(event="mem_hit")
+        assert stats["bucket_misses"] == \
+            rm.SERVING_BUCKET_CACHE.value(event="miss")
         assert stats["bucket_hits"] + stats["bucket_misses"] == \
             stats["batches"]
         assert stats["queue_depth"] == 0
@@ -693,6 +703,271 @@ class TestHotSwap:
                 t.join(60)
         assert not errors, errors[:3]
         assert seen_v2.is_set()             # swap became visible
+
+
+class _CountingModel:
+    """Function entry that counts executions — the fake-compile
+    fixture: make_program constructions show up as bucket misses, and
+    prewarm's forced first call shows up as an execution, with no real
+    XLA compile anywhere."""
+
+    def __init__(self):
+        self.calls = 0
+        self.lock = threading.Lock()
+
+    def __call__(self, x):
+        with self.lock:
+            self.calls += 1
+        return x * 2.0
+
+
+class TestPrewarm:
+    SIG = [{"shape": [None, 2], "dtype": "float32"}]
+
+    def test_prewarm_builds_and_executes_every_bucket(self):
+        """Cold start: prewarm() must construct AND run one program per
+        shape bucket, so no later request ever meets a first
+        (compiling) call."""
+        repo = ModelRepository()
+        model = _CountingModel()
+        repo.add_function("m", model, self.SIG)
+        with ModelServer(repo, _cfg(max_batch_size=8)) as srv:
+            out = srv.prewarm("m")
+            assert out["buckets"] == [1, 2, 4, 8]
+            assert out["compiled"] == 4 and out["disk_hits"] == 0
+            entry = repo.get("m")
+            assert srv.batcher.programs(entry) == 4
+            assert model.calls == 4             # each program forced once
+            misses = srv.batcher.bucket_misses
+            got = srv.predict("m", np.ones((3, 2), np.float32),
+                              timeout=60)
+            np.testing.assert_allclose(got, np.full((3, 2), 2.0))
+            # the request path saw only mem hits
+            assert srv.batcher.bucket_misses == misses
+
+    def test_prewarm_non_pow2_cap_and_static_entry(self):
+        repo = ModelRepository()
+        repo.add_function("dyn", _CountingModel(), self.SIG)
+        repo.add_function("static", _CountingModel(),
+                          [{"shape": [4, 2], "dtype": "float32"}],
+                          dynamic_batch=False)
+        with ModelServer(repo, _cfg(max_batch_size=6)) as srv:
+            assert srv.prewarm("dyn")["buckets"] == [1, 2, 4, 6]
+            # static artifacts have exactly one bucket: the exported batch
+            assert srv.prewarm("static")["buckets"] == [4]
+
+    def test_prewarm_staged_version_then_swap_serves_without_compile(
+            self):
+        """The zero-compile hot-swap loop: stage v2, prewarm it, swap —
+        post-swap traffic must never construct a program."""
+        repo = ModelRepository()
+        m1, m2 = _CountingModel(), _CountingModel()
+        repo.add_function("m", m1, self.SIG, version=1)
+        repo.add_function("m", m2, self.SIG, version=2, activate=False)
+        with ModelServer(repo, _cfg(max_batch_size=4)) as srv:
+            srv.predict("m", np.ones((1, 2), np.float32), timeout=60)
+            assert srv.prewarm("m", version=2)["buckets"] == [1, 2, 4]
+            misses = srv.batcher.bucket_misses      # 1 (v1) + 3 (v2)
+            assert repo.swap("m", 2) == 1
+            for n in (1, 2, 3, 4):
+                srv.predict("m", np.ones((n, 2), np.float32),
+                            timeout=60)
+            # no compile on the request path after the swap
+            assert srv.batcher.bucket_misses == misses
+            assert m2.calls == 3 + 4            # prewarm + 4 requests
+
+    def test_prewarm_swap_under_concurrent_load(self):
+        """Swap to a prewarmed staged version while callers hammer the
+        model: every response is valid and no post-swap request
+        constructs a program."""
+        repo = ModelRepository()
+        repo.add_function("m", lambda x: x * 2.0, self.SIG, version=1)
+        repo.add_function("m", lambda x: x * 3.0, self.SIG, version=2,
+                          activate=False)
+        errors = []
+        stop = threading.Event()
+
+        with ModelServer(repo, _cfg(max_batch_size=4,
+                                    max_latency_us=500)) as srv:
+            def caller():
+                x = np.ones((1, 2), np.float32)
+                while not stop.is_set():
+                    try:
+                        got = srv.predict("m", x, timeout=60)
+                    except Exception as e:      # noqa: BLE001
+                        errors.append(e)
+                        return
+                    if not (np.allclose(got, 2.0)
+                            or np.allclose(got, 3.0)):
+                        errors.append(AssertionError(repr(got)))
+                        return
+            threads = [threading.Thread(target=caller)
+                       for _ in range(4)]
+            for t in threads:
+                t.start()
+            try:
+                srv.prewarm("m", version=2)
+                v2 = repo._resolve("m", 2)
+                progs_at_swap = srv.batcher.programs(v2)
+                repo.swap("m", 2)
+                # post-swap traffic runs on the prewarmed programs
+                deadline = time.monotonic() + 30
+                while not np.allclose(
+                        srv.predict("m", np.ones((1, 2), np.float32),
+                                    timeout=60), 3.0):
+                    assert time.monotonic() < deadline
+            finally:
+                stop.set()
+                for t in threads:
+                    t.join(60)
+            assert not errors, errors[:3]
+            # every v2 bucket predates the swap (prewarm built them all)
+            # — post-swap traffic never constructed a v2 program, i.e.
+            # the hot-swap served zero compiles on the request path
+            assert progs_at_swap == 3
+            assert srv.batcher.programs(v2) == 3
+            misses_settled = srv.batcher.bucket_misses
+            for n in (1, 2, 3, 4):
+                srv.predict("m", np.ones((n, 2), np.float32),
+                            timeout=60)
+            assert srv.batcher.bucket_misses == misses_settled
+
+    def test_prewarm_summary_ignores_concurrent_other_entry_builds(
+            self):
+        """prewarm()'s compiled/disk_hits are per-entry: builds for
+        OTHER models racing the prewarm (the swap-under-load flow) must
+        not be misattributed."""
+        repo = ModelRepository()
+        repo.add_function("other", lambda x: x, self.SIG)
+        other = repo.get("other")
+        target = _CountingModel()
+        repo.add_function("m", target, self.SIG)
+        entry = repo.get("m")
+        batcher = serving.DynamicBatcher(_cfg(max_batch_size=4))
+        real = entry.make_program
+        side = {"bucket": 16}
+
+        def make_program_with_traffic(rows):
+            # deterministic stand-in for concurrent traffic: every
+            # build of "m" also builds a fresh bucket of "other"
+            side["bucket"] += 1
+            batcher.program_for(other, side["bucket"])
+            return real(rows)
+        entry.make_program = make_program_with_traffic
+        out = repo.prewarm("m", batcher=batcher)
+        assert out["buckets"] == [1, 2, 4]
+        assert out["compiled"] == 3 and out["disk_hits"] == 0
+        # the global counter did move for both entries
+        assert batcher.bucket_misses == 6
+
+    def test_prewarm_staged_needs_explicit_version(self):
+        repo = ModelRepository()
+        repo.add_function("m", _CountingModel(), self.SIG,
+                          activate=False)
+        with ModelServer(repo, _cfg()) as srv:
+            with pytest.raises(MXNetError, match="no active version"):
+                srv.prewarm("m")
+            srv.prewarm("m", version=1)
+
+    def test_prewarm_unknown_model_and_version(self):
+        repo = ModelRepository()
+        repo.add_function("m", _CountingModel(), self.SIG)
+        with ModelServer(repo, _cfg()) as srv:
+            with pytest.raises(MXNetError, match="no model"):
+                srv.prewarm("ghost")
+            with pytest.raises(MXNetError, match="no version"):
+                srv.prewarm("m", version=9)
+
+    def test_program_build_runs_outside_the_batcher_lock(self):
+        """An XLA compile can take seconds; it must not stall other
+        keys' mem-hit lookups, and concurrent lookups of the SAME key
+        must build once (misses stay == compiled programs)."""
+        repo = ModelRepository()
+        repo.add_function("slow", lambda x: x, self.SIG)
+        repo.add_function("fast", lambda x: x + 1.0, self.SIG)
+        slow, fast = repo.get("slow"), repo.get("fast")
+        batcher = serving.DynamicBatcher(_cfg(max_batch_size=4))
+        batcher.program_for(fast, 1)            # warm the fast key
+        in_build = threading.Event()
+        release = threading.Event()
+        builds = []
+        real = slow.make_program
+
+        def blocking_make_program(rows):
+            builds.append(rows)
+            in_build.set()
+            assert release.wait(30)
+            return real(rows)
+        slow.make_program = blocking_make_program
+        results = []
+        builders = [threading.Thread(
+            target=lambda: results.append(batcher.program_for(slow, 1)))
+            for _ in range(3)]
+        for t in builders:
+            t.start()
+        assert in_build.wait(30)                # a build is in flight
+        # ... and a DIFFERENT key's mem hit does not block behind it
+        t0 = time.monotonic()
+        assert batcher.program_for(fast, 1) is not None
+        assert time.monotonic() - t0 < 5
+        release.set()
+        for t in builders:
+            t.join(30)
+        # same key built exactly once; the other callers waited for it
+        assert builds == [1]
+        assert len(results) == 3
+        assert all(r is results[0] for r in results)
+        assert batcher.programs(slow) == 1
+
+    def test_failed_build_wakes_waiters_and_retries(self):
+        repo = ModelRepository()
+        repo.add_function("m", lambda x: x, self.SIG)
+        entry = repo.get("m")
+        real = entry.make_program
+        state = {"calls": 0}
+
+        def flaky(rows):
+            state["calls"] += 1
+            if state["calls"] == 1:
+                raise RuntimeError("transient compile failure")
+            return real(rows)
+        entry.make_program = flaky
+        batcher = serving.DynamicBatcher(_cfg(max_batch_size=4))
+        with pytest.raises(RuntimeError, match="transient"):
+            batcher.program_for(entry, 1)
+        # the in-flight marker was cleared: the next lookup rebuilds
+        assert batcher.program_for(entry, 1) is not None
+        assert state["calls"] == 2
+
+    def test_disk_loaded_programs_counted_as_disk_hits(self):
+        """A program whose make_program marks _mx_from_disk_cache (the
+        compile-cache deserialization path) must count as disk_hit, not
+        miss — misses stay == compiled programs."""
+        repo = ModelRepository()
+        repo.add_function("m", lambda x: x + 1.0, self.SIG)
+        entry = repo.get("m")
+        real = entry.make_program
+
+        def disk_make_program(rows):
+            prog = real(rows)
+            prog._mx_from_disk_cache = True
+            return prog
+        entry.make_program = disk_make_program
+        with ModelServer(repo, _cfg(max_batch_size=4)) as srv:
+            out = srv.prewarm("m")
+            assert out == {"model": "m", "version": 1,
+                           "buckets": [1, 2, 4], "compiled": 0,
+                           "disk_hits": 3}
+            stats = srv.stats()
+            assert stats["bucket_disk_hits"] == 3
+            assert stats["bucket_misses"] == 0
+            assert stats["programs"] == \
+                stats["bucket_misses"] + stats["bucket_disk_hits"]
+            assert rm.SERVING_BUCKET_CACHE.value(event="disk_hit") == 3
+            assert rm.SERVING_BUCKET_CACHE.value(event="miss") == 0
+            # second lookup of a disk-loaded program is a plain mem hit
+            srv.predict("m", np.ones((1, 2), np.float32), timeout=60)
+            assert rm.SERVING_BUCKET_CACHE.value(event="mem_hit") >= 1
 
 
 class TestConfig:

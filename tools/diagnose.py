@@ -4,8 +4,10 @@ info for bug reports).
 
 Prints: platform + Python, jax/jaxlib/numpy versions, the JAX backend
 and device list, every ``MXNET_*`` env knob (registry defaults plus
-anything set in the environment), native-library availability, and a
-runtime-metrics snapshot.  With ``--metrics-smoke`` it also enables the
+anything set in the environment), native-library availability, the
+persistent compile-cache state (dir, entry count, bytes, hit ratio —
+so a mis-set MXNET_COMPILE_CACHE_DIR is diagnosable in one command),
+and a runtime-metrics snapshot.  With ``--metrics-smoke`` it also enables the
 metrics registry, dispatches one op, and verifies the pipeline end to
 end (used as a CI smoke step by ci/runtime_functions.sh).
 
@@ -65,6 +67,24 @@ def diagnose(metrics_smoke=False):
                    and k not in mx.base.list_env_vars())
     for k in extra:
         print(f"{k}={os.environ[k]}  (set, unregistered)")
+
+    _section("Compile Cache")
+    from mxnet_tpu import compile_cache
+    st = compile_cache.get_default().stats()
+    if not st["enabled"]:
+        print("dir          : (disabled — set MXNET_COMPILE_CACHE_DIR "
+              "for zero-cold-start serving; docs/serving.md §5)")
+    else:
+        total = st["hits"] + st["misses"]
+        ratio = f"{st['hits'] / total:.2f}" if total else "n/a"
+        print(f"dir          : {st['dir']}")
+        print(f"entries      : {st['entries']}")
+        print(f"bytes        : {st['bytes']} "
+              f"(bound {st['max_bytes'] or 'unbounded'})")
+        print(f"hit ratio    : {ratio}  (this process: {st['hits']} hit / "
+              f"{st['misses']} miss / {st['corrupt']} corrupt / "
+              f"{st['evictions']} evicted)")
+        print(f"topology key : {compile_cache.topology_fingerprint()}")
 
     _section("Concurrency Sanitizer")
     from mxnet_tpu import engine
