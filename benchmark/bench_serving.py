@@ -25,10 +25,20 @@ process, restart against the same cache — and asserts the warm restart
 compiles ZERO new XLA programs (miss counter stays 0) while reporting
 cold-start-to-first-response before/after.
 
+``--decode`` (ISSUE-7) drives the autoregressive decode engine
+(docs/serving.md §6) under Poisson arrivals of mixed-length requests
+and reports tokens/sec, p50/p99 time-to-first-token, p50/p99 per-token
+latency, and KV-pool occupancy; with ``--smoke`` it also asserts the
+acceptance criteria — continuous batching demonstrably interleaves (a
+short request admitted mid-flight finishes before a long one admitted
+earlier) and total compiled programs stay <= prefill buckets + 1
+across the mixed-length run.
+
 Env knobs: BENCH_SERVING_REQUESTS (default 48), BENCH_SERVING_THREADS
 (16), BENCH_SERVING_MAX_BATCH (8), BENCH_SERVING_LATENCY_US (2000),
 BENCH_SERVING_CACHE_DIR (persistent compile-cache dir; unset = cache
-off for the main run — the roundtrip manages its own).
+off for the main run — the roundtrip manages its own),
+BENCH_DECODE_REQUESTS (20), BENCH_DECODE_RATE (arrivals/sec, 25).
 """
 import argparse
 import json
@@ -248,6 +258,136 @@ def run(requests, threads, max_batch, latency_us, workdir, smoke,
     return result
 
 
+def run_decode(args):
+    """ISSUE-7 decode tier: Poisson arrivals of mixed-length generate()
+    requests through the continuous-batching engine; one BENCH JSON
+    line with tokens/sec, TTFT/per-token percentiles, and KV-pool
+    occupancy."""
+    mx.random.seed(7)
+    rm.enable()
+    from mxnet_tpu.models.transformer_blocks import TransformerDecoderLM
+    lm = TransformerDecoderLM(32, units=16, hidden_size=32, num_layers=2,
+                              num_heads=2, max_length=32)
+    lm.initialize(mx.init.Xavier())
+    repo = serving.ModelRepository()
+    repo.add_decoder("lm", lm)
+    cfg = serving.ServingConfig(
+        decode_page_size=4, decode_pool_pages=65, decode_max_batch=4,
+        decode_max_new_tokens=16)
+    srv = serving.ModelServer(repo, cfg)
+
+    n_req = args.decode_requests
+    rate = args.decode_rate
+    # deterministic mixed-length plan: request 0 is LONG; later shorts
+    # must overtake it (the continuous-batching interleave criterion)
+    plan = []
+    for i in range(n_req):
+        prompt = list(range(1, 2 + i % 6))          # lens 1..6
+        max_new = 12 if i == 0 else 2 + i % 4
+        plan.append((prompt, max_new))
+
+    # warm the program families outside the timed window: prefill
+    # buckets for lens 1..6 ({1, 2, 4, 8}) + the one decode program
+    # (max_new_tokens=2 so at least one decode step actually runs —
+    # a 1-token request finishes at prefill)
+    for L in (1, 2, 3, 5):
+        srv.generate("lm", list(range(1, L + 1)), max_new_tokens=2,
+                     timeout=600)
+    warm_programs = srv.decode_stats("lm")["programs"]
+    rm.reset()
+
+    records = [{"submit": None, "tokens": [], "done": None}
+               for _ in range(n_req)]
+    errors = []
+
+    def worker(i):
+        rec = records[i]
+        prompt, max_new = plan[i]
+        rec["submit"] = time.perf_counter()
+        try:
+            out = srv.generate(
+                "lm", prompt, max_new_tokens=max_new,
+                on_token=lambda t: rec["tokens"].append(
+                    time.perf_counter()),
+                timeout=600)
+            rec["done"] = time.perf_counter()
+            rec["n"] = len(out)
+        except Exception as e:          # noqa: BLE001
+            errors.append(e)
+
+    rng = np.random.RandomState(0)
+    pool = [threading.Thread(target=worker, args=(i,))
+            for i in range(n_req)]
+    t0 = time.perf_counter()
+    # the long request goes first; the rest arrive Poisson once it is
+    # demonstrably mid-flight (first token streamed), so the interleave
+    # criterion is deterministic, not a race against a fast tiny model
+    pool[0].start()
+    deadline = time.monotonic() + 120
+    while not records[0]["tokens"] and time.monotonic() < deadline:
+        time.sleep(0.001)
+    for i, t in enumerate(pool[1:], start=1):
+        t.start()
+        if i + 1 < n_req:
+            time.sleep(float(rng.exponential(1.0 / rate)))
+    for t in pool:
+        t.join(600)
+    wall = time.perf_counter() - t0
+
+    assert not errors, errors[:3]
+    total_tokens = sum(r["n"] for r in records)
+    ttft_ms = [1e3 * (r["tokens"][0] - r["submit"]) for r in records]
+    gaps_ms = [1e3 * (b - a) for r in records
+               for a, b in zip(r["tokens"], r["tokens"][1:])]
+    stats = srv.decode_stats("lm")
+    srv.stop()
+
+    pct = lambda xs, q: float(np.percentile(xs, q)) if xs \
+        else float("nan")                           # noqa: E731
+    result = {
+        "metric": "serving.decode.throughput",
+        "value": round(total_tokens / wall, 2),
+        "unit": "tokens/s",
+        "requests": n_req,
+        "generated_tokens": total_tokens,
+        "ttft_p50_ms": round(pct(ttft_ms, 50), 3),
+        "ttft_p99_ms": round(pct(ttft_ms, 99), 3),
+        "token_p50_ms": round(pct(gaps_ms, 50), 3),
+        "token_p99_ms": round(pct(gaps_ms, 99), 3),
+        "decode_steps": stats["steps"],
+        "peak_running": stats["peak_running"],
+        "kv_pool_peak_occupancy": round(
+            stats["peak_used_pages"]
+            / max(1, cfg.decode_pool_pages - 1), 4),
+        "kv_pool_pages": cfg.decode_pool_pages,
+        "page_size": cfg.decode_page_size,
+        "decode_max_batch": cfg.decode_max_batch,
+        "programs": stats["programs"],
+        "program_bound": stats["program_bound"],
+        "arrival_rate_per_s": rate,
+        "errors": len(errors),
+    }
+    if args.smoke:
+        assert n_req >= 20, f"decode smoke wants >= 20 requests, {n_req}"
+        # O(log) program families: <= prefill buckets + 1 decode, and
+        # the timed run compiled NOTHING new after warm-up
+        assert stats["programs"] <= stats["program_bound"], stats
+        assert stats["programs"] == warm_programs, \
+            (stats["programs"], warm_programs)
+        # continuous batching interleaves: at least one short request
+        # submitted AFTER the long request 0 finished BEFORE it
+        long_rec = records[0]
+        overtook = [i for i in range(1, n_req)
+                    if records[i]["submit"] > long_rec["submit"]
+                    and records[i]["done"] < long_rec["done"]]
+        assert overtook, "no short request overtook the long one"
+        assert stats["peak_running"] >= 2, stats
+        assert np.isfinite(result["ttft_p99_ms"])
+        assert rm.SERVING_DECODE_TTFT_SECONDS.count(model="lm") == n_req
+        assert "serving_decode_tokens" in rm.dump_prometheus()
+    return result
+
+
 def cache_roundtrip(args):
     """ISSUE-6 CI criterion: serve -> kill the process -> restart on
     the same cache dir -> the warm restart compiles ZERO new XLA
@@ -305,6 +445,17 @@ def main():
     ap.add_argument("--cache-roundtrip", action="store_true",
                     help="CI tier: start -> kill -> restart on one "
                          "compile-cache dir; assert zero recompiles")
+    ap.add_argument("--decode", action="store_true",
+                    help="autoregressive decode tier: Poisson arrivals "
+                         "through the continuous-batching engine; "
+                         "tokens/sec + TTFT/per-token percentiles "
+                         "(--smoke asserts the ISSUE-7 criteria)")
+    ap.add_argument("--decode-requests", type=int,
+                    default=int(os.environ.get(
+                        "BENCH_DECODE_REQUESTS", 20)))
+    ap.add_argument("--decode-rate", type=float,
+                    default=float(os.environ.get(
+                        "BENCH_DECODE_RATE", 25)))
     ap.add_argument("--roundtrip-child", action="store_true",
                     help=argparse.SUPPRESS)       # internal
     ap.add_argument("--cache-dir",
@@ -328,6 +479,12 @@ def main():
 
     if args.cache_roundtrip:
         cache_roundtrip(args)
+        return
+
+    if args.decode:
+        print(json.dumps(run_decode(args)))
+        if args.smoke:
+            print("serving decode smoke ok", file=sys.stderr)
         return
 
     def _run(workdir):

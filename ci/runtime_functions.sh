@@ -115,6 +115,17 @@ serving_smoke() {
     # the warm restart must compile ZERO new XLA programs (asserted via
     # the compile-cache miss counter; every bucket deserializes)
     python benchmark/bench_serving.py --cache-roundtrip
+    # decode tier (ISSUE-7 acceptance): end-to-end generate round trip
+    # (prefill -> N decode steps -> eviction) under Poisson arrivals —
+    # asserts continuous batching interleaves (a short request admitted
+    # mid-flight beats a long one admitted earlier) and that compiled
+    # programs stay <= prefill buckets + 1 across a 20-request
+    # mixed-length run
+    python benchmark/bench_serving.py --decode --smoke
+    # the decode scheduler + paged-attention kernel tests double as
+    # race tests under the concurrency sanitizer
+    MXNET_ENGINE_SANITIZE=1 python -m pytest tests/test_serving_decode.py \
+        tests/test_pallas_paged.py -x -q
 }
 
 bench_cpu() {

@@ -252,6 +252,26 @@ declare_env("MXNET_SERVING_WORKERS", 1,
 declare_env("MXNET_SERVING_RETRY_AFTER_MS", 50,
             "Serving: retry-after hint (milliseconds) attached to "
             "ServerOverloadedError when a request is shed.")
+declare_env("MXNET_SERVING_DECODE_PAGE_SIZE", 16,
+            "Decode engine: tokens per KV-cache page "
+            "(mxnet_tpu.serving.kv_cache). Smaller pages waste less "
+            "HBM on short sequences but deepen the per-sequence block "
+            "table; the ragged-paged-attention kernel reads one page "
+            "per grid step.")
+declare_env("MXNET_SERVING_DECODE_POOL_PAGES", 64,
+            "Decode engine: TOTAL pages preallocated in the device KV "
+            "pool, including the reserved null page 0 (usable pages = "
+            "pool - 1). Pool bytes = 2 * layers * pages * page_size * "
+            "heads * head_dim * dtype_size.")
+declare_env("MXNET_SERVING_DECODE_MAX_BATCH", 4,
+            "Decode engine: sequence slots in the fixed-shape decode "
+            "step (token-level continuous batching admits/evicts into "
+            "these slots every step). ONE decode program compiles for "
+            "this batch size regardless of traffic mix.")
+declare_env("MXNET_SERVING_DECODE_MAX_NEW_TOKENS", 32,
+            "Decode engine: default cap on generated tokens per "
+            "request (generate(max_new_tokens=...) overrides, bounded "
+            "by the model's max_context).")
 declare_env("MXNET_COMPILE_CACHE_DIR", None,
             "Persistent AOT compiled-executable cache directory "
             "(mxnet_tpu.compile_cache): serving bucket programs are "

@@ -71,7 +71,8 @@ def _aot_buckets(precompile, dynamic_batch, fixed_batch):
 
 
 def export_stablehlo(block, *example_inputs, path, emit_text=False,
-                     dynamic_batch=False, version=None, precompile=()):
+                     dynamic_batch=False, version=None, precompile=(),
+                     decode=None):
     """Export ``block``'s inference forward as a StableHLO artifact.
 
     Writes ``path.shlo`` (serialized module, weights embedded as
@@ -98,6 +99,18 @@ def export_stablehlo(block, *example_inputs, path, emit_text=False,
     compiled programs.  The manifest records the dynamic dimension as
     ``null``.  ``version`` tags the manifest for
     ``serving.ModelRepository`` hot-swap bookkeeping.
+
+    ``decode`` ships decode-capable metadata in the manifest (v3
+    ``decode`` field): a dict of the dimensions an autoregressive
+    runtime needs to size a paged KV cache and drive the step loop —
+    ``vocab_size``, ``num_layers``, ``num_heads``, ``head_dim``,
+    ``max_context``, optional ``eos_id``
+    (``TransformerDecoderLM.decode_meta()`` produces it).  The exported
+    program itself stays the one-shot forward; the metadata is the
+    contract for external decode runtimes and for
+    ``serving.ModelRepository`` (which surfaces it as
+    ``entry.decode_meta``; in-process generation registers the block
+    via ``add_decoder``).
 
     The artifact is self-contained: load it with
     ``jax.export.deserialize(open(...).read()).call(*arrays)`` — no
@@ -147,6 +160,8 @@ def export_stablehlo(block, *example_inputs, path, emit_text=False,
                     for a in exported.out_avals],
         "block": type(block).__name__,
     }
+    if decode is not None:
+        manifest["decode"] = dict(decode)
     aot_blobs = []
     if precompile:
         from . import compile_cache as _cc
@@ -345,6 +360,27 @@ def validate_manifest(manifest, where="manifest"):
                 raise MXNetError(
                     f"{where}: precompiled entry {i} file {f!r} must "
                     f"be a relative path inside the artifact directory")
+    dec = manifest.get("decode")
+    if dec is not None:
+        # v3: decode-capable metadata — the paged-KV sizing contract for
+        # autoregressive runtimes; a malformed block must fail at
+        # export/load, not when a runtime divides by head_dim
+        if not isinstance(dec, dict):
+            raise MXNetError(f"{where}: manifest 'decode' must be a "
+                             f"dict of model dimensions")
+        for field in ("vocab_size", "num_layers", "num_heads",
+                      "head_dim", "max_context"):
+            v = dec.get(field)
+            if not isinstance(v, int) or v < 1:
+                raise MXNetError(
+                    f"{where}: decode metadata field {field!r} must be "
+                    f"a positive int, got {v!r}")
+        eos = dec.get("eos_id")
+        if eos is not None and (not isinstance(eos, int) or eos < 0
+                                or eos >= dec["vocab_size"]):
+            raise MXNetError(
+                f"{where}: decode metadata eos_id {eos!r} outside "
+                f"[0, vocab_size={dec['vocab_size']})")
     if bool(manifest.get("dynamic_batch")):
         for i, spec in enumerate(manifest["inputs"]):
             if not spec["shape"] or spec["shape"][0] is not None:

@@ -14,10 +14,11 @@ from .bert import (BERTEncoder, BERTModel, BERTForPretrain,
 from .transformer import (Transformer, TransformerEncoder,
                           TransformerDecoder, transformer_base,
                           transformer_big, SmoothedSoftmaxCELoss)
+from .transformer_blocks import TransformerDecoderLM
 
 __all__ = ["BERTEncoder", "BERTModel", "BERTForPretrain",
            "BERTPretrainLoss", "BERTForQA",
            "BERTClassifier", "bert_12_768_12", "bert_24_1024_16",
            "get_bert_model", "Transformer", "TransformerEncoder",
            "TransformerDecoder", "transformer_base", "transformer_big",
-           "SmoothedSoftmaxCELoss"]
+           "SmoothedSoftmaxCELoss", "TransformerDecoderLM"]

@@ -16,23 +16,13 @@ from .. import ndarray as nd
 from ..gluon import nn
 from ..gluon.block import HybridBlock
 from .transformer_blocks import TransformerEncoderCell, \
-    TransformerDecoderCell
+    TransformerDecoderCell, _sinusoid_table
 
 __all__ = ["TransformerEncoder", "TransformerDecoder", "Transformer",
            "transformer_big", "transformer_base",
            "SmoothedSoftmaxCELoss"]
 
 NEG_INF = -1e9
-
-
-def _sinusoid_table(max_len, units):
-    pos = np.arange(max_len)[:, None]
-    dim = np.arange(units)[None, :]
-    angle = pos / np.power(10000, (2 * (dim // 2)) / units)
-    table = np.zeros((max_len, units), dtype=np.float32)
-    table[:, 0::2] = np.sin(angle[:, 0::2])
-    table[:, 1::2] = np.cos(angle[:, 1::2])
-    return table
 
 
 class TransformerEncoder(HybridBlock):

@@ -12,13 +12,16 @@ from __future__ import annotations
 
 import math
 
+import numpy as np
+
 from ..base import MXNetError
 from ..gluon import nn
 from ..gluon.block import HybridBlock
 
 __all__ = ["PositionwiseFFN", "MultiHeadSelfAttention",
            "MultiHeadAttention", "TransformerEncoderCell",
-           "TransformerDecoderCell"]
+           "TransformerDecoderCell", "TransformerDecoderLM",
+           "paged_lm_params", "paged_prefill", "paged_decode_step"]
 
 
 class PositionwiseFFN(HybridBlock):
@@ -235,3 +238,259 @@ class TransformerDecoderCell(HybridBlock):
         c = self.cross_attention(h, mem, mem_mask)
         c = self.cross_norm(h + self.dropout_layer(c))
         return self.ffn(c)
+
+
+# ---------------------------------------------------------------------------
+# decoder-only LM + paged decode-mode forward (serving decode engine)
+# ---------------------------------------------------------------------------
+def _sinusoid_table(max_len, units):
+    """Shared sinusoidal position table (also consumed by
+    models/transformer.py — ONE copy of the formula)."""
+    pos = np.arange(max_len)[:, None]
+    dim = np.arange(units)[None, :]
+    angle = pos / np.power(10000, (2 * (dim // 2)) / units)
+    table = np.zeros((max_len, units), dtype=np.float32)
+    table[:, 0::2] = np.sin(angle[:, 0::2])
+    table[:, 1::2] = np.cos(angle[:, 1::2])
+    return table
+
+
+NEG_INF = -1e9
+
+
+class TransformerDecoderLM(HybridBlock):
+    """Decoder-only causal LM (GPT layout): embedding + sinusoid
+    positions, pre-norm self-attention cells, final LayerNorm, vocab
+    projection.
+
+    Two forwards share the SAME parameters:
+
+    - the hybridizable training/teacher-forcing forward here —
+      ``lm(tokens (B, L)) -> logits (B, L, V)`` with an additive causal
+      mask on the dense attention path;
+    - the serving *decode-mode* forward — the pure-jax
+      :func:`paged_prefill` / :func:`paged_decode_step` pair below,
+      which threads K/V through the paged cache pool
+      (``serving.kv_cache``) instead of rematerializing the whole
+      prefix each step.  ``paged_lm_params(lm)`` snapshots the
+      parameter arrays into the dict those functions consume.
+    """
+
+    def __init__(self, vocab_size, units=64, hidden_size=128,
+                 num_layers=2, num_heads=2, max_length=128, dropout=0.0,
+                 activation="relu", layer_norm_eps=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        if units % num_heads:
+            raise MXNetError(f"units {units} not divisible by heads "
+                             f"{num_heads}")
+        self.vocab_size = int(vocab_size)
+        self.units = int(units)
+        self.num_heads = int(num_heads)
+        self.num_layers = int(num_layers)
+        self.head_dim = self.units // self.num_heads
+        self.max_context = int(max_length)
+        self._activation = activation
+        self._eps = layer_norm_eps
+        with self.name_scope():
+            self.embed = nn.Embedding(vocab_size, units)
+            self.pos_embed = self.params.get_constant(
+                "pos_embed", _sinusoid_table(max_length, units))
+            self.dropout_layer = nn.Dropout(dropout)
+            self.cells = nn.HybridSequential()
+            for _ in range(num_layers):
+                self.cells.add(TransformerEncoderCell(
+                    units, hidden_size, num_heads, dropout,
+                    activation=activation, layer_norm_eps=layer_norm_eps,
+                    pre_norm=True))
+            self.final_norm = nn.LayerNorm(in_channels=units,
+                                           epsilon=layer_norm_eps)
+            self.proj = nn.Dense(vocab_size, in_units=units,
+                                 flatten=False)
+
+    def hybrid_forward(self, F, tokens, pos_embed=None):
+        # tokens: (B, L) int ids -> logits (B, L, V)
+        from .. import ndarray as nd
+        B, L = tokens.shape
+        x = self.embed(tokens) * math.sqrt(self.units)      # (B, L, C)
+        x = F.transpose(x, axes=(1, 0, 2))                  # (L, B, C)
+        x = x + pos_embed.slice_axis(axis=0, begin=0,
+                                     end=L).expand_dims(1)
+        x = self.dropout_layer(x)
+        steps = nd.arange(L)
+        ok = F.broadcast_lesser_equal(steps.reshape((1, L)),
+                                      steps.reshape((L, 1)))
+        mask = (1.0 - ok) * NEG_INF                         # (L, L) causal
+        for cell in self.cells:
+            x = cell(x, mask)
+        x = self.final_norm(x)
+        logits = self.proj(x)                               # (L, B, V)
+        return F.transpose(logits, axes=(1, 0, 2))
+
+    def decode_meta(self, eos_id=None):
+        """The decode-capable metadata block a serving/deploy manifest
+        carries (``deploy.export_stablehlo(decode=...)``): everything an
+        external runtime needs to size the paged KV cache and drive the
+        step loop."""
+        meta = {"vocab_size": self.vocab_size,
+                "num_layers": self.num_layers,
+                "num_heads": self.num_heads,
+                "head_dim": self.head_dim,
+                "max_context": self.max_context}
+        if eos_id is not None:
+            meta["eos_id"] = int(eos_id)
+        return meta
+
+
+def paged_lm_params(lm):
+    """Snapshot a :class:`TransformerDecoderLM`'s parameters into the
+    flat jnp dict :func:`paged_prefill` / :func:`paged_decode_step`
+    consume.  Arrays are snapshots: later training does not mutate a
+    served copy (re-snapshot to publish new weights), and weights enter
+    compiled programs as INPUTS, so a refresh never retraces."""
+    import jax.numpy as jnp
+
+    def g(p):
+        return p.data()._data.astype(jnp.float32)
+
+    cells = []
+    for cell in lm.cells:
+        att, ffn = cell.attention, cell.ffn
+        cells.append(dict(
+            n1_g=g(cell.attn_norm.gamma), n1_b=g(cell.attn_norm.beta),
+            qkv_w=g(att.qkv.weight), qkv_b=g(att.qkv.bias),
+            o_w=g(att.out_proj.weight), o_b=g(att.out_proj.bias),
+            n2_g=g(ffn.layer_norm.gamma), n2_b=g(ffn.layer_norm.beta),
+            f1_w=g(ffn.ffn_1.weight), f1_b=g(ffn.ffn_1.bias),
+            f2_w=g(ffn.ffn_2.weight), f2_b=g(ffn.ffn_2.bias),
+        ))
+    return {
+        "embed": g(lm.embed.weight),
+        "pos": lm.pos_embed.data()._data.astype(jnp.float32),
+        "fn_g": g(lm.final_norm.gamma), "fn_b": g(lm.final_norm.beta),
+        "proj_w": g(lm.proj.weight), "proj_b": g(lm.proj.bias),
+        "cells": cells,
+    }
+
+
+def _f_ln(x, gamma, beta, eps=1e-5):
+    import jax.numpy as jnp
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + eps) * gamma + beta
+
+
+def _f_act(x, activation):
+    import jax
+    if activation == "relu":
+        return jax.nn.relu(x)
+    if activation in ("gelu", "gelu_erf"):
+        return jax.nn.gelu(x, approximate=False)
+    if activation == "gelu_tanh":
+        return jax.nn.gelu(x, approximate=True)
+    raise MXNetError(f"paged decode forward: unsupported activation "
+                     f"{activation!r}")
+
+
+def _f_ffn(x, cp, activation):
+    h = _f_act(x @ cp["f1_w"].T + cp["f1_b"], activation)
+    return h @ cp["f2_w"].T + cp["f2_b"]
+
+
+def paged_prefill(params, tokens, length, block_table, k_pages, v_pages,
+                  *, num_heads, page_size, activation="relu",
+                  layer_norm_eps=1e-5):
+    """Prefill ONE sequence and write its K/V into cache pages.
+
+    ``tokens``: (1, L_bucket) int32, padded past ``length`` (a scalar);
+    ``block_table``: (pages_per_seq,) int32 physical pages (null page 0
+    in unused slots); ``k_pages``/``v_pages``: the full
+    (layers, pool_pages, page_size, heads, head_dim) pools.  Attention
+    over the fresh prompt is plain causal+padding-masked softmax (the
+    prefix IS the whole context — no cache read yet); K/V of positions
+    past ``length`` are routed to the null page.  Returns
+    ``(last-token logits (V,), k_pages, v_pages)``.
+    """
+    import jax.numpy as jnp
+    H = num_heads
+    L = tokens.shape[1]
+    C = params["embed"].shape[1]
+    D = C // H
+    x = params["embed"][tokens[0]] * math.sqrt(C) \
+        + params["pos"][:L]                                 # (L, C)
+    pos_idx = jnp.arange(L)
+    valid = pos_idx < length                                # (L,)
+    page_idx = jnp.where(valid, block_table[pos_idx // page_size], 0)
+    slot_idx = pos_idx % page_size
+    # causal + padding: key j visible to query i iff j <= i and j valid
+    mask = (pos_idx[None, :] <= pos_idx[:, None]) \
+        & valid[None, :]                                    # (L, L)
+    for li, cp in enumerate(params["cells"]):
+        h = _f_ln(x, cp["n1_g"], cp["n1_b"], layer_norm_eps)
+        qkv = (h @ cp["qkv_w"].T + cp["qkv_b"]).reshape(L, H, 3, D)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        k_pages = k_pages.at[li, page_idx, slot_idx].set(
+            k.astype(k_pages.dtype))
+        v_pages = v_pages.at[li, page_idx, slot_idx].set(
+            v.astype(v_pages.dtype))
+        s = jnp.einsum("ihd,jhd->hij", q, k) / math.sqrt(D)
+        s = jnp.where(mask[None], s, NEG_INF)
+        p = jnp.exp(s - jnp.max(s, -1, keepdims=True))
+        p = p / jnp.sum(p, -1, keepdims=True)
+        o = jnp.einsum("hij,jhd->ihd", p, v).reshape(L, C)
+        x = x + (o @ cp["o_w"].T + cp["o_b"])
+        x = x + _f_ffn(_f_ln(x, cp["n2_g"], cp["n2_b"], layer_norm_eps),
+                       cp, activation)
+    x_last = x[length - 1]                                  # (C,)
+    x_last = _f_ln(x_last, params["fn_g"], params["fn_b"],
+                   layer_norm_eps)
+    return (x_last @ params["proj_w"].T + params["proj_b"],
+            k_pages, v_pages)
+
+
+def paged_decode_step(params, tokens, positions, block_tables, k_pages,
+                      v_pages, *, num_heads, page_size,
+                      activation="relu", layer_norm_eps=1e-5,
+                      attention_impl="jax"):
+    """One decode step for the whole (fixed-size) decode batch.
+
+    ``tokens``: (B,) int32 current token per slot; ``positions``: (B,)
+    int32 write position (== context length so far); ``block_tables``:
+    (B, pages_per_seq) int32.  Inactive slots carry token 0, position
+    0, and an all-null block table — their K/V writes land in the null
+    page and their logits are garbage the engine never reads.  Each
+    layer writes the new token's K/V through the block table, then
+    attends over the ragged paged context with the Pallas kernel
+    (``attention_impl="pallas"``, TPU) or the pure-jax reference
+    (``"jax"``, the CPU serving path).  Returns
+    ``(logits (B, V), k_pages, v_pages)``.
+    """
+    import jax.numpy as jnp
+
+    from ..ops import pallas_kernels as pk
+    H = num_heads
+    B = tokens.shape[0]
+    C = params["embed"].shape[1]
+    D = C // H
+    x = params["embed"][tokens] * math.sqrt(C) \
+        + params["pos"][positions]                          # (B, C)
+    page = jnp.take_along_axis(
+        block_tables, (positions // page_size)[:, None], axis=1)[:, 0]
+    slot = positions % page_size
+    ctx = positions + 1                                     # incl. new tok
+    for li, cp in enumerate(params["cells"]):
+        h = _f_ln(x, cp["n1_g"], cp["n1_b"], layer_norm_eps)
+        qkv = (h @ cp["qkv_w"].T + cp["qkv_b"]).reshape(B, H, 3, D)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        k_pages = k_pages.at[li, page, slot].set(k.astype(k_pages.dtype))
+        v_pages = v_pages.at[li, page, slot].set(v.astype(v_pages.dtype))
+        if attention_impl == "pallas":
+            o = pk.ragged_paged_attention(
+                q, k_pages[li], v_pages[li], block_tables, ctx)
+        else:
+            o = pk.ragged_paged_attention_reference(
+                q, k_pages[li], v_pages[li], block_tables, ctx)
+        x = x + (o.reshape(B, C) @ cp["o_w"].T + cp["o_b"])
+        x = x + _f_ffn(_f_ln(x, cp["n2_g"], cp["n2_b"], layer_norm_eps),
+                       cp, activation)
+    x = _f_ln(x, params["fn_g"], params["fn_b"], layer_norm_eps)
+    return x @ params["proj_w"].T + params["proj_b"], k_pages, v_pages

@@ -39,7 +39,8 @@ except ImportError:  # pragma: no cover
 
 from .registry import register
 
-__all__ = ["flash_attention", "pallas_available"]
+__all__ = ["flash_attention", "pallas_available",
+           "ragged_paged_attention", "ragged_paged_attention_reference"]
 
 _NEG_INF = -1e30
 
@@ -460,3 +461,174 @@ def flash_selfatt_nomask(queries_keys_values, *, heads: int = 1,
                           window=None if window <= 0 else window)
     return out.reshape(B, heads, L, D).transpose(2, 0, 1, 3).reshape(
         L, B, heads * D)
+
+
+# ---------------------------------------------------------------------------
+# ragged paged attention (LLM decode: one query token per sequence, K/V
+# read through per-sequence block tables out of a fixed-page pool —
+# "Ragged Paged Attention" kernel design, PAPERS.md)
+# ---------------------------------------------------------------------------
+def _paged_fwd_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                      m_scr, l_scr, acc_scr, *, sm_scale, page_size,
+                      n_pages):
+    """One (sequence, head, page) grid step of decode attention.
+
+    The page axis is innermost and sequential, so the online-softmax
+    statistics (m/l/acc scratch) carry across the pages of one
+    (sequence, head) exactly like the flash kernel's k axis.  Which
+    physical page backs grid step (b, h, p) is decided by the BlockSpec
+    index map reading the scalar-prefetched block table — the kernel
+    body never sees a page id, only its (page_size, D) tile.
+    """
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    ctx = len_ref[b]
+    start = p * page_size
+
+    # skip pages entirely past the sequence's context (and everything
+    # for an inactive slot, ctx == 0: output falls out as zeros)
+    @pl.when(start < ctx)
+    def _step():
+        q = q_ref[0]                            # (1, D)
+        k = k_ref[0, :, 0]                      # (page_size, D)
+        v = v_ref[0, :, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # (1, ps)
+        idx = start + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        s = jnp.where(idx < ctx, s, _NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p_ = jnp.exp(s - m_new)                 # (1, ps)
+        l_scr[:] = l_scr[:] * corr + jnp.sum(p_, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p_.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+
+    @pl.when(p == n_pages - 1)
+    def _finish():
+        l = l_scr[:]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+
+
+def ragged_paged_attention(q, k_pages, v_pages, block_tables,
+                           context_lens, sm_scale=None, interpret=None):
+    """Decode attention over a paged KV cache (Pallas TPU kernel).
+
+    - ``q``: (B, H, D) — ONE query token per sequence slot (the ragged
+      decode batch; inactive slots carry ``context_lens == 0``).
+    - ``k_pages`` / ``v_pages``: (num_pages, page_size, H, D) — the
+      preallocated device pool (``serving.kv_cache``).
+    - ``block_tables``: (B, pages_per_seq) int32 — physical page of each
+      logical page of each sequence; entries past the sequence's length
+      must point at a valid (e.g. the null) page.
+    - ``context_lens``: (B,) int32 — tokens of valid context per slot,
+      INCLUDING the token whose K/V was just written; 0 = inactive slot
+      (output row is zeros).
+
+    The grid is (B, H, pages_per_seq) with pages innermost-sequential;
+    the block table rides scalar prefetch so the page indirection is an
+    index-map lookup, not in-kernel pointer math.  Returns (B, H, D) in
+    the query dtype.  Pure-jax twin:
+    :func:`ragged_paged_attention_reference` (CPU fallback + test
+    oracle).
+    """
+    if not pallas_available():
+        from ..base import MXNetError
+        raise MXNetError(
+            "ragged_paged_attention requires jax.experimental.pallas.tpu "
+            "(check mx.runtime.Features()['PALLAS']); use "
+            "ragged_paged_attention_reference on other backends")
+    B, H, D = q.shape
+    n_pool, page_size, HK, DK = k_pages.shape
+    if (HK, DK) != (H, D) or v_pages.shape != k_pages.shape:
+        from ..base import MXNetError
+        raise MXNetError(
+            f"ragged_paged_attention: q (B,H,D)={q.shape} inconsistent "
+            f"with k_pages {k_pages.shape} / v_pages {v_pages.shape} "
+            f"(want (num_pages, page_size, {H}, {D}))")
+    n_pages = block_tables.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(D))
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    block_tables = block_tables.astype(jnp.int32)
+    context_lens = context_lens.astype(jnp.int32)
+
+    q_spec = pl.BlockSpec((1, 1, D), lambda b, h, p, bt, ln: (b, h, 0))
+    kv_spec = pl.BlockSpec(
+        (1, page_size, 1, D),
+        lambda b, h, p, bt, ln: (bt[b, p], 0, h, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, H, n_pages),
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=q_spec,
+        scratch_shapes=[_scratch((1, 1), jnp.float32),
+                        _scratch((1, 1), jnp.float32),
+                        _scratch((1, D), jnp.float32)],
+    )
+    kernel = functools.partial(_paged_fwd_kernel,
+                               sm_scale=float(sm_scale),
+                               page_size=page_size, n_pages=n_pages)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        interpret=bool(interpret),
+    )(block_tables, context_lens, q, k_pages, v_pages)
+
+
+def ragged_paged_attention_reference(q, k_pages, v_pages, block_tables,
+                                     context_lens, sm_scale=None):
+    """Pure-jax twin of :func:`ragged_paged_attention` — same signature
+    and semantics (inactive ``context_lens == 0`` slots yield zeros),
+    used as the CPU serving path and the kernel-parity oracle.  Gathers
+    each sequence's pages into a contiguous (pages*page_size) context
+    and runs masked softmax attention."""
+    B, H, D = q.shape
+    page_size = k_pages.shape[1]
+    n_pages = block_tables.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(D))
+    block_tables = block_tables.astype(jnp.int32)
+    context_lens = context_lens.astype(jnp.int32)
+    # (B, n_pages, page_size, H, D) -> (B, T, H, D), T = n_pages * ps
+    k = k_pages[block_tables].reshape(B, n_pages * page_size, H, D)
+    v = v_pages[block_tables].reshape(B, n_pages * page_size, H, D)
+    s = jnp.einsum("bhd,bthd->bht", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    valid = (jnp.arange(n_pages * page_size)[None, :]
+             < context_lens[:, None])                       # (B, T)
+    s = jnp.where(valid[:, None, :], s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - m) * valid[:, None, :]
+    l = jnp.sum(e, axis=-1, keepdims=True)                  # (B, H, 1)
+    out = jnp.einsum("bht,bthd->bhd", e, v.astype(jnp.float32))
+    return (out / jnp.where(l == 0.0, 1.0, l)).astype(q.dtype)
+
+
+@register("_contrib_ragged_paged_attention", num_inputs=5,
+          differentiable=False, aliases=["ragged_paged_attention_op"])
+def ragged_paged_attention_auto(q, k_pages, v_pages, block_tables,
+                                context_lens):
+    """Registry frontend for decode-time paged attention: the Pallas
+    kernel on TPU backends, the pure-jax reference elsewhere (the same
+    dispatch the serving decode engine uses).  Block tables and context
+    lengths accept any numeric dtype (cast to int32)."""
+    bt = block_tables.astype(jnp.int32)
+    lens = context_lens.astype(jnp.int32)
+    if pallas_available() and jax.default_backend() == "tpu":
+        return ragged_paged_attention(q, k_pages, v_pages, bt, lens)
+    return ragged_paged_attention_reference(q, k_pages, v_pages, bt, lens)

@@ -610,6 +610,32 @@ SERVING_BUCKET_CACHE = counter(
     "persistent compile cache, and mem_hit+disk_hit+miss equals "
     "lookups — so in-memory programs == misses + disk hits).",
     labelnames=("event",))
+SERVING_DECODE_STEPS = counter(
+    "serving.decode.steps",
+    "Scheduler iterations of the continuous-batching decode engine "
+    "(admit -> prefill -> one decode step -> evict), per model.",
+    labelnames=("model",))
+SERVING_DECODE_TOKENS = counter(
+    "serving.decode.tokens",
+    "Tokens generated by the decode engine (prefill first tokens + "
+    "decode-step tokens), per model.", labelnames=("model",))
+SERVING_DECODE_EVICTIONS = counter(
+    "serving.decode.evictions",
+    "Sequences evicted from the decode batch (finished, cancelled, or "
+    "failed) with their KV pages returned to the free list, per model.",
+    labelnames=("model",))
+SERVING_DECODE_TTFT_SECONDS = histogram(
+    "serving.decode.ttft.seconds",
+    "Time to first token: generate() submission to the first sampled "
+    "token (queueing + prefill), per model.", labelnames=("model",))
+SERVING_DECODE_TOKEN_SECONDS = histogram(
+    "serving.decode.token.seconds",
+    "Per-token decode latency (time between consecutive sampled tokens "
+    "of one sequence), per model.", labelnames=("model",))
+SERVING_DECODE_KV_OCCUPANCY = gauge(
+    "serving.decode.kv.occupancy",
+    "Used fraction of the paged KV cache pool (allocated pages / "
+    "usable pages), per decode engine.", labelnames=("engine",))
 COMPILE_CACHE = counter(
     "compile.cache",
     "Persistent compiled-executable cache events "

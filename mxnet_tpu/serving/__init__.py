@@ -18,7 +18,13 @@ the missing serving tier over it:
   restart compiles zero new XLA programs);
 - first-class ``runtime_metrics`` instrumentation (queue depth, batch
   occupancy, per-model latency, shed counter, bucket-cache
-  mem/disk/miss counter — ``docs/observability.md``).
+  mem/disk/miss counter — ``docs/observability.md``);
+- :class:`DecodeEngine` — autoregressive ``generate()`` with
+  token-level continuous batching over a paged KV cache
+  (:mod:`~mxnet_tpu.serving.kv_cache`): admit/evict sequences every
+  STEP, prompt-length-bucketed prefill + one fixed-shape decode
+  program (ragged paged attention, ``ops/pallas_kernels.py``), and
+  streaming token callbacks (docs/serving.md §6).
 
 >>> from mxnet_tpu import serving
 >>> repo = serving.ModelRepository()
@@ -29,9 +35,13 @@ the missing serving tier over it:
 from .batcher import DynamicBatcher, next_bucket, pad_batch, \
     unpad_outputs
 from .config import ServingConfig
+from .decode import DecodeEngine, GenerateRequest, PagedLMAdapter
+from .kv_cache import DeviceKVPool, PageAllocator, PageGeometry
 from .repository import ModelEntry, ModelRepository
 from .server import ModelServer, ServerOverloadedError
 
 __all__ = ["ModelRepository", "ModelEntry", "ModelServer",
            "DynamicBatcher", "ServingConfig", "ServerOverloadedError",
-           "next_bucket", "pad_batch", "unpad_outputs"]
+           "next_bucket", "pad_batch", "unpad_outputs",
+           "DecodeEngine", "GenerateRequest", "PagedLMAdapter",
+           "PageGeometry", "PageAllocator", "DeviceKVPool"]

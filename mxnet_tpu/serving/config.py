@@ -27,11 +27,19 @@ class ServingConfig:
       (queued + dispatched-but-unfinished), so a slow model cannot
       pile up unbounded in-flight batches.
     - ``num_workers``: dispatch threads forming and executing batches.
+
+    Decode-engine knobs (autoregressive ``generate()``, docs/serving.md
+    §6): ``decode_page_size`` tokens per KV page,
+    ``decode_pool_pages`` total preallocated pages (incl. the null
+    page), ``decode_max_batch`` sequence slots in the fixed-shape
+    decode step, ``decode_max_new_tokens`` default generation cap.
     """
 
     def __init__(self, max_batch_size=None, max_latency_us=None,
                  queue_depth=None, shed_watermark=None, num_workers=None,
-                 retry_after_ms=None):
+                 retry_after_ms=None, decode_page_size=None,
+                 decode_pool_pages=None, decode_max_batch=None,
+                 decode_max_new_tokens=None):
         def pick(value, env, typ=int):
             if value is None:
                 value = get_env(env, typ=typ)
@@ -49,6 +57,14 @@ class ServingConfig:
         self.num_workers = pick(num_workers, "MXNET_SERVING_WORKERS")
         self.retry_after_ms = pick(retry_after_ms,
                                    "MXNET_SERVING_RETRY_AFTER_MS")
+        self.decode_page_size = pick(decode_page_size,
+                                     "MXNET_SERVING_DECODE_PAGE_SIZE")
+        self.decode_pool_pages = pick(decode_pool_pages,
+                                      "MXNET_SERVING_DECODE_POOL_PAGES")
+        self.decode_max_batch = pick(decode_max_batch,
+                                     "MXNET_SERVING_DECODE_MAX_BATCH")
+        self.decode_max_new_tokens = pick(
+            decode_max_new_tokens, "MXNET_SERVING_DECODE_MAX_NEW_TOKENS")
 
         if self.max_batch_size < 1:
             raise MXNetError("ServingConfig: max_batch_size must be >= 1")
@@ -67,6 +83,19 @@ class ServingConfig:
         if self.retry_after_ms < 0:
             raise MXNetError(
                 "ServingConfig: retry_after_ms must be >= 0")
+        if self.decode_page_size < 1:
+            raise MXNetError(
+                "ServingConfig: decode_page_size must be >= 1")
+        if self.decode_pool_pages < 2:
+            raise MXNetError(
+                "ServingConfig: decode_pool_pages must be >= 2 (page 0 "
+                "is the reserved null page)")
+        if self.decode_max_batch < 1:
+            raise MXNetError(
+                "ServingConfig: decode_max_batch must be >= 1")
+        if self.decode_max_new_tokens < 1:
+            raise MXNetError(
+                "ServingConfig: decode_max_new_tokens must be >= 1")
 
     def __repr__(self):
         return (f"ServingConfig(max_batch_size={self.max_batch_size}, "
@@ -74,4 +103,8 @@ class ServingConfig:
                 f"queue_depth={self.queue_depth}, "
                 f"shed_watermark={self.shed_watermark}, "
                 f"num_workers={self.num_workers}, "
-                f"retry_after_ms={self.retry_after_ms})")
+                f"retry_after_ms={self.retry_after_ms}, "
+                f"decode_page_size={self.decode_page_size}, "
+                f"decode_pool_pages={self.decode_pool_pages}, "
+                f"decode_max_batch={self.decode_max_batch}, "
+                f"decode_max_new_tokens={self.decode_max_new_tokens})")

@@ -1,0 +1,715 @@
+"""Autoregressive decode engine: token-level continuous batching over a
+paged KV cache (docs/serving.md §6).
+
+``predict()`` serves one-shot programs; *the* heavy-traffic workload is
+autoregressive generation, whose unit of work is a token, not a
+request.  Request-level batching would hold every sequence of a batch
+hostage to its longest member; this engine reschedules at TOKEN
+granularity instead — every step it admits waiting sequences into free
+decode slots, runs ONE fixed-shape decode step for all running
+sequences, and evicts the finished ones (the continuous-batching design
+of Orca/vLLM, with the kernel layout of "Ragged Paged Attention",
+PAPERS.md).  The host-side step loop only schedules and samples; all
+per-token math lives in two compiled program families, so the scheduler
+stays off the device critical path (the prefetch discipline of the
+tf.data design, PAPERS.md):
+
+- **prefill** — one program per prompt-length bucket (the serving
+  batcher's power-of-two ``bucket_set`` machinery reused for the length
+  axis), batch 1, writes the prompt's K/V into cache pages and returns
+  last-token logits;
+- **decode** — ONE program at the fixed ``decode_max_batch``, one token
+  per slot, reading/writing K/V through per-sequence block tables
+  (``serving.kv_cache``).
+
+Total compiled programs are therefore bounded by
+``len(bucket_set(max_context)) + 1`` for ANY traffic mix — the same
+O(log N) discipline the predict path gets from ``DynamicBatcher`` —
+and with the persistent compile cache configured
+(``MXNET_COMPILE_CACHE_DIR``) both families deserialize on a warm
+restart instead of compiling (weights enter the programs as inputs, so
+the cache key is the architecture, not the checkpoint).
+
+KV memory: sequences own fixed-size pages from a preallocated device
+pool via a free-list allocator (:mod:`mxnet_tpu.serving.kv_cache`).
+Admission reserves a sequence's worst case
+(``ceil((prompt + max_new_tokens) / page_size)``) up front —
+all-or-nothing, so a running sequence can never hit pool exhaustion
+mid-flight and no preemption machinery is needed; eviction returns the
+pages, unblocking the admission queue.  (vLLM-style lazy allocation
+with preemption is a policy swap inside ``_admit_locked``.)
+"""
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+
+import numpy as np
+
+from .. import engine as _engine, runtime_metrics as _rm
+from ..base import MXNetError
+from .batcher import bucket_set, next_bucket
+from .kv_cache import DeviceKVPool, PageAllocator, PageGeometry
+
+__all__ = ["DecodeEngine", "GenerateRequest", "PagedLMAdapter",
+           "as_decode_model"]
+
+_LOG = logging.getLogger("mxnet_tpu")
+_SEQ_IDS = itertools.count(1)
+
+
+class GenerateRequest:
+    """One ``generate()`` call's lifecycle handle.
+
+    ``tokens`` fills with generated ids (EOS included when hit) as the
+    engine steps; ``event`` fires at eviction (finished, failed, or
+    cancelled).  ``finish_reason`` is one of ``eos | length |
+    cancelled | stopped | error``.
+    """
+
+    __slots__ = ("seq_id", "prompt", "max_new_tokens", "eos_id",
+                 "on_token", "tokens", "event", "error", "finish_reason",
+                 "slot", "context_len", "t_submit", "t_first", "t_prev",
+                 "cancelled")
+
+    def __init__(self, prompt, max_new_tokens, eos_id, on_token):
+        self.seq_id = next(_SEQ_IDS)
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.eos_id = eos_id
+        self.on_token = on_token
+        self.tokens = []                  # generated ids (ints)
+        self.event = threading.Event()
+        self.error = None
+        self.finish_reason = None
+        self.slot = None                  # decode-batch slot while running
+        self.context_len = 0              # tokens whose K/V is written
+        self.t_submit = time.monotonic()
+        self.t_first = None               # first-token timestamp (TTFT)
+        self.t_prev = None                # previous-token timestamp
+        self.cancelled = False
+
+    @property
+    def ttft(self):
+        """Seconds from submit to first token, or None."""
+        return None if self.t_first is None \
+            else self.t_first - self.t_submit
+
+
+class DecodeEngine:
+    """Continuous-batching scheduler over one decode model.
+
+    ``model`` implements the decode-model protocol (duck-typed so
+    scheduler tests run on fake numpy models with zero compiles):
+
+    - attrs ``vocab_size``, ``max_context`` (and for pool sizing,
+      optional ``num_layers`` / ``num_heads`` / ``head_dim``);
+    - ``prefill(tokens (1, L) i32, length () i32, block_table (P,) i32)
+      -> last-token logits (V,)``, writing the prompt's K/V;
+    - ``decode_step(tokens (B,) i32, positions (B,) i32,
+      block_tables (B, P) i32) -> logits (B, V)`` — inactive slots
+      carry zeros and their logits are never read;
+    - optional ``setup(geometry)`` (allocate device pools) and
+      ``programs()`` (compiled-program count, for the bound asserts).
+
+    The engine owns the HOST side only: waiting queue (bounded by
+    ``config.queue_depth`` — submission past it sheds with
+    :class:`~mxnet_tpu.serving.server.ServerOverloadedError`, the same
+    backpressure contract as the predict path), slot map, page
+    allocator, sampling (greedy argmax), callbacks, metrics.  One
+    background thread drives :meth:`step`; tests drive it directly with
+    ``autostart=False``.
+    """
+
+    def __init__(self, model, config=None, model_name="decoder",
+                 autostart=False):
+        from .config import ServingConfig
+        self.model = model
+        self.config = config or ServingConfig()
+        self.model_name = model_name
+        max_context = int(model.max_context)
+        self.geometry = PageGeometry(
+            page_size=self.config.decode_page_size,
+            pool_pages=self.config.decode_pool_pages,
+            max_context=max_context,
+            num_layers=getattr(model, "num_layers", 1),
+            num_heads=getattr(model, "num_heads", 1),
+            head_dim=getattr(model, "head_dim", 1))
+        self.allocator = PageAllocator(self.geometry)
+        self.max_batch = self.config.decode_max_batch
+        # prompt-length buckets: the SAME power-of-two policy the
+        # predict path uses for batch rows, applied to the length axis —
+        # at most len(bucket_set(max_context)) prefill programs
+        self.prefill_buckets = bucket_set(max_context)
+        self.program_bound = len(self.prefill_buckets) + 1
+        setup = getattr(model, "setup", None)
+        if setup is not None:
+            setup(self.geometry)
+        self._model_bound = setup is not None
+        self._cond = _engine.make_condition("serving.DecodeEngine._cond")
+        self._waiting = []                # FIFO of GenerateRequest
+        self._running = {}                # slot -> GenerateRequest
+        self._free_slots = list(range(self.max_batch - 1, -1, -1))
+        self._started = False
+        self._stopping = False
+        self._thread = None
+        self._stats = {"steps": 0, "admitted": 0, "evicted": 0,
+                       "generated_tokens": 0, "peak_running": 0,
+                       "shed": 0}
+        if autostart:
+            self.start()
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self):
+        setup = getattr(self.model, "setup", None)
+        with self._cond:
+            if self._started:
+                return self
+            # restart after a stop(): the stop tore the adapter's
+            # device pool down — bind it again before serving
+            if setup is not None and not self._model_bound:
+                setup(self.geometry)
+                self._model_bound = True
+            self._started = True
+            self._stopping = False
+            self._thread = threading.Thread(
+                target=self._loop, name=f"mxnet-decode-{self.model_name}",
+                daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout=None):
+        """Stop the step loop and fail every outstanding request with
+        ``finish_reason="stopped"``.  Returns True once the loop thread
+        is down."""
+        with self._cond:
+            started, thread = self._started, self._thread
+            self._stopping = True
+            self._cond.notify_all()
+        if started and thread is not None:
+            thread.join(timeout)
+            if thread.is_alive():
+                return False
+        with self._cond:
+            outstanding = self._waiting + list(self._running.values())
+            self._waiting = []
+        for seq in outstanding:
+            self._evict(seq, reason="stopped",
+                        error=MXNetError(
+                            "DecodeEngine stopped before this request "
+                            "finished"))
+        with self._cond:
+            self._started = False
+            self._thread = None
+        # unbind the model adapter (drops its device KV pool) so a
+        # later engine — this one restarted, or a fresh server — can
+        # bind; only reached once the step loop is provably down
+        teardown = getattr(self.model, "teardown", None)
+        with self._cond:
+            if teardown is not None and self._model_bound:
+                teardown()
+                self._model_bound = False
+        return True
+
+    @property
+    def started(self):
+        return self._started
+
+    # -------------------------------------------------------------- submit
+    def submit(self, prompt, max_new_tokens=None, eos_id=None,
+               on_token=None):
+        """Queue one prompt for generation; returns the
+        :class:`GenerateRequest` handle (``result()`` blocks on it).
+        ``on_token(token_id)`` streams each generated id from the engine
+        thread as it is sampled."""
+        prompt = np.asarray(prompt).astype(np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise MXNetError("generate: prompt must hold >= 1 token")
+        if max_new_tokens is None:
+            max_new_tokens = self.config.decode_max_new_tokens
+        max_new_tokens = int(max_new_tokens)
+        if max_new_tokens < 1:
+            raise MXNetError("generate: max_new_tokens must be >= 1")
+        total = prompt.size + max_new_tokens
+        if total > self.geometry.max_context:
+            raise MXNetError(
+                f"generate: prompt ({prompt.size}) + max_new_tokens "
+                f"({max_new_tokens}) = {total} exceeds the model's "
+                f"max_context ({self.geometry.max_context})")
+        worst = self.geometry.pages_for(total)
+        if worst > self.geometry.usable_pages:
+            raise MXNetError(
+                f"generate: request needs {worst} KV pages but the pool "
+                f"only has {self.geometry.usable_pages} usable pages — "
+                f"raise MXNET_SERVING_DECODE_POOL_PAGES or shorten the "
+                f"request")
+        if eos_id is None:
+            eos_id = getattr(self.model, "eos_id", None)
+        seq = GenerateRequest(prompt, max_new_tokens, eos_id, on_token)
+        with self._cond:
+            if not self._started or self._stopping:
+                raise MXNetError(
+                    "DecodeEngine is not accepting requests (not "
+                    "started, or stopping)")
+            # the serving tier's backpressure contract applies to the
+            # decode path too: a bounded waiting line and a cheap
+            # reject with a retry hint, never an unbounded queue
+            if len(self._waiting) >= self.config.queue_depth:
+                from .server import ServerOverloadedError
+                self._stats["shed"] += 1
+                if _rm._ENABLED:
+                    _rm.SERVING_SHED.inc(model=self.model_name)
+                raise ServerOverloadedError(
+                    self.model_name, self.config.retry_after_ms,
+                    f"decode waiting queue {len(self._waiting)} >= "
+                    f"queue_depth {self.config.queue_depth}")
+            self._waiting.append(seq)
+            self._cond.notify_all()
+        return seq
+
+    def result(self, seq, timeout=None):
+        """Block until ``seq`` finishes; returns the generated ids as an
+        int32 array.  On timeout the request is cancelled (its slot and
+        pages are reclaimed on the next step) and ``MXNetError``
+        raises."""
+        if not seq.event.wait(timeout):
+            with self._cond:
+                seq.cancelled = True
+                self._cond.notify_all()
+            raise MXNetError(
+                f"generate: no result within {timeout}s "
+                f"({len(seq.tokens)} token(s) generated so far; the "
+                f"sequence is cancelled and its pages reclaimed)")
+        if seq.error is not None:
+            raise seq.error
+        return np.asarray(seq.tokens, np.int32)
+
+    def generate(self, prompt, max_new_tokens=None, eos_id=None,
+                 on_token=None, timeout=None):
+        """``submit`` + ``result`` in one call."""
+        return self.result(
+            self.submit(prompt, max_new_tokens=max_new_tokens,
+                        eos_id=eos_id, on_token=on_token),
+            timeout=timeout)
+
+    # ---------------------------------------------------------- scheduling
+    def _loop(self):
+        while True:
+            with self._cond:
+                while not self._stopping and not self._waiting \
+                        and not self._running:
+                    self._cond.wait()
+                if self._stopping:
+                    return
+            try:
+                self.step()
+            except Exception as e:      # noqa: BLE001 — fail the batch
+                # a model/compile failure must surface on the callers,
+                # not kill the loop silently
+                _LOG.warning("decode engine %s: step failed: %s",
+                             self.model_name, e)
+                with self._cond:
+                    victims = self._waiting \
+                        + list(self._running.values())
+                    self._waiting = []
+                for seq in victims:
+                    self._evict(seq, reason="error", error=e)
+
+    def step(self):
+        """ONE scheduler iteration: admit -> prefill admitted -> one
+        decode step for every running sequence -> evict finished.
+        Returns the number of tokens generated this step.  The step
+        loop is the only mutator of the slot map and the allocator;
+        ``submit``/``stats`` only touch the waiting queue and read
+        counters under the condition."""
+        admitted = self._admit()
+        produced = 0
+        for seq in admitted:
+            produced += self._prefill_one(seq)
+        produced += self._decode_step()
+        with self._cond:
+            self._stats["steps"] += 1
+            self._stats["generated_tokens"] += produced
+            occupancy = self.allocator.occupancy
+        if _rm._ENABLED:
+            _rm.SERVING_DECODE_STEPS.inc(model=self.model_name)
+            _rm.SERVING_DECODE_KV_OCCUPANCY.set(
+                occupancy, engine=self.model_name)
+        return produced
+
+    def _admit(self):
+        """Move waiting sequences into free decode slots while both a
+        slot AND the sequence's worst-case page reservation fit
+        (all-or-nothing, FIFO — a too-big head blocks the line rather
+        than starving: pages freed by the next eviction admit it)."""
+        admitted, dropped = [], []
+        with self._cond:
+            # prune cancelled entries ANYWHERE in the line first — a
+            # timed-out caller must not keep occupying bounded queue
+            # space just because the decode batch happens to be full
+            live = []
+            for seq in self._waiting:
+                (dropped if seq.cancelled else live).append(seq)
+            self._waiting = live
+            while self._waiting and self._free_slots:
+                seq = self._waiting[0]
+                pages = self.geometry.pages_for(
+                    seq.prompt.size + seq.max_new_tokens)
+                if not self.allocator.allocate(seq.seq_id, pages):
+                    break
+                self._waiting.pop(0)
+                seq.slot = self._free_slots.pop()
+                self._running[seq.slot] = seq
+                self._stats["admitted"] += 1
+                self._stats["peak_running"] = max(
+                    self._stats["peak_running"], len(self._running))
+                admitted.append(seq)
+        for seq in dropped:
+            self._finish(seq, "cancelled",
+                         MXNetError("generate: request cancelled "
+                                    "before admission"))
+        return admitted
+
+    def _prefill_one(self, seq):
+        """Run the (length-bucketed) prefill program for one admitted
+        sequence and sample its first token."""
+        L = seq.prompt.size
+        bucket = next_bucket(L, self.geometry.max_context)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :L] = seq.prompt
+        logits = np.asarray(self.model.prefill(
+            tokens, np.int32(L), self.allocator.block_table(seq.seq_id)))
+        seq.context_len = L
+        self._emit(seq, int(np.argmax(logits)))
+        self._maybe_evict(seq)
+        return 1
+
+    def _decode_step(self):
+        """One fixed-shape decode step over every running sequence."""
+        with self._cond:
+            running = [s for s in self._running.values()
+                       if not s.cancelled]
+            cancelled = [s for s in self._running.values()
+                         if s.cancelled]
+        for seq in cancelled:
+            self._release(seq)
+            self._finish(seq, "cancelled",
+                         MXNetError("generate: request cancelled"))
+        if not running:
+            return 0
+        B, P = self.max_batch, self.geometry.pages_per_seq
+        tokens = np.zeros((B,), np.int32)
+        positions = np.zeros((B,), np.int32)
+        block_tables = np.zeros((B, P), np.int32)
+        for seq in running:
+            # the slot's current token is the LAST sampled one — its
+            # K/V is written at `positions` (== context so far) by the
+            # decode program, which then attends over the full context
+            tokens[seq.slot] = seq.tokens[-1]
+            positions[seq.slot] = seq.context_len
+            block_tables[seq.slot] = self.allocator.block_table(
+                seq.seq_id)
+        logits = np.asarray(self.model.decode_step(
+            tokens, positions, block_tables))
+        produced = 0
+        for seq in running:
+            seq.context_len += 1
+            self._emit(seq, int(np.argmax(logits[seq.slot])))
+            produced += 1
+            self._maybe_evict(seq)
+        return produced
+
+    # ----------------------------------------------------- token plumbing
+    def _emit(self, seq, token):
+        now = time.monotonic()
+        if seq.t_first is None:
+            seq.t_first = now
+            if _rm._ENABLED:
+                _rm.SERVING_DECODE_TTFT_SECONDS.observe(
+                    now - seq.t_submit, model=self.model_name)
+        elif _rm._ENABLED:
+            _rm.SERVING_DECODE_TOKEN_SECONDS.observe(
+                now - seq.t_prev, model=self.model_name)
+        seq.t_prev = now
+        seq.tokens.append(token)
+        if _rm._ENABLED:
+            _rm.SERVING_DECODE_TOKENS.inc(model=self.model_name)
+        if seq.on_token is not None:
+            try:
+                seq.on_token(token)
+            except Exception as e:      # noqa: BLE001 — caller's bug
+                _LOG.warning("decode engine %s: on_token callback "
+                             "failed: %s", self.model_name, e)
+
+    def _maybe_evict(self, seq):
+        """Finish checks after a sampled token; evicts when done."""
+        reason = None
+        if seq.eos_id is not None and seq.tokens[-1] == seq.eos_id:
+            reason = "eos"
+        elif len(seq.tokens) >= seq.max_new_tokens:
+            reason = "length"
+        elif seq.cancelled:
+            reason = "cancelled"
+        if reason is None:
+            return False
+        self._release(seq)
+        self._finish(seq, reason,
+                     MXNetError("generate: request cancelled")
+                     if reason == "cancelled" else None)
+        return True
+
+    def _release(self, seq):
+        """Return a running sequence's slot + pages.  The evictions
+        counter moves here, not in ``_finish``: a request cancelled
+        while still WAITING never held a slot or pages, so counting it
+        would break ``admitted - evicted == running``."""
+        with self._cond:
+            if seq.slot is not None:
+                self._running.pop(seq.slot, None)
+                self._free_slots.append(seq.slot)
+                seq.slot = None
+                self.allocator.release(seq.seq_id)
+                self._stats["evicted"] += 1
+                if _rm._ENABLED:
+                    _rm.SERVING_DECODE_EVICTIONS.inc(
+                        model=self.model_name)
+                self._cond.notify_all()
+
+    def _finish(self, seq, reason, error=None):
+        seq.finish_reason = reason
+        if error is not None:
+            seq.error = error
+        seq.event.set()
+
+    def _evict(self, seq, reason, error):
+        """Out-of-band eviction (stop/step-failure): release whatever
+        the sequence holds and fail it."""
+        self._release(seq)
+        self._finish(seq, reason, error)
+
+    # ---------------------------------------------------------------- info
+    def stats(self):
+        with self._cond:
+            out = dict(self._stats)
+            out["running"] = len(self._running)
+            out["waiting"] = len(self._waiting)
+            out.update(self.allocator.stats())
+        out["program_bound"] = self.program_bound
+        programs = getattr(self.model, "programs", None)
+        if programs is not None:
+            out["programs"] = programs()
+        return out
+
+
+# ---------------------------------------------------------------------------
+# model adapters
+# ---------------------------------------------------------------------------
+class PagedLMAdapter:
+    """Decode-model protocol over a
+    :class:`~mxnet_tpu.models.transformer_blocks.TransformerDecoderLM`.
+
+    Owns the device KV pools and compiles the two bounded program
+    families from the LM's pure-jax decode-mode forwards
+    (``paged_prefill`` / ``paged_decode_step``):
+
+    - with the persistent compile cache configured, programs go through
+      ``compile_cache.aot_program`` keyed on the ARCHITECTURE (weights
+      are program inputs), so a warm restart deserializes instead of
+      compiling;
+    - otherwise one fresh ``jax.jit`` wrapper per family — the prefill
+      wrapper's ``_cache_size()`` counts exactly the length buckets
+      compiled, which is what the program-bound tests assert.
+
+    Attention inside the decode step is the ragged-paged-attention
+    Pallas kernel on TPU and its pure-jax reference elsewhere
+    (``attention_impl`` overrides).
+    """
+
+    def __init__(self, lm, attention_impl=None, eos_id=None):
+        import jax
+
+        from ..models.transformer_blocks import paged_lm_params
+        self.lm = lm
+        self.vocab_size = lm.vocab_size
+        self.max_context = lm.max_context
+        self.num_layers = lm.num_layers
+        self.num_heads = lm.num_heads
+        self.head_dim = lm.head_dim
+        if eos_id is not None:
+            self.eos_id = int(eos_id)
+        if attention_impl is None:
+            attention_impl = ("pallas" if jax.default_backend() == "tpu"
+                              else "jax")
+        if attention_impl not in ("pallas", "jax"):
+            raise MXNetError(
+                f"PagedLMAdapter: attention_impl must be 'pallas' or "
+                f"'jax', got {attention_impl!r}")
+        self.attention_impl = attention_impl
+        self.params = paged_lm_params(lm)
+        self.pool = None
+        self.compiled = 0               # programs built by XLA this process
+        self.disk_hits = 0              # deserialized from the compile cache
+        self._aot = {}                  # ("prefill", L) | ("decode",) -> prog
+
+    def refresh(self):
+        """Re-snapshot the LM's parameters (publish new weights).
+        Compiled programs survive — weights are program inputs."""
+        from ..models.transformer_blocks import paged_lm_params
+        self.params = paged_lm_params(self.lm)
+
+    def teardown(self):
+        """Unbind from a stopped engine: drop the device pool (a
+        retired engine must not pin KV HBM) so a later engine can
+        bind.  Compiled-program caches survive for the rebind."""
+        self.pool = None
+
+    # ------------------------------------------------------------- programs
+    def setup(self, geometry):
+        import functools
+
+        import jax
+
+        from ..models.transformer_blocks import (paged_decode_step,
+                                                 paged_prefill)
+        # one LIVE engine per adapter: the pool and program wrappers are
+        # this adapter's state, and a second engine calling setup()
+        # would zero the pool under the first one's feet (two servers
+        # sharing one repository entry, or a construction race).  The
+        # engine's stop() calls teardown(), so restart/hot-swap cycles
+        # rebind cleanly.
+        if self.pool is not None:
+            raise MXNetError(
+                "PagedLMAdapter: already bound to a live decode engine "
+                "— one decoder entry serves ONE engine at a time; "
+                "register a separate add_decoder entry per server")
+        rebind = (getattr(self, "geometry", None) is not None
+                  and self.geometry.page_size == geometry.page_size)
+        self.geometry = geometry
+        self.pool = DeviceKVPool(geometry)
+        if rebind:
+            # teardown() -> setup() cycle with the same page size: the
+            # program wrappers' traced statics are unchanged, so the
+            # compiled caches survive the rebind (zero recompiles on a
+            # server restart within one process)
+            return
+        kw = dict(num_heads=self.num_heads, page_size=geometry.page_size,
+                  activation=self.lm._activation,
+                  layer_norm_eps=self.lm._eps)
+        # donation lets XLA update the KV pools in place; the CPU
+        # backend cannot honor it and would warn on every program
+        donate = (4, 5) if jax.default_backend() != "cpu" else ()
+        self._prefill_jit = jax.jit(
+            functools.partial(paged_prefill, **kw),
+            donate_argnums=donate)
+        self._decode_jit = jax.jit(
+            functools.partial(paged_decode_step,
+                              attention_impl=self.attention_impl, **kw),
+            donate_argnums=donate)
+
+    def _cache(self):
+        from .. import compile_cache as _cc
+        cache = _cc.get_default()
+        return cache if cache.enabled else None
+
+    def _fingerprint(self, kind, rows):
+        """Architecture-level program identity for the compile-cache
+        key.  Weights are program INPUTS, so two checkpoints of one
+        architecture share executables."""
+        import hashlib
+
+        import jax
+        g = self.geometry
+        desc = "\x1f".join([
+            "mxnet_tpu.paged_lm/v1", kind, f"rows={rows}",
+            f"layers={self.num_layers}", f"heads={self.num_heads}",
+            f"units={self.lm.units}", f"vocab={self.vocab_size}",
+            f"hidden={int(self.params['cells'][0]['f1_w'].shape[0])}",
+            f"act={self.lm._activation}", f"eps={self.lm._eps!r}",
+            f"max_pos={self.max_context}",
+            f"page={g.page_size}", f"pool={g.pool_pages}",
+            f"pps={g.pages_per_seq}", f"batch={rows}",
+            f"impl={self.attention_impl}", jax.__version__,
+        ])
+        return hashlib.sha256(desc.encode()).hexdigest()
+
+    def _avals(self, arrays):
+        import jax
+        return jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(np.shape(a),
+                                           np.asarray(a).dtype
+                                           if not hasattr(a, "dtype")
+                                           else a.dtype), arrays)
+
+    def _aot_for(self, kind, rows, fn, example_args):
+        """Cache-through AOT program for one (kind, shape) — built once
+        per process, deserialized from the persistent cache when it can
+        be."""
+        from .. import compile_cache as _cc
+        key_id = (kind, rows)
+        prog = self._aot.get(key_id)
+        if prog is None:
+            key = _cc.cache_key(self._fingerprint(kind, rows), rows,
+                                ["float32", "int32"])
+            prog, source = _cc.aot_program(fn, self._avals(example_args),
+                                           key)
+            if source == "disk":
+                self.disk_hits += 1
+            else:
+                self.compiled += 1
+            self._aot[key_id] = prog
+        return prog
+
+    def programs(self):
+        """Compiled-program count across both families (the decode
+        engine's ``programs <= program_bound`` acceptance check)."""
+        if self._aot:
+            return len(self._aot)
+        return (self._prefill_jit._cache_size()
+                + self._decode_jit._cache_size())
+
+    # ------------------------------------------------------------ protocol
+    def prefill(self, tokens, length, block_table):
+        pool = self.pool
+        args = (self.params, tokens, length, block_table,
+                pool.k_pages, pool.v_pages)
+        if self._cache() is not None:
+            prog = self._aot_for("prefill", tokens.shape[1],
+                                 self._prefill_jit, args)
+        else:
+            prog = self._prefill_jit
+        logits, k_pages, v_pages = prog(*args)
+        pool.swap(k_pages, v_pages)
+        return logits
+
+    def decode_step(self, tokens, positions, block_tables):
+        pool = self.pool
+        args = (self.params, tokens, positions, block_tables,
+                pool.k_pages, pool.v_pages)
+        if self._cache() is not None:
+            prog = self._aot_for("decode", tokens.shape[0],
+                                 self._decode_jit, args)
+        else:
+            prog = self._decode_jit
+        logits, k_pages, v_pages = prog(*args)
+        pool.swap(k_pages, v_pages)
+        return logits
+
+
+def as_decode_model(obj, attention_impl=None, eos_id=None):
+    """Normalize what ``ModelRepository.add_decoder`` accepted into the
+    decode-model protocol: objects already implementing
+    ``prefill``/``decode_step`` pass through (fake/cheap test models);
+    a :class:`TransformerDecoderLM` is wrapped in
+    :class:`PagedLMAdapter`."""
+    if hasattr(obj, "prefill") and hasattr(obj, "decode_step"):
+        return obj
+    from ..models.transformer_blocks import TransformerDecoderLM
+    if isinstance(obj, TransformerDecoderLM):
+        return PagedLMAdapter(obj, attention_impl=attention_impl,
+                              eos_id=eos_id)
+    raise MXNetError(
+        f"as_decode_model: {type(obj).__name__} neither implements the "
+        f"decode-model protocol (prefill/decode_step) nor is a "
+        f"TransformerDecoderLM")

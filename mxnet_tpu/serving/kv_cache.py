@@ -1,0 +1,261 @@
+"""Paged KV cache for the decode engine (docs/serving.md §6).
+
+The KV cache of an autoregressive batch is ragged — every sequence has
+a different length, and lengths grow every step.  A contiguous
+per-sequence (max_len) slab wastes HBM on short sequences and
+fragments on long ones; the paged layout ("Ragged Paged Attention",
+PAPERS.md / vLLM's PagedAttention) instead preallocates ONE device
+pool of fixed-size pages and gives each sequence a *block table* of
+page indices, so long and short sequences share the pool with zero
+fragmentation and page granularity waste only.
+
+Three pieces, split by where the state lives:
+
+- :class:`PageGeometry` — the shared layout constants (page size, pool
+  pages, per-sequence table width, model dims).  Everything that must
+  agree between the allocator, the device pool, and the compiled
+  programs derives from here, so it cannot drift.
+- :class:`PageAllocator` — HOST-side free-list bookkeeping: page
+  alloc/free per sequence, block-table materialization, occupancy.
+  Page 0 is reserved as the *null page*: block-table entries past a
+  sequence's allocation point at it, and padded/inactive batch slots
+  write their garbage K/V into it — so compiled programs never need a
+  "valid" mask on the write path.
+- :class:`DeviceKVPool` — the preallocated DEVICE arrays, one K and one
+  V pool of shape (layers, pool_pages, page_size, heads, head_dim).
+  Compiled decode programs take the pools as (donated) inputs and
+  return the updated arrays; :meth:`DeviceKVPool.swap` rebinds them.
+
+The allocator is deliberately strict: freeing a page twice, freeing a
+page that is not allocated, or releasing an unknown sequence raises
+``MXNetError`` — the decode scheduler's invariants (admit/evict every
+step) are enforced here rather than trusted.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["PageGeometry", "PageAllocator", "DeviceKVPool"]
+
+
+class PageGeometry:
+    """Layout constants shared by the allocator, the device pool, and
+    the compiled decode programs.
+
+    - ``page_size``: tokens per KV page.
+    - ``pool_pages``: TOTAL pages in the device pool, including the
+      reserved null page 0 (``usable_pages`` = pool_pages - 1).
+    - ``max_context``: longest context a sequence may reach (prompt +
+      generated); ``pages_per_seq`` block-table slots cover it.
+    - ``num_layers`` / ``num_heads`` / ``head_dim``: the model dims the
+      pool arrays are shaped with.
+    """
+
+    def __init__(self, page_size, pool_pages, max_context, num_layers,
+                 num_heads, head_dim):
+        if page_size < 1:
+            raise MXNetError("PageGeometry: page_size must be >= 1")
+        if pool_pages < 2:
+            raise MXNetError(
+                "PageGeometry: pool_pages must be >= 2 (page 0 is the "
+                "reserved null page)")
+        if max_context < 1:
+            raise MXNetError("PageGeometry: max_context must be >= 1")
+        self.page_size = int(page_size)
+        self.pool_pages = int(pool_pages)
+        self.max_context = int(max_context)
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.pages_per_seq = -(-self.max_context // self.page_size)
+
+    @property
+    def usable_pages(self):
+        return self.pool_pages - 1
+
+    def pages_for(self, tokens):
+        """Pages needed to hold ``tokens`` tokens of context."""
+        if tokens < 0:
+            raise MXNetError(f"pages_for: negative token count {tokens}")
+        return -(-tokens // self.page_size)
+
+    def kv_bytes(self, dtype_size=4):
+        """Device bytes of ONE pool array (K or V)."""
+        return (self.num_layers * self.pool_pages * self.page_size
+                * self.num_heads * self.head_dim * dtype_size)
+
+    def __repr__(self):
+        return (f"PageGeometry(page_size={self.page_size}, "
+                f"pool_pages={self.pool_pages}, "
+                f"max_context={self.max_context}, "
+                f"pages_per_seq={self.pages_per_seq}, "
+                f"layers={self.num_layers}, heads={self.num_heads}, "
+                f"head_dim={self.head_dim})")
+
+
+class PageAllocator:
+    """Free-list page allocator with per-sequence block tables.
+
+    NOT thread-safe by itself — the decode engine mutates it only from
+    its step loop (one writer); readers go through :meth:`stats`, which
+    callers take under the engine's condition.  All-or-nothing
+    semantics: an allocation that cannot be fully satisfied changes
+    nothing and returns False, so a half-admitted sequence can never
+    strand pages.
+    """
+
+    def __init__(self, geometry):
+        self.geometry = geometry
+        # LIFO free list: a just-freed page is reused first, which keeps
+        # the working set of hot pages small and makes block-table reuse
+        # after eviction directly observable (tests assert it)
+        self._free = list(range(geometry.pool_pages - 1, 0, -1))
+        self._pages = {}                # seq_id -> [page, ...]
+        self.peak_used = 0
+
+    # ------------------------------------------------------------ queries
+    @property
+    def free_pages(self):
+        return len(self._free)
+
+    @property
+    def used_pages(self):
+        return self.geometry.usable_pages - len(self._free)
+
+    @property
+    def occupancy(self):
+        """Used fraction of the usable pool (0.0 - 1.0)."""
+        return self.used_pages / max(1, self.geometry.usable_pages)
+
+    def pages_of(self, seq_id):
+        return list(self._pages.get(seq_id, ()))
+
+    def can_allocate(self, n_pages):
+        return n_pages <= len(self._free)
+
+    # ---------------------------------------------------------- mutation
+    def allocate(self, seq_id, n_pages):
+        """Grow ``seq_id``'s allocation by ``n_pages`` pages (first call
+        creates it).  Returns True, or False (state unchanged) when the
+        free list cannot cover the request."""
+        if n_pages < 0:
+            raise MXNetError(f"allocate({seq_id!r}): negative page "
+                             f"count {n_pages}")
+        owned = self._pages.setdefault(seq_id, [])
+        if len(owned) + n_pages > self.geometry.pages_per_seq:
+            raise MXNetError(
+                f"allocate({seq_id!r}): {len(owned)} + {n_pages} pages "
+                f"exceed the block table "
+                f"({self.geometry.pages_per_seq} slots = max_context "
+                f"{self.geometry.max_context} / page_size "
+                f"{self.geometry.page_size})")
+        if n_pages > len(self._free):
+            if not owned:
+                del self._pages[seq_id]
+            return False
+        for _ in range(n_pages):
+            owned.append(self._free.pop())
+        self.peak_used = max(self.peak_used, self.used_pages)
+        return True
+
+    def release(self, seq_id):
+        """Return every page of ``seq_id`` to the free list.  Raises on
+        an unknown sequence or a corrupted (double-freed / duplicated)
+        page — the leak/double-free guard the scheduler tests lean on."""
+        pages = self._pages.pop(seq_id, None)
+        if pages is None:
+            raise MXNetError(
+                f"release({seq_id!r}): unknown sequence (double "
+                f"release, or never admitted)")
+        free = set(self._free)
+        for p in pages:
+            if p in free or not 1 <= p < self.geometry.pool_pages:
+                raise MXNetError(
+                    f"release({seq_id!r}): page {p} is already free or "
+                    f"out of range — allocator state corrupted")
+            free.add(p)
+            self._free.append(p)
+        return len(pages)
+
+    def block_table(self, seq_id):
+        """The (pages_per_seq,) int32 block table of ``seq_id`` —
+        allocated pages first, null page 0 in every unused slot (what
+        the compiled programs and the attention kernel consume)."""
+        import numpy as np
+        table = np.zeros((self.geometry.pages_per_seq,), np.int32)
+        pages = self._pages.get(seq_id, ())
+        table[:len(pages)] = pages
+        return table
+
+    def check_leaks(self):
+        """Assert the pool is fully accounted for: every usable page is
+        exactly once in the free list or in exactly one block table.
+        Cheap enough to run every test step; returns the live page
+        count."""
+        seen = {}
+        for sid, pages in self._pages.items():
+            for p in pages:
+                if p in seen:
+                    raise MXNetError(
+                        f"page {p} owned by both {seen[p]!r} and "
+                        f"{sid!r}")
+                seen[p] = sid
+        free = set(self._free)
+        if len(free) != len(self._free):
+            raise MXNetError("free list holds duplicate pages")
+        overlap = free.intersection(seen)
+        if overlap:
+            raise MXNetError(
+                f"pages {sorted(overlap)} are both free and allocated")
+        total = len(free) + len(seen)
+        if total != self.geometry.usable_pages:
+            raise MXNetError(
+                f"page leak: {len(seen)} allocated + {len(free)} free "
+                f"!= {self.geometry.usable_pages} usable pages")
+        return len(seen)
+
+    def stats(self):
+        return {"used_pages": self.used_pages,
+                "free_pages": self.free_pages,
+                "peak_used_pages": self.peak_used,
+                "occupancy": self.occupancy,
+                "sequences": len(self._pages)}
+
+
+class DeviceKVPool:
+    """The preallocated device-side page pools.
+
+    One K and one V array of shape
+    ``(num_layers, pool_pages, page_size, num_heads, head_dim)``,
+    allocated ONCE at engine start.  Compiled prefill/decode programs
+    take both as inputs (donated, so XLA updates them in place) and
+    return the new arrays; :meth:`swap` rebinds after each step.  Page 0
+    is the null page — writes routed there (padded prefill tail,
+    inactive decode slots) land in memory nothing ever attends to.
+    """
+
+    def __init__(self, geometry, dtype=None):
+        import jax
+        import jax.numpy as jnp
+        self.geometry = geometry
+        self.dtype = dtype or jnp.float32
+        g = geometry
+        shape = (g.num_layers, g.pool_pages, g.page_size, g.num_heads,
+                 g.head_dim)
+        # device_put COMMITS the arrays: compiled steps return committed
+        # outputs, and a jit cache keys on placement — an uncommitted
+        # initial pool would make the very first call of each program
+        # family compile twice (once for each placement)
+        dev = jax.devices()[0]
+        self.k_pages = jax.device_put(jnp.zeros(shape, self.dtype), dev)
+        self.v_pages = jax.device_put(jnp.zeros(shape, self.dtype), dev)
+
+    def swap(self, k_pages, v_pages):
+        """Adopt the pool arrays a compiled step returned (the donated
+        buffers' successors)."""
+        self.k_pages = k_pages
+        self.v_pages = v_pages
+
+    @property
+    def nbytes(self):
+        return int(self.k_pages.nbytes) + int(self.v_pages.nbytes)

@@ -46,14 +46,21 @@ class ModelEntry:
     """
 
     def __init__(self, name, version, kind, signature, dynamic_batch,
-                 make_program, fixed_batch=None):
+                 make_program, fixed_batch=None, decode_model=None,
+                 decode_meta=None):
         self.name = name
         self.version = version
-        self.kind = kind                    # "stablehlo" | "block" | "function"
+        # "stablehlo" | "block" | "function" | "decoder"
+        self.kind = kind
         self.signature = signature
         self.dynamic_batch = bool(dynamic_batch)
         self.fixed_batch = fixed_batch      # exported batch when static
         self.make_program = make_program
+        # autoregressive entries: the decode-model object generate()
+        # drives (serving/decode.py protocol), and/or the manifest's
+        # decode-capable metadata block (artifact exports)
+        self.decode_model = decode_model
+        self.decode_meta = decode_meta
         self.uid = next(_UID)               # distinct across re-registrations
 
     @property
@@ -203,7 +210,8 @@ class ModelRepository:
             return jax.jit(lambda *xs: _as_tuple(exported.call(*xs)))
 
         entry = ModelEntry(name, version, "stablehlo", sig, dynamic,
-                           make_program, fixed_batch=fixed)
+                           make_program, fixed_batch=fixed,
+                           decode_meta=manifest.get("decode"))
         return self._register(entry, activate)
 
     def add_block(self, name, block, *example_inputs, version=None,
@@ -235,6 +243,35 @@ class ModelRepository:
                            make_program,
                            fixed_batch=None if dynamic_batch
                            else nd_inputs[0].shape[0])
+        return self._register(entry, activate)
+
+    def add_decoder(self, name, model, version=None, activate=True,
+                    attention_impl=None, eos_id=None):
+        """Register an autoregressive decode model served through
+        ``ModelServer.generate()`` (docs/serving.md §6).
+
+        ``model`` is either a
+        :class:`~mxnet_tpu.models.transformer_blocks.TransformerDecoderLM`
+        (wrapped in the compiled paged-KV adapter) or any object already
+        implementing the decode-model protocol
+        (``prefill``/``decode_step`` — fake/cheap models in tests).
+        Decoder entries answer ``generate()`` only; ``predict()``
+        rejects them with a pointer here.  Versioning/hot-swap semantics
+        match every other entry kind: the decode engine resolves its
+        entry at creation, requests admitted after a ``swap`` see the
+        new version's engine."""
+        from .decode import as_decode_model
+        adapter = as_decode_model(model, attention_impl=attention_impl,
+                                  eos_id=eos_id)
+        sig = [{"shape": [None], "dtype": "int32"}]
+
+        def make_program(bucket_rows):
+            raise MXNetError(
+                f"model {name!r} is a decoder entry — it serves "
+                f"autoregressive generate(), not predict()")
+
+        entry = ModelEntry(name, version, "decoder", sig, False,
+                           make_program, decode_model=adapter)
         return self._register(entry, activate)
 
     def add_function(self, name, fn, signature, version=None,

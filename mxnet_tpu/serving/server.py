@@ -88,6 +88,12 @@ class ModelServer:
         # double as race tests in CI's sanity_lint job)
         self._cond = engine.make_condition("serving.ModelServer._cond")
         self._queues = OrderedDict()    # entry.uid -> (entry, deque)
+        self._decoders = OrderedDict()  # entry.uid -> DecodeEngine
+        # serializes decode-engine CONSTRUCTION (KV-pool allocation +
+        # adapter bind) without holding _cond: two first-generate()
+        # racers must not both run setup() on one shared adapter
+        self._decoder_build = engine.make_lock(
+            "serving.ModelServer._decoder_build")
         self._depth = 0
         self._inflight = 0              # admitted, popped, not finished
         self._started = False
@@ -114,7 +120,7 @@ class ModelServer:
             # acquisition order is one-way (the repository never calls
             # back into the server)
             if not self._evict_subscribed:
-                self.repository.subscribe_unload(self.batcher.evict)
+                self.repository.subscribe_unload(self._on_unload)
                 self._evict_subscribed = True
         with self._cond:
             self._workers = [
@@ -157,13 +163,40 @@ class ModelServer:
         alive = [t for t in self._workers if t.is_alive()]
         if alive:
             return False
+        # decode engines go down with the worker pool; outstanding
+        # generate() calls fail with finish_reason="stopped"
+        with self._cond:
+            decoders = dict(self._decoders)
+            self._decoders.clear()
+        stuck = {}
+        for uid, eng in decoders.items():
+            if not eng.stop(timeout=None if deadline is None
+                            else max(0.0, deadline - time.monotonic())):
+                stuck[uid] = eng
+        if stuck:
+            # same contract as a stuck worker: keep the references so a
+            # later stop() can finish the job, stay in the stopping
+            # state, report failure — never leak a live step loop
+            with self._cond:
+                self._decoders.update(stuck)
+            return False
         with self._cond:
             self._started = False
             self._workers = []
             if self._evict_subscribed:
-                self.repository.unsubscribe_unload(self.batcher.evict)
+                self.repository.unsubscribe_unload(self._on_unload)
                 self._evict_subscribed = False
         return True
+
+    def _on_unload(self, entry):
+        """Repository unload hook: drop the batcher's cached programs
+        AND stop/drop the entry's decode engine (its KV pool must not
+        pin device memory for a retired version)."""
+        self.batcher.evict(entry)
+        with self._cond:
+            eng = self._decoders.pop(entry.uid, None)
+        if eng is not None:
+            eng.stop()
 
     def __enter__(self):
         return self.start()
@@ -185,6 +218,10 @@ class ModelServer:
         """
         from .. import deploy
         entry = self.repository.get(model)
+        if entry.decode_model is not None:
+            raise MXNetError(
+                f"serving predict({model!r}): decoder entry — "
+                f"autoregressive models serve through generate()")
         np_inputs = tuple(
             np.asarray(x.asnumpy()) if hasattr(x, "asnumpy")
             else np.asarray(x) for x in inputs)
@@ -257,6 +294,103 @@ class ModelServer:
         if req.error is not None:
             raise req.error
         return req.result if len(req.result) > 1 else req.result[0]
+
+    # ------------------------------------------------------------- generate
+    def _decoder_engine(self, entry):
+        """The (lazily created) decode engine of a decoder entry.  One
+        engine per entry uid: a hot-swap makes later generate() calls
+        resolve the new version's entry and spin up ITS engine, while
+        in-flight sequences finish on the old one (the predict-path
+        admission contract applied to engines)."""
+        from .decode import DecodeEngine
+        not_accepting = MXNetError(
+            "ModelServer is not accepting requests "
+            "(not started, or shutting down)")
+        with self._cond:
+            if not self._started or self._stopping:
+                raise not_accepting
+            eng = self._decoders.get(entry.uid)
+        if eng is None:
+            # engine construction is HEAVY (device KV-pool allocation +
+            # adapter bind) — build under the dedicated build lock, NOT
+            # _cond, so predict() admissions never stall behind a first
+            # generate() and two racers cannot both run setup() on the
+            # shared adapter (a losing racer's setup would zero the
+            # winner's live KV pool)
+            with self._decoder_build:
+                with self._cond:
+                    if not self._started or self._stopping:
+                        raise not_accepting
+                    eng = self._decoders.get(entry.uid)
+                if eng is None:
+                    fresh = DecodeEngine(entry.decode_model, self.config,
+                                         model_name=entry.name)
+                    reject = False
+                    with self._cond:
+                        if not self._started or self._stopping:
+                            reject = True
+                        else:
+                            self._decoders[entry.uid] = fresh
+                            eng = fresh
+                    if reject:
+                        fresh.stop()        # unbinds the adapter again
+                        raise not_accepting
+        eng.start()
+        # close the start-vs-stop race: a concurrent stop()/unload that
+        # cleared the map between our insert and start() has already
+        # "stopped" an engine with no thread — the one we just started
+        # would leak; stop it and reject
+        with self._cond:
+            tracked = self._decoders.get(entry.uid) is eng
+        if not tracked:
+            eng.stop()
+            raise not_accepting
+        return eng
+
+    def generate(self, model, prompt, *, max_new_tokens=None,
+                 eos_id=None, on_token=None, timeout=None):
+        """Autoregressive generation through the continuous-batching
+        decode engine (docs/serving.md §6).
+
+        ``prompt`` is a 1-D int sequence; returns the generated ids as
+        int32 (EOS included when hit).  ``on_token(token_id)`` streams
+        every sampled token from the engine thread as it lands —
+        time-to-first-token is one prefill away regardless of how many
+        other sequences are mid-generation, because the engine admits
+        new sequences every STEP, not every request.  Concurrent
+        ``generate()`` calls of mixed lengths share the fixed-shape
+        decode batch; a short request admitted mid-flight finishes
+        ahead of a longer one admitted earlier.
+        """
+        entry = self.repository.get(model)
+        if entry.decode_model is None:
+            extra = ""
+            if entry.decode_meta is not None:
+                extra = (" (the artifact manifest carries decode "
+                         "metadata, but artifact entries serve "
+                         "predict() only — register the block with "
+                         "add_decoder for in-process generation)")
+            raise MXNetError(
+                f"serving generate({model!r}): not a decoder entry — "
+                f"register the model with "
+                f"ModelRepository.add_decoder{extra}")
+        eng = self._decoder_engine(entry)
+        seq = eng.submit(prompt, max_new_tokens=max_new_tokens,
+                         eos_id=eos_id, on_token=on_token)
+        return eng.result(seq, timeout=timeout)
+
+    def decode_stats(self, model):
+        """The decode engine's scheduler/pool counters for ``model``
+        (steps, generated tokens, admissions/evictions, KV-pool
+        occupancy, compiled programs vs bound)."""
+        entry = self.repository.get(model)
+        with self._cond:
+            eng = self._decoders.get(entry.uid)
+        if eng is None:
+            raise MXNetError(
+                f"decode_stats({model!r}): no decode engine yet "
+                f"(generate() creates it lazily)")
+        return eng.stats()
 
     # -------------------------------------------------------------- prewarm
     def prewarm(self, model, version=None):

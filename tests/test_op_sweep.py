@@ -729,6 +729,10 @@ ORACLES.update({
         _np_dense_selfatt(qkv, heads, vlen),
     "_contrib_flash_selfatt_nomask": lambda qkv, heads=1, **k:
         _np_dense_selfatt(qkv, heads, None),
+    # decode-path paged attention vs a per-sequence gather + dense
+    # softmax (block-table indirection materialized in numpy)
+    "_contrib_ragged_paged_attention": lambda q, kp, vp, bt, lens:
+        _np_paged_attention(q, kp, vp, bt, lens),
     # int8 quantization formulas (reference quantize.cc symmetric scale)
     "_contrib_quantize": lambda x, mn, mx, out_type="int8":
         np.clip(np.round(x / (max(abs(mn[0]), abs(mx[0])) / 127.0)),
@@ -864,6 +868,26 @@ def _np_dense_selfatt(qkv, heads, vlen):
     out = np.einsum("bqk,bkd->bqd", p, v)
     return out.reshape(B, heads, L, D).transpose(2, 0, 1, 3).reshape(
         L, B, heads * D)
+
+
+def _np_paged_attention(q, k_pages, v_pages, block_tables, lens):
+    """Gather each sequence's pages through its block table, then dense
+    masked softmax attention (the ragged-paged-attention contract:
+    context_lens == 0 slots yield zeros)."""
+    B, H, D = q.shape
+    bt = block_tables.astype(np.int64)
+    out = np.zeros_like(q)
+    for b in range(B):
+        L = int(lens[b])
+        if L == 0:
+            continue
+        k = k_pages[bt[b]].reshape(-1, H, D)[:L]
+        v = v_pages[bt[b]].reshape(-1, H, D)[:L]
+        s = np.einsum("hd,thd->ht", q[b], k) / np.sqrt(D)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        out[b] = np.einsum("ht,thd->hd", p, v)
+    return out
 
 
 def _np_bilinear_sampler(data, grid):
@@ -1068,6 +1092,13 @@ SPECS = {
         inputs=lambda r: [_f32(r, 4, 2, 12),
                           np.array([3.5, 4.0], np.float32)],
         kwargs=dict(heads=2), wrt=[0], rtol=3e-2, atol=3e-3),
+    # q (B,H,D); K/V page pools (pages, page_size, H, D); block tables
+    # (B, pages_per_seq) and context lens as x.5 floats (cast to int32
+    # inside); forward-only (decode-path op, differentiable=False)
+    "_contrib_ragged_paged_attention": dict(
+        inputs=lambda r: [_f32(r, 2, 2, 4), _f32(r, 5, 2, 2, 4),
+                          _f32(r, 5, 2, 2, 4), _idx(r, 5, 2, 3),
+                          np.array([4.5, 1.5], np.float32)]),
     "_contrib_flash_selfatt_nomask": dict(
         inputs=lambda r: [_f32(r, 4, 2, 12)], kwargs=dict(heads=2),
         rtol=3e-2, atol=3e-3),

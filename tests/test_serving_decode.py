@@ -1,0 +1,501 @@
+"""Decode engine: token-level continuous batching + paged KV cache
+(docs/serving.md §6).
+
+Scheduler invariants run on fake numpy models — ZERO XLA compiles — so
+admit/evict, page alloc/free, and block-table reuse are tested at step
+granularity.  The end-to-end class at the bottom drives a tiny real
+``TransformerDecoderLM`` through ``ModelServer.generate()`` (a handful
+of tiny compiles) and asserts the program-count bound via the jit
+cache-size helper.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, runtime_metrics as rm, serving
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.serving.decode import DecodeEngine
+from mxnet_tpu.serving.kv_cache import PageAllocator, PageGeometry
+
+
+@pytest.fixture(autouse=True)
+def _metrics_on():
+    rm.reset()
+    rm.enable()
+    yield
+    rm.disable()
+    rm.reset()
+
+
+def _cfg(**kw):
+    kw.setdefault("decode_page_size", 4)
+    kw.setdefault("decode_pool_pages", 9)      # 8 usable
+    kw.setdefault("decode_max_batch", 2)
+    kw.setdefault("decode_max_new_tokens", 4)
+    return serving.ServingConfig(**kw)
+
+
+class FakeModel:
+    """Decode-model protocol in plain numpy: next token = (last + 1)
+    mod vocab; prefill proposes the prompt's last token.  Asserts the
+    engine's inactive-slot contract on every step."""
+
+    vocab_size = 16
+    max_context = 32
+
+    def __init__(self, eos_id=None):
+        self.prefills = 0
+        self.steps = 0
+        self.step_batches = []          # active slot count per step
+        if eos_id is not None:
+            self.eos_id = eos_id
+
+    def prefill(self, tokens, length, block_table):
+        self.prefills += 1
+        assert tokens.ndim == 2 and tokens.shape[0] == 1
+        assert tokens.shape[1] >= int(length)
+        logits = np.zeros((self.vocab_size,), np.float32)
+        logits[int(tokens[0, int(length) - 1]) % self.vocab_size] = 1.0
+        return logits
+
+    def decode_step(self, tokens, positions, block_tables):
+        self.steps += 1
+        active = positions > 0
+        # inactive slots carry zeros and an all-null block table
+        assert np.all(tokens[~active] == 0)
+        assert np.all(block_tables[~active] == 0)
+        self.step_batches.append(int(active.sum()))
+        logits = np.zeros((tokens.shape[0], self.vocab_size), np.float32)
+        logits[np.arange(tokens.shape[0]),
+               (tokens + 1) % self.vocab_size] = 1.0
+        return logits
+
+
+def _drive(eng, seqs, limit=64):
+    """Step until every sequence finished (bounded)."""
+    n = 0
+    while not all(s.event.is_set() for s in seqs):
+        eng.step()
+        n += 1
+        assert n < limit, "scheduler did not converge"
+    return n
+
+
+def _engine(model=None, **cfg_kw):
+    eng = DecodeEngine(model or FakeModel(), _cfg(**cfg_kw),
+                       model_name="fake")
+    eng._started = True                 # manual stepping, no loop thread
+    return eng
+
+
+# --------------------------------------------------------------- allocator
+class TestPageAllocator:
+    def _geom(self, **kw):
+        kw.setdefault("page_size", 4)
+        kw.setdefault("pool_pages", 9)
+        kw.setdefault("max_context", 32)
+        return PageGeometry(num_layers=1, num_heads=1, head_dim=1, **kw)
+
+    def test_alloc_release_roundtrip(self):
+        a = PageAllocator(self._geom())
+        assert a.allocate("s1", 3)
+        assert a.used_pages == 3 and a.free_pages == 5
+        assert 0 not in a.pages_of("s1")            # null page reserved
+        assert a.release("s1") == 3
+        assert a.used_pages == 0
+        a.check_leaks()
+
+    def test_all_or_nothing(self):
+        a = PageAllocator(self._geom(max_context=64))   # 16-slot tables
+        assert not a.allocate("big", 9)             # > 8 usable
+        assert a.used_pages == 0                    # nothing stranded
+        assert a.allocate("s1", 8)
+        assert not a.allocate("s2", 1)
+        a.check_leaks()
+
+    def test_double_release_raises(self):
+        a = PageAllocator(self._geom())
+        a.allocate("s1", 2)
+        a.release("s1")
+        with pytest.raises(MXNetError, match="unknown sequence"):
+            a.release("s1")
+
+    def test_corrupted_state_detected(self):
+        a = PageAllocator(self._geom())
+        a.allocate("s1", 2)
+        a._free.append(a.pages_of("s1")[0])         # simulate corruption
+        with pytest.raises(MXNetError, match="already free"):
+            a.release("s1")
+
+    def test_block_table_width_enforced(self):
+        g = self._geom(max_context=8)               # 2 slots of 4
+        a = PageAllocator(PageGeometry(4, 9, 8, 1, 1, 1))
+        assert g.pages_per_seq == 2
+        with pytest.raises(MXNetError, match="exceed the block table"):
+            a.allocate("s1", 3)
+
+    def test_block_table_null_fill_and_reuse_after_eviction(self):
+        a = PageAllocator(self._geom())
+        a.allocate("s1", 2)
+        first = a.pages_of("s1")
+        table = a.block_table("s1")
+        assert list(table[:2]) == first and np.all(table[2:] == 0)
+        a.release("s1")
+        a.allocate("s2", 2)
+        # LIFO free list: the evicted pages back the new sequence
+        assert set(a.pages_of("s2")) == set(first)
+        a.check_leaks()
+
+    def test_random_arrival_finish_orders_never_leak(self):
+        rng = np.random.RandomState(0)
+        a = PageAllocator(self._geom(pool_pages=17, max_context=64))
+        live, next_id = {}, 0
+        for _ in range(300):
+            if live and rng.rand() < 0.45:
+                sid = rng.choice(sorted(live))
+                a.release(sid)
+                del live[sid]
+            else:
+                n = int(rng.randint(1, 5))
+                sid = next_id = next_id + 1
+                if a.allocate(sid, n):
+                    live[sid] = n
+            a.check_leaks()
+            assert a.used_pages == sum(live.values())
+        for sid in sorted(live):
+            a.release(sid)
+        a.check_leaks()
+        assert a.free_pages == a.geometry.usable_pages
+
+    def test_geometry_validation(self):
+        with pytest.raises(MXNetError, match="null page"):
+            PageGeometry(4, 1, 32, 1, 1, 1)
+        with pytest.raises(MXNetError, match="page_size"):
+            PageGeometry(0, 8, 32, 1, 1, 1)
+        g = self._geom()
+        assert g.pages_for(0) == 0
+        assert g.pages_for(1) == 1
+        assert g.pages_for(4) == 1
+        assert g.pages_for(5) == 2
+
+
+# --------------------------------------------------------------- scheduler
+class TestSchedulerInvariants:
+    def test_greedy_chain_and_prefill_token(self):
+        eng = _engine()
+        s = eng.submit([1, 2, 3], max_new_tokens=3)
+        _drive(eng, [s])
+        # prefill proposes last prompt token, then +1 per decode step
+        assert s.tokens == [3, 4, 5]
+        assert s.finish_reason == "length"
+        eng.allocator.check_leaks()
+
+    def test_admit_and_evict_every_step(self):
+        """Slot freed by an eviction is refilled on the NEXT step, not
+        after the whole batch drains (token-level, not request-level).
+        A step is admit -> prefill -> one decode step, so a 2-token
+        request finishes WITHIN its admission step."""
+        eng = _engine()                 # 2 slots
+        long = eng.submit([1], max_new_tokens=6)
+        short = eng.submit([2], max_new_tokens=2)
+        third = eng.submit([3], max_new_tokens=4)
+        eng.step()                      # admits long+short; third waits
+        # short got prefill token + one decode token = done this step
+        assert short.event.is_set()
+        st = eng.stats()
+        assert st["running"] == 1 and st["waiting"] == 1
+        eng.step()                      # third admitted into freed slot
+        st = eng.stats()
+        assert st["running"] == 2 and st["waiting"] == 0
+        assert not long.event.is_set()
+        _drive(eng, [long, short, third])
+        eng.allocator.check_leaks()
+        assert eng.allocator.used_pages == 0
+
+    def test_short_request_admitted_mid_flight_finishes_first(self):
+        """The ISSUE-7 interleave criterion at engine level."""
+        eng = _engine()
+        long = eng.submit([1], max_new_tokens=8,
+                          on_token=lambda t: None)
+        eng.step()                      # long is mid-flight
+        short = eng.submit([2], max_new_tokens=2)
+        _drive(eng, [short])
+        assert short.event.is_set() and not long.event.is_set()
+        _drive(eng, [long])
+        eng.allocator.check_leaks()
+
+    def test_admission_gates_on_page_reservation(self):
+        # 8 usable pages; each request needs ceil((1+15)/4) = 4 pages
+        model = FakeModel()
+        model.max_context = 16
+        eng = _engine(model, decode_max_batch=4, decode_pool_pages=9)
+        a = eng.submit([1], max_new_tokens=15)
+        b = eng.submit([2], max_new_tokens=15)
+        c = eng.submit([3], max_new_tokens=15)
+        eng.step()
+        st = eng.stats()
+        # only two reservations fit even though a slot is free
+        assert st["running"] == 2 and st["waiting"] == 1
+        assert st["free_pages"] == 0
+        _drive(eng, [a, b, c], limit=64)
+        eng.allocator.check_leaks()
+        assert eng.allocator.free_pages == 8
+
+    def test_eos_evicts(self):
+        eng = _engine()
+        # chain 5 -> 6 -> 7(eos)
+        s = eng.submit([5], max_new_tokens=8, eos_id=7)
+        _drive(eng, [s])
+        assert s.tokens[-1] == 7 and s.finish_reason == "eos"
+        assert len(s.tokens) == 3
+        eng.allocator.check_leaks()
+
+    def test_streaming_callbacks_in_order(self):
+        eng = _engine()
+        got = []
+        s = eng.submit([1, 2], max_new_tokens=3, on_token=got.append)
+        _drive(eng, [s])
+        assert got == s.tokens == [2, 3, 4]
+
+    def test_callback_exception_does_not_kill_sequence(self):
+        eng = _engine()
+
+        def boom(tok):
+            raise RuntimeError("client went away")
+
+        s = eng.submit([1], max_new_tokens=2, on_token=boom)
+        _drive(eng, [s])
+        assert s.error is None and len(s.tokens) == 2
+
+    def test_submit_validation(self):
+        eng = _engine()
+        with pytest.raises(MXNetError, match=">= 1 token"):
+            eng.submit([], max_new_tokens=2)
+        with pytest.raises(MXNetError, match="max_context"):
+            eng.submit([1] * 30, max_new_tokens=10)
+        with pytest.raises(MXNetError, match="max_new_tokens"):
+            eng.submit([1], max_new_tokens=0)
+
+    def test_waiting_queue_sheds_past_queue_depth(self):
+        eng = _engine(queue_depth=2, shed_watermark=2)
+        eng.submit([1], max_new_tokens=4)
+        eng.submit([2], max_new_tokens=4)
+        with pytest.raises(serving.ServerOverloadedError,
+                           match="queue_depth"):
+            eng.submit([3], max_new_tokens=4)
+        assert eng.stats()["shed"] == 1
+
+    def test_cancelled_waiting_pruned_even_with_full_batch(self):
+        """A timed-out waiting request is dropped on the next step even
+        when no slot frees — it must not occupy bounded queue space."""
+        eng = _engine(decode_max_batch=1)
+        running = eng.submit([1], max_new_tokens=8)
+        eng.step()                      # occupies the only slot
+        waiting = eng.submit([2], max_new_tokens=8)
+        with pytest.raises(MXNetError):
+            eng.result(waiting, timeout=0.01)   # cancels it
+        before = rm.SERVING_DECODE_EVICTIONS.value(model="fake")
+        eng.step()                      # batch still full, yet pruned
+        assert waiting.event.is_set()
+        assert waiting.finish_reason == "cancelled"
+        assert eng.stats()["waiting"] == 0
+        # never admitted -> not an eviction (pages were never held)
+        assert rm.SERVING_DECODE_EVICTIONS.value(model="fake") == before
+        _drive(eng, [running])
+        eng.allocator.check_leaks()
+
+    def test_result_timeout_cancels_and_reclaims(self):
+        eng = _engine()
+        s = eng.submit([1], max_new_tokens=8)
+        eng.step()
+        assert eng.allocator.used_pages > 0
+        with pytest.raises(MXNetError, match="cancelled"):
+            eng.result(s, timeout=0.01)
+        eng.step()                      # eviction happens on the step
+        assert s.finish_reason == "cancelled"
+        eng.allocator.check_leaks()
+        assert eng.allocator.used_pages == 0
+
+    def test_metrics_published(self):
+        eng = _engine()
+        s = eng.submit([1, 2], max_new_tokens=3)
+        _drive(eng, [s])
+        assert rm.SERVING_DECODE_TOKENS.value(model="fake") == 3
+        assert rm.SERVING_DECODE_EVICTIONS.value(model="fake") == 1
+        assert rm.SERVING_DECODE_TTFT_SECONDS.count(model="fake") == 1
+        assert rm.SERVING_DECODE_TOKEN_SECONDS.count(model="fake") == 2
+        assert "serving_decode_steps" in rm.dump_prometheus()
+
+    def test_threaded_engine_lifecycle(self):
+        """autostart path: background loop, concurrent submitters,
+        clean stop failing a straggler."""
+        model = FakeModel()
+        eng = DecodeEngine(model, _cfg(decode_max_batch=2),
+                           model_name="fake", autostart=True)
+        try:
+            outs = {}
+
+            def gen(i):
+                outs[i] = eng.generate([i + 1], max_new_tokens=2,
+                                       timeout=60)
+
+            ts = [threading.Thread(target=gen, args=(i,))
+                  for i in range(5)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(120)
+            assert len(outs) == 5
+            for i, toks in outs.items():
+                assert toks.tolist() == [(i + 1) % 16, (i + 2) % 16]
+            eng.allocator.check_leaks()
+            assert eng.allocator.used_pages == 0
+        finally:
+            assert eng.stop(timeout=30)
+        with pytest.raises(MXNetError, match="not accepting"):
+            eng.submit([1])
+
+    def test_stop_fails_outstanding(self):
+        eng = DecodeEngine(FakeModel(), _cfg(), model_name="fake",
+                           autostart=True)
+        # saturate so one request stays waiting, then stop immediately
+        seqs = [eng.submit([1], max_new_tokens=4) for _ in range(3)]
+        assert eng.stop(timeout=30)
+        for s in seqs:
+            assert s.event.is_set()
+            # each either finished legitimately or was failed by stop
+            assert s.finish_reason in ("length", "stopped")
+        eng.allocator.check_leaks()
+
+
+# ------------------------------------------------------------- end to end
+@pytest.fixture(scope="module")
+def tiny_lm_server():
+    mx.random.seed(7)
+    from mxnet_tpu.models.transformer_blocks import TransformerDecoderLM
+    lm = TransformerDecoderLM(13, units=8, hidden_size=16, num_layers=1,
+                              num_heads=2, max_length=16)
+    lm.initialize(mx.init.Xavier())
+    repo = serving.ModelRepository()
+    repo.add_decoder("lm", lm)
+    cfg = serving.ServingConfig(decode_page_size=4, decode_pool_pages=17,
+                                decode_max_batch=2,
+                                decode_max_new_tokens=4)
+    srv = serving.ModelServer(repo, cfg)
+    yield srv, lm
+    srv.stop()
+
+
+class TestGenerateEndToEnd:
+    def _ref_generate(self, lm, prompt, n):
+        toks = list(prompt)
+        for _ in range(n):
+            lg = lm(nd.NDArray(np.asarray([toks], np.int32))).asnumpy()
+            toks.append(int(np.argmax(lg[0, -1])))
+        return toks[len(prompt):]
+
+    def test_generate_matches_full_forward(self, tiny_lm_server):
+        srv, lm = tiny_lm_server
+        for prompt, n in (([1, 2, 3], 3), ([5], 2), ([2, 4], 3)):
+            got = srv.generate("lm", prompt, max_new_tokens=n,
+                               timeout=300).tolist()
+            assert got == self._ref_generate(lm, prompt, n)
+
+    def test_concurrent_mixed_lengths_bound_programs(self, tiny_lm_server):
+        """Program-count bound under a mixed-length run, via the jit
+        cache-size helper (delta around the run — the pjit cache is per
+        underlying function, and this adapter owns a fresh one)."""
+        srv, lm = tiny_lm_server
+        outs = {}
+
+        def gen(i):
+            prompt = list(range(1, 2 + i % 4))
+            outs[i] = (prompt,
+                       srv.generate("lm", prompt,
+                                    max_new_tokens=2 + i % 3,
+                                    timeout=300))
+
+        ts = [threading.Thread(target=gen, args=(i,)) for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(300)
+        assert len(outs) == 8
+        for i, (prompt, toks) in outs.items():
+            assert toks.tolist() == self._ref_generate(
+                lm, prompt, 2 + i % 3)
+        st = srv.decode_stats("lm")
+        # <= prefill buckets + 1 decode program, from the pjit caches
+        assert st["programs"] <= st["program_bound"], st
+        from mxnet_tpu.serving.batcher import bucket_set
+        adapter = list(srv._decoders.values())[0].model
+        assert adapter._decode_jit._cache_size() == 1
+        assert adapter._prefill_jit._cache_size() \
+            <= len(bucket_set(adapter.max_context))
+
+    def test_predict_and_generate_reject_wrong_kind(self, tiny_lm_server):
+        srv, _lm = tiny_lm_server
+        with pytest.raises(MXNetError, match="generate"):
+            srv.predict("lm", np.zeros((1, 4), np.int32))
+        srv.repository.add_function(
+            "plain", lambda x: x,
+            [{"shape": [None, 1], "dtype": "float32"}])
+        with pytest.raises(MXNetError, match="add_decoder"):
+            srv.generate("plain", [1, 2])
+
+    def test_adapter_binds_one_live_engine(self, tiny_lm_server):
+        """A second engine on the SAME adapter must be rejected (its
+        setup would zero the live engine's KV pool), and a
+        stop->start rebind keeps the compiled-program caches."""
+        srv, _lm = tiny_lm_server
+        srv.generate("lm", [1], max_new_tokens=2, timeout=300)
+        eng = list(srv._decoders.values())[0]
+        adapter = eng.model
+        with pytest.raises(MXNetError, match="one decoder entry serves"):
+            serving.DecodeEngine(adapter, srv.config, model_name="dup")
+        programs = adapter.programs()
+        assert eng.stop(timeout=60)
+        assert adapter.pool is None            # pool released
+        eng.start()                            # rebind, programs survive
+        assert adapter.pool is not None
+        out = srv.generate("lm", [1], max_new_tokens=2, timeout=300)
+        assert adapter.programs() == programs  # zero recompiles
+        assert len(out) == 2
+
+    def test_paged_forward_honors_layer_norm_eps(self):
+        """Non-default layer_norm_eps must reach the decode-mode
+        forward — prefill logits match the training forward exactly."""
+        import jax.numpy as jnp
+
+        from mxnet_tpu.models.transformer_blocks import (
+            TransformerDecoderLM, paged_lm_params, paged_prefill)
+        mx.random.seed(3)
+        lm = TransformerDecoderLM(11, units=8, hidden_size=16,
+                                  num_layers=1, num_heads=2,
+                                  max_length=8, layer_norm_eps=1e-1)
+        lm.initialize(mx.init.Xavier())
+        toks = np.array([[1, 2, 3]], np.int32)
+        want = lm(nd.NDArray(toks)).asnumpy()[0, -1]
+        params = paged_lm_params(lm)
+        kp = jnp.zeros((1, 3, 4, 2, 4), jnp.float32)
+        bt = np.array([1, 2], np.int32)
+        got, _, _ = paged_prefill(
+            params, jnp.asarray(toks), jnp.int32(3), jnp.asarray(bt),
+            kp, kp, num_heads=2, page_size=4, layer_norm_eps=lm._eps)
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-4)
+        # and the default-eps path would NOT match (the eps matters)
+        bad, _, _ = paged_prefill(
+            params, jnp.asarray(toks), jnp.int32(3), jnp.asarray(bt),
+            kp, kp, num_heads=2, page_size=4)
+        assert not np.allclose(np.asarray(bad), want, atol=1e-4)
+
+    def test_ttft_histogram_recorded(self, tiny_lm_server):
+        srv, _lm = tiny_lm_server
+        rm.reset()
+        srv.generate("lm", [1, 2], max_new_tokens=2, timeout=300)
+        assert rm.SERVING_DECODE_TTFT_SECONDS.count(model="lm") == 1
+        p99 = rm.SERVING_DECODE_TTFT_SECONDS.quantile(0.99, model="lm")
+        assert np.isfinite(p99) and p99 > 0
