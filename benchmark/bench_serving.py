@@ -58,6 +58,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 import mxnet_tpu as mx                                    # noqa: E402
 from mxnet_tpu import compile_cache                       # noqa: E402
 from mxnet_tpu import nd, runtime_metrics as rm, serving  # noqa: E402
+from mxnet_tpu import tracing                             # noqa: E402
 from mxnet_tpu.gluon import nn                            # noqa: E402
 
 
@@ -71,11 +72,15 @@ def build_lenet():
 
 
 def run(requests, threads, max_batch, latency_us, workdir, smoke,
-        cache_dir=None, shed_phase=True):
+        cache_dir=None, shed_phase=True, trace_out=None):
     if cache_dir:
         os.environ["MXNET_COMPILE_CACHE_DIR"] = cache_dir
     mx.random.seed(42)
     rm.enable()
+    # the bench runs fully traced: every request gets a span timeline,
+    # and the p99's exemplar trace is dumped (chrome-trace) next to the
+    # BENCH json so a tail regression ships with its own evidence
+    tracing.enable(sample=1.0)
     net = build_lenet()
     net.initialize(mx.init.Xavier())
     net.hybridize(static_alloc=True)
@@ -214,6 +219,17 @@ def run(requests, threads, max_batch, latency_us, workdir, smoke,
     done = per_thread * threads
     p50 = rm.SERVING_REQUEST_SECONDS.quantile(0.50, model="lenet")
     p99 = rm.SERVING_REQUEST_SECONDS.quantile(0.99, model="lenet")
+    # exemplar workflow (docs/observability.md): p99 -> trace id ->
+    # chrome-trace file next to the BENCH json
+    p99_trace_id = rm.SERVING_REQUEST_SECONDS.exemplar_for_quantile(
+        0.99, model="lenet")
+    p99_trace = tracing.TRACER.find(p99_trace_id) \
+        if p99_trace_id else None
+    trace_dump = None
+    if p99_trace is not None:
+        trace_dump = trace_out or os.path.join(workdir,
+                                               "serving_p99_trace.json")
+        tracing.dump_chrome_trace(trace_dump, p99_trace)
     bound = int(math.ceil(math.log2(max_batch))) + 1
     result = {
         "metric": "serving.throughput",
@@ -245,6 +261,9 @@ def run(requests, threads, max_batch, latency_us, workdir, smoke,
         "compile_cache_hits": cache1["hits"] - cache0["hits"],
         "compile_cache_misses": cache1["misses"] - cache0["misses"],
         "compile_cache_dir": cache_dir,
+        # the trace behind the reported p99 (exemplar workflow)
+        "p99_exemplar_trace": p99_trace_id,
+        "p99_trace_dump": trace_dump,
     }
     if smoke:
         assert not errors, errors[:3]
@@ -255,6 +274,18 @@ def run(requests, threads, max_batch, latency_us, workdir, smoke,
         assert np.isfinite(p99) and p99 > 0, "p99 not recorded"
         assert sheds > 0, "load shedding never triggered"
         assert "serving_request_seconds" in rm.dump_prometheus()
+        # exemplar workflow end to end: the p99 resolves to a trace
+        # that is still in the flight-recorder ring, and its
+        # chrome-trace dump parses with the request span chain inside
+        assert p99_trace_id, "p99 exemplar not recorded"
+        assert p99_trace is not None, \
+            f"p99 exemplar trace {p99_trace_id} evicted from the ring"
+        names = {s["name"] for s in p99_trace["spans"]}
+        assert {"serving.predict", "serving.queue_wait",
+                "serving.batch"} <= names, names
+        with open(trace_dump) as f:
+            events = json.load(f)["traceEvents"]
+        assert any(e.get("ph") == "X" for e in events), trace_dump
     return result
 
 
@@ -265,6 +296,7 @@ def run_decode(args):
     occupancy."""
     mx.random.seed(7)
     rm.enable()
+    tracing.enable(sample=1.0)
     from mxnet_tpu.models.transformer_blocks import TransformerDecoderLM
     lm = TransformerDecoderLM(32, units=16, hidden_size=32, num_layers=2,
                               num_heads=2, max_length=32)
@@ -385,6 +417,21 @@ def run_decode(args):
         assert np.isfinite(result["ttft_p99_ms"])
         assert rm.SERVING_DECODE_TTFT_SECONDS.count(model="lm") == n_req
         assert "serving_decode_tokens" in rm.dump_prometheus()
+        # ISSUE-8: a traced generate() must contain a coherent
+        # prefill -> decode-step span chain (same trace, parent links
+        # resolving inside it)
+        chained = None
+        for tr in tracing.TRACER.traces():
+            names = {s["name"] for s in tr["spans"]}
+            if {"decode.prefill", "decode.step"} <= names:
+                chained = tr
+                break
+        assert chained is not None, \
+            "no trace holds a prefill -> decode-step span chain"
+        ids = {s["span_id"] for s in chained["spans"]}
+        for s in chained["spans"]:
+            assert s["trace_id"] == chained["trace_id"], s
+            assert s["parent_id"] is None or s["parent_id"] in ids, s
     return result
 
 
@@ -475,6 +522,12 @@ def main():
     ap.add_argument("--latency-us", type=int,
                     default=int(os.environ.get(
                         "BENCH_SERVING_LATENCY_US", 2000)))
+    ap.add_argument("--trace-out",
+                    default=os.environ.get("BENCH_SERVING_TRACE_OUT"),
+                    help="where to write the p99 exemplar's "
+                         "chrome-trace (default: next to the bench "
+                         "workdir artifacts; set this to place it "
+                         "next to the BENCH json)")
     args = ap.parse_args()
 
     if args.cache_roundtrip:
@@ -491,7 +544,8 @@ def main():
         return run(args.requests, args.threads, args.max_batch,
                    args.latency_us, workdir, args.smoke,
                    cache_dir=args.cache_dir,
-                   shed_phase=not args.roundtrip_child)
+                   shed_phase=not args.roundtrip_child,
+                   trace_out=args.trace_out)
 
     if args.workdir is not None:
         os.makedirs(args.workdir, exist_ok=True)

@@ -122,10 +122,16 @@ serving_smoke() {
     # programs stay <= prefill buckets + 1 across a 20-request
     # mixed-length run
     python benchmark/bench_serving.py --decode --smoke
-    # the decode scheduler + paged-attention kernel tests double as
-    # race tests under the concurrency sanitizer
+    # traced request round trip (ISSUE-8 acceptance): one predict +
+    # one generate with MXNET_TRACE on — asserts the span chains
+    # (admission -> queue wait -> batch/execute; admission -> queue
+    # wait -> prefill -> decode step -> evict), the p99 exemplar link,
+    # and that the flight-recorder dump is non-empty and parsable
+    python tools/diagnose.py --trace-smoke
+    # the decode scheduler + paged-attention kernel + tracer tests
+    # double as race tests under the concurrency sanitizer
     MXNET_ENGINE_SANITIZE=1 python -m pytest tests/test_serving_decode.py \
-        tests/test_pallas_paged.py -x -q
+        tests/test_pallas_paged.py tests/test_tracing.py -x -q
 }
 
 bench_cpu() {

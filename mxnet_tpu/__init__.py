@@ -43,7 +43,7 @@ import importlib as _importlib
 
 for _mod in ("initializer", "optimizer", "metric", "gluon", "io", "kvstore",
              "recordio", "callback", "profiler", "runtime_metrics",
-             "monitor", "util", "runtime",
+             "tracing", "monitor", "util", "runtime",
              "test_utils", "executor", "module", "image", "contrib",
              "parallel", "models", "np", "npx", "lr_scheduler", "operator",
              "library", "subgraph", "deploy", "serving"):

@@ -225,6 +225,25 @@ declare_env("MXNET_RUNTIME_METRICS_GRAD_NORM", "0",
             "1 = also sample the global L2 gradient norm into the "
             "trainer.grad_norm gauge after each step (forces a device "
             "sync per step to read gradients; NaN/blowup debugging aid).")
+declare_env("MXNET_TRACE", "0",
+            "1 = enable the request span tracer (mxnet_tpu.tracing): "
+            "every serving request gets a trace-id/span-id timeline "
+            "(admission, queue wait, batch assembly, execute, prefill, "
+            "decode steps, eviction) exportable as chrome-trace/JSONL, "
+            "with histogram exemplars linking Prometheus quantiles to "
+            "traces and the flight recorder dumping recent traces on "
+            "overload incidents. Off by default; the disabled path is "
+            "a single flag check per site and compiles zero additional "
+            "XLA programs.")
+declare_env("MXNET_TRACE_SAMPLE", 1.0,
+            "Head-based trace sampling rate in [0, 1]: the keep/drop "
+            "decision is made once per request at root-span start "
+            "(deterministic stride, so 0.25 keeps exactly every 4th "
+            "trace). 1.0 = trace everything (default).")
+declare_env("MXNET_TRACE_RING", 64,
+            "Completed traces retained by the flight-recorder ring "
+            "(mxnet_tpu.tracing) — always the most recent N; older "
+            "traces are evicted in completion order.")
 declare_env("MXNET_SERVING_MAX_BATCH", 8,
             "Serving: max rows coalesced into one dispatched batch "
             "(mxnet_tpu.serving.DynamicBatcher); shape buckets are "

@@ -25,6 +25,7 @@ import os
 
 import numpy as np
 
+from . import tracing as _tr
 from .base import MXNetError
 
 __all__ = ["export_stablehlo", "load_stablehlo", "load_manifest",
@@ -580,7 +581,11 @@ class StableHLOModel:
     def call(self, *arrays):
         self.validate(arrays)
         raw = tuple(a._data if hasattr(a, "_data") else a for a in arrays)
-        return self.exported.call(*raw)
+        # execute span under whatever request span the caller entered
+        # (no ambient trace -> no-op); the artifact path identifies
+        # WHICH program version a slow request actually ran
+        with _tr.span("stablehlo.execute", path=self.path):
+            return self.exported.call(*raw)
 
     __call__ = call
 

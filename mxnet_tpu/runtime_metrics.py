@@ -22,6 +22,7 @@ per-metric lock (uncontended in the common single-writer case).
 """
 from __future__ import annotations
 
+import logging
 import math
 import os
 import re
@@ -31,6 +32,8 @@ from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from .base import MXNetError, env_truthy
+
+_LOG = logging.getLogger("mxnet_tpu")
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
@@ -84,6 +87,15 @@ def _fmt(v: float) -> str:
     return str(iv) if v == iv else repr(float(v))
 
 
+# per-metric bound on distinct label-value tuples: a call site that
+# labels with a request-scoped value (user id, trace id, prompt...)
+# would otherwise grow the registry without bound.  Beyond the bound,
+# new label sets clamp into one overflow series and the metric warns
+# ONCE — memory stays bounded, the misuse stays visible.
+MAX_LABEL_SETS = 512
+_OVERFLOW_LABEL = "__overflow__"
+
+
 class _Metric:
     """Base: a named metric with optional label dimensions.
 
@@ -100,6 +112,30 @@ class _Metric:
         self.help = help
         self.labelnames = tuple(labelnames)
         self._lock = threading.Lock()
+        # per-instance so tests (and unusual metrics) can tighten it
+        self.max_label_sets = MAX_LABEL_SETS
+        self._cardinality_warned = False
+
+    def _store_key(self, store: dict,
+                   key: Tuple[str, ...]) -> Tuple[str, ...]:
+        """Cardinality guard — call with ``self._lock`` held.  A key
+        already tracked passes through; a NEW key past the bound clamps
+        to the shared overflow series (warning once), so per-request
+        label misuse cannot grow memory without bound."""
+        if not self.labelnames or key in store \
+                or len(store) < self.max_label_sets:
+            return key
+        if not self._cardinality_warned:
+            # mxlint: disable=lock-discipline (contract: callers hold
+            # self._lock — every call site is inside `with self._lock`)
+            self._cardinality_warned = True
+            _LOG.warning(
+                "metric %r exceeded %d distinct label sets — further "
+                "new label values clamp into %s (per-request values do "
+                "not belong in labels; put them in span tags via "
+                "mxnet_tpu.tracing instead)",
+                self.name, self.max_label_sets, _OVERFLOW_LABEL)
+        return (_OVERFLOW_LABEL,) * len(self.labelnames)
 
     def _key(self, labels: Dict[str, object]) -> Tuple[str, ...]:
         if not self.labelnames:
@@ -133,6 +169,7 @@ class Counter(_Metric):
             return
         key = self._key(labels)
         with self._lock:
+            key = self._store_key(self._values, key)
             self._values[key] = self._values.get(key, 0.0) + amount
 
     def value(self, **labels) -> float:
@@ -166,6 +203,7 @@ class Gauge(_Metric):
             return
         key = self._key(labels)
         with self._lock:
+            key = self._store_key(self._values, key)
             self._values[key] = float(value)
 
     def set_max(self, value: float, **labels):
@@ -174,6 +212,7 @@ class Gauge(_Metric):
             return
         key = self._key(labels)
         with self._lock:
+            key = self._store_key(self._values, key)
             cur = self._values.get(key)
             if cur is None or value > cur:
                 self._values[key] = float(value)
@@ -183,6 +222,7 @@ class Gauge(_Metric):
             return
         key = self._key(labels)
         with self._lock:
+            key = self._store_key(self._values, key)
             self._values[key] = self._values.get(key, 0.0) + amount
 
     def dec(self, amount: float = 1, **labels):
@@ -208,7 +248,10 @@ DEFAULT_BUCKETS = (1e-6, 1e-5, 1e-4, 1e-3, 5e-3, 1e-2, 5e-2, 0.1, 0.25,
 
 class Histogram(_Metric):
     """Cumulative-bucket histogram (Prometheus semantics) with a
-    bucket-interpolated ``quantile()`` reader."""
+    bucket-interpolated ``quantile()`` reader and per-bucket
+    **exemplars**: ``observe(v, exemplar=trace_id)`` remembers the most
+    recent trace that landed in each bucket, so a scraped p99 links
+    straight to the trace behind it (``exemplar_for_quantile``)."""
 
     kind = "histogram"
 
@@ -218,28 +261,36 @@ class Histogram(_Metric):
         if not bs:
             raise MXNetError(f"histogram {self.name!r}: empty buckets")
         self.buckets = bs
-        # per label key: [per-bucket counts..., +Inf count], sum, count
+        # per label key: [[per-bucket counts..., +Inf count], sum,
+        #                 count, [per-bucket (exemplar, value) | None]]
         self._data: Dict[Tuple[str, ...], list] = {}
 
-    def observe(self, value: float, **labels):
+    def observe(self, value: float, exemplar=None, **labels):
+        """Record one observation.  ``exemplar`` (typically a
+        ``tracing`` trace id) is attached to the bucket the value lands
+        in — latest exemplar per bucket wins."""
         if not _ENABLED:
             return
         key = self._key(labels)
         v = float(value)
         with self._lock:
+            key = self._store_key(self._data, key)
             entry = self._data.get(key)
             if entry is None:
-                entry = [[0] * (len(self.buckets) + 1), 0.0, 0]
+                n = len(self.buckets) + 1
+                entry = [[0] * n, 0.0, 0, [None] * n]
                 self._data[key] = entry
-            counts, _, _ = entry
+            counts = entry[0]
             for i, b in enumerate(self.buckets):
                 if v <= b:
-                    counts[i] += 1
                     break
             else:
-                counts[-1] += 1
+                i = len(self.buckets)
+            counts[i] += 1
             entry[1] += v
             entry[2] += 1
+            if exemplar is not None:
+                entry[3][i] = (str(exemplar), v)
 
     def count(self, **labels) -> int:
         with self._lock:
@@ -261,7 +312,7 @@ class Histogram(_Metric):
             entry = self._data.get(self._key(labels))
             if entry is None or entry[2] == 0:
                 return float("nan")
-            counts, _, total = entry
+            counts, _, total = entry[0], entry[1], entry[2]
             rank = q * total
             cum = 0.0
             lo = 0.0
@@ -275,10 +326,48 @@ class Histogram(_Metric):
                 lo = b
             return self.buckets[-1]
 
+    def exemplars(self, **labels):
+        """Per-bucket ``(exemplar, value)`` pairs (None where no
+        exemplar landed), aligned with ``buckets + (+Inf,)``."""
+        with self._lock:
+            entry = self._data.get(self._key(labels))
+            return list(entry[3]) if entry else \
+                [None] * (len(self.buckets) + 1)
+
+    def exemplar_for_quantile(self, q: float, **labels):
+        """The exemplar (trace id) nearest the q-quantile: the bucket
+        the quantile falls in, else the closest populated neighbor
+        (higher buckets first — for a p99 you want the slower trace).
+        Returns the exemplar string, or None."""
+        if not 0.0 <= q <= 1.0:
+            raise MXNetError(f"quantile {q} outside [0, 1]")
+        with self._lock:
+            entry = self._data.get(self._key(labels))
+            if entry is None or entry[2] == 0:
+                return None
+            counts, _, total, exemplars = entry
+            rank = q * total
+            cum = 0.0
+            idx = len(counts) - 1
+            for i, c in enumerate(counts):
+                cum += c
+                if cum >= rank:
+                    idx = i
+                    break
+            for i in list(range(idx, len(exemplars))) + \
+                    list(range(idx - 1, -1, -1)):
+                if exemplars[i] is not None:
+                    return exemplars[i][0]
+            return None
+
     def _snapshot(self):
         with self._lock:
             return {k: (list(e[0]), e[1], e[2])
                     for k, e in self._data.items()}
+
+    def _snapshot_exemplars(self):
+        with self._lock:
+            return {k: list(e[3]) for k, e in self._data.items()}
 
     def _reset(self):
         with self._lock:
@@ -440,6 +529,18 @@ def dump_prometheus() -> str:
                     f"{_fmt(snap[key])}")
         else:  # histogram
             snap = m._snapshot()
+            exs = m._snapshot_exemplars()
+
+            def _ex(key, i):
+                # OpenMetrics exemplar suffix: the bucket's most recent
+                # trace id, so a scraped p99 resolves to a trace
+                e = exs.get(key)
+                if not e or e[i] is None:
+                    return ""
+                tid, v = e[i]
+                return (f' # {{trace_id="{_escape_label(tid)}"}} '
+                        f"{_fmt(v)}")
+
             for key in sorted(snap):
                 counts, total, n = snap[key]
                 cum = 0
@@ -447,10 +548,12 @@ def dump_prometheus() -> str:
                     cum += counts[i]
                     lbl = _label_str(m.labelnames + ("le",),
                                      key + (_fmt(b),))
-                    lines.append(f"{base}_bucket{lbl} {cum}")
+                    lines.append(f"{base}_bucket{lbl} {cum}"
+                                 f"{_ex(key, i)}")
                 cum += counts[-1]
                 lbl = _label_str(m.labelnames + ("le",), key + ("+Inf",))
-                lines.append(f"{base}_bucket{lbl} {cum}")
+                lines.append(f"{base}_bucket{lbl} {cum}"
+                             f"{_ex(key, len(m.buckets))}")
                 ls = _label_str(m.labelnames, key)
                 lines.append(f"{base}_sum{ls} {_fmt(total)}")
                 lines.append(f"{base}_count{ls} {n}")

@@ -23,7 +23,7 @@ import threading
 
 import numpy as np
 
-from .. import engine, runtime_metrics as _rm
+from .. import engine, runtime_metrics as _rm, tracing as _tr
 from ..base import MXNetError
 
 __all__ = ["DynamicBatcher", "next_bucket", "bucket_set", "pad_batch",
@@ -137,6 +137,7 @@ class DynamicBatcher:
                     self.bucket_hits += 1
                     if _rm._ENABLED:
                         _rm.SERVING_BUCKET_CACHE.inc(event="mem_hit")
+                    _tr.tag("bucket_outcome", "mem_hit")
                     return prog
                 pending = self._building.get(key)
                 if pending is None:
@@ -162,6 +163,7 @@ class DynamicBatcher:
                 event = "miss"
             if _rm._ENABLED:
                 _rm.SERVING_BUCKET_CACHE.inc(event=event)
+            _tr.tag("bucket_outcome", event)
             # a batch admitted before unload can dispatch after evict():
             # run it, but never re-cache under a retired uid (no future
             # unload event would ever clear it again)
@@ -202,12 +204,17 @@ class DynamicBatcher:
         list of per-request output tuples."""
         rows = sum(req[0].shape[0] for req in request_inputs)
         bucket = self.bucket_for(entry, rows)
+        # annotate whatever span the dispatching worker entered (the
+        # shared batch-assembly span) — no handle threading needed
+        _tr.tag("bucket", bucket)
+        _tr.tag("rows", rows)
         padded, offsets = pad_batch(request_inputs, bucket)
         prog = self.program_for(entry, bucket)
-        outs = prog(*padded)
-        # bounded sync point: block on THIS batch (async errors surface
-        # here, engine rethrow-at-sync-point contract)
-        engine.sync_outputs(outs, site="serving")
+        with _tr.span("serving.execute", bucket=bucket, rows=rows):
+            outs = prog(*padded)
+            # bounded sync point: block on THIS batch (async errors
+            # surface here, engine rethrow-at-sync-point contract)
+            engine.sync_outputs(outs, site="serving")
         if _rm._ENABLED:
             _rm.SERVING_BATCHES.inc(model=entry.name)
             _rm.SERVING_BATCH_OCCUPANCY.observe(rows / bucket)

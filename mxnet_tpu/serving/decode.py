@@ -48,7 +48,7 @@ import time
 
 import numpy as np
 
-from .. import engine as _engine, runtime_metrics as _rm
+from .. import engine as _engine, runtime_metrics as _rm, tracing as _tr
 from ..base import MXNetError
 from .batcher import bucket_set, next_bucket
 from .kv_cache import DeviceKVPool, PageAllocator, PageGeometry
@@ -58,6 +58,15 @@ __all__ = ["DecodeEngine", "GenerateRequest", "PagedLMAdapter",
 
 _LOG = logging.getLogger("mxnet_tpu")
 _SEQ_IDS = itertools.count(1)
+# traced sequences record a decode.step span for their FIRST decode
+# step and then every Nth token — per-token spans on a long generation
+# would blow the per-trace span budget without adding information
+_STEP_SPAN_EVERY = 8
+# submit(_trace_ctx=...) sentinel: "no caller decision — inspect the
+# ambient context / make the head-sampling call here".  ModelServer
+# always passes its root's context instead (None when that root was
+# sampled out), so one request NEVER gets two sampling decisions.
+_AMBIENT = object()
 
 
 class GenerateRequest:
@@ -72,7 +81,8 @@ class GenerateRequest:
     __slots__ = ("seq_id", "prompt", "max_new_tokens", "eos_id",
                  "on_token", "tokens", "event", "error", "finish_reason",
                  "slot", "context_len", "t_submit", "t_first", "t_prev",
-                 "cancelled")
+                 "cancelled", "trace", "root_span", "queue_span",
+                 "released_pages")
 
     def __init__(self, prompt, max_new_tokens, eos_id, on_token):
         self.seq_id = next(_SEQ_IDS)
@@ -90,6 +100,14 @@ class GenerateRequest:
         self.t_first = None               # first-token timestamp (TTFT)
         self.t_prev = None                # previous-token timestamp
         self.cancelled = False
+        # tracing: the request's TraceContext (None when untraced), an
+        # engine-owned root span when generate() was called without an
+        # ambient trace, and the queue-wait span started at submit and
+        # ended by the step loop at admission
+        self.trace = None
+        self.root_span = None
+        self.queue_span = _tr._NOOP
+        self.released_pages = 0
 
     @property
     def ttft(self):
@@ -219,11 +237,17 @@ class DecodeEngine:
 
     # -------------------------------------------------------------- submit
     def submit(self, prompt, max_new_tokens=None, eos_id=None,
-               on_token=None):
+               on_token=None, _trace_ctx=_AMBIENT):
         """Queue one prompt for generation; returns the
         :class:`GenerateRequest` handle (``result()`` blocks on it).
         ``on_token(token_id)`` streams each generated id from the engine
-        thread as it is sampled."""
+        thread as it is sampled.
+
+        ``_trace_ctx`` (internal): the caller's already-decided trace
+        context — a :class:`~mxnet_tpu.tracing.TraceContext`, or None
+        for "the request was sampled out, stay on the no-op path".
+        Left at the sentinel, the engine inspects the ambient context
+        and roots its own trace (the directly-driven case)."""
         prompt = np.asarray(prompt).astype(np.int32).reshape(-1)
         if prompt.size < 1:
             raise MXNetError("generate: prompt must hold >= 1 token")
@@ -248,25 +272,70 @@ class DecodeEngine:
         if eos_id is None:
             eos_id = getattr(self.model, "eos_id", None)
         seq = GenerateRequest(prompt, max_new_tokens, eos_id, on_token)
-        with self._cond:
-            if not self._started or self._stopping:
-                raise MXNetError(
-                    "DecodeEngine is not accepting requests (not "
-                    "started, or stopping)")
-            # the serving tier's backpressure contract applies to the
-            # decode path too: a bounded waiting line and a cheap
-            # reject with a retry hint, never an unbounded queue
-            if len(self._waiting) >= self.config.queue_depth:
-                from .server import ServerOverloadedError
-                self._stats["shed"] += 1
-                if _rm._ENABLED:
-                    _rm.SERVING_SHED.inc(model=self.model_name)
-                raise ServerOverloadedError(
-                    self.model_name, self.config.retry_after_ms,
-                    f"decode waiting queue {len(self._waiting)} >= "
-                    f"queue_depth {self.config.queue_depth}")
-            self._waiting.append(seq)
-            self._cond.notify_all()
+        # trace identity: an explicit caller decision wins (the
+        # ModelServer passes its root's context — None when that root
+        # was sampled out, so the head-sampling call is made ONCE per
+        # request); otherwise join the ambient trace, else root one
+        # here so a directly-driven engine still records full
+        # timelines.  The engine-owned root is ended at eviction, in
+        # the step loop.
+        if _tr._ENABLED:
+            if _trace_ctx is not _AMBIENT:
+                seq.trace = _trace_ctx
+            else:
+                ctx = _tr.current_context()
+                if ctx is None:
+                    root = _tr.trace("decode.request",
+                                     model=self.model_name)
+                    if root.sampled:
+                        seq.root_span = root
+                        ctx = root.context
+                seq.trace = ctx
+        admission = _tr.span("decode.admission", parent=seq.trace,
+                             prompt_tokens=int(prompt.size),
+                             max_new_tokens=max_new_tokens,
+                             pages_reserved=worst)
+        try:
+            with self._cond:
+                if not self._started or self._stopping:
+                    raise MXNetError(
+                        "DecodeEngine is not accepting requests (not "
+                        "started, or stopping)")
+                # the serving tier's backpressure contract applies to
+                # the decode path too: a bounded waiting line and a
+                # cheap reject with a retry hint, never an unbounded
+                # queue
+                if len(self._waiting) >= self.config.queue_depth:
+                    from .server import ServerOverloadedError
+                    self._stats["shed"] += 1
+                    if _rm._ENABLED:
+                        _rm.SERVING_SHED.inc(model=self.model_name)
+                    admission.set_tag("shed", True)
+                    raise ServerOverloadedError(
+                        self.model_name, self.config.retry_after_ms,
+                        f"decode waiting queue {len(self._waiting)} >= "
+                        f"queue_depth {self.config.queue_depth}")
+                self._waiting.append(seq)
+                seq.queue_span = _tr.span(
+                    "decode.queue_wait", parent=seq.trace,
+                    waiting=len(self._waiting))
+                self._cond.notify_all()
+        except MXNetError as e:
+            # flight recorder on overload; the not-accepting reject is
+            # not an incident.  Runs after _cond is released.
+            from .server import ServerOverloadedError
+            if isinstance(e, ServerOverloadedError):
+                _tr.record_incident("decode.shed", self.debug_state)
+            # order matters on an engine-rooted trace: the admission
+            # span (carrying the shed tag) must land BEFORE the root
+            # ends and completes the trace — a straggler would be
+            # dropped (the finally's end() is then an idempotent no-op)
+            admission.end()
+            if seq.root_span is not None:
+                seq.root_span.end(error=type(e).__name__)
+            raise
+        finally:
+            admission.end()
         return seq
 
     def result(self, seq, timeout=None):
@@ -316,6 +385,10 @@ class DecodeEngine:
                     self._waiting = []
                 for seq in victims:
                     self._evict(seq, reason="error", error=e)
+                # an eviction storm (every in-flight sequence failed
+                # at once) is exactly what the flight recorder is for
+                _tr.record_incident(
+                    f"decode.step_failure: {e}", self.debug_state)
 
     def step(self):
         """ONE scheduler iteration: admit -> prefill admitted -> one
@@ -366,7 +439,15 @@ class DecodeEngine:
                 self._stats["peak_running"] = max(
                     self._stats["peak_running"], len(self._running))
                 admitted.append(seq)
+        for seq in admitted:
+            # queue wait ends at slot assignment (cross-thread end:
+            # the span was started in the submitter's thread)
+            seq.queue_span.end(
+                slot=seq.slot,
+                kv_pages=len(self.allocator.pages_of(seq.seq_id)),
+                kv_free_pages=self.allocator.free_pages)
         for seq in dropped:
+            seq.queue_span.end(error="cancelled")
             self._finish(seq, "cancelled",
                          MXNetError("generate: request cancelled "
                                     "before admission"))
@@ -377,12 +458,16 @@ class DecodeEngine:
         sequence and sample its first token."""
         L = seq.prompt.size
         bucket = next_bucket(L, self.geometry.max_context)
-        tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :L] = seq.prompt
-        logits = np.asarray(self.model.prefill(
-            tokens, np.int32(L), self.allocator.block_table(seq.seq_id)))
-        seq.context_len = L
-        self._emit(seq, int(np.argmax(logits)))
+        with _tr.span("decode.prefill", parent=seq.trace,
+                      prompt_tokens=int(L), bucket=bucket,
+                      kv_pages=len(self.allocator.pages_of(seq.seq_id))):
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :L] = seq.prompt
+            logits = np.asarray(self.model.prefill(
+                tokens, np.int32(L),
+                self.allocator.block_table(seq.seq_id)))
+            seq.context_len = L
+            self._emit(seq, int(np.argmax(logits)))
         self._maybe_evict(seq)
         return 1
 
@@ -411,10 +496,25 @@ class DecodeEngine:
             positions[seq.slot] = seq.context_len
             block_tables[seq.slot] = self.allocator.block_table(
                 seq.seq_id)
+        t0 = time.perf_counter()
         logits = np.asarray(self.model.decode_step(
             tokens, positions, block_tables))
+        t1 = time.perf_counter()
         produced = 0
         for seq in running:
+            # per-sequence decode-step spans (first step, then every
+            # Nth): ONE device call serves the whole batch, so each due
+            # sequence gets the shared interval with its own tags
+            if seq.trace is not None:
+                n_prior = len(seq.tokens)
+                if n_prior == 1 or n_prior % _STEP_SPAN_EVERY == 0:
+                    _tr.record_span(
+                        "decode.step", seq.trace, t0, t1,
+                        {"step": n_prior, "slot": seq.slot,
+                         "context_len": seq.context_len,
+                         "batch": len(running),
+                         "kv_pages": len(self.allocator.pages_of(
+                             seq.seq_id))})
             seq.context_len += 1
             self._emit(seq, int(np.argmax(logits[seq.slot])))
             produced += 1
@@ -428,7 +528,9 @@ class DecodeEngine:
             seq.t_first = now
             if _rm._ENABLED:
                 _rm.SERVING_DECODE_TTFT_SECONDS.observe(
-                    now - seq.t_submit, model=self.model_name)
+                    now - seq.t_submit, model=self.model_name,
+                    exemplar=None if seq.trace is None
+                    else seq.trace.trace_id)
         elif _rm._ENABLED:
             _rm.SERVING_DECODE_TOKEN_SECONDS.observe(
                 now - seq.t_prev, model=self.model_name)
@@ -470,7 +572,7 @@ class DecodeEngine:
                 self._running.pop(seq.slot, None)
                 self._free_slots.append(seq.slot)
                 seq.slot = None
-                self.allocator.release(seq.seq_id)
+                seq.released_pages = self.allocator.release(seq.seq_id)
                 self._stats["evicted"] += 1
                 if _rm._ENABLED:
                     _rm.SERVING_DECODE_EVICTIONS.inc(
@@ -481,12 +583,24 @@ class DecodeEngine:
         seq.finish_reason = reason
         if error is not None:
             seq.error = error
+        if seq.trace is not None:
+            now = time.perf_counter()
+            _tr.record_span(
+                "decode.evict", seq.trace, now, now,
+                {"reason": reason,
+                 "pages_released": seq.released_pages,
+                 "generated_tokens": len(seq.tokens)})
+            if seq.root_span is not None:
+                # engine-rooted trace: the request span closes at
+                # eviction (server-rooted ones close in the caller)
+                seq.root_span.end(finish_reason=reason)
         seq.event.set()
 
     def _evict(self, seq, reason, error):
         """Out-of-band eviction (stop/step-failure): release whatever
         the sequence holds and fail it."""
         self._release(seq)
+        seq.queue_span.end(error=reason)     # idempotent if admitted
         self._finish(seq, reason, error)
 
     # ---------------------------------------------------------------- info
@@ -501,6 +615,46 @@ class DecodeEngine:
         if programs is not None:
             out["programs"] = programs()
         return out
+
+    def debug_state(self):
+        """JSON-serializable scheduler snapshot for the flight
+        recorder: per-sequence slot map with block-table occupancy,
+        the waiting line, free slots/pages, and the counters
+        (``ModelServer.debug_state`` aggregates one per engine)."""
+        now = time.monotonic()
+        with self._cond:
+            running = [
+                {"seq_id": s.seq_id, "slot": s.slot,
+                 "context_len": s.context_len,
+                 "generated_tokens": len(s.tokens),
+                 "max_new_tokens": s.max_new_tokens,
+                 "cancelled": s.cancelled,
+                 "age_s": round(now - s.t_submit, 6),
+                 "kv_pages": len(self.allocator.pages_of(s.seq_id)),
+                 "trace_id": None if s.trace is None
+                 else s.trace.trace_id}
+                for s in self._running.values()]
+            waiting = [
+                {"seq_id": s.seq_id, "prompt_tokens": int(s.prompt.size),
+                 "cancelled": s.cancelled,
+                 "age_s": round(now - s.t_submit, 6)}
+                for s in self._waiting]
+            state = {
+                "model": self.model_name,
+                "started": self._started,
+                "stopping": self._stopping,
+                "max_batch": self.max_batch,
+                "free_slots": len(self._free_slots),
+                "running": running,
+                "waiting": waiting,
+                "allocator": self.allocator.stats(),
+                "stats": dict(self._stats),
+            }
+        state["program_bound"] = self.program_bound
+        programs = getattr(self.model, "programs", None)
+        if programs is not None:
+            state["programs"] = programs()
+        return state
 
 
 # ---------------------------------------------------------------------------
@@ -679,7 +833,11 @@ class PagedLMAdapter:
                                  self._prefill_jit, args)
         else:
             prog = self._prefill_jit
-        logits, k_pages, v_pages = prog(*args)
+        # device-call child of the engine's decode.prefill span (no-op
+        # without an ambient span): separates program dispatch from the
+        # scheduler's host-side framing
+        with _tr.span("paged_lm.prefill", bucket=int(tokens.shape[1])):
+            logits, k_pages, v_pages = prog(*args)
         pool.swap(k_pages, v_pages)
         return logits
 
@@ -692,6 +850,9 @@ class PagedLMAdapter:
                                  self._decode_jit, args)
         else:
             prog = self._decode_jit
+        # no adapter-level span here: the step loop calls this with no
+        # ambient span (ONE device call serves many traces) and records
+        # the timed interval per due sequence as decode.step instead
         logits, k_pages, v_pages = prog(*args)
         pool.swap(k_pages, v_pages)
         return logits

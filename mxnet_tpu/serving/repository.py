@@ -415,6 +415,21 @@ class ModelRepository:
         with self._lock:
             return sorted(self._models)
 
+    def debug_state(self):
+        """JSON-serializable snapshot of the version map (one entry per
+        model: current version, staged versions, entry kinds) for the
+        flight recorder (``ModelServer.debug_state``)."""
+        with self._lock:
+            return {
+                name: {
+                    "current": slot["current"],
+                    "versions": [
+                        {"version": v, "kind": e.kind, "uid": e.uid,
+                         "dynamic_batch": e.dynamic_batch}
+                        for v, e in slot["versions"].items()],
+                }
+                for name, slot in self._models.items()}
+
     def current_version(self, name):
         with self._lock:
             slot = self._models.get(name)

@@ -23,7 +23,7 @@ from collections import OrderedDict, deque
 
 import numpy as np
 
-from .. import engine, runtime_metrics as _rm
+from .. import engine, runtime_metrics as _rm, tracing as _tr
 from ..base import MXNetError
 from .batcher import DynamicBatcher
 from .config import ServingConfig
@@ -51,7 +51,7 @@ class ServerOverloadedError(MXNetError):
 
 class _Request:
     __slots__ = ("entry", "inputs", "rows", "event", "result", "error",
-                 "t_enq")
+                 "t_enq", "trace", "queue_span")
 
     def __init__(self, entry, inputs, rows):
         self.entry = entry
@@ -61,6 +61,12 @@ class _Request:
         self.result = None
         self.error = None
         self.t_enq = time.monotonic()
+        # tracing: the request's TraceContext (None when untraced) and
+        # its queue-wait span — started in the caller's thread at
+        # enqueue, ended in whichever worker pops it (Span.end is
+        # idempotent, so the timeout-withdrawal race is benign)
+        self.trace = None
+        self.queue_span = _tr._NOOP
 
 
 class ModelServer:
@@ -215,7 +221,17 @@ class ModelServer:
         coalesced batch is ready.  Inputs are batch-major NDArray /
         numpy arrays validated against the model's serving signature;
         returns numpy (one array, or a tuple for multi-output models).
+
+        With ``MXNET_TRACE=1`` the request carries one trace identity
+        end to end: admission, queue wait, the (shared) batch-assembly
+        span with its bucket outcome, and execute — and the latency
+        histogram records the trace id as its exemplar, so a p99 links
+        to the exact trace behind it (docs/observability.md).
         """
+        with _tr.trace("serving.predict", model=model) as root:
+            return self._predict_impl(model, inputs, timeout, root)
+
+    def _predict_impl(self, model, inputs, timeout, root):
         from .. import deploy
         entry = self.repository.get(model)
         if entry.decode_model is not None:
@@ -241,39 +257,57 @@ class ModelServer:
                 f"exported batch={entry.fixed_batch})")
 
         req = _Request(entry, np_inputs, rows)
-        with self._cond:
-            if not self._started or self._stopping:
-                raise MXNetError(
-                    "ModelServer is not accepting requests "
-                    "(not started, or shutting down)")
-            # two-level backpressure: the watermark bounds the WAITING
-            # queue; queue_depth additionally bounds total outstanding
-            # work (queued + in-flight), so a slow model cannot pile up
-            # unbounded dispatched-but-unfinished requests
-            reason = None
-            if self._depth >= self.config.shed_watermark:
-                reason = (f"queue depth {self._depth} >= shed watermark "
-                          f"{self.config.shed_watermark}")
-            elif self._depth + self._inflight >= self.config.queue_depth:
-                reason = (f"outstanding work {self._depth} queued + "
-                          f"{self._inflight} in flight >= queue_depth "
-                          f"{self.config.queue_depth}")
-            if reason is not None:
-                self._stats["shed"] += 1
+        req.trace = root.context
+        admit = _tr.span("serving.admit", parent=req.trace, rows=rows)
+        try:
+            with self._cond:
+                if not self._started or self._stopping:
+                    raise MXNetError(
+                        "ModelServer is not accepting requests "
+                        "(not started, or shutting down)")
+                # two-level backpressure: the watermark bounds the
+                # WAITING queue; queue_depth additionally bounds total
+                # outstanding work (queued + in-flight), so a slow
+                # model cannot pile up unbounded
+                # dispatched-but-unfinished requests
+                reason = None
+                if self._depth >= self.config.shed_watermark:
+                    reason = (f"queue depth {self._depth} >= shed "
+                              f"watermark {self.config.shed_watermark}")
+                elif self._depth + self._inflight \
+                        >= self.config.queue_depth:
+                    reason = (f"outstanding work {self._depth} queued "
+                              f"+ {self._inflight} in flight >= "
+                              f"queue_depth {self.config.queue_depth}")
+                if reason is not None:
+                    self._stats["shed"] += 1
+                    if _rm._ENABLED:
+                        _rm.SERVING_SHED.inc(model=model)
+                    admit.set_tag("shed", reason)
+                    raise ServerOverloadedError(
+                        model, self.config.retry_after_ms, reason)
+                slot = self._queues.get(entry.uid)
+                if slot is None:
+                    slot = (entry, deque())
+                    self._queues[entry.uid] = slot
+                slot[1].append(req)
+                self._set_depth(self._depth + 1)
+                self._stats["requests"] += 1
                 if _rm._ENABLED:
-                    _rm.SERVING_SHED.inc(model=model)
-                raise ServerOverloadedError(
-                    model, self.config.retry_after_ms, reason)
-            slot = self._queues.get(entry.uid)
-            if slot is None:
-                slot = (entry, deque())
-                self._queues[entry.uid] = slot
-            slot[1].append(req)
-            self._set_depth(self._depth + 1)
-            self._stats["requests"] += 1
-            if _rm._ENABLED:
-                _rm.SERVING_REQUESTS.inc(model=model)
-            self._cond.notify_all()
+                    _rm.SERVING_REQUESTS.inc(model=model)
+                req.queue_span = _tr.span("serving.queue_wait",
+                                          parent=req.trace,
+                                          depth=self._depth)
+                self._cond.notify_all()
+        except ServerOverloadedError:
+            # flight recorder: an overloaded replica dumps its recent
+            # traces + debug state ONCE per debounce window (the
+            # callable defers the state walk until a dump really
+            # happens) — called after _cond is released
+            _tr.record_incident("serving.shed", self.debug_state)
+            raise
+        finally:
+            admit.end()
 
         if not req.event.wait(timeout):
             # withdraw an abandoned request so it neither occupies
@@ -288,6 +322,7 @@ class ModelServer:
                     if not slot[1]:
                         self._queues.pop(entry.uid, None)
                     self._set_depth(self._depth - 1)
+            req.queue_span.end(error="timeout")
             raise MXNetError(
                 f"serving predict({model!r}): no result within "
                 f"{timeout}s (queue depth {self._depth})")
@@ -361,23 +396,32 @@ class ModelServer:
         ``generate()`` calls of mixed lengths share the fixed-shape
         decode batch; a short request admitted mid-flight finishes
         ahead of a longer one admitted earlier.
+
+        With ``MXNET_TRACE=1`` the request is one trace end to end:
+        admission, queue wait, prefill, every Nth decode step, and
+        eviction, with KV-page counts as tags (docs/observability.md).
         """
-        entry = self.repository.get(model)
-        if entry.decode_model is None:
-            extra = ""
-            if entry.decode_meta is not None:
-                extra = (" (the artifact manifest carries decode "
-                         "metadata, but artifact entries serve "
-                         "predict() only — register the block with "
-                         "add_decoder for in-process generation)")
-            raise MXNetError(
-                f"serving generate({model!r}): not a decoder entry — "
-                f"register the model with "
-                f"ModelRepository.add_decoder{extra}")
-        eng = self._decoder_engine(entry)
-        seq = eng.submit(prompt, max_new_tokens=max_new_tokens,
-                         eos_id=eos_id, on_token=on_token)
-        return eng.result(seq, timeout=timeout)
+        with _tr.trace("serving.generate", model=model) as root:
+            entry = self.repository.get(model)
+            if entry.decode_model is None:
+                extra = ""
+                if entry.decode_meta is not None:
+                    extra = (" (the artifact manifest carries decode "
+                             "metadata, but artifact entries serve "
+                             "predict() only — register the block with "
+                             "add_decoder for in-process generation)")
+                raise MXNetError(
+                    f"serving generate({model!r}): not a decoder entry "
+                    f"— register the model with "
+                    f"ModelRepository.add_decoder{extra}")
+            eng = self._decoder_engine(entry)
+            # pass the (already made) sampling decision down: a
+            # sampled-out request must NOT re-enter head sampling in
+            # the engine and root a fragment trace
+            seq = eng.submit(prompt, max_new_tokens=max_new_tokens,
+                             eos_id=eos_id, on_token=on_token,
+                             _trace_ctx=root.context)
+            return eng.result(seq, timeout=timeout)
 
     def decode_stats(self, model):
         """The decode engine's scheduler/pool counters for ``model``
@@ -425,6 +469,49 @@ class ModelServer:
         out["bucket_misses"] = self.batcher.bucket_misses
         out["programs"] = self.batcher.programs()
         return out
+
+    def debug_state(self):
+        """Deep, JSON-serializable snapshot of the serving stack for
+        the flight recorder: per-model queue depths and head ages,
+        in-flight counts, per-engine decode state (running sequences
+        with their block-table occupancy), program-cache sizes, the
+        repository's version map, and tracer counters.  Dumped
+        automatically on overload incidents
+        (:func:`mxnet_tpu.tracing.record_incident`) and on demand by
+        ``tools/diagnose.py``."""
+        now = time.monotonic()
+        with self._cond:
+            queues = []
+            for entry, q in self._queues.values():
+                queues.append({
+                    "model": entry.name, "version": entry.version,
+                    "depth": len(q),
+                    "head_age_s": None if not q
+                    else round(now - q[0].t_enq, 6)})
+            decoders = dict(self._decoders)
+            state = {
+                "server": self.name,
+                "started": self._started,
+                "stopping": self._stopping,
+                "workers": len(self._workers),
+                "queue_depth": self._depth,
+                "inflight": self._inflight,
+                "stats": dict(self._stats),
+                "queues": queues,
+            }
+        # engine/batcher/repository snapshots go through THEIR locks
+        # only after _cond is released (one-way acquisition order)
+        state["decoders"] = {str(uid): eng.debug_state()
+                             for uid, eng in decoders.items()}
+        state["batcher"] = {
+            "programs": self.batcher.programs(),
+            "bucket_hits": self.batcher.bucket_hits,
+            "bucket_disk_hits": self.batcher.bucket_disk_hits,
+            "bucket_misses": self.batcher.bucket_misses,
+        }
+        state["repository"] = self.repository.debug_state()
+        state["tracer"] = _tr.TRACER.stats()
+        return state
 
     # -------------------------------------------------------------- workers
     def _set_depth(self, depth):
@@ -494,9 +581,39 @@ class ModelServer:
             if batch is None:
                 return
             entry, reqs = batch
+            # queue-wait spans end at the pop (outside _cond — the
+            # tracer lock is never taken while a serving lock is held)
+            for r in reqs:
+                r.queue_span.end()
+            # ONE batch-assembly span shared by every coalesced
+            # request: it lives in the first sampled request's trace
+            # and is copied (same interval, same tags) into the others
+            # after dispatch — chrome-trace has no multi-parent links,
+            # so each trace gets a complete private timeline instead
+            home = next((r.trace for r in reqs if r.trace is not None),
+                        None)
+            bspan = _tr.span("serving.batch", parent=home,
+                             model=entry.name, requests=len(reqs))
+
+            def _share_batch_span():
+                # copy the (ended) shared span into the OTHER coalesced
+                # traces — must run BEFORE any r.event.set(): a woken
+                # caller completes its root, after which the copy would
+                # be dropped as a straggler
+                if bspan.sampled:
+                    for r in reqs:
+                        if r.trace is not None \
+                                and r.trace.trace_id != bspan.trace_id:
+                            _tr.record_span(
+                                "serving.batch", r.trace, bspan.t0,
+                                bspan.t1 or bspan.t0,
+                                dict(bspan.tags or {},
+                                     shared_with=bspan.trace_id))
+
             try:
-                results = self.batcher.run_batch(
-                    entry, [r.inputs for r in reqs])
+                with bspan:
+                    results = self.batcher.run_batch(
+                        entry, [r.inputs for r in reqs])
             except Exception as e:        # noqa: BLE001 — fail the batch
                 # also log it: a caller that already timed out will
                 # never read req.error, and a compile failure must not
@@ -504,6 +621,7 @@ class ModelServer:
                 _LOG.warning("serving: batch of %d request(s) for "
                              "%s:%s failed: %s", len(reqs), entry.name,
                              entry.version, e)
+                _share_batch_span()       # bspan ended by the with-exit
                 with self._cond:
                     self._stats["errors"] += len(reqs)
                     self._inflight -= len(reqs)
@@ -512,6 +630,7 @@ class ModelServer:
                     r.error = e
                     r.event.set()
                 continue
+            _share_batch_span()
             done = time.monotonic()
             with self._cond:
                 self._stats["batches"] += 1
@@ -522,5 +641,7 @@ class ModelServer:
                 r.result = out
                 if _rm._ENABLED:
                     _rm.SERVING_REQUEST_SECONDS.observe(
-                        done - r.t_enq, model=entry.name)
+                        done - r.t_enq, model=entry.name,
+                        exemplar=None if r.trace is None
+                        else r.trace.trace_id)
                 r.event.set()

@@ -7,12 +7,30 @@ and device list, every ``MXNET_*`` env knob (registry defaults plus
 anything set in the environment), native-library availability, the
 persistent compile-cache state (dir, entry count, bytes, hit ratio —
 so a mis-set MXNET_COMPILE_CACHE_DIR is diagnosable in one command),
-and a runtime-metrics snapshot.  With ``--metrics-smoke`` it also enables the
-metrics registry, dispatches one op, and verifies the pipeline end to
-end (used as a CI smoke step by ci/runtime_functions.sh).
+the request-tracer / flight-recorder state, and a runtime-metrics
+snapshot.  With ``--metrics-smoke`` it also enables the metrics
+registry, dispatches one op, and verifies the pipeline end to end
+(used as a CI smoke step by ci/runtime_functions.sh).
 
-Usage: python tools/diagnose.py [--metrics-smoke]
+``--trace-smoke`` runs a traced serving round trip IN PROCESS — one
+``predict()`` and one ``generate()`` through a ModelServer over
+fake (numpy, zero-compile) models with ``MXNET_TRACE`` forced on —
+then asserts the span chains (admission -> queue wait -> batch/execute
+on the predict side; admission -> queue wait -> prefill -> decode
+steps -> evict on the generate side), the p99 exemplar link, and that
+the flight-recorder dump is non-empty and parses as chrome trace.
+This is the CI gate for docs/observability.md's tracing section
+(ci/runtime_functions.sh serving_smoke).
+
+``--flight-dump [PATH]`` writes the in-process flight-recorder
+snapshot (tracer stats + completed-trace ring) as JSON to PATH
+(default ./flight_record.json) — the on-demand half of the flight
+recorder.
+
+Usage: python tools/diagnose.py [--metrics-smoke] [--trace-smoke]
+                                [--flight-dump [PATH]]
 """
+import json
 import os
 import platform
 import sys
@@ -92,6 +110,26 @@ def diagnose(metrics_smoke=False):
           f"(MXNET_ENGINE_SANITIZE=1 to enable lock-order recording + "
           f"tracked-array assertions; docs/static_analysis.md)")
 
+    _section("Tracing / Flight Recorder")
+    from mxnet_tpu import tracing
+    st = tracing.TRACER.stats()
+    if not st["enabled"]:
+        print("enabled      : False  (MXNET_TRACE=1 for per-request "
+              "span timelines + the flight recorder; "
+              "docs/observability.md)")
+    else:
+        print(f"enabled      : True  (sample={st['sample']}, "
+              f"ring={st['ring']})")
+        print(f"traces       : {st['completed']} completed in ring / "
+              f"{st['active']} active / {st['traces_started']} started "
+              f"/ {st['traces_unsampled']} sampled out / "
+              f"{st['traces_evicted']} evicted")
+        print(f"spans        : {st['spans']} recorded / "
+              f"{st['spans_dropped']} dropped")
+    incidents = tracing.incident_paths()
+    print(f"incidents    : {len(incidents)}"
+          + ("".join(f"\n  dump       : {p}" for p in incidents)))
+
     _section("Runtime Metrics")
     from mxnet_tpu import runtime_metrics as rm
     print(f"enabled      : {rm.enabled()}")
@@ -114,7 +152,115 @@ def diagnose(metrics_smoke=False):
         print("\nmetrics smoke: OK")
 
 
+class _FakeLM:
+    """Zero-compile decode model (numpy only) so the trace smoke runs
+    in CI time: deterministic logits, no jax programs."""
+
+    vocab_size = 8
+    max_context = 16
+
+    def prefill(self, tokens, length, block_table):
+        import numpy as np
+        return np.eye(self.vocab_size,
+                      dtype=np.float32)[int(length) % self.vocab_size]
+
+    def decode_step(self, tokens, positions, block_tables):
+        import numpy as np
+        out = np.zeros((tokens.shape[0], self.vocab_size), np.float32)
+        out[np.arange(tokens.shape[0]),
+            (tokens + 1) % self.vocab_size] = 1.0
+        return out
+
+
+def trace_smoke():
+    """Traced predict + generate round trip; asserts the span chains,
+    the exemplar link, and a non-empty, parsable flight-recorder dump.
+    The serving_smoke CI job runs this."""
+    import tempfile
+
+    import numpy as np
+
+    from mxnet_tpu import runtime_metrics as rm, serving, tracing
+    tracing.enable(sample=1.0)
+    tracing.reset()
+    rm.enable()
+
+    repo = serving.ModelRepository()
+    repo.add_function("echo", lambda x: x * 2.0,
+                      [{"shape": [None, 3], "dtype": "float32"}])
+    repo.add_decoder("lm", _FakeLM())
+    cfg = serving.ServingConfig(decode_page_size=4, decode_pool_pages=16,
+                                decode_max_batch=2,
+                                decode_max_new_tokens=4)
+    srv = serving.ModelServer(repo, cfg)
+    try:
+        out = srv.predict("echo", np.ones((2, 3), np.float32),
+                          timeout=120)
+        np.testing.assert_allclose(out, 2.0)
+        toks = srv.generate("lm", [1, 2, 3], max_new_tokens=3,
+                            timeout=120)
+        assert len(toks) == 3, toks
+        state = srv.debug_state()
+    finally:
+        srv.stop()
+
+    # span chains: one trace each, every span parent-linked inside it
+    pt = tracing.TRACER.last(root="serving.predict")
+    gt = tracing.TRACER.last(root="serving.generate")
+    assert pt is not None and gt is not None, tracing.TRACER.stats()
+    for tr, need in (
+            (pt, {"serving.predict", "serving.admit",
+                  "serving.queue_wait", "serving.batch",
+                  "serving.execute"}),
+            (gt, {"serving.generate", "decode.admission",
+                  "decode.queue_wait", "decode.prefill", "decode.step",
+                  "decode.evict"})):
+        names = {s["name"] for s in tr["spans"]}
+        assert need <= names, (sorted(need - names), sorted(names))
+        ids = {s["span_id"] for s in tr["spans"]}
+        for s in tr["spans"]:
+            assert s["trace_id"] == tr["trace_id"], s
+            assert s["parent_id"] is None or s["parent_id"] in ids, s
+
+    # exemplar link: the p99 resolves to the predict trace
+    ex = rm.SERVING_REQUEST_SECONDS.exemplar_for_quantile(
+        0.99, model="echo")
+    assert ex == pt["trace_id"], (ex, pt["trace_id"])
+
+    # flight-recorder dump: non-empty and parsable (the CI criterion)
+    with tempfile.TemporaryDirectory() as tmp:
+        fpath = os.path.join(tmp, "flight.json")
+        with open(fpath, "w") as f:
+            json.dump(tracing.flight_record(state=state), f,
+                      default=str)
+        with open(fpath) as f:
+            rec = json.load(f)
+        assert rec["traces"], "flight-recorder dump is empty"
+        assert rec["state"]["repository"]["lm"]["current"] == 1
+        cpath = tracing.dump_chrome_trace(
+            os.path.join(tmp, "trace.json"), [pt, gt])
+        with open(cpath) as f:
+            events = json.load(f)["traceEvents"]
+        assert len(events) > 8, "chrome-trace dump is empty"
+
+    print(f"trace smoke: OK ({len(pt['spans'])} predict span(s), "
+          f"{len(gt['spans'])} generate span(s), flight recorder "
+          f"parsed)")
+
+
 def main(argv):
+    if "--trace-smoke" in argv:
+        trace_smoke()
+        return 0
+    if "--flight-dump" in argv:
+        from mxnet_tpu import tracing
+        i = argv.index("--flight-dump")
+        path = argv[i + 1] if i + 1 < len(argv) \
+            and not argv[i + 1].startswith("-") else "flight_record.json"
+        with open(path, "w") as f:
+            json.dump(tracing.flight_record(), f, default=str)
+        print(f"flight record written to {path}")
+        return 0
     diagnose(metrics_smoke="--metrics-smoke" in argv)
     return 0
 

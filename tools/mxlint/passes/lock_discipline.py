@@ -1,9 +1,9 @@
 """lock-discipline: shared-state mutation, lock ordering, blocking calls.
 
 Scope: the threading-reachable modules (``engine``, ``serving/*``,
-``runtime_metrics``, ``parallel/dist`` — the surfaces where worker
-pools, the metrics registry, and multi-process shutdown already shipped
-race fixes).  Four checks:
+``runtime_metrics``, ``tracing``, ``parallel/dist`` — the surfaces
+where worker pools, the metrics registry, the span tracer, and
+multi-process shutdown already shipped race fixes).  Four checks:
 
 1. **module-state**: a module-level mutable container (dict/list/set/
    deque/...) mutated inside a function without a held lock — the
@@ -34,6 +34,7 @@ from ..core import Issue, LintPass, dotted_name, register_pass
 _SCOPE_RES = [re.compile(p) for p in (
     r"(^|/)engine\.py$",
     r"(^|/)runtime_metrics\.py$",
+    r"(^|/)tracing\.py$",
     r"(^|/)serving/[^/]+\.py$",
     r"(^|/)parallel/dist\.py$",
 )]
