@@ -34,6 +34,15 @@ short request admitted mid-flight finishes before a long one admitted
 earlier) and total compiled programs stay <= prefill buckets + 1
 across the mixed-length run.
 
+``--quantized`` (ISSUE-10, also run by serving_smoke) exports the SAME
+model as an f32 and an int8 artifact (docs/serving.md §7), serves both
+versions of one model through the bucket machinery, and reports req/s
+side by side plus ``wire_bytes_*`` / ``compression_ratio`` (the
+artifact bytes every replica pulls).  With ``--smoke`` it asserts a
+tampered-scale manifest is rejected at load, quantized outputs stay
+within the recorded calibration error, and the quantized version adds
+zero programs beyond the per-version bucket bound.
+
 Env knobs: BENCH_SERVING_REQUESTS (default 48), BENCH_SERVING_THREADS
 (16), BENCH_SERVING_MAX_BATCH (8), BENCH_SERVING_LATENCY_US (2000),
 BENCH_SERVING_CACHE_DIR (persistent compile-cache dir; unset = cache
@@ -435,6 +444,160 @@ def run_decode(args):
     return result
 
 
+def run_quantized(args):
+    """ISSUE-10 quantized-serving tier: export LeNet as BOTH the f32
+    and the int8 artifact, register them as two versions of one model,
+    and serve each under the same concurrent load — one BENCH JSON line
+    with quantized-vs-f32 req/s side by side, artifact wire bytes and
+    compression ratio, and the per-version compiled-program bound.
+
+    With ``--smoke`` (the CI serving_smoke tier) it also asserts the
+    acceptance criteria: a tampered-scale manifest is rejected at load
+    with ``MXNetError``, quantized predictions stay within the
+    manifest's recorded calibration error of the f32 references, and
+    the quantized version compiles ZERO programs beyond the same
+    per-version bucket bound the f32 version gets."""
+    import shutil
+
+    from mxnet_tpu.base import MXNetError
+    mx.random.seed(42)
+    rm.enable()
+    net = build_lenet()
+    net.initialize(mx.init.Xavier())
+    net.hybridize(static_alloc=True)
+    x0 = nd.random.uniform(shape=(4, 1, 28, 28))
+    net(x0)
+
+    with tempfile.TemporaryDirectory() as workdir:
+        p_f32 = net.export_stablehlo(
+            x0, path=os.path.join(workdir, "lenet_f32"),
+            dynamic_batch=True, version=1)
+        p_int8 = net.export_stablehlo(
+            x0, path=os.path.join(workdir, "lenet_int8"),
+            dynamic_batch=True, version=2, quantize="int8")
+        bytes_f32 = os.path.getsize(p_f32)
+        bytes_int8 = os.path.getsize(p_int8)
+        manifest = json.load(open(os.path.join(workdir,
+                                               "lenet_int8.json")))
+        calib = manifest["quantization"]["calibration"]
+
+        # tampered-scale manifest must be rejected at load, BEFORE any
+        # serving admission (digest check in deploy.validate_manifest)
+        tampered = os.path.join(workdir, "tampered")
+        shutil.copyfile(p_int8, tampered + ".shlo")
+        bad = json.loads(json.dumps(manifest))
+        bad["quantization"]["weights"][0]["scale"] *= 1.25
+        json.dump(bad, open(tampered + ".json", "w"))
+        tamper_rejected = False
+        try:
+            serving.ModelRepository().load_artifact("evil",
+                                                    tampered + ".shlo")
+        except MXNetError:
+            tamper_rejected = True
+
+        repo = serving.ModelRepository()
+        repo.load_artifact("lenet", p_f32)              # v1 (current)
+        repo.load_artifact("lenet", p_int8, activate=False)  # stage v2
+        cfg = serving.ServingConfig(max_batch_size=args.max_batch,
+                                    max_latency_us=args.latency_us,
+                                    queue_depth=max(64, args.requests))
+        srv = serving.ModelServer(repo, cfg)
+
+        sizes = (1, 2, 3)
+        rng = np.random.RandomState(0)
+        payloads = {n: rng.randn(n, 1, 28, 28).astype(np.float32)
+                    for n in sizes}
+        refs = {n: net(nd.NDArray(payloads[n])).asnumpy()
+                for n in sizes}
+
+        def drive(version_label):
+            srv.prewarm("lenet")
+            errors = []
+            threads = args.threads
+            per_thread = max(1, args.requests // threads)
+
+            def worker(tid):
+                try:
+                    for i in range(per_thread):
+                        n = sizes[(tid + i) % len(sizes)]
+                        got = srv.predict("lenet", payloads[n],
+                                          timeout=300)
+                        # quantized outputs match within the recorded
+                        # calibration error (plus float slack)
+                        tol = 1e-4 + 2.0 * calib["max_abs_err"]
+                        if np.abs(got - refs[n]).max() > tol:
+                            raise AssertionError(
+                                f"{version_label}: output error "
+                                f"{np.abs(got - refs[n]).max()} > {tol}")
+                except Exception as e:          # noqa: BLE001
+                    errors.append(e)
+
+            pool = [threading.Thread(target=worker, args=(t,))
+                    for t in range(threads)]
+            t0 = time.perf_counter()
+            for t in pool:
+                t.start()
+            for t in pool:
+                t.join(600)
+            wall = time.perf_counter() - t0
+            assert not errors, errors[:3]
+            return per_thread * threads / wall
+
+        def cache_misses():
+            # bucket-cache misses == freshly COMPILED XLA programs
+            # (the batcher invariant) — the acceptance criterion's
+            # counter of record
+            return int(rm.SERVING_BUCKET_CACHE.value(event="miss"))
+
+        stats0, miss0 = srv.stats(), cache_misses()
+        req_s_f32 = drive("f32")
+        progs_f32 = srv.stats()["programs"] - stats0["programs"]
+        miss_f32 = cache_misses() - miss0
+        repo.swap("lenet", 2)                   # cutover to int8
+        stats1, miss1 = srv.stats(), cache_misses()
+        req_s_int8 = drive("int8")
+        progs_int8 = srv.stats()["programs"] - stats1["programs"]
+        miss_int8 = cache_misses() - miss1
+        srv.stop()
+
+    bound = int(math.ceil(math.log2(args.max_batch))) + 1
+    result = {
+        "metric": "serving.quantized.throughput",
+        "value": round(req_s_int8, 2),
+        "unit": "req/s",
+        "req_s_f32": round(req_s_f32, 2),
+        "req_s_int8": round(req_s_int8, 2),
+        # artifact wire cost: what every replica pulls at deploy time
+        "wire_bytes_f32": bytes_f32,
+        "wire_bytes_int8": bytes_int8,
+        "compression_ratio": round(bytes_f32 / bytes_int8, 3),
+        "calib_max_abs_err": calib["max_abs_err"],
+        "calib_max_rel_err": calib["max_rel_err"],
+        "programs_f32": progs_f32,
+        "programs_int8": progs_int8,
+        "bucket_misses_f32": miss_f32,
+        "bucket_misses_int8": miss_int8,
+        "program_bound": bound,
+        "tamper_rejected": tamper_rejected,
+        "requests_per_version": args.requests,
+        "max_batch": args.max_batch,
+    }
+    if args.smoke:
+        assert tamper_rejected, \
+            "tampered-scale manifest was NOT rejected at load"
+        # zero extra programs vs the f32 bucket bound: the quantized
+        # version rides the same bucket machinery under the same bound,
+        # verified through the serving.bucket.cache counter (misses ==
+        # freshly compiled programs) AND the batcher's program count
+        assert progs_f32 <= bound, (progs_f32, bound)
+        assert progs_int8 <= bound, (progs_int8, bound)
+        assert miss_f32 == progs_f32, (miss_f32, progs_f32)
+        assert miss_int8 == progs_int8, (miss_int8, progs_int8)
+        assert bytes_f32 / bytes_int8 > 2.0, (bytes_f32, bytes_int8)
+        assert calib["max_rel_err"] < 0.05, calib
+    return result
+
+
 def cache_roundtrip(args):
     """ISSUE-6 CI criterion: serve -> kill the process -> restart on
     the same cache dir -> the warm restart compiles ZERO new XLA
@@ -497,6 +660,12 @@ def main():
                          "through the continuous-batching engine; "
                          "tokens/sec + TTFT/per-token percentiles "
                          "(--smoke asserts the ISSUE-7 criteria)")
+    ap.add_argument("--quantized", action="store_true",
+                    help="quantized-artifact tier: export f32 + int8, "
+                         "serve both versions under load; req/s side "
+                         "by side, artifact compression ratio "
+                         "(--smoke asserts tamper rejection + the "
+                         "program bound)")
     ap.add_argument("--decode-requests", type=int,
                     default=int(os.environ.get(
                         "BENCH_DECODE_REQUESTS", 20)))
@@ -538,6 +707,12 @@ def main():
         print(json.dumps(run_decode(args)))
         if args.smoke:
             print("serving decode smoke ok", file=sys.stderr)
+        return
+
+    if args.quantized:
+        print(json.dumps(run_quantized(args)))
+        if args.smoke:
+            print("serving quantized smoke ok", file=sys.stderr)
         return
 
     def _run(workdir):

@@ -122,6 +122,12 @@ serving_smoke() {
     # programs stay <= prefill buckets + 1 across a 20-request
     # mixed-length run
     python benchmark/bench_serving.py --decode --smoke
+    # quantized round trip (ISSUE-10 acceptance): export int8 ->
+    # tampered-scale manifest rejected at load -> predict through the
+    # quantized version under load, with zero XLA programs beyond the
+    # same per-version bucket bound the f32 version gets, and the
+    # artifact compression ratio reported next to req/s
+    python benchmark/bench_serving.py --quantized --smoke
     # traced request round trip (ISSUE-8 acceptance): one predict +
     # one generate with MXNET_TRACE on — asserts the span chains
     # (admission -> queue wait -> batch/execute; admission -> queue

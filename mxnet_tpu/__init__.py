@@ -46,7 +46,7 @@ for _mod in ("initializer", "optimizer", "metric", "gluon", "io", "kvstore",
              "tracing", "monitor", "util", "runtime",
              "test_utils", "executor", "module", "image", "contrib",
              "parallel", "models", "np", "npx", "lr_scheduler", "operator",
-             "library", "subgraph", "deploy", "serving"):
+             "library", "subgraph", "deploy", "serving", "quantize"):
     try:
         globals()[_mod] = _importlib.import_module(f".{_mod}", __name__)
     except ModuleNotFoundError as _e:

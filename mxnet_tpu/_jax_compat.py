@@ -15,7 +15,25 @@ try:                                      # jax >= 0.8 public location
 except ImportError:                       # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 
-__all__ = ["shard_map", "to_varying"]
+__all__ = ["shard_map", "shard_map_unchecked", "to_varying"]
+
+
+def shard_map_unchecked(f, mesh, in_specs, out_specs):
+    """``shard_map`` with the static replication check disabled.
+
+    The quantized-collective bodies (``mxnet_tpu.quantize``) produce
+    replicated outputs via a symmetric ``all_gather`` + local reduce —
+    semantically replicated, but not provably so to shard_map's static
+    checker (only psum-family results are).  The kwarg spelling moved
+    across JAX versions (``check_rep`` -> ``check_vma``), hence the
+    shim.
+    """
+    try:
+        return shard_map(f, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=False)
+    except TypeError:                     # pragma: no cover - jax >= 0.8
+        return shard_map(f, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
 
 
 def to_varying(x, axis_names):

@@ -194,6 +194,15 @@ declare_env("MXNET_FUSED_STEP_SAVE_POLICY", "auto",
             "or force all / dots / dots_no_batch / none / inherit.")
 declare_env("MXNET_KVSTORE_BIGARRAY_BOUND", 1000000,
             "Arrays above this many elements get their own allreduce bucket.")
+declare_env("MXNET_KVSTORE_GRAD_COMPRESSION", None,
+            "Process-wide default gradient compression for every created "
+            "kvstore: a CompressionSpec string — 'int8' or 'fp8', "
+            "optionally with options ('int8:block=64,stochastic=1,"
+            "error_feedback=0').  On the 'xla' tier quant/dequant runs "
+            "inside the jitted collective (only compressed payloads "
+            "cross chips; kvstore.wire.bytes vs kvstore.push.bytes is "
+            "the live ratio).  Unset (default) = uncompressed; "
+            "set_gradient_compression() overrides per store.")
 declare_env("MXNET_PROFILER_AUTOSTART", 0, "Start profiler at import.")
 declare_env("MXNET_EXCEPTION_VERBOSE", 0, "Verbose async error traces.")
 declare_env("MXNET_DEFAULT_DTYPE", "float32", "Default dtype for new arrays.")
@@ -291,6 +300,21 @@ declare_env("MXNET_SERVING_DECODE_MAX_NEW_TOKENS", 32,
             "Decode engine: default cap on generated tokens per "
             "request (generate(max_new_tokens=...) overrides, bounded "
             "by the model's max_context).")
+declare_env("MXNET_SERVING_QUANT_REQUIRE_DIGEST", "1",
+            "Serving admission of quantized artifacts "
+            "(ModelRepository.load_artifact): 1 (default) rejects a "
+            "manifest v4 quantization block that ships without its "
+            "scale digest — undetectable scale tampering/corruption — "
+            "with a clear MXNetError; 0 admits unprotected scales "
+            "(dev/test only).  A PRESENT digest is always verified "
+            "regardless of this knob.")
+declare_env("MXNET_SERVING_QUANT_MAX_REL_ERR", None,
+            "Serving admission bound on a quantized artifact's "
+            "recorded calibration error: reject at "
+            "ModelRepository.load_artifact when the manifest's "
+            "quantization.calibration.max_rel_err exceeds this float "
+            "(quality gate on what a replica will serve).  Unset "
+            "(default) = no bound.")
 declare_env("MXNET_COMPILE_CACHE_DIR", None,
             "Persistent AOT compiled-executable cache directory "
             "(mxnet_tpu.compile_cache): serving bucket programs are "

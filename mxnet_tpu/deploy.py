@@ -71,9 +71,67 @@ def _aot_buckets(precompile, dynamic_batch, fixed_batch):
     return buckets
 
 
+def _quantization_digest(qblock) -> str:
+    """Content address of a manifest ``quantization`` block (minus the
+    digest field itself): canonical-JSON sha256.  Load-time validation
+    recomputes it, so a hand-edited (or bit-rotted) scale is rejected
+    at ``validate_manifest`` instead of silently mis-describing the
+    baked weights."""
+    body = {k: v for k, v in qblock.items() if k != "digest"}
+    payload = json.dumps(body, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(payload.encode("utf-8")).hexdigest()
+
+
+def _quantize_params(block_name, params, quantize):
+    """Weight-only post-training quantization of a functionalized
+    parameter dict: per-tensor symmetric scales over every >=2d float
+    tensor (matmul/conv kernels — biases and norm vectors stay f32;
+    they are byte-trivial and precision-critical).
+
+    Returns ``(param_fn, quant_block)``: ``param_fn()`` materializes
+    the dequantizing parameter dict (int8/fp8 constants widened in f32,
+    one narrowing cast — the accumulate-wide contract of
+    ``mxnet_tpu.quantize``), and ``quant_block`` is the manifest v4
+    ``quantization`` entry (mode, per-tensor scales, digest).
+    """
+    import jax.numpy as jnp
+
+    from . import quantize as qz
+    if quantize not in ("int8", "fp8"):
+        raise MXNetError(
+            f"export_stablehlo: quantize must be 'int8' or 'fp8', "
+            f"got {quantize!r}")
+    spec = qz.CompressionSpec(kind=quantize)
+    wire_dtype = "int8" if quantize == "int8" else "float8_e4m3fn"
+    packed, weights_meta = {}, []
+    for n, w in params.items():
+        if w.ndim < 2 or not jnp.issubdtype(w.dtype, jnp.floating):
+            continue
+        scale = qz.tensor_scale(w, spec)
+        packed[n] = (qz.quantize_tensor(w, scale, spec), scale,
+                     w.dtype)
+        weights_meta.append({"name": n, "scale": float(scale),
+                             "dtype": wire_dtype,
+                             "elems": int(w.size)})
+    if not weights_meta:
+        raise MXNetError(
+            f"export_stablehlo(quantize={quantize!r}): "
+            f"{block_name} has no >=2d float weight tensors to "
+            f"quantize")
+
+    def param_fn():
+        from . import quantize as qz
+        return {n: (qz.dequantize_tensor(*packed[n])
+                    if n in packed else w)
+                for n, w in params.items()}
+
+    quant_block = {"mode": quantize, "weights": weights_meta}
+    return param_fn, quant_block
+
+
 def export_stablehlo(block, *example_inputs, path, emit_text=False,
                      dynamic_batch=False, version=None, precompile=(),
-                     decode=None):
+                     decode=None, quantize=None):
     """Export ``block``'s inference forward as a StableHLO artifact.
 
     Writes ``path.shlo`` (serialized module, weights embedded as
@@ -113,6 +171,19 @@ def export_stablehlo(block, *example_inputs, path, emit_text=False,
     ``entry.decode_meta``; in-process generation registers the block
     via ``add_decoder``).
 
+    ``quantize='int8'|'fp8'`` exports the QUANTIZED serving shape
+    (manifest v4): every >=2d float weight tensor is packed to
+    int8/float8 with a per-tensor symmetric scale and the program
+    dequantizes at entry (XLA folds the widen-multiply into the
+    consuming ops), so the artifact holds 1-byte weight constants —
+    ~4x smaller, ~4x fewer bytes per replica pull.  The example inputs
+    double as the **calibration batch**: the f32 and quantized forwards
+    both run at export time and the observed output error lands in the
+    manifest's ``quantization.calibration`` entry, so serving admission
+    can bound accepted quality loss (``MXNET_SERVING_QUANT_*``).  The
+    per-tensor scales are digest-protected — a tampered/corrupted
+    manifest scale is rejected at load, not served.
+
     The artifact is self-contained: load it with
     ``jax.export.deserialize(open(...).read()).call(*arrays)`` — no
     ``mxnet_tpu`` import needed at serving time (the deployment-boundary
@@ -126,9 +197,18 @@ def export_stablehlo(block, *example_inputs, path, emit_text=False,
     apply_fn, params = functionalize(block, *example_inputs,
                                      train_mode=False)
 
-    def infer(*xs):
-        out, _aux = apply_fn(params, *xs)
-        return out
+    quant_block = None
+    if quantize:
+        param_fn, quant_block = _quantize_params(
+            type(block).__name__, params, quantize)
+
+        def infer(*xs):
+            out, _aux = apply_fn(param_fn(), *xs)
+            return out
+    else:
+        def infer(*xs):
+            out, _aux = apply_fn(params, *xs)
+            return out
 
     if dynamic_batch:
         if any(len(x.shape) < 1 for x in example_inputs):
@@ -147,10 +227,35 @@ def export_stablehlo(block, *example_inputs, path, emit_text=False,
         exported = jexport.export(jax.jit(infer))(*args)
     except Exception as e:
         raise MXNetError(f"export_stablehlo: lowering failed: {e}") from e
+    if quant_block is not None:
+        # calibration: run the f32 reference AND the quantized forward
+        # on the example inputs, record the observed output error so
+        # load/admission can bound accepted quality loss
+        def _outs(fn):
+            out = fn(*(x._data if hasattr(x, "_data") else x
+                       for x in example_inputs))
+            return out if isinstance(out, (tuple, list)) else (out,)
+        refs = _outs(lambda *xs: apply_fn(params, *xs)[0])
+        qouts = _outs(infer)
+        max_abs = max_rel = 0.0
+        for r, q in zip(refs, qouts):
+            r = np.asarray(r, np.float32)
+            q = np.asarray(q, np.float32)
+            abs_err = float(np.max(np.abs(q - r))) if r.size else 0.0
+            ref_mag = float(np.max(np.abs(r))) if r.size else 0.0
+            max_abs = max(max_abs, abs_err)
+            max_rel = max(max_rel, abs_err / (ref_mag + 1e-12))
+        quant_block["calibration"] = {
+            "examples": int(example_inputs[0].shape[0])
+            if example_inputs and example_inputs[0].shape else 0,
+            "max_abs_err": max_abs,
+            "max_rel_err": max_rel,
+        }
+        quant_block["digest"] = _quantization_digest(quant_block)
     blob = bytes(exported.serialize())
     manifest = {
         "format": "jax.export/stablehlo",
-        "manifest_version": 3,
+        "manifest_version": 4 if quant_block is not None else 3,
         # null when the caller did not pick one, so the serving
         # repository's auto-increment stays in charge (a hard-coded 1
         # would collide on the second default export of a model)
@@ -163,6 +268,8 @@ def export_stablehlo(block, *example_inputs, path, emit_text=False,
     }
     if decode is not None:
         manifest["decode"] = dict(decode)
+    if quant_block is not None:
+        manifest["quantization"] = quant_block
     aot_blobs = []
     if precompile:
         from . import compile_cache as _cc
@@ -335,10 +442,10 @@ def validate_manifest(manifest, where="manifest"):
             f"{version!r}")
     mver = manifest.get("manifest_version")
     if mver is not None and (not isinstance(mver, int)
-                             or not 2 <= mver <= 3):
+                             or not 2 <= mver <= 4):
         raise MXNetError(
             f"{where}: unsupported manifest_version {mver!r} "
-            f"(this loader understands 2..3)")
+            f"(this loader understands 2..4)")
     pre = manifest.get("precompiled")
     if pre is not None:
         # v3: shipped AOT executables; entries must be loadable without
@@ -361,6 +468,81 @@ def validate_manifest(manifest, where="manifest"):
                 raise MXNetError(
                     f"{where}: precompiled entry {i} file {f!r} must "
                     f"be a relative path inside the artifact directory")
+    qb = manifest.get("quantization")
+    if qb is not None:
+        # v4: quantized-artifact metadata.  The scales here describe
+        # the int8/fp8 constants baked into the .shlo — a wrong scale
+        # means the manifest lies about the program, so the block is
+        # both structurally checked and digest-verified.
+        from .ops.shape_rules import QUANT_DTYPES
+        if mver is None or mver < 4:
+            raise MXNetError(
+                f"{where}: 'quantization' needs manifest_version >= 4 "
+                f"(got {mver!r}) — re-export with "
+                f"deploy.export_stablehlo(quantize=...)")
+        if not isinstance(qb, dict) \
+                or qb.get("mode") not in ("int8", "fp8") \
+                or not isinstance(qb.get("weights"), list) \
+                or not qb["weights"]:
+            raise MXNetError(
+                f"{where}: manifest 'quantization' must be a dict with "
+                f"mode in ('int8', 'fp8') and a non-empty 'weights' "
+                f"list")
+        for i, w in enumerate(qb["weights"]):
+            ok = isinstance(w, dict) \
+                and isinstance(w.get("name"), str) \
+                and isinstance(w.get("scale"), (int, float)) \
+                and not isinstance(w.get("scale"), bool) \
+                and isinstance(w.get("dtype"), str) \
+                and isinstance(w.get("elems"), int) and w["elems"] >= 1
+            if not ok:
+                raise MXNetError(
+                    f"{where}: quantization weight entry {i} is not a "
+                    f"{{name, scale, dtype, elems>=1}} record")
+            scale = float(w["scale"])
+            if not (scale > 0.0) or not np.isfinite(scale):
+                raise MXNetError(
+                    f"{where}: quantization scale for {w['name']!r} "
+                    f"must be a positive finite float, got {w['scale']!r}"
+                    f" — the manifest is corrupted or hand-edited; "
+                    f"re-export the artifact")
+            if w["dtype"] not in QUANT_DTYPES:
+                raise MXNetError(
+                    f"{where}: quantization dtype {w['dtype']!r} for "
+                    f"{w['name']!r} not in {sorted(QUANT_DTYPES)}")
+            if (qb["mode"] == "int8") != (w["dtype"] == "int8"):
+                raise MXNetError(
+                    f"{where}: quantization weight {w['name']!r} dtype "
+                    f"{w['dtype']!r} disagrees with mode "
+                    f"{qb['mode']!r}")
+        calib = qb.get("calibration")
+        if calib is not None:
+            if not isinstance(calib, dict):
+                raise MXNetError(
+                    f"{where}: quantization 'calibration' must be a "
+                    f"dict")
+            for field in ("max_abs_err", "max_rel_err"):
+                v = calib.get(field)
+                if v is not None and (
+                        not isinstance(v, (int, float))
+                        or isinstance(v, bool)
+                        or not np.isfinite(float(v)) or float(v) < 0):
+                    raise MXNetError(
+                        f"{where}: calibration {field} must be a "
+                        f"finite nonnegative number, got {v!r}")
+        if "digest" in qb:
+            # a PRESENT digest key must verify — including a null/
+            # non-string value, else nulling the digest would bypass
+            # both this check and the serving REQUIRE_DIGEST gate
+            digest = qb["digest"]
+            if not isinstance(digest, str) \
+                    or digest != _quantization_digest(qb):
+                raise MXNetError(
+                    f"{where}: quantization digest mismatch — the "
+                    f"per-tensor scales were modified after export "
+                    f"(tampered or corrupted manifest); the baked "
+                    f"weights no longer match their description, "
+                    f"refusing to serve.  Re-export the artifact.")
     dec = manifest.get("decode")
     if dec is not None:
         # v3: decode-capable metadata — the paged-KV sizing contract for
@@ -502,6 +684,12 @@ class StableHLOModel:
     @property
     def dynamic_batch(self):
         return bool(self.manifest and self.manifest.get("dynamic_batch"))
+
+    @property
+    def quantization(self):
+        """The manifest v4 ``quantization`` block (mode, per-tensor
+        scales, calibration error) or None for f32 artifacts."""
+        return (self.manifest or {}).get("quantization")
 
     def _shipped_payload(self, key):
         """Path of a precompiled executable shipped next to the manifest

@@ -854,7 +854,7 @@ class HybridBlock(Block):
 
     def export_stablehlo(self, *example_inputs, path, emit_text=False,
                          dynamic_batch=False, version=None,
-                         precompile=()):
+                         precompile=(), quantize=None):
         """Export this block's inference forward as a self-contained
         StableHLO artifact (``deploy.export_stablehlo``): weights baked
         in, ``path.json`` serving-signature manifest alongside.  Pass
@@ -863,12 +863,16 @@ class HybridBlock(Block):
         artifact; ``version`` tags the manifest for repository
         hot-swap; ``precompile`` (bucket list, or True for the serving
         defaults) ships AOT-compiled executables next to the manifest
-        so a matching-topology server starts with zero XLA compiles."""
+        so a matching-topology server starts with zero XLA compiles;
+        ``quantize='int8'|'fp8'`` ships the quantized serving shape
+        (weights packed to 1 byte with per-tensor scales in the
+        manifest v4 ``quantization`` block, example inputs doubling as
+        the calibration batch — docs/serving.md §7)."""
         from .. import deploy
         return deploy.export_stablehlo(
             self, *example_inputs, path=path, emit_text=emit_text,
             dynamic_batch=dynamic_batch, version=version,
-            precompile=precompile)
+            precompile=precompile, quantize=quantize)
 
 
 class SymbolBlock(HybridBlock):

@@ -17,6 +17,16 @@ TPU-native redesign (not a translation):
   ``MXNET_KVSTORE_BIGARRAY_BOUND`` fusion in KVStoreNCCL).
 - 2-bit gradient compression with error-feedback residual (reference:
   ``src/kvstore/gradient_compression.cc``) applies to every tier's push.
+- int8/fp8 blockwise gradient compression (``mxnet_tpu.quantize``;
+  EQuARX, PAPERS.md): on the ``'xla'`` tier quant/dequant runs INSIDE
+  the jitted collective — each device quantizes its shard (+ the
+  error-feedback residual), all-gathers only the 1-byte payload and
+  per-block f32 scales, and accumulates in f32 — so compressed bytes
+  are what actually crosses chips.  Enable per store via
+  ``set_gradient_compression({'type': 'int8', ...})`` or process-wide
+  via ``MXNET_KVSTORE_GRAD_COMPRESSION``.  ``kvstore.wire.bytes``
+  counts interconnect traffic next to the logical
+  ``kvstore.push.bytes``; their ratio is the live compression factor.
 """
 from __future__ import annotations
 
@@ -34,6 +44,7 @@ from ..base import MXNetError, get_env
 from ..context import cpu
 from ..ndarray import NDArray
 from .. import optimizer as opt
+from .. import quantize as qz
 from .. import runtime_metrics as _rm
 from .base import KVStoreBase
 
@@ -44,8 +55,13 @@ from ..util import as_list as _as_list
 
 
 def _nd_bytes(vals) -> int:
-    """Payload size of a list of NDArrays (shape x itemsize; sparse and
-    exotic values count 0 rather than densifying just to be measured)."""
+    """LOGICAL payload size of a list of NDArrays (shape x itemsize;
+    sparse and exotic values count 0 rather than densifying just to be
+    measured).  This is the application-level gradient volume feeding
+    ``kvstore.push.bytes`` / ``kvstore.pull.bytes`` — NOT wire traffic:
+    under gradient compression the interconnect moves the (smaller)
+    compressed representation, counted by ``kvstore.wire.bytes``
+    (docs/observability.md)."""
     total = 0
     for v in vals:
         try:
@@ -92,6 +108,48 @@ class _TwoBitCompressor:
         self._residual[(key, idx)] = g - q
         return q
 
+    def wire_bytes(self, vals) -> int:
+        # host-side sign simulation: nothing compressed actually
+        # crosses a wire here, so account the logical volume
+        return _nd_bytes(vals)
+
+
+class _QuantCompressor:
+    """int8/fp8 blockwise compression (``mxnet_tpu.quantize``) for the
+    per-key host tiers: a quantize -> dequantize round trip with an
+    error-feedback residual per (key, device copy) — the value-level
+    twin of the fused in-collective path the ``'xla'`` tier runs."""
+
+    def __init__(self, spec: qz.CompressionSpec):
+        self.spec = spec
+        self._residual = {}
+        self._step = 0          # stochastic-rounding key stream
+
+    def compress(self, key, idx, grad_data):
+        spec = self.spec
+        res = self._residual.get((key, idx))
+        if res is None or res.shape != grad_data.shape:
+            res = jnp.zeros(grad_data.shape, jnp.float32)
+        rkey = None
+        if spec.stochastic:
+            self._step += 1
+            rkey = jax.random.fold_in(
+                jax.random.PRNGKey(self._step), idx)
+        payload, scales, new_res = qz.quantize_with_feedback(
+            grad_data, res, spec, key=rkey)
+        self._residual[(key, idx)] = new_res
+        return qz.dequantize(payload, scales, grad_data.shape,
+                             grad_data.dtype)
+
+    def wire_bytes(self, vals) -> int:
+        total = 0
+        for v in vals:
+            n = 1
+            for s in v.shape:
+                n *= int(s)
+            total += qz.wire_bytes(n, self.spec)
+        return total
+
 
 class KVStore(KVStoreBase):
     """Classic imperative API: init / push / pull / pushpull.
@@ -135,7 +193,18 @@ class KVStore(KVStoreBase):
             if _rm._ENABLED:
                 _rm.KV_PUSH.inc()
                 _rm.KV_PUSH_BYTES.inc(_nd_bytes(vals))
+                self._count_wire(vals)
             self._push_one(k, vals)
+
+    def _count_wire(self, vals):
+        """Wire-traffic accounting for one push: logical bytes when
+        uncompressed, the compressed representation's size under
+        gradient compression.  The 'xla' tier overrides this — its
+        fused collective accounts per bucket instead."""
+        if self._compressor is not None:
+            _rm.KV_WIRE_BYTES.inc(self._compressor.wire_bytes(vals))
+        else:
+            _rm.KV_WIRE_BYTES.inc(_nd_bytes(vals))
 
     def _push_one(self, k, vals):
         if k not in self._store:
@@ -204,13 +273,41 @@ class KVStore(KVStoreBase):
         self._updater = opt.get_updater(optimizer)
 
     def set_gradient_compression(self, compression_params):
+        """Enable gradient compression on every subsequent push.
+
+        - ``{'type': '2bit', 'threshold': t}`` — reference sign
+          compression with error feedback (host-side simulation);
+        - ``{'type': 'int8'|'fp8', 'block': ..., 'stochastic': ...,
+          'error_feedback': ...}`` — blockwise quantization
+          (``mxnet_tpu.quantize.CompressionSpec``); also accepted as a
+          spec string (``'int8:block=64'``) or a ``CompressionSpec``.
+          On the ``'xla'`` tier quant/dequant runs inside the jitted
+          collective, so only compressed payloads cross chips.
+        """
+        if compression_params is None:
+            self._compressor = None         # disable (e.g. override an
+            return                          # env-default compression)
+        if isinstance(compression_params, qz.CompressionSpec):
+            self._compressor = _QuantCompressor(compression_params)
+            return
+        if isinstance(compression_params, str):
+            spec = qz.CompressionSpec.parse(compression_params)
+            self._compressor = None if spec is None \
+                else _QuantCompressor(spec)
+            return
         params = dict(compression_params)
         ctype = params.pop("type", "2bit")
-        if ctype != "2bit":
-            raise MXNetError(f"unsupported compression type {ctype!r}")
-        self._compressor = _TwoBitCompressor(params.pop("threshold", 0.5))
-        if params:
-            raise MXNetError(f"unknown compression params {params}")
+        if ctype == "2bit":
+            self._compressor = _TwoBitCompressor(
+                params.pop("threshold", 0.5))
+            if params:
+                raise MXNetError(f"unknown compression params {params}")
+            return
+        if ctype in ("int8", "fp8"):
+            self._compressor = _QuantCompressor(
+                qz.CompressionSpec.parse(dict(params, type=ctype)))
+            return
+        raise MXNetError(f"unsupported compression type {ctype!r}")
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
         if self._updater is None:
@@ -279,11 +376,34 @@ class XLA(KVStore):
         super().__init__()
         self._fn_cache = {}
         self._mesh_cache = {}
+        # error-feedback residuals of the quantized fused collective,
+        # keyed by (dtype, bucket key tuple, total): one per-device
+        # rounding-error vector per bucket, sharded over the mesh
+        self._ef_residuals = {}
+        self._quant_step = 0
         self.bigarray_bound = int(get_env("MXNET_KVSTORE_BIGARRAY_BOUND",
                                           1 << 19))
 
     def _pin(self, value):
         return value.copy()
+
+    def _count_wire(self, vals):
+        # the fused collective accounts wire bytes per bucket (it knows
+        # what actually crosses); counting here too would double it
+        pass
+
+    def _maybe_compress(self, k, vals):
+        # int8/fp8 compression happens INSIDE the fused collective —
+        # the host-side value round trip would quantize twice.  Every
+        # multi-copy reduce on this tier (classic push/_push_one
+        # included) lands in _fused_allreduce, which applies the quant
+        # spec there; a single-copy key skips both, correctly — it has
+        # no interconnect hop to compress (and set_optimizer is
+        # rejected on this tier, so the updater fallback is
+        # unreachable).
+        if isinstance(self._compressor, _QuantCompressor):
+            return vals
+        return super()._maybe_compress(k, vals)
 
     # single-key reduce (used by push when called per key)
     def _reduce(self, k, vals):
@@ -302,9 +422,11 @@ class XLA(KVStore):
                 raise MXNetError(
                     f"kvstore: push to uninitialized key {k!r}")
         if any(len(v) == 1 for _, v in pairs) or self._updater is not None \
-                or self._compressor is not None:
-            # degenerate / compressed path: classic push+pull via store
-            # (which carries its own push/pull accounting)
+                or isinstance(self._compressor, _TwoBitCompressor):
+            # degenerate / host-compressed path: classic push+pull via
+            # the store (which carries its own push/pull accounting);
+            # int8/fp8 quantization stays ON the fused path below —
+            # it runs inside the jitted collective
             return super().pushpull(key, value, out, priority)
         if _rm._ENABLED:
             for _k, vals in pairs:
@@ -348,6 +470,40 @@ class XLA(KVStore):
             self._fn_cache[cache_key] = fn
         return fn
 
+    def _quant_allreduce_fn(self, devices, size, dtype, spec):
+        """ONE compiled program per (topology, bucket, dtype, spec):
+        error-feedback quantize + all-gather of the compressed payload
+        + f32 dequant-accumulate, all inside the jitted shard_map body
+        so XLA fuses quant/dequant into the collective and only
+        compressed bytes cross chips."""
+        cache_key = ("quant", devices, size, dtype, spec.key())
+        fn = self._fn_cache.get(cache_key)
+        if fn is None:
+            mesh, _ = self._sharding(devices)
+            from .._jax_compat import shard_map
+            if spec.stochastic:
+                def body(x, res, k):
+                    rkey = jax.random.fold_in(k, lax.axis_index("dev"))
+                    return qz.allreduce_sum(x, res, spec, "dev",
+                                            key=rkey)
+                in_specs = (P("dev"), P("dev"), P())
+            else:
+                def body(x, res):
+                    return qz.allreduce_sum(x, res, spec, "dev")
+                in_specs = (P("dev"), P("dev"))
+            # out_specs P("dev") for the sum too: every device returns
+            # its own (identical, via the symmetric all_gather) copy,
+            # which sidesteps shard_map's static replication check and
+            # hands back exactly the per-device layout the shard
+            # splitter reads (addressable_shards[d] = full sum)
+            sm = shard_map(body, mesh=mesh, in_specs=in_specs,
+                           out_specs=(P("dev"), P("dev")))
+            fn = jax.jit(sm, out_shardings=(
+                NamedSharding(mesh, P("dev")),
+                NamedSharding(mesh, P("dev"))))
+            self._fn_cache[cache_key] = fn
+        return fn
+
     def _fused_allreduce(self, pairs):
         """pairs: [(key, [NDArray per device])] -> {key: [NDArray per dev]}.
 
@@ -385,6 +541,10 @@ class XLA(KVStore):
                     cur, cur_elems = [], 0
             if cur:
                 buckets.append(cur)
+            quant_spec = self._compressor.spec \
+                if isinstance(self._compressor, _QuantCompressor) \
+                and jnp.issubdtype(jnp.dtype(dtype), jnp.floating) \
+                else None
             for bucket in buckets:
                 total = sum(n for _, _, n in bucket)
                 shards = []
@@ -396,7 +556,40 @@ class XLA(KVStore):
                 _, in_sharding = self._sharding(devices)
                 mesh_arr = jax.make_array_from_single_device_arrays(
                     (ndev * total,), in_sharding, shards)
-                out = self._allreduce_fn(devices, total, dtype)(mesh_arr)
+                if quant_spec is not None:
+                    res_key = (dtype, tuple(k for k, _, _ in bucket),
+                               total)
+                    res = self._ef_residuals.get(res_key)
+                    if res is None:
+                        res = jax.device_put(
+                            jnp.zeros((ndev * total,), jnp.float32),
+                            in_sharding)
+                    fn = self._quant_allreduce_fn(
+                        devices, total, dtype, quant_spec)
+                    # bucket totals are NOT request-scoped: they derive
+                    # from the training job's fixed key set (one
+                    # program per (topology, bucket, dtype, spec),
+                    # cached in _fn_cache — same contract as the
+                    # uncompressed _allreduce_fn path)
+                    if quant_spec.stochastic:
+                        self._quant_step += 1
+                        # mxlint: disable=recompile-churn
+                        out, new_res = fn(
+                            mesh_arr, res,
+                            jax.random.PRNGKey(self._quant_step))
+                    else:
+                        # mxlint: disable=recompile-churn
+                        out, new_res = fn(mesh_arr, res)
+                    self._ef_residuals[res_key] = new_res
+                    if _rm._ENABLED:
+                        _rm.KV_WIRE_BYTES.inc(
+                            ndev * qz.wire_bytes(total, quant_spec))
+                else:
+                    out = self._allreduce_fn(devices, total,
+                                             dtype)(mesh_arr)
+                    if _rm._ENABLED:
+                        _rm.KV_WIRE_BYTES.inc(
+                            ndev * total * jnp.dtype(dtype).itemsize)
                 per_dev_full = [s.data for s in out.addressable_shards]
                 # addressable_shards order follows device order in mesh
                 offset = 0
@@ -499,4 +692,10 @@ def create(name="local") -> KVStore:
         raise MXNetError(
             f"unknown kvstore type {name!r}; registered: "
             f"{sorted(KVStoreBase.kv_registry)}")
-    return klass()
+    store = klass()
+    # process-wide default gradient compression: every created store
+    # starts compressed (set_gradient_compression still overrides)
+    env_spec = qz.CompressionSpec.from_env()
+    if env_spec is not None:
+        store.set_gradient_compression(env_spec)
+    return store

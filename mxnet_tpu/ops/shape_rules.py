@@ -44,7 +44,7 @@ __all__ = [
     "dim_eq", "product", "fmt_dim", "fmt_shape",
     "check_reshape", "check_transpose", "broadcast", "check_matmul",
     "check_einsum", "reduce_shape", "concat_shapes",
-    "promote", "DTYPES", "FLOAT_DTYPES", "INT_DTYPES",
+    "promote", "DTYPES", "FLOAT_DTYPES", "INT_DTYPES", "QUANT_DTYPES",
     "SHAPE_RULES", "shape_rule", "rule_for",
 ]
 
@@ -432,7 +432,14 @@ _LATTICE_EDGES = {
     "int8": ("int16",),
     "int16": ("int32",),
     "int32": ("int64",),
-    "int64": ("float",),
+    # float8 members mirror jnp.promote_types exactly: each fp8 flavor
+    # joins with every int (int64 sits atop the signed-int chain) but
+    # with NO other float — jax raises TypePromotionError there, which
+    # this lattice models as "no common ancestor" (promote -> None,
+    # checkers stay quiet)
+    "int64": ("float", "float8_e4m3fn", "float8_e5m2"),
+    "float8_e4m3fn": (),
+    "float8_e5m2": (),
     "float": ("bfloat16", "float16", "complex"),
     "bfloat16": ("float32",),
     "float16": ("float32",),
@@ -446,6 +453,9 @@ DTYPES = frozenset(_LATTICE_EDGES)
 FLOAT_DTYPES = frozenset({"bfloat16", "float16", "float32", "float64"})
 INT_DTYPES = frozenset({"int8", "int16", "int32", "int64",
                         "uint8", "uint16", "uint32", "uint64"})
+# wire/storage dtypes a quantized artifact may declare for its packed
+# weights (deploy manifest v4 `quantization` block)
+QUANT_DTYPES = frozenset({"int8", "float8_e4m3fn", "float8_e5m2"})
 
 _ANCESTORS: Dict[str, frozenset] = {}
 

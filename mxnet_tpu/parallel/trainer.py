@@ -3,13 +3,27 @@
 Replaces Trainer+kvstore at pod scale (SURVEY.md §3.4 TPU mapping): the
 entire fwd+bwd+optimizer+allreduce is a single pjit program; XLA lowers
 the gradient reductions to ICI/DCN collectives from the shardings alone.
+
+With ``compression=`` (int8/fp8, ``mxnet_tpu.quantize``) the
+data-parallel gradient mean runs as an EXPLICIT quantized collective
+instead: the step computes per-device gradients under ``shard_map``
+over the ``dp`` axis, error-feedback-quantizes each device's
+contribution, all-gathers only the compressed payload + per-block f32
+scales, and dequant-accumulates in f32 — still ONE compiled program
+(quant/dequant fuse into the collective), but the bytes crossing chips
+shrink ~4x (EQuARX, PAPERS.md).  The per-device rounding-error
+residuals ride the donated step state like the optimizer state does.
 """
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import quantize as qz
+from .. import runtime_metrics as _rm
+from .._jax_compat import shard_map_unchecked
 from ..base import MXNetError
 from . import optim as _optim
 from .functional import functionalize
@@ -42,12 +56,28 @@ class ShardedTrainer:
 
     def __init__(self, block, loss_fn, mesh: Mesh, optimizer="adamw",
                  optimizer_params=None, rules=MEGATRON_RULES,
-                 example_inputs=(), n_labels=1, dtype=None):
+                 example_inputs=(), n_labels=1, dtype=None,
+                 compression=None):
         if optimizer not in _OPTIMS:
             raise MXNetError(f"unknown optimizer {optimizer!r}; "
                              f"known: {sorted(_OPTIMS)}")
         self.mesh = mesh
         self.block = block
+        self.compression = qz.CompressionSpec.parse(compression)
+        if self.compression is not None:
+            if "dp" not in mesh.shape:
+                raise MXNetError(
+                    "ShardedTrainer(compression=...): mesh has no 'dp' "
+                    "axis to compress gradients over")
+            sharded_axes = [a for a, s in mesh.shape.items()
+                            if a != "dp" and s > 1]
+            if sharded_axes:
+                raise MXNetError(
+                    f"ShardedTrainer(compression=...) needs a pure "
+                    f"data-parallel mesh: axes {sharded_axes} have size "
+                    f"> 1, and quantized sync of tensor/pipeline-"
+                    f"sharded gradients is not supported — drop "
+                    f"compression or reshape the mesh to dp-only")
         opt_init, opt_update, opt_shard = _OPTIMS[optimizer]
         opt_kw = dict(optimizer_params or {})
         if "learning_rate" in opt_kw:
@@ -73,6 +103,7 @@ class ShardedTrainer:
             params, mesh, rules)
         self.opt_state = opt_init(self.params)
         self._n_inputs = len(example_inputs)
+        self._n_labels = int(n_labels)
         # aux/frozen params (grad_req='null': BatchNorm running stats,
         # positional constants) must NOT receive optimizer updates — with
         # zero grads the weight-decay term would silently erode them
@@ -90,33 +121,128 @@ class ShardedTrainer:
             lambda a, s: jax.device_put(a, s), self.opt_state,
             opt_shardings)
 
-        def train_step(params, opt_state, *batch):
-            inputs = batch[:self._n_inputs]
-            labels = batch[self._n_inputs:]
+        if self.compression is None:
+            def train_step(params, opt_state, *batch):
+                inputs = batch[:self._n_inputs]
+                labels = batch[self._n_inputs:]
+
+                def loss_of(p):
+                    out, aux = apply_fn(p, *inputs)
+                    return loss_fn(out, *labels), aux
+
+                (loss, aux), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(params)
+                new_params, new_state = opt_update(params, grads,
+                                                   opt_state, **opt_kw)
+                # frozen params pass through untouched; aux states take
+                # the forward-captured update (BatchNorm moving stats),
+                # exactly like the eager/CachedOp paths
+                new_params = {n: (v if n in trainable else params[n])
+                              for n, v in new_params.items()}
+                for n, v in aux.items():
+                    if n in new_params:
+                        new_params[n] = v.astype(new_params[n].dtype)
+                return new_params, new_state, loss
+
+            self._step = jax.jit(
+                train_step,
+                donate_argnums=(0, 1),
+                out_shardings=(self.param_shardings, opt_shardings,
+                               repl))
+        else:
+            self._build_compressed_step(
+                apply_fn, loss_fn, opt_update, opt_kw, trainable,
+                opt_shardings, repl)
+        self._batch_spec = batch_spec
+
+    def _build_compressed_step(self, apply_fn, loss_fn, opt_update,
+                               opt_kw, trainable, opt_shardings, repl):
+        """The quantized-allreduce variant of the train step: local
+        grads under ``shard_map`` over dp, EF-quantized mean, optimizer
+        outside the manual region.  Per-device residuals are state —
+        donated and re-emitted every step like ``opt_state``."""
+        mesh, spec = self.mesh, self.compression
+        ndp = mesh.shape["dp"]
+        comp_names = tuple(
+            n for n in self.params
+            if n in trainable
+            and jnp.issubdtype(self.params[n].dtype, jnp.floating))
+        comp_set = frozenset(comp_names)
+        comp_index = {n: i for i, n in enumerate(comp_names)}
+        res_sharding = NamedSharding(mesh, P("dp"))
+        # residual leading axis = dp (each device's rounding error);
+        # f32 regardless of param dtype (the EF accumulate-wide rule)
+        self.residuals = {
+            n: jax.device_put(
+                jnp.zeros((ndp,) + tuple(self.params[n].shape),
+                          jnp.float32), res_sharding)
+            for n in comp_names}
+        res_shardings = {n: res_sharding for n in comp_names}
+        n_inputs = self._n_inputs
+        self._quant_step = 0
+
+        def local_sync(p, res, key, *b):
+            inputs = b[:n_inputs]
+            labels = b[n_inputs:]
 
             def loss_of(p):
                 out, aux = apply_fn(p, *inputs)
                 return loss_fn(out, *labels), aux
 
             (loss, aux), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(params)
-            new_params, new_state = opt_update(params, grads, opt_state,
-                                               **opt_kw)
-            # frozen params pass through untouched; aux states take the
-            # forward-captured update (BatchNorm moving stats), exactly
-            # like the eager/CachedOp paths
+                loss_of, has_aux=True)(p)
+            dkey = None
+            if spec.stochastic:
+                dkey = jax.random.fold_in(key, lax.axis_index("dp"))
+            synced, new_res = {}, {}
+            for n, g in grads.items():
+                if n in comp_set:
+                    pkey = None if dkey is None else \
+                        jax.random.fold_in(dkey, comp_index[n])
+                    m, r = qz.allreduce_mean(g, res[n][0], spec, "dp",
+                                             key=pkey)
+                    synced[n] = m
+                    new_res[n] = r[None]
+                else:
+                    synced[n] = lax.pmean(g, "dp")
+            loss = lax.pmean(loss, "dp")
+            aux = {n: (lax.pmean(v, "dp")
+                       if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                   for n, v in aux.items()}
+            return synced, new_res, loss, aux
+
+        sync = shard_map_unchecked(
+            local_sync, mesh,
+            in_specs=(P(), P("dp"), P())
+            + (P("dp"),) * (n_inputs + self._n_labels),
+            out_specs=(P(), P("dp"), P(), P()))
+
+        def train_step(params, opt_state, residuals, key, *batch):
+            synced, new_res, loss, aux = sync(params, residuals, key,
+                                              *batch)
+            new_params, new_state = opt_update(params, synced,
+                                               opt_state, **opt_kw)
             new_params = {n: (v if n in trainable else params[n])
                           for n, v in new_params.items()}
             for n, v in aux.items():
                 if n in new_params:
                     new_params[n] = v.astype(new_params[n].dtype)
-            return new_params, new_state, loss
+            return new_params, new_state, new_res, loss
 
         self._step = jax.jit(
             train_step,
-            donate_argnums=(0, 1),
-            out_shardings=(self.param_shardings, opt_shardings, repl))
-        self._batch_spec = batch_spec
+            donate_argnums=(0, 1, 2),
+            out_shardings=(self.param_shardings, opt_shardings,
+                           res_shardings, repl))
+        # wire accounting, computed once: each of the dp devices
+        # transmits its compressed contribution per step (vs the f32
+        # payload the uncompressed allreduce would move)
+        sizes = [int(self.params[n].size) for n in comp_names]
+        self.wire_bytes_per_step = ndp * sum(
+            qz.wire_bytes(s, spec) for s in sizes)
+        self.logical_bytes_per_step = ndp * sum(
+            qz.logical_bytes(s, self.params[n].dtype)
+            for s, n in zip(sizes, comp_names))
 
     def shard_batch(self, *arrays):
         """Place host arrays batch-sharded over dp."""
@@ -129,8 +255,17 @@ class ShardedTrainer:
     def step(self, *batch):
         """One compiled step; returns the (replicated) scalar loss."""
         batch = self.shard_batch(*[getattr(b, "_data", b) for b in batch])
-        self.params, self.opt_state, loss = self._step(
-            self.params, self.opt_state, *batch)
+        if self.compression is None:
+            self.params, self.opt_state, loss = self._step(
+                self.params, self.opt_state, *batch)
+        else:
+            self._quant_step += 1
+            key = jax.random.PRNGKey(self._quant_step)
+            self.params, self.opt_state, self.residuals, loss = \
+                self._step(self.params, self.opt_state, self.residuals,
+                           key, *batch)
+            if _rm._ENABLED:
+                _rm.KV_WIRE_BYTES.inc(self.wire_bytes_per_step)
         return loss
 
     def write_back(self):

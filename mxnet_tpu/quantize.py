@@ -1,0 +1,333 @@
+"""Blockwise int8/fp8 quantization core — move fewer bytes everywhere.
+
+ONE quantization algebra shared by every byte-moving surface:
+
+- **gradient collectives** — ``kvstore`` push/pull and the fused XLA
+  pushpull quantize *inside* the jitted collective (EQuARX, PAPERS.md),
+  so only int8/fp8 payloads plus per-block f32 scales cross chips;
+- **ShardedTrainer** — the data-parallel gradient allreduce runs the
+  same quant/all-gather/dequant body under ``shard_map``;
+- **serving export** — ``deploy.export_stablehlo(quantize=...)`` bakes
+  int8/fp8 weights + per-tensor scales into the artifact (weight-only
+  post-training quantization, the Gemma-on-TPU serving shape).
+
+Numerical contract (the reason the dtype-promotion lint pass exempts
+this file's core): quantized payloads are ALWAYS accumulated in
+float32 — ``dequantize`` widens the int8/fp8 payload to f32, applies
+the scale in f32, sums across devices in f32, and only then casts back
+to the caller's dtype.  Narrowing happens exactly once, at the
+quantize boundary, where the per-block scale bounds the error to
+``amax / qmax`` per element; the **error-feedback residual** carries
+that rounding error into the next step so it cancels in expectation
+(gradient compression stays convergent — EQuARX / 1-bit-SGD lineage).
+
+Everything here is pure ``jnp`` and jit-safe: no host syncs, no python
+branching on traced values, so XLA fuses quant/dequant into the
+surrounding collective program.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .base import MXNetError, get_env
+
+__all__ = [
+    "CompressionSpec", "quantize", "dequantize",
+    "quantize_with_feedback", "allreduce_sum", "allreduce_mean",
+    "wire_bytes", "logical_bytes", "quantize_tensor",
+    "dequantize_tensor", "tensor_scale",
+]
+
+# int8 uses the symmetric range [-127, 127] (−128 is never emitted so
+# the codebook is symmetric and dequant needs no zero-point); fp8
+# e4m3fn saturates at ±448.
+_QMAX = {"int8": 127.0, "fp8": 448.0}
+_WIRE_ITEMSIZE = {"int8": 1, "fp8": 1}      # both are 1-byte payloads
+_SCALE_ITEMSIZE = 4                          # per-block f32 scale
+
+
+class CompressionSpec:
+    """Immutable description of one quantized-transport policy.
+
+    - ``kind``: ``'int8'`` (symmetric codebook, round-to-nearest or
+      stochastic) or ``'fp8'`` (float8_e4m3fn payload; rounding is the
+      fp8 cast itself).
+    - ``block``: elements per scale block.  Smaller blocks track local
+      gradient magnitude better (lower error) at a scale-overhead cost
+      of ``4 / block`` bytes per element.
+    - ``stochastic``: int8 rounds stochastically (unbiased: E[q] = x)
+      instead of to-nearest; needs a PRNG ``key`` at quantize time.
+    - ``error_feedback``: carry the per-device rounding error into the
+      next step's gradient (on by default — this is what preserves
+      convergence for gradient compression).
+    """
+
+    __slots__ = ("kind", "block", "stochastic", "error_feedback")
+
+    def __init__(self, kind="int8", block=128, stochastic=False,
+                 error_feedback=True):
+        if kind not in _QMAX:
+            raise MXNetError(
+                f"CompressionSpec: unknown kind {kind!r} "
+                f"(supported: {sorted(_QMAX)})")
+        if kind == "fp8" and stochastic:
+            raise MXNetError(
+                "CompressionSpec: stochastic rounding is int8-only — "
+                "the fp8 payload rounds in the e4m3 cast itself "
+                "(round-to-nearest-even); silently ignoring the knob "
+                "would hand back biased rounding where unbiased was "
+                "asked for")
+        block = int(block)
+        if block < 1:
+            raise MXNetError(
+                f"CompressionSpec: block must be >= 1, got {block}")
+        object.__setattr__(self, "kind", kind)
+        object.__setattr__(self, "block", block)
+        object.__setattr__(self, "stochastic", bool(stochastic))
+        object.__setattr__(self, "error_feedback", bool(error_feedback))
+
+    def __setattr__(self, name, value):
+        raise AttributeError("CompressionSpec is immutable")
+
+    # ------------------------------------------------------------ parsing
+    @classmethod
+    def parse(cls, value):
+        """``None`` | spec | ``'int8'`` | ``'int8:block=64,stochastic=1'``
+        | ``{'type': 'int8', 'block': 64, ...}`` -> CompressionSpec|None.
+        """
+        if value is None:
+            return None
+        if isinstance(value, cls):
+            return value
+        if isinstance(value, str):
+            text = value.strip()
+            if not text or text.lower() == "none":
+                return None
+            kind, _, opts = text.partition(":")
+            params = {"type": kind.strip()}
+            for item in filter(None, opts.split(",")):
+                k, sep, v = item.partition("=")
+                if not sep:
+                    raise MXNetError(
+                        f"CompressionSpec: malformed option {item!r} in "
+                        f"{value!r} (want key=value)")
+                params[k.strip()] = v.strip()
+            value = params
+        if not isinstance(value, dict):
+            raise MXNetError(
+                f"CompressionSpec: cannot parse {value!r}")
+        params = dict(value)
+        kind = params.pop("type", params.pop("kind", "int8"))
+        known = {"block", "stochastic", "error_feedback"}
+        unknown = set(params) - known
+        if unknown:
+            raise MXNetError(
+                f"CompressionSpec: unknown params {sorted(unknown)} "
+                f"(known: {sorted(known)})")
+
+        def as_bool(v):
+            if isinstance(v, str):
+                return v.strip().lower() not in ("0", "false", "no", "")
+            return bool(v)
+
+        return cls(kind=kind,
+                   block=params.get("block", 128),
+                   stochastic=as_bool(params.get("stochastic", False)),
+                   error_feedback=as_bool(
+                       params.get("error_feedback", True)))
+
+    @classmethod
+    def from_env(cls):
+        """The ``MXNET_KVSTORE_GRAD_COMPRESSION`` default (None when
+        unset)."""
+        return cls.parse(get_env("MXNET_KVSTORE_GRAD_COMPRESSION"))
+
+    # ---------------------------------------------------------- properties
+    @property
+    def qmax(self) -> float:
+        return _QMAX[self.kind]
+
+    @property
+    def wire_dtype(self):
+        return jnp.int8 if self.kind == "int8" else jnp.float8_e4m3fn
+
+    def key(self):
+        """Hashable identity for program caches."""
+        return (self.kind, self.block, self.stochastic,
+                self.error_feedback)
+
+    def __repr__(self):
+        return (f"CompressionSpec({self.kind!r}, block={self.block}, "
+                f"stochastic={self.stochastic}, "
+                f"error_feedback={self.error_feedback})")
+
+    def __eq__(self, other):
+        return isinstance(other, CompressionSpec) \
+            and self.key() == other.key()
+
+    def __hash__(self):
+        return hash(self.key())
+
+
+# ------------------------------------------------------------------ sizing
+def _nblocks(n_elems: int, spec: CompressionSpec) -> int:
+    return max(1, math.ceil(n_elems / spec.block))
+
+
+def wire_bytes(n_elems: int, spec: CompressionSpec) -> int:
+    """Bytes of the compressed representation one device transmits for
+    an ``n_elems`` tensor: the (block-padded) 1-byte payload plus one
+    f32 scale per block."""
+    nb = _nblocks(n_elems, spec)
+    return nb * spec.block * _WIRE_ITEMSIZE[spec.kind] \
+        + nb * _SCALE_ITEMSIZE
+
+
+def logical_bytes(n_elems: int, dtype) -> int:
+    """Uncompressed payload size (what the f32 collective would move)."""
+    return int(n_elems) * jnp.dtype(dtype).itemsize
+
+
+# -------------------------------------------------------------- quant core
+def _blockify(x, spec: CompressionSpec):
+    """Flatten + zero-pad to a block multiple -> (nb, block) f32."""
+    flat = jnp.ravel(x).astype(jnp.float32)
+    n = flat.shape[0]
+    nb = _nblocks(n, spec)
+    pad = nb * spec.block - n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    return flat.reshape(nb, spec.block)
+
+
+def quantize(x, spec: CompressionSpec, key=None):
+    """Blockwise quantize ``x`` -> ``(payload, scales)``.
+
+    ``payload`` is ``(nb, block)`` of ``spec.wire_dtype``; ``scales``
+    is ``(nb,)`` float32 with ``x ~= payload * scales[:, None]``.
+    Stochastic int8 rounding needs ``key`` (a jax PRNG key); it is
+    unbiased per element, so quantization noise averages out across
+    devices/steps even without error feedback.
+    """
+    blocks = _blockify(x, spec)
+    amax = jnp.max(jnp.abs(blocks), axis=1)
+    # all-zero blocks quantize through scale 1 (payload is all zeros
+    # either way; guards the 0/0 in the divide)
+    scales = jnp.where(amax > 0.0, amax / spec.qmax, 1.0)
+    y = blocks / scales[:, None]
+    if spec.kind == "int8":
+        if spec.stochastic:
+            if key is None:
+                raise MXNetError(
+                    "quantize: stochastic rounding needs a PRNG key")
+            # floor(y + u), u ~ U[0,1): rounds x up with probability
+            # frac(x) — the unbiased-rounding identity E[q] = y
+            u = jax.random.uniform(key, y.shape, jnp.float32)
+            q = jnp.floor(y + u)
+        else:
+            q = jnp.round(y)
+        payload = jnp.clip(q, -spec.qmax, spec.qmax).astype(jnp.int8)
+    else:
+        # fp8: the e4m3 cast IS the rounding step (round-to-nearest-even
+        # in hardware); y is pre-scaled into the saturating range
+        payload = y.astype(jnp.float8_e4m3fn)
+    return payload, scales
+
+
+def dequantize(payload, scales, shape, dtype, n_elems=None):
+    """Invert :func:`quantize` back to ``shape``/``dtype``.
+
+    The widen-multiply runs in float32 regardless of payload or target
+    dtype (the accumulate-wide contract in the module docstring).
+    """
+    f = payload.astype(jnp.float32) * scales[:, None]
+    flat = f.reshape(-1)
+    n = n_elems
+    if n is None:
+        n = 1
+        for d in shape:
+            n *= int(d)
+    return flat[:n].reshape(shape).astype(dtype)
+
+
+def quantize_with_feedback(grad, residual, spec: CompressionSpec,
+                           key=None):
+    """Error-feedback quantize: ``(payload, scales, new_residual)``.
+
+    The residual (previous steps' rounding error, f32, same shape as
+    ``grad``) is added before quantizing; the new residual is what THIS
+    quantization failed to represent.  With ``spec.error_feedback``
+    off, the residual passes through as zeros.
+    """
+    g32 = grad.astype(jnp.float32)
+    total = g32 + residual if spec.error_feedback else g32
+    payload, scales = quantize(total, spec, key=key)
+    if spec.error_feedback:
+        deq = dequantize(payload, scales, total.shape, jnp.float32)
+        new_residual = total - deq
+    else:
+        new_residual = jnp.zeros_like(residual)
+    return payload, scales, new_residual
+
+
+# ------------------------------------------------------- collective bodies
+def allreduce_sum(x, residual, spec: CompressionSpec, axis_name,
+                  key=None):
+    """Quantized allreduce-sum for use INSIDE ``shard_map``: each
+    device quantizes its local ``x`` (+ error-feedback ``residual``),
+    all-gathers the compressed payload + scales over ``axis_name``
+    (only compressed bytes cross the interconnect), dequantizes every
+    device's contribution in f32, and sums.  Returns
+    ``(summed, new_residual)`` — ``summed`` is replicated (identical on
+    every device), ``new_residual`` stays per-device.
+    """
+    payload, scales, new_res = quantize_with_feedback(
+        x, residual, spec, key=key)
+    qg = lax.all_gather(payload, axis_name)          # (ndev, nb, block)
+    sg = lax.all_gather(scales, axis_name)           # (ndev, nb)
+    # accumulate across devices in f32 (see module docstring), then a
+    # single narrowing cast back to the caller's dtype
+    acc = jnp.sum(qg.astype(jnp.float32) * sg[:, :, None], axis=0)
+    n = 1
+    for d in x.shape:
+        n *= int(d)
+    out = acc.reshape(-1)[:n].reshape(x.shape).astype(x.dtype)
+    return out, new_res
+
+
+def allreduce_mean(x, residual, spec: CompressionSpec, axis_name,
+                   key=None):
+    """:func:`allreduce_sum` divided by the axis size (the
+    data-parallel gradient mean)."""
+    summed, new_res = allreduce_sum(x, residual, spec, axis_name,
+                                    key=key)
+    ndev = lax.psum(1, axis_name)
+    return (summed.astype(jnp.float32) / ndev).astype(x.dtype), new_res
+
+
+# -------------------------------------------------- per-tensor (serving)
+def tensor_scale(w, spec: CompressionSpec) -> float:
+    """Per-tensor calibration scale (host-side, used at export time)."""
+    import numpy as np
+    amax = float(np.max(np.abs(np.asarray(w, dtype=np.float32))))
+    return amax / spec.qmax if amax > 0.0 else 1.0
+
+
+def quantize_tensor(w, scale: float, spec: CompressionSpec):
+    """Whole-tensor quantize against a fixed scale (the serving-export
+    path: ONE scale per weight tensor, recorded in the manifest)."""
+    y = jnp.asarray(w, jnp.float32) / jnp.float32(scale)
+    if spec.kind == "int8":
+        return jnp.clip(jnp.round(y), -spec.qmax,
+                        spec.qmax).astype(jnp.int8)
+    return y.astype(jnp.float8_e4m3fn)
+
+
+def dequantize_tensor(q, scale: float, dtype):
+    """Widen a per-tensor quantized weight back (f32 multiply, single
+    narrowing cast — same contract as :func:`dequantize`)."""
+    return (q.astype(jnp.float32) * jnp.float32(scale)).astype(dtype)

@@ -652,10 +652,26 @@ IO_PREFETCH_DEPTH = gauge(
     "Prefetch queue depth observed at the last consumer read.")
 KV_PUSH = counter("kvstore.push", "kvstore push() calls (per key).")
 KV_PUSH_BYTES = counter(
-    "kvstore.push.bytes", "Bytes moved into the kvstore by push().")
+    "kvstore.push.bytes",
+    "LOGICAL (uncompressed, shape x itemsize) bytes pushed into the "
+    "kvstore — the application-level gradient volume, NOT wire "
+    "traffic; see kvstore.wire.bytes for what actually crosses the "
+    "interconnect.")
 KV_PULL = counter("kvstore.pull", "kvstore pull() calls (per key).")
 KV_PULL_BYTES = counter(
-    "kvstore.pull.bytes", "Bytes copied out of the kvstore by pull().")
+    "kvstore.pull.bytes",
+    "LOGICAL (uncompressed) bytes copied out of the kvstore by pull() "
+    "— application-level volume, not wire traffic.")
+KV_WIRE_BYTES = counter(
+    "kvstore.wire.bytes",
+    "Gradient-sync payload bytes that actually cross the interconnect "
+    "(push-direction accounting, per device copy): equals the logical "
+    "push volume for uncompressed collectives, and the compressed "
+    "payload + per-block-scale size under int8/fp8 gradient "
+    "compression (kvstore.set_gradient_compression / "
+    "MXNET_KVSTORE_GRAD_COMPRESSION; ShardedTrainer(compression=...) "
+    "counts its quantized dp-allreduce here too).  "
+    "wire.bytes / push.bytes is the live compression ratio.")
 TRAINER_STEP_SECONDS = histogram(
     "trainer.step.seconds",
     "Wall-clock time of one optimizer step (gluon.Trainer.step / "

@@ -47,7 +47,7 @@ class ModelEntry:
 
     def __init__(self, name, version, kind, signature, dynamic_batch,
                  make_program, fixed_batch=None, decode_model=None,
-                 decode_meta=None):
+                 decode_meta=None, quantization=None):
         self.name = name
         self.version = version
         # "stablehlo" | "block" | "function" | "decoder"
@@ -61,6 +61,9 @@ class ModelEntry:
         # decode-capable metadata block (artifact exports)
         self.decode_model = decode_model
         self.decode_meta = decode_meta
+        # manifest v4 quantization block for quantized artifacts
+        # (mode, per-tensor scales, calibration error) — None for f32
+        self.quantization = quantization
         self.uid = next(_UID)               # distinct across re-registrations
 
     @property
@@ -182,6 +185,33 @@ class ModelRepository:
         if version is None:
             version = manifest.get("version")
         exported = model.exported
+        quantization = manifest.get("quantization")
+        if quantization is not None:
+            # serving-admission policy on top of the structural +
+            # digest checks validate_manifest already ran: production
+            # artifacts must carry the scale digest, and an operator
+            # can bound the calibration error a replica will serve
+            from ..base import env_truthy, get_env
+            if env_truthy("MXNET_SERVING_QUANT_REQUIRE_DIGEST", True) \
+                    and not isinstance(quantization.get("digest"), str):
+                raise MXNetError(
+                    f"load_artifact({name!r}): quantized manifest "
+                    f"ships no scale digest — re-export with "
+                    f"deploy.export_stablehlo(quantize=...) (or set "
+                    f"MXNET_SERVING_QUANT_REQUIRE_DIGEST=0 to admit "
+                    f"unprotected scales)")
+            max_err = get_env("MXNET_SERVING_QUANT_MAX_REL_ERR",
+                              typ=float)
+            rel = (quantization.get("calibration") or {}).get(
+                "max_rel_err")
+            if max_err is not None and rel is not None \
+                    and float(rel) > float(max_err):
+                raise MXNetError(
+                    f"load_artifact({name!r}): quantized artifact's "
+                    f"calibration error {float(rel):.4g} exceeds the "
+                    f"admission bound MXNET_SERVING_QUANT_MAX_REL_ERR="
+                    f"{float(max_err):.4g} — recalibrate/re-export, or "
+                    f"raise the bound")
 
         def make_program(bucket_rows):
             # persistent-cache path first: an AOT executable keyed on
@@ -211,7 +241,8 @@ class ModelRepository:
 
         entry = ModelEntry(name, version, "stablehlo", sig, dynamic,
                            make_program, fixed_batch=fixed,
-                           decode_meta=manifest.get("decode"))
+                           decode_meta=manifest.get("decode"),
+                           quantization=quantization)
         return self._register(entry, activate)
 
     def add_block(self, name, block, *example_inputs, version=None,

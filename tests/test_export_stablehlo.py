@@ -289,3 +289,201 @@ def test_bfloat16_artifact_validates_not_crashes(tmp_path):
     got = np.asarray(fn.call(x.asnumpy())).astype(np.float32)
     want = net(x).asnumpy().astype(np.float32)
     np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+
+
+# ------------------------------------------------------------------------
+# Quantized artifacts (ISSUE-10): export_stablehlo(quantize=) -> manifest
+# v4 quantization block -> digest-validated load -> serving admission.
+# One quantized export shared module-wide (exports are slow).
+# ------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def quant_art(tmp_path_factory):
+    """One int8 dynamic-batch export: (net, x, path-prefix)."""
+    net = _build_net()
+    x = nd.random.uniform(shape=(4, 8))
+    net(x)
+    path = str(tmp_path_factory.mktemp("shlo_quant") / "net_int8")
+    deploy.export_stablehlo(net, x, path=path, dynamic_batch=True,
+                            version=1, quantize="int8")
+    return net, x, path
+
+
+def test_quantized_export_manifest_v4(quant_art):
+    _net, _x, path = quant_art
+    manifest = json.load(open(path + ".json"))
+    assert manifest["manifest_version"] == 4
+    qb = manifest["quantization"]
+    assert qb["mode"] == "int8"
+    # only >=2d float tensors quantize (Dense kernels; BatchNorm
+    # vectors and biases stay f32)
+    names = {w["name"] for w in qb["weights"]}
+    assert len(names) == 2 and all("weight" in n for n in names)
+    for w in qb["weights"]:
+        assert w["dtype"] == "int8" and w["scale"] > 0 and w["elems"] > 0
+    calib = qb["calibration"]
+    assert calib["examples"] == 4
+    assert 0 <= calib["max_rel_err"] < 0.1
+    assert isinstance(qb["digest"], str) and len(qb["digest"]) == 64
+    # inputs/outputs stay f32 — quantization is a weights-storage
+    # property, not a signature change
+    assert manifest["inputs"][0]["dtype"] == "float32"
+
+
+def test_quantized_artifact_roundtrip_within_calibration(quant_art):
+    net, x, path = quant_art
+    model = deploy.load_stablehlo(path + ".shlo")
+    calib = model.quantization["calibration"]
+    ref = net(x).asnumpy()
+    got = np.asarray(model.call(x.asnumpy()))
+    assert np.abs(got - ref).max() <= calib["max_abs_err"] + 1e-6
+    # and a batch size the calibration never saw
+    x2 = nd.random.uniform(shape=(7, 8))
+    got2 = np.asarray(model.call(x2.asnumpy()))
+    ref2 = net(x2).asnumpy()
+    assert np.abs(got2 - ref2).max() < 10 * calib["max_abs_err"] + 1e-3
+
+
+def test_quantized_artifact_smaller_than_f32(tmp_path):
+    # needs weights big enough that the MLIR container overhead does
+    # not drown the 4x constant shrink (the shared fixture net is tiny)
+    mx.random.seed(9)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(256, in_units=64, activation="relu"))
+        net.add(nn.Dense(16, in_units=256))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = nd.random.uniform(shape=(2, 64))
+    net(x)
+    f32 = str(tmp_path / "f32")
+    i8 = str(tmp_path / "i8")
+    deploy.export_stablehlo(net, x, path=f32)
+    deploy.export_stablehlo(net, x, path=i8, quantize="int8")
+    assert os.path.getsize(f32 + ".shlo") \
+        > 2.5 * os.path.getsize(i8 + ".shlo")
+
+
+def test_tampered_scale_rejected_at_load(quant_art, tmp_path):
+    _net, _x, path = quant_art
+    prefix = str(tmp_path / "tampered")
+    shutil.copyfile(path + ".shlo", prefix + ".shlo")
+    manifest = json.load(open(path + ".json"))
+    manifest["quantization"]["weights"][0]["scale"] *= 2.0
+    json.dump(manifest, open(prefix + ".json", "w"))
+    with pytest.raises(MXNetError, match="digest mismatch"):
+        deploy.load_stablehlo(prefix + ".shlo")
+
+
+def test_corrupt_scale_values_rejected(quant_art, tmp_path):
+    _net, _x, path = quant_art
+    manifest = json.load(open(path + ".json"))
+    for bad in (-1.0, 0.0, float("nan"), "x"):
+        m = json.loads(json.dumps(manifest))
+        m["quantization"]["weights"][0]["scale"] = bad
+        with pytest.raises(MXNetError):
+            deploy.validate_manifest(m)
+    # quantization block on a pre-v4 manifest is malformed
+    m = json.loads(json.dumps(manifest))
+    m["manifest_version"] = 3
+    with pytest.raises(MXNetError, match="manifest_version >= 4"):
+        deploy.validate_manifest(m)
+    # nulling the digest must NOT bypass verification: a present key
+    # verifies whatever its value is
+    m = json.loads(json.dumps(manifest))
+    m["quantization"]["digest"] = None
+    with pytest.raises(MXNetError, match="digest mismatch"):
+        deploy.validate_manifest(m)
+    # mode/dtype disagreement
+    m = json.loads(json.dumps(manifest))
+    m["quantization"]["weights"][0]["dtype"] = "float8_e4m3fn"
+    with pytest.raises(MXNetError, match="disagrees with mode"):
+        deploy.validate_manifest(m)
+
+
+def test_quantized_serving_admission_knobs(quant_art, tmp_path,
+                                           monkeypatch):
+    from mxnet_tpu import serving
+    _net, _x, path = quant_art
+    # stripped digest: admitted by validate_manifest (digest optional
+    # structurally) but rejected at serving admission by default
+    prefix = str(tmp_path / "nodigest")
+    shutil.copyfile(path + ".shlo", prefix + ".shlo")
+    manifest = json.load(open(path + ".json"))
+    del manifest["quantization"]["digest"]
+    json.dump(manifest, open(prefix + ".json", "w"))
+    repo = serving.ModelRepository()
+    with pytest.raises(MXNetError, match="no scale digest"):
+        repo.load_artifact("m", prefix + ".shlo")
+    monkeypatch.setenv("MXNET_SERVING_QUANT_REQUIRE_DIGEST", "0")
+    repo.load_artifact("m", prefix + ".shlo")       # dev-mode admits
+    # calibration-error admission bound
+    monkeypatch.delenv("MXNET_SERVING_QUANT_REQUIRE_DIGEST")
+    monkeypatch.setenv("MXNET_SERVING_QUANT_MAX_REL_ERR", "1e-9")
+    with pytest.raises(MXNetError, match="exceeds the admission bound"):
+        repo.load_artifact("m2", path + ".shlo")
+    monkeypatch.setenv("MXNET_SERVING_QUANT_MAX_REL_ERR", "0.5")
+    entry = repo.load_artifact("m2", path + ".shlo")
+    assert entry.quantization["mode"] == "int8"
+
+
+def test_quantized_and_f32_versions_coexist_in_serving(quant_art,
+                                                       tmp_path):
+    """The tentpole serving criterion: f32 and int8 artifacts of ONE
+    model serve side by side through the same bucket machinery, each
+    within the per-version program bound, swap switching between
+    them."""
+    from mxnet_tpu import serving
+    net, x, path = quant_art
+    f32 = str(tmp_path / "f32v")
+    deploy.export_stablehlo(net, x, path=f32, dynamic_batch=True,
+                            version=1)
+    repo = serving.ModelRepository()
+    repo.load_artifact("net", f32 + ".shlo")                 # v1 f32
+    repo.load_artifact("net", path + ".shlo", version=2,
+                       activate=False)                       # v2 int8
+    cfg = serving.ServingConfig(max_batch_size=4, max_latency_us=0)
+    srv = serving.ModelServer(repo, cfg)
+    try:
+        payload = x.asnumpy()
+        ref = net(x).asnumpy()
+        f32_out = srv.predict("net", payload, timeout=120)
+        np.testing.assert_allclose(f32_out, ref, rtol=1e-5, atol=1e-5)
+        repo.swap("net", 2)
+        q_out = srv.predict("net", payload, timeout=120)
+        calib = repo.get("net").quantization["calibration"]
+        assert np.abs(q_out - ref).max() <= calib["max_abs_err"] + 1e-6
+        # distinct programs per version (uids differ), both bounded
+        batcher = srv.batcher
+        assert batcher.programs(repo._resolve("net", 1)) >= 1
+        assert batcher.programs(repo._resolve("net", 2)) >= 1
+        import math
+        bound = int(math.ceil(math.log2(cfg.max_batch_size))) + 1
+        assert batcher.programs(repo._resolve("net", 2)) <= bound
+    finally:
+        srv.stop()
+
+
+def test_fp8_export_roundtrip(tmp_path):
+    net = _build_net()
+    x = nd.random.uniform(shape=(3, 8))
+    net(x)
+    path = str(tmp_path / "net_fp8")
+    deploy.export_stablehlo(net, x, path=path, dynamic_batch=True,
+                            quantize="fp8")
+    model = deploy.load_stablehlo(path + ".shlo")
+    qb = model.quantization
+    assert qb["mode"] == "fp8"
+    assert all(w["dtype"] == "float8_e4m3fn" for w in qb["weights"])
+    ref = net(x).asnumpy()
+    got = np.asarray(model.call(x.asnumpy()))
+    assert np.abs(got - ref).max() <= qb["calibration"]["max_abs_err"] \
+        + 1e-6
+
+
+def test_quantize_arg_validated(tmp_path):
+    net = _build_net()
+    x = nd.random.uniform(shape=(3, 8))
+    net(x)
+    with pytest.raises(MXNetError, match="'int8' or 'fp8'"):
+        deploy.export_stablehlo(net, x, path=str(tmp_path / "bad"),
+                                quantize="int4")

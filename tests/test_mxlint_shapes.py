@@ -392,6 +392,44 @@ def test_dtype_promotion_bf16_accumulation():
     assert "accumulates in bfloat16" in issues[0].message
 
 
+def test_dtype_promotion_quant_core_scoped_exemption():
+    """ISSUE-10 satellite: narrow-accumulation findings anchored in
+    mxnet_tpu/quantize.py are intentional-by-contract (the quant ->
+    accumulate-in-f32 -> dequant core widens before every accumulate;
+    a deliberate 16-bit accumulate there is part of the quant
+    codebook, not a bug) — while the SAME code at any other path still
+    flags, and non-accumulation dtype findings still flag even in the
+    quant core."""
+    accum_src = """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            y = x.astype(jnp.bfloat16)
+            return jnp.sum(y, axis=0)
+    """
+    # the same source: exempt under the quant-core path ...
+    assert run(accum_src, select=["dtype-promotion"],
+               path="mxnet_tpu/quantize.py") == []
+    # ... still a finding anywhere else
+    assert ids(run(accum_src, select=["dtype-promotion"],
+                   path="mxnet_tpu/other.py")) == ["dtype-promotion"]
+    # silent-f64 widening is NOT covered by the exemption
+    f64_src = """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def g(x):
+            y = x.astype("float32")
+            return y * np.float64(2.0)
+    """
+    issues = run(f64_src, select=["dtype-promotion"],
+                 path="mxnet_tpu/quantize.py")
+    assert ids(issues) == ["dtype-promotion"]
+
+
 def test_dtype_promotion_explicit_accum_dtype_is_quiet():
     issues = run("""
         import jax

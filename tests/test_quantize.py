@@ -1,0 +1,438 @@
+"""Quantized collectives (ISSUE-10): the mxnet_tpu.quantize core, the
+kvstore int8/fp8 compressed allreduce (quant/dequant INSIDE the jitted
+collective), the kvstore.wire.bytes accounting, and the ShardedTrainer
+quantized data-parallel gradient sync with error feedback."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, kvstore, nd, parallel
+from mxnet_tpu import quantize as qz
+from mxnet_tpu import runtime_metrics as rm
+from mxnet_tpu.base import MXNetError
+
+CTXS = [mx.cpu(0), mx.cpu(1)]
+
+
+def _rand(shape, seed=0, scale=1.0):
+    return (np.random.RandomState(seed).uniform(-1, 1, shape)
+            * scale).astype("float32")
+
+
+# ---------------------------------------------------------------- spec
+class TestCompressionSpec:
+    def test_parse_string_and_options(self):
+        spec = qz.CompressionSpec.parse("int8:block=64,stochastic=1")
+        assert (spec.kind, spec.block, spec.stochastic) \
+            == ("int8", 64, True)
+        assert spec.error_feedback is True
+        spec = qz.CompressionSpec.parse("fp8:error_feedback=0")
+        assert spec.kind == "fp8" and spec.error_feedback is False
+
+    def test_parse_dict_none_and_passthrough(self):
+        assert qz.CompressionSpec.parse(None) is None
+        assert qz.CompressionSpec.parse("none") is None
+        spec = qz.CompressionSpec.parse({"type": "int8", "block": 32})
+        assert spec.block == 32
+        assert qz.CompressionSpec.parse(spec) is spec
+
+    def test_parse_rejects_unknown(self):
+        with pytest.raises(MXNetError, match="unknown kind"):
+            qz.CompressionSpec.parse("int4")
+        with pytest.raises(MXNetError, match="unknown params"):
+            qz.CompressionSpec.parse({"type": "int8", "threshold": 1})
+        with pytest.raises(MXNetError, match="malformed option"):
+            qz.CompressionSpec.parse("int8:block")
+
+    def test_fp8_stochastic_rejected_not_ignored(self):
+        # fp8 rounds in the e4m3 cast; silently ignoring stochastic=1
+        # would hand back biased rounding where unbiased was asked for
+        with pytest.raises(MXNetError, match="int8-only"):
+            qz.CompressionSpec.parse("fp8:stochastic=1")
+
+    def test_from_env(self, monkeypatch):
+        monkeypatch.setenv("MXNET_KVSTORE_GRAD_COMPRESSION",
+                           "int8:block=16")
+        spec = qz.CompressionSpec.from_env()
+        assert spec.kind == "int8" and spec.block == 16
+        monkeypatch.delenv("MXNET_KVSTORE_GRAD_COMPRESSION")
+        assert qz.CompressionSpec.from_env() is None
+
+    def test_immutable_hashable(self):
+        spec = qz.CompressionSpec("int8")
+        with pytest.raises(AttributeError):
+            spec.block = 7
+        assert spec == qz.CompressionSpec("int8") \
+            and hash(spec) == hash(qz.CompressionSpec("int8"))
+
+
+# ----------------------------------------------------------- quant core
+class TestQuantCore:
+    @pytest.mark.parametrize("kind", ["int8", "fp8"])
+    def test_roundtrip_error_bounded_by_block_scale(self, kind):
+        spec = qz.CompressionSpec(kind, block=32)
+        x = jnp.asarray(_rand((40, 13), 3))
+        payload, scales = qz.quantize(x, spec)
+        assert payload.dtype == spec.wire_dtype
+        assert scales.shape == (qz._nblocks(x.size, spec),)
+        back = qz.dequantize(payload, scales, x.shape, x.dtype)
+        # per-element error <= half a quantization step of its block
+        # (fp8's mantissa step at the block max is coarser than int8's)
+        step = np.repeat(np.asarray(scales), spec.block)[:x.size]
+        err = np.abs(np.asarray(back - x)).ravel()
+        slack = 0.51 if kind == "int8" else 16.1
+        assert (err <= step * slack + 1e-7).all()
+
+    def test_blockwise_scales_track_local_magnitude(self):
+        # one huge block would drown the small half in quant noise;
+        # blockwise scales keep each half's error proportional to ITS
+        # own magnitude
+        spec = qz.CompressionSpec("int8", block=64)
+        x = jnp.concatenate([jnp.full((64,), 100.0),
+                             jnp.full((64,), 1e-3)])
+        _, scales = qz.quantize(x, spec)
+        assert float(scales[0]) > 0.5 and float(scales[1]) < 1e-4
+
+    def test_zero_block_survives(self):
+        spec = qz.CompressionSpec("int8", block=8)
+        x = jnp.zeros((16,))
+        payload, scales = qz.quantize(x, spec)
+        assert np.asarray(qz.dequantize(payload, scales, x.shape,
+                                        x.dtype)).sum() == 0.0
+
+    def test_stochastic_rounding_unbiased(self):
+        spec = qz.CompressionSpec("int8", block=8, stochastic=True)
+        # 0.3 quantization steps above a representable point: determin-
+        # istic rounding always lands below; stochastic averages to it
+        x = jnp.full((8,), 10.3 / 127.0 * 1.0)
+        got = []
+        for i in range(200):
+            p, s = qz.quantize(x, spec, key=jax.random.PRNGKey(i))
+            got.append(float(np.asarray(
+                qz.dequantize(p, s, x.shape, x.dtype))[0]))
+        assert abs(np.mean(got) - float(x[0])) < 0.1 * float(s[0])
+        with pytest.raises(MXNetError, match="PRNG key"):
+            qz.quantize(x, spec)
+
+    def test_error_feedback_residual(self):
+        spec = qz.CompressionSpec("int8", block=8)
+        g = jnp.asarray(_rand((8,), 1))
+        res = jnp.zeros((8,))
+        payload, scales, new_res = qz.quantize_with_feedback(
+            g, res, spec)
+        deq = qz.dequantize(payload, scales, g.shape, jnp.float32)
+        np.testing.assert_allclose(np.asarray(new_res),
+                                   np.asarray(g - deq), rtol=1e-6)
+        no_ef = qz.CompressionSpec("int8", block=8,
+                                   error_feedback=False)
+        _, _, r2 = qz.quantize_with_feedback(g, res, no_ef)
+        assert np.asarray(r2).sum() == 0.0
+
+    def test_wire_bytes_math(self):
+        spec = qz.CompressionSpec("int8", block=128)
+        # 300 elems -> 3 blocks: 384 payload bytes + 12 scale bytes
+        assert qz.wire_bytes(300, spec) == 3 * 128 + 3 * 4
+        assert qz.logical_bytes(300, "float32") == 1200
+        assert qz.logical_bytes(300, "bfloat16") == 600
+
+    def test_tensor_quant_roundtrip(self):
+        spec = qz.CompressionSpec("int8")
+        w = _rand((32, 16), 5)
+        scale = qz.tensor_scale(w, spec)
+        q = qz.quantize_tensor(w, scale, spec)
+        back = np.asarray(qz.dequantize_tensor(q, scale, jnp.float32))
+        assert np.abs(back - w).max() <= scale * 0.51 + 1e-7
+
+
+# ------------------------------------------------------------- kvstore
+class TestKVStoreQuantized:
+    @pytest.mark.parametrize("kind", ["int8", "fp8"])
+    def test_xla_compressed_pushpull_parity(self, kind):
+        kv = kvstore.create("xla")
+        kv.set_gradient_compression({"type": kind, "block": 128})
+        shape = (128, 40)
+        kv.init("w", nd.zeros(shape))
+        a, b = _rand(shape, 1, 0.1), _rand(shape, 2, 0.1)
+        vals = [nd.array(a, ctx=CTXS[0]), nd.array(b, ctx=CTXS[1])]
+        outs = [nd.zeros(shape, ctx=c) for c in CTXS]
+        kv.pushpull("w", vals, out=outs)
+        want = a + b
+        err = np.abs(outs[0].asnumpy() - want).max()
+        # one step's quantization error is bounded by ~a block step
+        # per device contribution
+        assert err < 0.02, err
+        np.testing.assert_array_equal(outs[0].asnumpy(),
+                                      outs[1].asnumpy())
+
+    def test_xla_wire_bytes_ratio(self):
+        rm.enable()
+        rm.reset()
+        try:
+            kv = kvstore.create("xla")
+            kv.set_gradient_compression({"type": "int8"})
+            shape = (256, 64)          # 16384 elems = 128 full blocks
+            kv.init("w", nd.zeros(shape))
+            vals = [nd.array(_rand(shape, i, 0.1), ctx=c)
+                    for i, c in enumerate(CTXS)]
+            outs = [nd.zeros(shape, ctx=c) for c in CTXS]
+            kv.pushpull("w", vals, out=outs)
+            push = rm.KV_PUSH_BYTES.value()
+            wire = rm.KV_WIRE_BYTES.value()
+            assert push / wire >= 3.5, (push, wire)
+            # the ISSUE CI criterion spelling
+            assert wire < push / 3, (push, wire)
+        finally:
+            rm.disable()
+            rm.reset()
+
+    def test_xla_uncompressed_wire_equals_logical(self):
+        rm.enable()
+        rm.reset()
+        try:
+            kv = kvstore.create("xla")
+            shape = (64, 8)
+            kv.init("w", nd.zeros(shape))
+            vals = [nd.array(_rand(shape, i), ctx=c)
+                    for i, c in enumerate(CTXS)]
+            outs = [nd.zeros(shape, ctx=c) for c in CTXS]
+            kv.pushpull("w", vals, out=outs)
+            assert rm.KV_WIRE_BYTES.value() \
+                == rm.KV_PUSH_BYTES.value() > 0
+        finally:
+            rm.disable()
+            rm.reset()
+
+    def test_xla_error_feedback_converges(self):
+        """Repeated pushes of the SAME grads: the running mean of the
+        quantized allreduce approaches the exact sum (EF cancels the
+        rounding error across steps)."""
+        kv = kvstore.create("xla")
+        kv.set_gradient_compression({"type": "int8", "block": 64})
+        shape = (64, 9)
+        kv.init("w", nd.zeros(shape))
+        a, b = _rand(shape, 1, 0.1), _rand(shape, 2, 0.1)
+        vals = [nd.array(a, ctx=CTXS[0]), nd.array(b, ctx=CTXS[1])]
+        outs = [nd.zeros(shape, ctx=c) for c in CTXS]
+        want = a + b
+        kv.pushpull("w", vals, out=outs)
+        one_step = np.abs(outs[0].asnumpy() - want).max()
+        acc = np.zeros(shape, np.float32)
+        steps = 16
+        for _ in range(steps):
+            kv.pushpull("w", vals, out=outs)
+            acc += outs[0].asnumpy()
+        averaged = np.abs(acc / steps - want).max()
+        assert averaged < one_step / 3, (averaged, one_step)
+
+    def test_xla_compressed_multi_key_bucket_fusion(self):
+        kv = kvstore.create("xla")
+        kv.set_gradient_compression({"type": "int8", "block": 64})
+        kv.bigarray_bound = 256     # force shared + solo buckets
+        shapes = [(7,), (130,), (300,)]
+        keys = [str(i) for i in range(len(shapes))]
+        kv.init(keys, [nd.zeros(s) for s in shapes])
+        per_key, want = [], []
+        for i, s in enumerate(shapes):
+            a, b = _rand(s, i, 0.1), _rand(s, 100 + i, 0.1)
+            per_key.append([nd.array(a, ctx=CTXS[0]),
+                            nd.array(b, ctx=CTXS[1])])
+            want.append(a + b)
+        outs = [[nd.zeros(s, ctx=c) for c in CTXS] for s in shapes]
+        kv.pushpull(keys, per_key, out=outs)
+        for i in range(len(shapes)):
+            assert np.abs(outs[i][0].asnumpy() - want[i]).max() < 0.02
+
+    def test_local_tier_quant_compressor(self):
+        kv = kvstore.create("device")
+        kv.set_gradient_compression("int8:block=32")
+        shape = (64,)
+        kv.init("0", nd.zeros(shape))
+        g = _rand(shape, 3, 0.1)
+        vals = [nd.array(g, ctx=c) for c in CTXS]
+        outs = [nd.zeros(shape, ctx=CTXS[0])]
+        kv.pushpull("0", vals, out=outs)
+        assert np.abs(outs[0].asnumpy() - 2 * g).max() < 0.01
+
+    def test_env_knob_compresses_created_stores(self, monkeypatch):
+        monkeypatch.setenv("MXNET_KVSTORE_GRAD_COMPRESSION", "int8")
+        kv = kvstore.create("xla")
+        from mxnet_tpu.kvstore.kvstore import _QuantCompressor
+        assert isinstance(kv._compressor, _QuantCompressor)
+        assert kv._compressor.spec.kind == "int8"
+        # per-store override back to uncompressed must work (the env
+        # default would otherwise be sticky for the whole process)
+        kv.set_gradient_compression(None)
+        assert kv._compressor is None
+
+    def test_xla_classic_push_path_still_compresses(self):
+        """push() (not the fused pushpull) also routes through the
+        in-collective quantizer — wire bytes shrink and the stored
+        value is the quantized sum (no silent f32 fallback)."""
+        rm.enable()
+        rm.reset()
+        try:
+            kv = kvstore.create("xla")
+            kv.set_gradient_compression({"type": "int8"})
+            shape = (256, 16)
+            kv.init("w", nd.zeros(shape))
+            a, b = _rand(shape, 1, 0.1), _rand(shape, 2, 0.1)
+            kv.push("w", [nd.array(a, ctx=CTXS[0]),
+                          nd.array(b, ctx=CTXS[1])])
+            outs = [nd.zeros(shape, ctx=CTXS[0])]
+            kv.pull("w", out=outs)
+            assert np.abs(outs[0].asnumpy() - (a + b)).max() < 0.02
+            push = rm.KV_PUSH_BYTES.value()
+            wire = rm.KV_WIRE_BYTES.value()
+            assert wire < push / 3, (push, wire)
+        finally:
+            rm.disable()
+            rm.reset()
+
+    def test_int8_int_dtype_keys_stay_exact(self):
+        """Non-float keys bypass quantization (exact psum)."""
+        kv = kvstore.create("xla")
+        kv.set_gradient_compression({"type": "int8"})
+        kv.init("i", nd.array(np.zeros((8,), "int32")))
+        vals = [nd.array(np.arange(8, dtype="int32"), ctx=c)
+                for c in CTXS]
+        outs = [nd.array(np.zeros((8,), "int32"), ctx=CTXS[0])]
+        kv.pushpull("i", vals, out=outs)
+        np.testing.assert_array_equal(
+            outs[0].asnumpy(), 2 * np.arange(8, dtype="int32"))
+
+
+# -------------------------------------------------------- ShardedTrainer
+def _mlp():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, activation="relu"), gluon.nn.Dense(1))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def _mse(out, y):
+    return ((out - y) ** 2).mean()
+
+
+class TestShardedTrainerCompression:
+    def test_requires_pure_dp_mesh(self):
+        mesh = parallel.make_mesh(dp=4, tp=2)
+        net = _mlp()
+        x = nd.array(_rand((8, 8), 1))
+        with pytest.raises(MXNetError, match="pure data-parallel"):
+            parallel.ShardedTrainer(net, _mse, mesh,
+                                    example_inputs=(x,),
+                                    compression="int8")
+
+    def test_compressed_step_matches_f32(self):
+        mesh = parallel.make_mesh(dp=8)
+        X = _rand((16, 8), 7)
+        Y = (X @ _rand((8, 1), 8) + 0.1).astype("float32")
+        xs, ys = nd.array(X), nd.array(Y)
+
+        def run(compression):
+            mx.random.seed(0)
+            tr = parallel.ShardedTrainer(
+                _mlp(), _mse, mesh, optimizer="adamw",
+                optimizer_params={"learning_rate": 1e-2},
+                example_inputs=(xs,), n_labels=1,
+                compression=compression)
+            return [float(jax.device_get(tr.step(xs, ys)))
+                    for _ in range(8)], tr
+
+        f32, _ = run(None)
+        int8, tr = run("int8")
+        # forward loss on identical params must match exactly-ish; the
+        # trajectory stays within tight tolerance thanks to EF
+        assert abs(f32[0] - int8[0]) < 1e-4
+        assert abs(f32[-1] - int8[-1]) < 0.05 * abs(f32[0])
+        assert int8[-1] < int8[0] * 0.5, "compressed run not learning"
+        assert tr.wire_bytes_per_step < tr.logical_bytes_per_step
+        assert len(tr.residuals) > 0
+
+    def test_stochastic_rounding_variant_learns(self):
+        mesh = parallel.make_mesh(dp=8)
+        X = _rand((16, 8), 3)
+        Y = (X @ _rand((8, 1), 4)).astype("float32")
+        xs, ys = nd.array(X), nd.array(Y)
+        mx.random.seed(0)
+        tr = parallel.ShardedTrainer(
+            _mlp(), _mse, mesh, optimizer="adamw",
+            optimizer_params={"learning_rate": 1e-2},
+            example_inputs=(xs,), n_labels=1,
+            compression="int8:stochastic=1")
+        losses = [float(jax.device_get(tr.step(xs, ys)))
+                  for _ in range(6)]
+        assert losses[-1] < losses[0]
+
+    def test_wire_counter_increments(self):
+        rm.enable()
+        rm.reset()
+        try:
+            mesh = parallel.make_mesh(dp=8)
+            xs = nd.array(_rand((8, 8), 1))
+            ys = nd.array(_rand((8, 1), 2))
+            tr = parallel.ShardedTrainer(
+                _mlp(), _mse, mesh, example_inputs=(xs,), n_labels=1,
+                compression="int8")
+            tr.step(xs, ys)
+            tr.step(xs, ys)
+            assert rm.KV_WIRE_BYTES.value() \
+                == 2 * tr.wire_bytes_per_step > 0
+        finally:
+            rm.disable()
+            rm.reset()
+
+
+class TestConvergenceParity:
+    """ISSUE-10 satellite: BERT-tiny trained N steps with int8
+    error-feedback compression matches the f32 run's loss within
+    tolerance, on the fake-multidevice harness (tier-1 cheap: 1-layer
+    tiny config, 6 steps)."""
+
+    def test_bert_tiny_int8_matches_f32(self):
+        from mxnet_tpu import models
+        devices = jax.devices()[:4]
+        mesh = parallel.make_mesh(dp=4, devices=devices)
+        rng = np.random.RandomState(0)
+        B, L, V = 8, 8, 64
+        inputs = nd.array(rng.randint(0, V, (B, L)), dtype="int32")
+        token_types = nd.zeros((B, L), dtype="int32")
+        valid_length = nd.array(np.full((B,), L, np.float32))
+        labels = nd.array(rng.randint(0, 2, (B,)), dtype="int32")
+
+        def loss_fn(logits, labels):
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return -jnp.take_along_axis(
+                logp, labels[:, None], axis=1).mean()
+
+        def run(compression, steps=6):
+            mx.random.seed(0)
+            bert = models.get_bert_model(
+                "bert_12_768_12", vocab_size=V, units=32,
+                hidden_size=64, num_layers=1, num_heads=2,
+                max_length=16, dropout=0.0)
+            bert.initialize()
+            head = models.BERTClassifier(bert, num_classes=2,
+                                         dropout=0.0)
+            head.initialize()
+            tr = parallel.ShardedTrainer(
+                head, loss_fn, mesh, optimizer="adamw",
+                optimizer_params={"learning_rate": 5e-3},
+                example_inputs=(inputs, token_types, valid_length),
+                n_labels=1, compression=compression)
+            return [float(jax.device_get(
+                tr.step(inputs, token_types, valid_length, labels)))
+                for _ in range(steps)]
+
+        f32 = run(None)
+        int8 = run("int8")
+        assert np.isfinite(int8).all()
+        # identical initial forward; per-step drift bounded; final loss
+        # within 3% (absolute floor for near-zero losses)
+        assert abs(f32[0] - int8[0]) < 1e-4, (f32[0], int8[0])
+        tol = max(0.03 * abs(f32[-1]), 0.03)
+        assert abs(f32[-1] - int8[-1]) < tol, (f32, int8)
+        assert int8[-1] < int8[0], "int8 run did not descend"
