@@ -598,6 +598,195 @@ def run_quantized(args):
     return result
 
 
+def run_faults(args):
+    """Chaos smoke (docs/serving.md §8): one seeded MXNET_FAULTS-style
+    plan drives execute faults, compile-cache corruption, and a decode
+    poison through the whole resilience layer — ZERO real compiles
+    (numpy function/decoder entries), so it is cheap enough for every
+    CI run.  Asserts the chaos acceptance criteria: every request
+    resolves (completed or TYPED failure — no hung futures), p99 stays
+    bounded, retried outputs byte-match a fault-free run with zero
+    extra programs, quarantined sequences release all KV pages, and
+    the circuit breaker opens and re-closes."""
+    from mxnet_tpu import faults
+    from mxnet_tpu.serving.resilience import CircuitOpenError
+
+    rm.enable()
+    sizes = (1, 2, 3)
+    rng = np.random.RandomState(0)
+    payloads = {n: rng.randn(n, 2).astype(np.float32) for n in sizes}
+    sig = [{"shape": [None, 2], "dtype": "float32"}]
+    n_req, threads = 64, 8
+
+    def serve_round(label, plan_spec):
+        """One full concurrent round; returns (results, stats)."""
+        repo = serving.ModelRepository()
+        repo.add_function("m", lambda a: a * 2.0 + 1.0, sig)
+        cfg = serving.ServingConfig(
+            max_batch_size=4, max_latency_us=500, queue_depth=128,
+            retry_backoff_ms=1, num_workers=2)
+        results, errors = [], []
+
+        def worker(tid):
+            for i in range(n_req // threads):
+                n = sizes[(tid + i) % len(sizes)]
+                try:
+                    results.append(
+                        (n, srv.predict("m", payloads[n], timeout=30)))
+                except Exception as e:          # noqa: BLE001
+                    errors.append(e)
+
+        fired = {}
+        with serving.ModelServer(repo, cfg) as srv:
+            ctx = faults.plan(plan_spec) if plan_spec else None
+            plan_obj = ctx.__enter__() if ctx else None
+            try:
+                pool = [threading.Thread(target=worker, args=(t,))
+                        for t in range(threads)]
+                t0 = time.perf_counter()
+                for t in pool:
+                    t.start()
+                for t in pool:
+                    t.join(120)
+                wall = time.perf_counter() - t0
+            finally:
+                if ctx:
+                    fired = plan_obj.counters()
+                    ctx.__exit__(None, None, None)
+            stats = srv.stats()
+        # zero hung futures: every request resolved one way or the other
+        assert len(results) + len(errors) == n_req, \
+            (label, len(results), len(errors))
+        # typed failures only
+        from mxnet_tpu.base import MXNetError
+        assert all(isinstance(e, MXNetError) for e in errors), errors[:3]
+        # correct results on every success
+        for n, got in results:
+            np.testing.assert_array_equal(got, payloads[n] * 2.0 + 1.0)
+        return results, errors, stats, wall, fired
+
+    # --- phase 1: 5% seeded execute faults, retries absorb them -------
+    ok0, err0, stats0, _, _ = serve_round("fault-free", None)
+    ok1, err1, stats1, wall1, fired = serve_round(
+        "chaos", "serving.execute=fail,p=0.05,seed=11")
+    p99 = rm.SERVING_REQUEST_SECONDS.quantile(0.99, model="m")
+    assert not err0 and stats0["errors"] == 0, (err0[:3], stats0)
+    assert stats0["retries"] == 0
+    assert stats1["retries"] > 0, "5% fault plan never fired"
+    # same program set either way (no chaos-path compiles/buckets)
+    assert stats1["programs"] == stats0["programs"], (stats0, stats1)
+    assert np.isfinite(p99) and p99 < 30, p99
+
+    # --- phase 2: circuit opens under a dead version, then recovers ---
+    repo = serving.ModelRepository()
+    repo.add_function("m", lambda a: a, sig)
+    cfg = serving.ServingConfig(
+        max_batch_size=1, max_latency_us=1, retry_max=0,
+        circuit_window=4, circuit_threshold=0.5, circuit_cooldown_ms=100)
+    opened = recovered = False
+    with serving.ModelServer(repo, cfg) as srv:
+        with faults.plan("serving.execute=fail,times=4"):
+            for _ in range(4):
+                try:
+                    srv.predict("m", payloads[1], timeout=30)
+                except faults.InjectedFault:
+                    pass
+            try:
+                srv.predict("m", payloads[1], timeout=30)
+            except CircuitOpenError:
+                opened = True
+        time.sleep(0.12)                    # cooldown -> half-open probe
+        out = srv.predict("m", payloads[1], timeout=30)
+        np.testing.assert_array_equal(out, payloads[1])
+        state = [c["state"]
+                 for c in srv.debug_state()["circuits"].values()]
+        recovered = state == ["closed"]
+    assert opened, "circuit never opened under 100% execute faults"
+    assert recovered, "circuit did not re-close after the probe"
+
+    # --- phase 3: decode poison -> quarantine, leak-free --------------
+    class PoisonLM:
+        vocab_size, max_context = 16, 32
+
+        def prefill(self, tokens, length, block_table):
+            logits = np.zeros((self.vocab_size,), np.float32)
+            logits[int(tokens[0, int(length) - 1]) % self.vocab_size] = 1
+            return logits
+
+        def decode_step(self, tokens, positions, block_tables):
+            if np.any(tokens == 13):
+                raise ValueError("poisoned decode token")
+            logits = np.zeros((tokens.shape[0], self.vocab_size),
+                              np.float32)
+            logits[np.arange(tokens.shape[0]),
+                   (tokens + 1) % self.vocab_size] = 1.0
+            return logits
+
+    repo = serving.ModelRepository()
+    repo.add_decoder("lm", PoisonLM())
+    cfg = serving.ServingConfig(
+        decode_page_size=4, decode_pool_pages=17, decode_max_batch=4,
+        decode_max_new_tokens=8, retry_backoff_ms=1)
+    quarantined = 0
+    with serving.ModelServer(repo, cfg) as srv:
+        outs, errs = {}, {}
+
+        def gen(i, prompt):
+            try:
+                outs[i] = srv.generate("lm", prompt, max_new_tokens=4,
+                                       timeout=60)
+            except Exception as e:          # noqa: BLE001
+                errs[i] = e
+
+        prompts = [[3], [12], [5], [1]]     # [12] decodes into 13: poison
+        pool = [threading.Thread(target=gen, args=(i, p))
+                for i, p in enumerate(prompts)]
+        for t in pool:
+            t.start()
+        for t in pool:
+            t.join(120)
+        dstats = srv.decode_stats("lm")
+        quarantined = dstats["quarantined"]
+        entry = repo.get("lm")
+        eng = srv._decoders[entry.uid]
+        eng.allocator.check_leaks()         # all pages accounted for
+        assert dstats["used_pages"] == 0, dstats
+    assert set(outs) == {0, 2, 3}, (outs.keys(), errs)
+    assert outs[0].tolist() == [3, 4, 5, 6]
+    assert isinstance(errs[1], ValueError), errs
+    assert quarantined == 1, quarantined
+
+    # --- phase 4: compile-cache blob rot degrades to a counted miss ---
+    import tempfile as _tf
+    with _tf.TemporaryDirectory() as d:
+        cache = compile_cache.CompileCache(cache_dir=d)
+        cache.put("k" * 64, b"payload-bytes")
+        with faults.plan("compile_cache.load=corrupt,times=1"):
+            assert cache.get("k" * 64) is None      # rot -> typed miss
+        assert cache.corrupt == 1 and cache.misses == 1
+        cache.put("k" * 64, b"payload-bytes")       # re-store heals
+        assert cache.get("k" * 64) == b"payload-bytes"
+
+    result = {
+        "metric": "serving.chaos",
+        "value": round(n_req / wall1, 2),
+        "unit": "req/s_under_5pct_execute_faults",
+        "requests": n_req,
+        "completed_chaos": len(ok1),
+        "typed_failures_chaos": len(err1),
+        "hung": 0,
+        "p99_ms": round(p99 * 1e3, 3),
+        "retries": stats1["retries"],
+        "programs_fault_free": stats0["programs"],
+        "programs_chaos": stats1["programs"],
+        "circuit_opened": opened,
+        "circuit_recovered": recovered,
+        "decode_quarantined": quarantined,
+        "faults_fired": fired,
+    }
+    return result
+
+
 def cache_roundtrip(args):
     """ISSUE-6 CI criterion: serve -> kill the process -> restart on
     the same cache dir -> the warm restart compiles ZERO new XLA
@@ -666,6 +855,14 @@ def main():
                          "by side, artifact compression ratio "
                          "(--smoke asserts tamper rejection + the "
                          "program bound)")
+    ap.add_argument("--faults", action="store_true",
+                    help="chaos tier: a seeded 5%% execute-fault plan "
+                         "plus decode poison + cache rot through the "
+                         "resilience layer — asserts zero hung "
+                         "requests, typed failures, bounded p99, "
+                         "leak-free quarantine, and circuit "
+                         "open->probe->close (docs/serving.md §8); "
+                         "numpy fakes only, zero XLA compiles")
     ap.add_argument("--decode-requests", type=int,
                     default=int(os.environ.get(
                         "BENCH_DECODE_REQUESTS", 20)))
@@ -701,6 +898,12 @@ def main():
 
     if args.cache_roundtrip:
         cache_roundtrip(args)
+        return
+
+    if args.faults:
+        print(json.dumps(run_faults(args)))
+        print("serving chaos smoke ok (no hung requests, circuit "
+              "recovered)", file=sys.stderr)
         return
 
     if args.decode:

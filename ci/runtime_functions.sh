@@ -134,10 +134,20 @@ serving_smoke() {
     # wait -> prefill -> decode step -> evict), the p99 exemplar link,
     # and that the flight-recorder dump is non-empty and parsable
     python tools/diagnose.py --trace-smoke
+    # chaos tier (ISSUE-11 acceptance): a seeded fault plan (5%
+    # execute faults + decode poison + compile-cache rot) through the
+    # resilience layer — zero hung requests, typed failures only, p99
+    # bounded, quarantine leak-free, circuit opens AND re-closes, and
+    # the fault-free twin workload byte-matches with zero extra
+    # programs.  Numpy fakes: no XLA compiles in this tier.
+    python benchmark/bench_serving.py --faults
     # the decode scheduler + paged-attention kernel + tracer tests
-    # double as race tests under the concurrency sanitizer
+    # double as race tests under the concurrency sanitizer, and the
+    # fault/resilience tests join them (deadline/retry/bisection paths
+    # cross the same locks)
     MXNET_ENGINE_SANITIZE=1 python -m pytest tests/test_serving_decode.py \
-        tests/test_pallas_paged.py tests/test_tracing.py -x -q
+        tests/test_pallas_paged.py tests/test_tracing.py \
+        tests/test_faults.py -x -q
 }
 
 bench_cpu() {

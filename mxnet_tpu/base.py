@@ -300,6 +300,44 @@ declare_env("MXNET_SERVING_DECODE_MAX_NEW_TOKENS", 32,
             "Decode engine: default cap on generated tokens per "
             "request (generate(max_new_tokens=...) overrides, bounded "
             "by the model's max_context).")
+declare_env("MXNET_SERVING_DEADLINE_DEFAULT", None,
+            "Serving: default end-to-end deadline (seconds, float) for "
+            "predict()/generate() calls that pass no timeout.  The "
+            "timeout is an absolute deadline carried through admission "
+            "-> queue -> batch assembly -> execute: expired requests "
+            "are cancelled BEFORE consuming a batch slot and fail with "
+            "DeadlineExceededError.  Unset (default) = no deadline.")
+declare_env("MXNET_SERVING_RETRY_MAX", 2,
+            "Serving: max re-executions of a TRANSIENT failure "
+            "(exc.transient truthy, e.g. an injected execute fault) "
+            "per coalesced batch / decode model call, with jittered "
+            "exponential backoff.  0 disables retries.")
+declare_env("MXNET_SERVING_RETRY_BACKOFF_MS", 10,
+            "Serving: base of the jittered exponential retry backoff "
+            "(sleep ~ backoff * 2^attempt * U[0.5,1.0) milliseconds "
+            "between transient-failure retries).")
+declare_env("MXNET_SERVING_CIRCUIT_WINDOW", 20,
+            "Serving circuit breaker: sliding window of the last N "
+            "execute outcomes per model version; the breaker can only "
+            "trip once the window is full (doubling as the min-samples "
+            "guard).  0 disables the breaker.")
+declare_env("MXNET_SERVING_CIRCUIT_THRESHOLD", 0.5,
+            "Serving circuit breaker: error rate over the full sliding "
+            "window at/above which the circuit OPENs (admissions shed "
+            "instantly with CircuitOpenError + retry-after until the "
+            "cooldown's half-open probe).")
+declare_env("MXNET_SERVING_CIRCUIT_COOLDOWN_MS", 1000,
+            "Serving circuit breaker: how long an OPEN circuit sheds "
+            "before admitting ONE half-open probe request (probe "
+            "success re-closes, failure re-opens).")
+declare_env("MXNET_FAULTS", None,
+            "Deterministic fault-injection plan for chaos testing "
+            "(mxnet_tpu.faults): 'site=mode[,k=v...][;...]' with mode "
+            "in fail|delay|corrupt|stall and keys p/after/times/ms/"
+            "seed, e.g. 'serving.execute=fail,p=0.05,seed=7'.  Sites "
+            "thread through deploy, compile_cache, the serving "
+            "batcher, the decode engine, and the KV page allocator.  "
+            "Unset (default) = injection off at zero cost.")
 declare_env("MXNET_SERVING_QUANT_REQUIRE_DIGEST", "1",
             "Serving admission of quantized artifacts "
             "(ModelRepository.load_artifact): 1 (default) rejects a "

@@ -40,7 +40,7 @@ import pickle
 import tempfile
 import time
 
-from . import engine, runtime_metrics as _rm
+from . import engine, faults as _faults, runtime_metrics as _rm
 from .base import MXNetError, get_env
 
 __all__ = ["CompileCache", "cache_key", "topology_fingerprint",
@@ -261,6 +261,14 @@ class CompileCache:
             with open(path, "rb") as f:
                 raw = f.read()
         except OSError:
+            return None
+        try:
+            # chaos site: blob rot (corrupt flips a byte -> the
+            # checksum below turns it into a counted miss) or a slow/
+            # failing cache volume — ALL modes degrade to a miss, the
+            # cache's never-raise contract
+            raw = _faults.inject("compile_cache.load", value=raw)
+        except MXNetError:
             return None
         body = _unwrap_payload(raw)
         if body is None:

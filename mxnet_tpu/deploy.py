@@ -25,7 +25,7 @@ import os
 
 import numpy as np
 
-from . import tracing as _tr
+from . import faults as _faults, tracing as _tr
 from .base import MXNetError
 
 __all__ = ["export_stablehlo", "load_stablehlo", "load_manifest",
@@ -773,6 +773,9 @@ class StableHLOModel:
         # (no ambient trace -> no-op); the artifact path identifies
         # WHICH program version a slow request actually ran
         with _tr.span("stablehlo.execute", path=self.path):
+            # chaos site: artifact-execute fail/delay/stall (the
+            # direct-call twin of the batcher's serving.execute site)
+            _faults.inject("deploy.execute")
             return self.exported.call(*raw)
 
     __call__ = call

@@ -1,0 +1,395 @@
+"""Deterministic seeded fault injection for the serving stack
+(docs/serving.md §8).
+
+The serving/decode layers are deep but optimistic: a failed device
+execute, a corrupt cache blob, or a stuck step loop must surface as a
+*typed, bounded* failure, and the only way to prove that is to make the
+failures happen on demand — reproducibly, in CI, on numpy fakes.  This
+module is that chaos switch: a :class:`FaultPlan` maps named injection
+points (threaded through ``deploy``, ``compile_cache``, the batcher,
+the decode engine, and the page allocator) to one of four fault modes,
+with seeded-RNG probability and after-N-calls triggers, so a 5%%
+execute-fault chaos run replays byte-identically from its spec string.
+
+Spec grammar (``MXNET_FAULTS``, or :func:`install` / :func:`plan`)::
+
+    plan  := rule (';' rule)*
+    rule  := site '=' mode (',' key '=' value)*
+    site  := dotted injection-point name; fnmatch globs allowed
+             ('serving.*' matches every serving-layer site)
+    mode  := fail | delay | corrupt | stall
+    keys  := p=<float>      fire probability per call (default 1.0)
+             after=<int>    skip the first N calls of the site (0)
+             times=<int>    fire at most N times (default unlimited)
+             ms=<float>     delay duration (delay: 10ms, stall: 1000ms)
+             seed=<int>     RNG seed component for this rule (0)
+
+    MXNET_FAULTS='serving.execute=fail,p=0.05,seed=7;compile_cache.load=corrupt,times=1'
+
+Modes: **fail** raises :class:`InjectedFault` (marked ``transient`` so
+the serving retry policy treats it as retryable); **delay** and
+**stall** sleep (stall defaults 100x longer — the stuck-worker shape
+that deadline propagation must bound); **corrupt** mutates the value
+passing through the injection point (bytes get a flipped byte, float
+arrays a NaN) so checksum/validation layers downstream must catch it.
+
+Contracts:
+
+- **zero-cost when off**: :func:`inject` / :func:`check` test one
+  module global against None and return — no parsing, no locks, no
+  allocation on the fault-free path (mirrors the ``runtime_metrics``
+  ``_ENABLED`` discipline).
+- **every fired fault is observable**: counted per (site, mode) on the
+  plan, mirrored into ``serving.faults{site,mode}`` when runtime
+  metrics are on, and recorded as a zero-length ``fault.<mode>`` span
+  in the active trace so a chaos run's flight-recorder dumps show
+  exactly which faults a request absorbed.
+- **deterministic**: each rule owns a ``random.Random`` seeded from
+  (seed, site, mode); one plan spec -> one reproducible decision
+  sequence per rule, independent of other rules.
+"""
+from __future__ import annotations
+
+import fnmatch
+import logging
+import threading
+import time
+
+from .base import MXNetError, get_env
+
+__all__ = ["FaultRule", "FaultPlan", "InjectedFault", "install",
+           "clear", "active", "plan", "inject", "check", "counters"]
+
+_LOG = logging.getLogger("mxnet_tpu")
+
+_MODES = ("fail", "delay", "corrupt", "stall")
+_DEFAULT_MS = {"delay": 10.0, "stall": 1000.0}
+
+
+class InjectedFault(MXNetError):
+    """A fault fired by the active :class:`FaultPlan`.
+
+    ``transient`` marks it retryable to the serving retry policy — an
+    injected execute failure models a transient device fault, which is
+    exactly what bounded retries exist to absorb.  ``site``/``mode``
+    let tests and the flight recorder attribute the failure."""
+
+    transient = True
+
+    def __init__(self, site, mode="fail"):
+        self.site = site
+        self.mode = mode
+        super().__init__(f"injected fault at {site!r} (mode={mode})")
+
+
+class FaultRule:
+    """One ``site=mode,...`` clause of a plan.  Trigger state (calls
+    seen, times fired, RNG) is mutated only under the owning plan's
+    lock."""
+
+    __slots__ = ("pattern", "mode", "p", "after", "times", "ms", "seed",
+                 "calls", "fired", "_rng")
+
+    def __init__(self, pattern, mode, p=1.0, after=0, times=None,
+                 ms=None, seed=0):
+        if mode not in _MODES:
+            raise MXNetError(
+                f"fault rule {pattern!r}: unknown mode {mode!r} "
+                f"(expected one of {'/'.join(_MODES)})")
+        if not 0.0 <= p <= 1.0:
+            raise MXNetError(
+                f"fault rule {pattern!r}: p={p} outside [0, 1]")
+        if after < 0 or (times is not None and times < 1):
+            raise MXNetError(
+                f"fault rule {pattern!r}: after must be >= 0 and "
+                f"times >= 1 (got after={after}, times={times})")
+        self.pattern = pattern
+        self.mode = mode
+        self.p = float(p)
+        self.after = int(after)
+        self.times = None if times is None else int(times)
+        self.ms = _DEFAULT_MS.get(mode, 0.0) if ms is None else float(ms)
+        self.seed = int(seed)
+        self.calls = 0
+        self.fired = 0
+        # per-rule deterministic stream: the decision sequence depends
+        # only on (seed, pattern, mode) and this rule's own call order,
+        # never on other rules or global RNG state
+        import random
+        self._rng = random.Random(f"{self.seed}\x1f{pattern}\x1f{mode}")
+
+    def matches(self, site):
+        return self.pattern == site or fnmatch.fnmatchcase(site,
+                                                           self.pattern)
+
+    def should_fire(self):
+        # mxlint: disable=lock-discipline (contract: FaultPlan calls
+        # this under its plan lock — rules are plan-internal state)
+        self.calls += 1
+        if self.calls <= self.after:
+            return False
+        if self.times is not None and self.fired >= self.times:
+            return False
+        if self.p < 1.0 and self._rng.random() >= self.p:
+            return False
+        self.fired += 1
+        return True
+
+    def spec(self):
+        out = f"{self.pattern}={self.mode}"
+        if self.p < 1.0:
+            out += f",p={self.p}"
+        if self.after:
+            out += f",after={self.after}"
+        if self.times is not None:
+            out += f",times={self.times}"
+        if self.ms != _DEFAULT_MS.get(self.mode, 0.0):
+            out += f",ms={self.ms}"
+        if self.seed:
+            out += f",seed={self.seed}"
+        return out
+
+    def __repr__(self):
+        return (f"FaultRule({self.spec()!r}, calls={self.calls}, "
+                f"fired={self.fired})")
+
+
+def _parse_rule(clause):
+    head, _, tail = clause.partition(",")
+    site, sep, mode = head.partition("=")
+    if not sep or not site or not mode:
+        raise MXNetError(
+            f"fault spec clause {clause!r}: expected 'site=mode[,k=v...]'"
+            f" (grammar in mxnet_tpu/faults.py)")
+    kw = {}
+    if tail:
+        for pair in tail.split(","):
+            key, sep, value = pair.partition("=")
+            key = key.strip()
+            if not sep or key not in ("p", "after", "times", "ms",
+                                      "seed"):
+                raise MXNetError(
+                    f"fault spec clause {clause!r}: bad option {pair!r} "
+                    f"(expected p/after/times/ms/seed = value)")
+            typ = float if key in ("p", "ms") else int
+            try:
+                kw[key] = typ(value)
+            except ValueError as e:
+                raise MXNetError(
+                    f"fault spec clause {clause!r}: {e}") from None
+    return FaultRule(site.strip(), mode.strip(), **kw)
+
+
+class FaultPlan:
+    """A parsed set of :class:`FaultRule`\\ s plus their firing state.
+
+    The plan owns one lock for trigger bookkeeping; the sleep of a
+    delay/stall fault happens OUTSIDE it so a stalled site never blocks
+    other sites' trigger decisions."""
+
+    def __init__(self, rules, spec=""):
+        from . import engine
+        self.rules = list(rules)
+        self.spec = spec or ";".join(r.spec() for r in self.rules)
+        self._lock = engine.make_lock("faults.FaultPlan._lock")
+
+    @classmethod
+    def parse(cls, spec):
+        clauses = [c.strip() for c in str(spec).split(";") if c.strip()]
+        if not clauses:
+            raise MXNetError(
+                f"fault spec {spec!r} holds no rules — expected "
+                f"'site=mode[,k=v...][;...]'")
+        return cls([_parse_rule(c) for c in clauses], spec=str(spec))
+
+    # ------------------------------------------------------------- firing
+    def fire(self, site, modes=None):
+        """The first matching rule that fires for this call of ``site``
+        (or None).  Every matching rule's call counter advances, so
+        ``after=N`` counts real traffic even when an earlier rule
+        shadows it.  ``modes`` restricts which rule modes may fire —
+        sites with custom failure semantics (the page allocator's
+        refusal contract) only honor the modes they can express; a
+        non-matching mode neither fires nor consumes the rule's
+        call/times budget at this site."""
+        hit = None
+        with self._lock:
+            for rule in self.rules:
+                if not rule.matches(site):
+                    continue
+                if modes is not None and rule.mode not in modes:
+                    continue
+                if rule.should_fire() and hit is None:
+                    hit = rule
+        if hit is not None:
+            self._observe(site, hit)
+        return hit
+
+    def _observe(self, site, rule):
+        from . import runtime_metrics as _rm, tracing as _tr
+        if _rm._ENABLED:
+            _rm.SERVING_FAULTS.inc(site=site, mode=rule.mode)
+        if _tr._ENABLED:
+            ctx = _tr.current_context()
+            if ctx is not None:
+                now = time.perf_counter()
+                _tr.record_span(f"fault.{rule.mode}", ctx, now, now,
+                                {"site": site})
+        _LOG.debug("faults: fired %s at %s (rule %s)", rule.mode, site,
+                   rule.spec())
+
+    # ------------------------------------------------------------ readers
+    def counters(self):
+        """{'site-pattern:mode': fired} — what actually happened, for
+        chaos-smoke assertions and incident dumps."""
+        with self._lock:
+            return {f"{r.pattern}:{r.mode}": r.fired for r in self.rules}
+
+    def debug_state(self):
+        with self._lock:
+            return {"spec": self.spec,
+                    "rules": [{"pattern": r.pattern, "mode": r.mode,
+                               "p": r.p, "after": r.after,
+                               "times": r.times, "ms": r.ms,
+                               "seed": r.seed, "calls": r.calls,
+                               "fired": r.fired}
+                              for r in self.rules]}
+
+    def __repr__(self):
+        return f"FaultPlan({self.spec!r})"
+
+
+# ---------------------------------------------------------------------------
+# module-level switch (the hot path reads ONE global against None)
+# ---------------------------------------------------------------------------
+_ACTIVE = None
+
+
+def _init_from_env():
+    spec = get_env("MXNET_FAULTS", typ=str)
+    if not spec:
+        return None
+    try:
+        return FaultPlan.parse(spec)
+    except MXNetError as e:
+        # a typo in the chaos knob must not take the process down —
+        # faults are a test harness, not a correctness dependency
+        _LOG.warning("faults: ignoring invalid MXNET_FAULTS: %s", e)
+        return None
+
+
+def install(plan_or_spec):
+    """Activate a plan process-wide (replacing any active one).
+    Accepts a :class:`FaultPlan` or a spec string.  Returns the plan."""
+    global _ACTIVE
+    fp = plan_or_spec if isinstance(plan_or_spec, FaultPlan) \
+        else FaultPlan.parse(plan_or_spec)
+    _ACTIVE = fp
+    return fp
+
+
+def clear():
+    """Deactivate fault injection (back to the zero-cost path)."""
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def active():
+    """The installed :class:`FaultPlan`, or None."""
+    return _ACTIVE
+
+
+class plan:
+    """Scoped installation for tests::
+
+        with faults.plan("serving.execute=fail,times=1"):
+            ...
+    """
+
+    def __init__(self, plan_or_spec):
+        self._plan = plan_or_spec
+
+    def __enter__(self):
+        self._prev = _ACTIVE
+        return install(self._plan)
+
+    def __exit__(self, *exc):
+        global _ACTIVE
+        _ACTIVE = self._prev
+        return False
+
+
+def counters():
+    """The active plan's fired counters ({} when off) — merged into
+    flight-recorder incident dumps by ``tracing.record_incident``."""
+    fp = _ACTIVE
+    return fp.counters() if fp is not None else {}
+
+
+# ---------------------------------------------------------------------------
+# injection points
+# ---------------------------------------------------------------------------
+def _flip_byte(data):
+    if not data:
+        return data
+    buf = bytearray(data)
+    buf[len(buf) // 2] ^= 0xFF
+    return bytes(buf)
+
+
+def _corrupt_value(site, value):
+    import numpy as np
+    if value is None:
+        # nothing flows through this site — the honest degraded
+        # behavior is a typed failure, not silent success
+        raise InjectedFault(site, "corrupt")
+    if isinstance(value, (bytes, bytearray)):
+        return _flip_byte(value)
+    arr = np.array(value, copy=True)
+    if arr.dtype.kind == "f" and arr.size:
+        arr.flat[arr.size // 2] = np.nan
+    elif arr.size:
+        arr.flat[arr.size // 2] = ~arr.flat[arr.size // 2]
+    return arr
+
+
+def inject(site, value=None):
+    """The generic injection point.  Zero-cost no-op without an active
+    plan; otherwise applies the first firing rule for ``site``:
+
+    - ``fail``    -> raises :class:`InjectedFault` (transient);
+    - ``delay`` / ``stall`` -> sleeps the rule's ``ms``;
+    - ``corrupt`` -> returns a corrupted copy of ``value`` (bytes: one
+      flipped byte; float array: one NaN; ``value=None``: raises).
+
+    Returns ``value`` (possibly corrupted) so call sites can thread a
+    payload through: ``raw = faults.inject("compile_cache.load", raw)``.
+    """
+    fp = _ACTIVE
+    if fp is None:
+        return value
+    rule = fp.fire(site)
+    if rule is None:
+        return value
+    if rule.mode == "fail":
+        raise InjectedFault(site)
+    if rule.mode == "corrupt":
+        return _corrupt_value(site, value)
+    time.sleep(rule.ms / 1e3)           # delay | stall
+    return value
+
+
+def check(site):
+    """Fire-only probe for sites with custom failure semantics (the
+    page allocator reports exhaustion by returning False, not by
+    raising).  True when a ``fail``-mode rule fired for ``site``;
+    never raises, never sleeps — and only ``fail`` rules fire here,
+    so a latency-only plan (``*=delay``) can never masquerade as
+    resource exhaustion."""
+    fp = _ACTIVE
+    if fp is None:
+        return False
+    return fp.fire(site, modes=("fail",)) is not None
+
+
+_ACTIVE = _init_from_env()

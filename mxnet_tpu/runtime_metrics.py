@@ -755,6 +755,32 @@ SERVING_DECODE_KV_OCCUPANCY = gauge(
     "serving.decode.kv.occupancy",
     "Used fraction of the paged KV cache pool (allocated pages / "
     "usable pages), per decode engine.", labelnames=("engine",))
+SERVING_FAULTS = counter(
+    "serving.faults",
+    "Faults fired by the active fault-injection plan "
+    "(mxnet_tpu.faults, MXNET_FAULTS), labeled by injection site and "
+    "mode (fail|delay|corrupt|stall).",
+    labelnames=("site", "mode"))
+SERVING_RETRIES = counter(
+    "serving.retries",
+    "Transient-failure retries on the serving execute paths (coalesced "
+    "batch re-execution, decode prefill/step re-execution), per model.",
+    labelnames=("model",))
+SERVING_DEADLINE_EXCEEDED = counter(
+    "serving.deadline_exceeded",
+    "Requests failed by end-to-end deadline expiry (in the queue, at "
+    "batch assembly, or mid-generation), per model.",
+    labelnames=("model",))
+SERVING_CIRCUIT_STATE = gauge(
+    "serving.circuit.state",
+    "Per-model-version circuit-breaker state: 0 closed, 1 half-open, "
+    "2 open (serving.resilience.CircuitBreaker).",
+    labelnames=("model", "version"))
+SERVING_DECODE_QUARANTINED = counter(
+    "serving.decode.quarantined",
+    "Sequences evicted alone after a decode/prefill step failure was "
+    "bisected down to them (pages reclaimed, batchmates keep "
+    "decoding), per model.", labelnames=("model",))
 COMPILE_CACHE = counter(
     "compile.cache",
     "Persistent compiled-executable cache events "

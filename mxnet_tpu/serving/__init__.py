@@ -24,7 +24,15 @@ the missing serving tier over it:
   (:mod:`~mxnet_tpu.serving.kv_cache`): admit/evict sequences every
   STEP, prompt-length-bucketed prefill + one fixed-shape decode
   program (ragged paged attention, ``ops/pallas_kernels.py``), and
-  streaming token callbacks (docs/serving.md §6).
+  streaming token callbacks (docs/serving.md §6);
+- the resilience layer (docs/serving.md §8): end-to-end request
+  deadlines (:class:`DeadlineExceededError` instead of silent hangs),
+  bounded jittered retries for transient execute failures,
+  failed-batch bisection (one poisoned request fails alone), decode
+  step-failure quarantine, and per-model-version circuit breakers
+  (:class:`CircuitBreaker`, :class:`CircuitOpenError`) — all provable
+  under the deterministic fault-injection plans of
+  :mod:`mxnet_tpu.faults` (``MXNET_FAULTS``).
 
 >>> from mxnet_tpu import serving
 >>> repo = serving.ModelRepository()
@@ -38,10 +46,14 @@ from .config import ServingConfig
 from .decode import DecodeEngine, GenerateRequest, PagedLMAdapter
 from .kv_cache import DeviceKVPool, PageAllocator, PageGeometry
 from .repository import ModelEntry, ModelRepository
+from .resilience import (CircuitBreaker, CircuitOpenError, Deadline,
+                         DeadlineExceededError)
 from .server import ModelServer, ServerOverloadedError
 
 __all__ = ["ModelRepository", "ModelEntry", "ModelServer",
            "DynamicBatcher", "ServingConfig", "ServerOverloadedError",
            "next_bucket", "pad_batch", "unpad_outputs",
            "DecodeEngine", "GenerateRequest", "PagedLMAdapter",
-           "PageGeometry", "PageAllocator", "DeviceKVPool"]
+           "PageGeometry", "PageAllocator", "DeviceKVPool",
+           "Deadline", "DeadlineExceededError", "CircuitBreaker",
+           "CircuitOpenError"]

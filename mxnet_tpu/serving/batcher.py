@@ -23,7 +23,8 @@ import threading
 
 import numpy as np
 
-from .. import engine, runtime_metrics as _rm, tracing as _tr
+from .. import engine, faults as _faults, runtime_metrics as _rm, \
+    tracing as _tr
 from ..base import MXNetError
 
 __all__ = ["DynamicBatcher", "next_bucket", "bucket_set", "pad_batch",
@@ -145,6 +146,10 @@ class DynamicBatcher:
                     break               # this thread builds
             pending.wait()              # builder done (or failed): recheck
         try:
+            # chaos site: a transient compile/build failure — the
+            # worker-level retry policy re-enters program_for, and the
+            # waiter-wake contract below hands the build to a retrier
+            _faults.inject("serving.compile")
             prog = entry.make_program(bucket_rows)
         except BaseException:
             # wake waiters so one of them retries as the next builder
@@ -211,6 +216,9 @@ class DynamicBatcher:
         padded, offsets = pad_batch(request_inputs, bucket)
         prog = self.program_for(entry, bucket)
         with _tr.span("serving.execute", bucket=bucket, rows=rows):
+            # chaos site: device-execute fail/delay/stall — what the
+            # serving retry + bisection + deadline machinery absorbs
+            _faults.inject("serving.execute")
             outs = prog(*padded)
             # bounded sync point: block on THIS batch (async errors
             # surface here, engine rethrow-at-sync-point contract)

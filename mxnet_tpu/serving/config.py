@@ -33,13 +33,25 @@ class ServingConfig:
     ``decode_pool_pages`` total preallocated pages (incl. the null
     page), ``decode_max_batch`` sequence slots in the fixed-shape
     decode step, ``decode_max_new_tokens`` default generation cap.
+
+    Resilience knobs (docs/serving.md §8): ``deadline_default``
+    seconds applied when a call passes no timeout (None = unbounded),
+    ``retry_max`` transient-failure re-executions with
+    ``retry_backoff_ms`` jittered exponential backoff, and the
+    per-model-version circuit breaker (``circuit_window`` sliding
+    outcomes, trip at ``circuit_threshold`` error rate, shed for
+    ``circuit_cooldown_ms`` before the half-open probe;
+    ``circuit_window=0`` disables).
     """
 
     def __init__(self, max_batch_size=None, max_latency_us=None,
                  queue_depth=None, shed_watermark=None, num_workers=None,
                  retry_after_ms=None, decode_page_size=None,
                  decode_pool_pages=None, decode_max_batch=None,
-                 decode_max_new_tokens=None):
+                 decode_max_new_tokens=None, deadline_default=None,
+                 retry_max=None, retry_backoff_ms=None,
+                 circuit_window=None, circuit_threshold=None,
+                 circuit_cooldown_ms=None):
         def pick(value, env, typ=int):
             if value is None:
                 value = get_env(env, typ=typ)
@@ -65,6 +77,22 @@ class ServingConfig:
                                      "MXNET_SERVING_DECODE_MAX_BATCH")
         self.decode_max_new_tokens = pick(
             decode_max_new_tokens, "MXNET_SERVING_DECODE_MAX_NEW_TOKENS")
+        # resilience policy (docs/serving.md §8)
+        self.deadline_default = pick(deadline_default,
+                                     "MXNET_SERVING_DEADLINE_DEFAULT",
+                                     typ=float)
+        self.retry_max = pick(retry_max, "MXNET_SERVING_RETRY_MAX")
+        self.retry_backoff_ms = pick(retry_backoff_ms,
+                                     "MXNET_SERVING_RETRY_BACKOFF_MS",
+                                     typ=float)
+        self.circuit_window = pick(circuit_window,
+                                   "MXNET_SERVING_CIRCUIT_WINDOW")
+        self.circuit_threshold = pick(circuit_threshold,
+                                      "MXNET_SERVING_CIRCUIT_THRESHOLD",
+                                      typ=float)
+        self.circuit_cooldown_ms = pick(
+            circuit_cooldown_ms, "MXNET_SERVING_CIRCUIT_COOLDOWN_MS",
+            typ=float)
 
         if self.max_batch_size < 1:
             raise MXNetError("ServingConfig: max_batch_size must be >= 1")
@@ -96,6 +124,26 @@ class ServingConfig:
         if self.decode_max_new_tokens < 1:
             raise MXNetError(
                 "ServingConfig: decode_max_new_tokens must be >= 1")
+        if self.deadline_default is not None \
+                and self.deadline_default <= 0:
+            raise MXNetError(
+                "ServingConfig: deadline_default must be > 0 seconds "
+                "(or None for no deadline)")
+        if self.retry_max < 0:
+            raise MXNetError("ServingConfig: retry_max must be >= 0")
+        if self.retry_backoff_ms < 0:
+            raise MXNetError(
+                "ServingConfig: retry_backoff_ms must be >= 0")
+        if self.circuit_window < 0:
+            raise MXNetError(
+                "ServingConfig: circuit_window must be >= 0 "
+                "(0 disables the breaker)")
+        if not 0.0 < self.circuit_threshold <= 1.0:
+            raise MXNetError(
+                "ServingConfig: circuit_threshold must be in (0, 1]")
+        if self.circuit_cooldown_ms < 0:
+            raise MXNetError(
+                "ServingConfig: circuit_cooldown_ms must be >= 0")
 
     def __repr__(self):
         return (f"ServingConfig(max_batch_size={self.max_batch_size}, "
@@ -107,4 +155,10 @@ class ServingConfig:
                 f"decode_page_size={self.decode_page_size}, "
                 f"decode_pool_pages={self.decode_pool_pages}, "
                 f"decode_max_batch={self.decode_max_batch}, "
-                f"decode_max_new_tokens={self.decode_max_new_tokens})")
+                f"decode_max_new_tokens={self.decode_max_new_tokens}, "
+                f"deadline_default={self.deadline_default}, "
+                f"retry_max={self.retry_max}, "
+                f"retry_backoff_ms={self.retry_backoff_ms}, "
+                f"circuit_window={self.circuit_window}, "
+                f"circuit_threshold={self.circuit_threshold}, "
+                f"circuit_cooldown_ms={self.circuit_cooldown_ms})")

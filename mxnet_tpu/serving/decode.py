@@ -43,15 +43,18 @@ from __future__ import annotations
 
 import itertools
 import logging
+import random
 import threading
 import time
 
 import numpy as np
 
-from .. import engine as _engine, runtime_metrics as _rm, tracing as _tr
+from .. import engine as _engine, faults as _faults, \
+    runtime_metrics as _rm, tracing as _tr
 from ..base import MXNetError
 from .batcher import bucket_set, next_bucket
 from .kv_cache import DeviceKVPool, PageAllocator, PageGeometry
+from .resilience import Deadline, DeadlineExceededError, retry_call
 
 __all__ = ["DecodeEngine", "GenerateRequest", "PagedLMAdapter",
            "as_decode_model"]
@@ -75,16 +78,17 @@ class GenerateRequest:
     ``tokens`` fills with generated ids (EOS included when hit) as the
     engine steps; ``event`` fires at eviction (finished, failed, or
     cancelled).  ``finish_reason`` is one of ``eos | length |
-    cancelled | stopped | error``.
+    cancelled | stopped | error | deadline | quarantined``.
     """
 
     __slots__ = ("seq_id", "prompt", "max_new_tokens", "eos_id",
                  "on_token", "tokens", "event", "error", "finish_reason",
                  "slot", "context_len", "t_submit", "t_first", "t_prev",
                  "cancelled", "trace", "root_span", "queue_span",
-                 "released_pages")
+                 "released_pages", "deadline")
 
-    def __init__(self, prompt, max_new_tokens, eos_id, on_token):
+    def __init__(self, prompt, max_new_tokens, eos_id, on_token,
+                 deadline=None):
         self.seq_id = next(_SEQ_IDS)
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
@@ -100,6 +104,10 @@ class GenerateRequest:
         self.t_first = None               # first-token timestamp (TTFT)
         self.t_prev = None                # previous-token timestamp
         self.cancelled = False
+        # end-to-end deadline (resilience.Deadline; may be unbounded):
+        # checked in the waiting line (expire before consuming a slot
+        # or pages) and after every step while running
+        self.deadline = deadline or Deadline()
         # tracing: the request's TraceContext (None when untraced), an
         # engine-owned root span when generate() was called without an
         # ambient trace, and the queue-wait span started at submit and
@@ -175,7 +183,12 @@ class DecodeEngine:
         self._thread = None
         self._stats = {"steps": 0, "admitted": 0, "evicted": 0,
                        "generated_tokens": 0, "peak_running": 0,
-                       "shed": 0}
+                       "shed": 0, "retries": 0, "quarantined": 0,
+                       "deadline_exceeded": 0}
+        # jitter source for transient-retry backoff — instance-owned so
+        # tests can inject a seeded one; entropy-seeded by default so
+        # replicas do not retry in lockstep against a shared backend
+        self._retry_rng = random.Random()
         if autostart:
             self.start()
 
@@ -237,11 +250,18 @@ class DecodeEngine:
 
     # -------------------------------------------------------------- submit
     def submit(self, prompt, max_new_tokens=None, eos_id=None,
-               on_token=None, _trace_ctx=_AMBIENT):
+               on_token=None, timeout=None, _trace_ctx=_AMBIENT):
         """Queue one prompt for generation; returns the
         :class:`GenerateRequest` handle (``result()`` blocks on it).
         ``on_token(token_id)`` streams each generated id from the engine
         thread as it is sampled.
+
+        ``timeout`` becomes the sequence's END-TO-END deadline: an
+        expired waiting sequence is failed with
+        :class:`~mxnet_tpu.serving.resilience.DeadlineExceededError`
+        before it consumes a decode slot or KV pages, and an expired
+        running sequence is evicted (pages reclaimed) on the step that
+        observes the expiry.
 
         ``_trace_ctx`` (internal): the caller's already-decided trace
         context — a :class:`~mxnet_tpu.tracing.TraceContext`, or None
@@ -271,7 +291,8 @@ class DecodeEngine:
                 f"request")
         if eos_id is None:
             eos_id = getattr(self.model, "eos_id", None)
-        seq = GenerateRequest(prompt, max_new_tokens, eos_id, on_token)
+        seq = GenerateRequest(prompt, max_new_tokens, eos_id, on_token,
+                              deadline=Deadline.start(timeout))
         # trace identity: an explicit caller decision wins (the
         # ModelServer passes its root's context — None when that root
         # was sampled out, so the head-sampling call is made ONCE per
@@ -340,27 +361,39 @@ class DecodeEngine:
 
     def result(self, seq, timeout=None):
         """Block until ``seq`` finishes; returns the generated ids as an
-        int32 array.  On timeout the request is cancelled (its slot and
-        pages are reclaimed on the next step) and ``MXNetError``
+        int32 array.  On timeout — the tighter of this call's
+        ``timeout`` and the sequence's submit-time deadline — the
+        request is cancelled (its slot and pages are reclaimed on the
+        next step) and
+        :class:`~mxnet_tpu.serving.resilience.DeadlineExceededError`
         raises."""
-        if not seq.event.wait(timeout):
+        wait = Deadline.start(timeout)
+        if seq.deadline.t is not None \
+                and (wait.t is None or seq.deadline.t < wait.t):
+            wait = seq.deadline
+        if not seq.event.wait(wait.remaining()):
             with self._cond:
                 seq.cancelled = True
+                self._stats["deadline_exceeded"] += 1
                 self._cond.notify_all()
-            raise MXNetError(
-                f"generate: no result within {timeout}s "
-                f"({len(seq.tokens)} token(s) generated so far; the "
-                f"sequence is cancelled and its pages reclaimed)")
+            if _rm._ENABLED:
+                _rm.SERVING_DEADLINE_EXCEEDED.inc(model=self.model_name)
+            raise DeadlineExceededError(
+                "generate", wait.timeout,
+                f"{len(seq.tokens)} token(s) generated so far; the "
+                f"sequence is cancelled and its pages reclaimed")
         if seq.error is not None:
             raise seq.error
         return np.asarray(seq.tokens, np.int32)
 
     def generate(self, prompt, max_new_tokens=None, eos_id=None,
                  on_token=None, timeout=None):
-        """``submit`` + ``result`` in one call."""
+        """``submit`` + ``result`` in one call; ``timeout`` is the
+        end-to-end deadline (see :meth:`submit`)."""
         return self.result(
             self.submit(prompt, max_new_tokens=max_new_tokens,
-                        eos_id=eos_id, on_token=on_token),
+                        eos_id=eos_id, on_token=on_token,
+                        timeout=timeout),
             timeout=timeout)
 
     # ---------------------------------------------------------- scheduling
@@ -417,15 +450,25 @@ class DecodeEngine:
         slot AND the sequence's worst-case page reservation fit
         (all-or-nothing, FIFO — a too-big head blocks the line rather
         than starving: pages freed by the next eviction admit it)."""
-        admitted, dropped = [], []
+        admitted, dropped, expired = [], [], []
         with self._cond:
-            # prune cancelled entries ANYWHERE in the line first — a
-            # timed-out caller must not keep occupying bounded queue
-            # space just because the decode batch happens to be full
+            # prune cancelled AND deadline-expired entries ANYWHERE in
+            # the line first — a timed-out caller must not keep
+            # occupying bounded queue space just because the decode
+            # batch happens to be full, and a dead request must never
+            # consume a slot or KV pages
             live = []
+            now = time.monotonic()
             for seq in self._waiting:
-                (dropped if seq.cancelled else live).append(seq)
+                if seq.cancelled:
+                    dropped.append(seq)
+                elif seq.deadline.expired(now):
+                    expired.append(seq)
+                else:
+                    live.append(seq)
             self._waiting = live
+            if expired:
+                self._stats["deadline_exceeded"] += len(expired)
             while self._waiting and self._free_slots:
                 seq = self._waiting[0]
                 pages = self.geometry.pages_for(
@@ -451,11 +494,49 @@ class DecodeEngine:
             self._finish(seq, "cancelled",
                          MXNetError("generate: request cancelled "
                                     "before admission"))
+        for seq in expired:
+            if _rm._ENABLED:
+                _rm.SERVING_DEADLINE_EXCEEDED.inc(model=self.model_name)
+            seq.queue_span.end(error="deadline")
+            self._finish(seq, "deadline",
+                         DeadlineExceededError(
+                             "generate", seq.deadline.timeout,
+                             "deadline expired while waiting — "
+                             "cancelled before admission"))
         return admitted
+
+    def _note_retry(self, attempt, exc):
+        with self._cond:
+            self._stats["retries"] += 1
+        if _rm._ENABLED:
+            _rm.SERVING_RETRIES.inc(model=self.model_name)
+        _LOG.warning("decode engine %s: transient failure (retry "
+                     "%d/%d): %s", self.model_name, attempt,
+                     self.config.retry_max, exc)
+
+    def _quarantine(self, seq, error, where):
+        """Evict ONE poisoned sequence after its model call failed
+        (post-retry, post-bisection): pages reclaimed through the
+        release path the leak guards watch, batchmates keep decoding.
+        """
+        _LOG.warning("decode engine %s: quarantining seq %d after %s "
+                     "failure: %s", self.model_name, seq.seq_id, where,
+                     error)
+        with self._cond:
+            self._stats["quarantined"] += 1
+        if _rm._ENABLED:
+            _rm.SERVING_DECODE_QUARANTINED.inc(model=self.model_name)
+        self._release(seq)
+        self._finish(seq, "quarantined", error)
+        _tr.record_incident(
+            f"decode.quarantine: {where} failed for seq {seq.seq_id}: "
+            f"{error}", self.debug_state)
 
     def _prefill_one(self, seq):
         """Run the (length-bucketed) prefill program for one admitted
-        sequence and sample its first token."""
+        sequence and sample its first token.  Transient failures retry
+        with backoff; a persistent failure quarantines THIS sequence
+        only (prefill is per-sequence, so no bisection is needed)."""
         L = seq.prompt.size
         bucket = next_bucket(L, self.geometry.max_context)
         with _tr.span("decode.prefill", parent=seq.trace,
@@ -463,16 +544,85 @@ class DecodeEngine:
                       kv_pages=len(self.allocator.pages_of(seq.seq_id))):
             tokens = np.zeros((1, bucket), np.int32)
             tokens[0, :L] = seq.prompt
-            logits = np.asarray(self.model.prefill(
-                tokens, np.int32(L),
-                self.allocator.block_table(seq.seq_id)))
+
+            def call():
+                _faults.inject("decode.prefill")
+                return np.asarray(self.model.prefill(
+                    tokens, np.int32(L),
+                    self.allocator.block_table(seq.seq_id)))
+
+            try:
+                logits = retry_call(
+                    call, retries=self.config.retry_max,
+                    backoff_ms=self.config.retry_backoff_ms,
+                    deadline=seq.deadline, rng=self._retry_rng,
+                    on_retry=self._note_retry)
+            except Exception as e:      # noqa: BLE001 — isolate it
+                self._quarantine(seq, e, where="prefill")
+                return 0
             seq.context_len = L
             self._emit(seq, int(np.argmax(logits)))
         self._maybe_evict(seq)
         return 1
 
+    def _decode_call(self, active):
+        """One fixed-shape decode-step model call for the ``active``
+        subset (inactive slots zeroed, exactly the padding contract the
+        programs already honor).  Transient failures retry with
+        backoff; a persistent failure BISECTS the subset so the
+        poisoned sequence is quarantined alone and the rest of the
+        batch keeps decoding.  Returns ``(seq, logits_row, t0, t1,
+        batch_n)`` tuples for the sequences that got a token."""
+        B, P = self.max_batch, self.geometry.pages_per_seq
+        tokens = np.zeros((B,), np.int32)
+        positions = np.zeros((B,), np.int32)
+        block_tables = np.zeros((B, P), np.int32)
+        for seq in active:
+            # the slot's current token is the LAST sampled one — its
+            # K/V is written at `positions` (== context so far) by the
+            # decode program, which then attends over the full context
+            tokens[seq.slot] = seq.tokens[-1]
+            positions[seq.slot] = seq.context_len
+            block_tables[seq.slot] = self.allocator.block_table(
+                seq.seq_id)
+
+        def call():
+            _faults.inject("decode.step")
+            return np.asarray(self.model.decode_step(
+                tokens, positions, block_tables))
+
+        # retry backoff must not sleep past the TIGHTEST member
+        # deadline: the single engine thread is every sequence's clock,
+        # so one sleep drains every running budget at once
+        times = [s.deadline.t for s in active if s.deadline.t is not None]
+        group_deadline = Deadline(min(times)) if times else Deadline()
+        t0 = time.perf_counter()
+        try:
+            logits = retry_call(
+                call, retries=self.config.retry_max,
+                backoff_ms=self.config.retry_backoff_ms,
+                deadline=group_deadline,
+                rng=self._retry_rng, on_retry=self._note_retry)
+        except Exception as e:          # noqa: BLE001 — isolate it
+            if len(active) == 1:
+                self._quarantine(active[0], e, where="decode step")
+                return []
+            _LOG.warning("decode engine %s: step failed for %d "
+                         "sequence(s) (%s); bisecting to quarantine "
+                         "the poisoned sequence", self.model_name,
+                         len(active), e)
+            mid = len(active) // 2
+            # re-running a subset re-writes the SAME K/V positions
+            # (idempotent) — a failed call never advanced context_len
+            return self._decode_call(active[:mid]) \
+                + self._decode_call(active[mid:])
+        t1 = time.perf_counter()
+        return [(seq, logits[seq.slot], t0, t1, len(active))
+                for seq in active]
+
     def _decode_step(self):
-        """One fixed-shape decode step over every running sequence."""
+        """One decode step over every running sequence (bisection-aware
+        model call via :meth:`_decode_call`)."""
         with self._cond:
             running = [s for s in self._running.values()
                        if not s.cancelled]
@@ -484,24 +634,10 @@ class DecodeEngine:
                          MXNetError("generate: request cancelled"))
         if not running:
             return 0
-        B, P = self.max_batch, self.geometry.pages_per_seq
-        tokens = np.zeros((B,), np.int32)
-        positions = np.zeros((B,), np.int32)
-        block_tables = np.zeros((B, P), np.int32)
-        for seq in running:
-            # the slot's current token is the LAST sampled one — its
-            # K/V is written at `positions` (== context so far) by the
-            # decode program, which then attends over the full context
-            tokens[seq.slot] = seq.tokens[-1]
-            positions[seq.slot] = seq.context_len
-            block_tables[seq.slot] = self.allocator.block_table(
-                seq.seq_id)
-        t0 = time.perf_counter()
-        logits = np.asarray(self.model.decode_step(
-            tokens, positions, block_tables))
-        t1 = time.perf_counter()
+        # deterministic bisection order: slot order, not dict order
+        running.sort(key=lambda s: s.slot)
         produced = 0
-        for seq in running:
+        for seq, row, t0, t1, batch_n in self._decode_call(running):
             # per-sequence decode-step spans (first step, then every
             # Nth): ONE device call serves the whole batch, so each due
             # sequence gets the shared interval with its own tags
@@ -512,11 +648,11 @@ class DecodeEngine:
                         "decode.step", seq.trace, t0, t1,
                         {"step": n_prior, "slot": seq.slot,
                          "context_len": seq.context_len,
-                         "batch": len(running),
+                         "batch": batch_n,
                          "kv_pages": len(self.allocator.pages_of(
                              seq.seq_id))})
             seq.context_len += 1
-            self._emit(seq, int(np.argmax(logits[seq.slot])))
+            self._emit(seq, int(np.argmax(row)))
             produced += 1
             self._maybe_evict(seq)
         return produced
@@ -546,20 +682,33 @@ class DecodeEngine:
                              "failed: %s", self.model_name, e)
 
     def _maybe_evict(self, seq):
-        """Finish checks after a sampled token; evicts when done."""
-        reason = None
+        """Finish checks after a sampled token; evicts when done.  A
+        running sequence past its deadline evicts here (pages
+        reclaimed) — a request never outlives its timeout inside the
+        decode batch."""
+        reason = error = None
         if seq.eos_id is not None and seq.tokens[-1] == seq.eos_id:
             reason = "eos"
         elif len(seq.tokens) >= seq.max_new_tokens:
             reason = "length"
         elif seq.cancelled:
             reason = "cancelled"
+            error = MXNetError("generate: request cancelled")
+        elif seq.deadline.expired():
+            reason = "deadline"
+            error = DeadlineExceededError(
+                "generate", seq.deadline.timeout,
+                f"deadline expired mid-generation after "
+                f"{len(seq.tokens)} token(s); sequence evicted and "
+                f"pages reclaimed")
+            with self._cond:
+                self._stats["deadline_exceeded"] += 1
+            if _rm._ENABLED:
+                _rm.SERVING_DEADLINE_EXCEEDED.inc(model=self.model_name)
         if reason is None:
             return False
         self._release(seq)
-        self._finish(seq, reason,
-                     MXNetError("generate: request cancelled")
-                     if reason == "cancelled" else None)
+        self._finish(seq, reason, error)
         return True
 
     def _release(self, seq):

@@ -33,6 +33,7 @@ step) are enforced here rather than trusted.
 """
 from __future__ import annotations
 
+from .. import faults as _faults
 from ..base import MXNetError
 
 __all__ = ["PageGeometry", "PageAllocator", "DeviceKVPool"]
@@ -141,6 +142,11 @@ class PageAllocator:
         if n_pages < 0:
             raise MXNetError(f"allocate({seq_id!r}): negative page "
                              f"count {n_pages}")
+        # chaos site: injected pool exhaustion — reported the way real
+        # exhaustion is (refusal, state unchanged), so the admission/
+        # deadline path downstream is what gets proven
+        if n_pages and _faults.check("kv_cache.allocate"):
+            return False
         owned = self._pages.setdefault(seq_id, [])
         if len(owned) + n_pages > self.geometry.pages_per_seq:
             raise MXNetError(

@@ -25,7 +25,7 @@ from collections import OrderedDict
 
 import numpy as np
 
-from .. import engine
+from .. import engine, faults as _faults
 from ..base import MXNetError
 
 __all__ = ["ModelEntry", "ModelRepository"]
@@ -172,6 +172,10 @@ class ModelRepository:
         from .. import deploy
         if not path.endswith(".shlo"):
             path = path + ".shlo"
+        # chaos site: artifact pull/parse failure during a deploy —
+        # must surface as a typed load error on the operator path while
+        # traffic keeps serving the currently-active version
+        _faults.inject("repository.load_artifact")
         model = deploy.load_stablehlo(path)
         manifest = model.manifest
         if manifest is None:

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import itertools
 import logging
+import random
 import threading
 import time
 from collections import OrderedDict, deque
@@ -28,32 +29,21 @@ from ..base import MXNetError
 from .batcher import DynamicBatcher
 from .config import ServingConfig
 from .repository import ModelRepository
+from .resilience import (CircuitBreaker, Deadline, DeadlineExceededError,
+                         ServerOverloadedError, retry_call)
 
-__all__ = ["ModelServer", "ServerOverloadedError"]
+__all__ = ["ModelServer", "ServerOverloadedError",
+           "DeadlineExceededError"]
 
 _LOG = logging.getLogger("mxnet_tpu")
 _SERVER_SEQ = itertools.count(1)
 
 
-class ServerOverloadedError(MXNetError):
-    """Request shed by the backpressure bounds.  ``retry_after_ms`` is
-    the server's backoff hint (an HTTP frontend maps this to 429 +
-    Retry-After); the message names which bound actually tripped so
-    operators tune the right knob."""
-
-    def __init__(self, model, retry_after_ms, reason):
-        self.model = model
-        self.retry_after_ms = retry_after_ms
-        super().__init__(
-            f"server overloaded: {reason} for model {model!r}; "
-            f"retry after {retry_after_ms}ms")
-
-
 class _Request:
     __slots__ = ("entry", "inputs", "rows", "event", "result", "error",
-                 "t_enq", "trace", "queue_span")
+                 "t_enq", "trace", "queue_span", "deadline")
 
-    def __init__(self, entry, inputs, rows):
+    def __init__(self, entry, inputs, rows, deadline=None):
         self.entry = entry
         self.inputs = inputs
         self.rows = rows
@@ -61,6 +51,11 @@ class _Request:
         self.result = None
         self.error = None
         self.t_enq = time.monotonic()
+        # end-to-end deadline (resilience.Deadline; may be unbounded):
+        # fixed at admission, consulted at batch assembly and by the
+        # retry policy — a request can never outlive its timeout just
+        # because it made it into a batch
+        self.deadline = deadline or Deadline()
         # tracing: the request's TraceContext (None when untraced) and
         # its queue-wait span — started in the caller's thread at
         # enqueue, ended in whichever worker pops it (Span.end is
@@ -105,8 +100,23 @@ class ModelServer:
         self._started = False
         self._stopping = False
         self._workers = []
+        # per-model-version circuit breakers (entry.uid -> breaker),
+        # created lazily at first admission; a hot-swap naturally gets
+        # a FRESH breaker because the new version is a new uid.  The
+        # retired set mirrors the batcher's: a worker finishing an
+        # in-flight batch for an unloaded entry must not resurrect its
+        # breaker into the map (nothing would ever evict it again)
+        self._breakers = {}
+        self._retired_uids = set()
+        # jitter source for retry backoff — instance-owned so tests can
+        # inject a seeded one; entropy-seeded by default so N replicas
+        # hitting one backend failure do NOT retry in lockstep (the
+        # thundering herd jitter exists to break up)
+        self._retry_rng = random.Random()
         self._stats = {"requests": 0, "completed": 0, "shed": 0,
-                       "batches": 0, "errors": 0}
+                       "batches": 0, "errors": 0, "retries": 0,
+                       "deadline_exceeded": 0, "bisected": 0,
+                       "circuit_open_rejects": 0}
         if autostart:
             self.start()
 
@@ -195,12 +205,16 @@ class ModelServer:
         return True
 
     def _on_unload(self, entry):
-        """Repository unload hook: drop the batcher's cached programs
-        AND stop/drop the entry's decode engine (its KV pool must not
-        pin device memory for a retired version)."""
+        """Repository unload hook: drop the batcher's cached programs,
+        the version's circuit breaker (a retired uid's error history
+        must not pin memory across hot-swap churn), AND stop/drop the
+        entry's decode engine (its KV pool must not pin device memory
+        for a retired version)."""
         self.batcher.evict(entry)
         with self._cond:
             eng = self._decoders.pop(entry.uid, None)
+            self._breakers.pop(entry.uid, None)
+            self._retired_uids.add(entry.uid)
         if eng is not None:
             eng.stop()
 
@@ -215,12 +229,62 @@ class ModelServer:
     def started(self):
         return self._started
 
+    # ------------------------------------------------------------ breakers
+    def _breaker(self, entry):
+        """The (lazily created) circuit breaker of one model VERSION.
+        Keyed on entry.uid: a hot-swapped version starts with a fresh,
+        closed circuit, and a rolled-back version's error history dies
+        with its uid.  A RETIRED uid (unloaded mid-flight) gets an
+        ephemeral breaker that is never stored — the unload hook has
+        already run, so re-inserting would leak it forever."""
+        with self._cond:
+            br = self._breakers.get(entry.uid)
+            if br is None:
+                br = CircuitBreaker(
+                    self.config.circuit_window,
+                    self.config.circuit_threshold,
+                    self.config.circuit_cooldown_ms,
+                    model=entry.name, version=entry.version)
+                if entry.uid not in self._retired_uids:
+                    self._breakers[entry.uid] = br
+        return br
+
+    def _admit_circuit(self, entry):
+        """Breaker gate at admission; counts the reject as a shed (to a
+        caller an open circuit IS an overload — back off and retry),
+        with the same observability every other shed gets: an admit
+        span tagged with the shed reason (parented to the ambient
+        predict/generate root) and a debounced serving.shed incident
+        dump."""
+        try:
+            self._breaker(entry).admit()
+        except ServerOverloadedError as e:
+            with self._cond:
+                self._stats["shed"] += 1
+                self._stats["circuit_open_rejects"] += 1
+            if _rm._ENABLED:
+                _rm.SERVING_SHED.inc(model=entry.name)
+            sp = _tr.span("serving.admit")
+            sp.set_tag("shed", str(e))
+            sp.end()
+            _tr.record_incident("serving.shed", self.debug_state)
+            raise
+
     # -------------------------------------------------------------- predict
     def predict(self, model, *inputs, timeout=None):
         """Run one inference request; blocks until its slice of a
         coalesced batch is ready.  Inputs are batch-major NDArray /
         numpy arrays validated against the model's serving signature;
         returns numpy (one array, or a tuple for multi-output models).
+
+        ``timeout`` (default ``config.deadline_default``) is the
+        request's END-TO-END deadline, not just the queue wait: it is
+        fixed at admission and carried through queue -> batch assembly
+        -> execute, an expired request is cancelled before it consumes
+        a batch slot, and the caller gets
+        :class:`~mxnet_tpu.serving.resilience.DeadlineExceededError`
+        within one scheduling quantum of the deadline — never a hang
+        (docs/serving.md §8).
 
         With ``MXNET_TRACE=1`` the request carries one trace identity
         end to end: admission, queue wait, the (shared) batch-assembly
@@ -255,8 +319,15 @@ class ModelServer:
                 f"rows outside [1, {cap}] (max_batch_size="
                 f"{self.config.max_batch_size}, "
                 f"exported batch={entry.fixed_batch})")
+        if timeout is None:
+            timeout = self.config.deadline_default
+        deadline = Deadline.start(timeout)
+        # circuit gate AFTER validation (a malformed request says
+        # nothing about version health) and BEFORE queueing (an open
+        # circuit must shed instantly, not after a queue wait)
+        self._admit_circuit(entry)
 
-        req = _Request(entry, np_inputs, rows)
+        req = _Request(entry, np_inputs, rows, deadline=deadline)
         req.trace = root.context
         admit = _tr.span("serving.admit", parent=req.trace, rows=rows)
         try:
@@ -309,12 +380,16 @@ class ModelServer:
         finally:
             admit.end()
 
-        if not req.event.wait(timeout):
+        if not req.event.wait(deadline.remaining()):
             # withdraw an abandoned request so it neither occupies
             # bounded-queue depth (pushing admissions into the shed
             # watermark) nor burns device time computing a result
             # nobody will read; if a worker popped it meanwhile, let
-            # that batch complete — the result is simply dropped
+            # that batch complete — the result is simply dropped.
+            # Count the expiry only when WE withdrew it: a popped
+            # request is counted by the worker instead (executed, or
+            # expired at batch assembly) — never twice.
+            withdrawn = False
             with self._cond:
                 slot = self._queues.get(entry.uid)
                 if slot is not None and req in slot[1]:
@@ -322,10 +397,15 @@ class ModelServer:
                     if not slot[1]:
                         self._queues.pop(entry.uid, None)
                     self._set_depth(self._depth - 1)
+                    withdrawn = True
+                if withdrawn:
+                    self._stats["deadline_exceeded"] += 1
+            if withdrawn and _rm._ENABLED:
+                _rm.SERVING_DEADLINE_EXCEEDED.inc(model=model)
             req.queue_span.end(error="timeout")
-            raise MXNetError(
-                f"serving predict({model!r}): no result within "
-                f"{timeout}s (queue depth {self._depth})")
+            raise DeadlineExceededError(
+                f"serving predict({model!r})", timeout,
+                f"queue depth {self._depth}")
         if req.error is not None:
             raise req.error
         return req.result if len(req.result) > 1 else req.result[0]
@@ -397,6 +477,14 @@ class ModelServer:
         decode batch; a short request admitted mid-flight finishes
         ahead of a longer one admitted earlier.
 
+        ``timeout`` (default ``config.deadline_default``) is the
+        END-TO-END deadline: carried into the engine's waiting queue
+        (an expired waiting sequence is cancelled before it consumes a
+        decode slot or KV pages) and checked every step while running
+        (an expired running sequence is evicted with its pages
+        reclaimed), so a request can never outlive its timeout inside
+        the decode batch (docs/serving.md §8).
+
         With ``MXNET_TRACE=1`` the request is one trace end to end:
         admission, queue wait, prefill, every Nth decode step, and
         eviction, with KV-page counts as tags (docs/observability.md).
@@ -414,14 +502,27 @@ class ModelServer:
                     f"serving generate({model!r}): not a decoder entry "
                     f"— register the model with "
                     f"ModelRepository.add_decoder{extra}")
+            if timeout is None:
+                timeout = self.config.deadline_default
+            self._admit_circuit(entry)
             eng = self._decoder_engine(entry)
             # pass the (already made) sampling decision down: a
             # sampled-out request must NOT re-enter head sampling in
             # the engine and root a fragment trace
             seq = eng.submit(prompt, max_new_tokens=max_new_tokens,
                              eos_id=eos_id, on_token=on_token,
-                             _trace_ctx=root.context)
-            return eng.result(seq, timeout=timeout)
+                             timeout=timeout, _trace_ctx=root.context)
+            breaker = self._breaker(entry)
+            try:
+                out = eng.result(seq, timeout=timeout)
+            except Exception:
+                # execute outcomes only: a step failure / quarantine is
+                # version health, a cancel/deadline/shed is not
+                if seq.finish_reason in ("error", "quarantined"):
+                    breaker.record(False)
+                raise
+            breaker.record(True)
+            return out
 
     def decode_stats(self, model):
         """The decode engine's scheduler/pool counters for ``model``
@@ -499,10 +600,13 @@ class ModelServer:
                 "stats": dict(self._stats),
                 "queues": queues,
             }
+            breakers = dict(self._breakers)
         # engine/batcher/repository snapshots go through THEIR locks
         # only after _cond is released (one-way acquisition order)
         state["decoders"] = {str(uid): eng.debug_state()
                              for uid, eng in decoders.items()}
+        state["circuits"] = {str(uid): br.debug_state()
+                             for uid, br in breakers.items()}
         state["batcher"] = {
             "programs": self.batcher.programs(),
             "bucket_hits": self.batcher.bucket_hits,
@@ -524,7 +628,8 @@ class ModelServer:
 
     def _next_batch(self):
         """Block until a batch is ready to dispatch (or shutdown drain
-        is complete).  Returns ``(entry, [requests])`` or None.
+        is complete).  Returns ``(entry, [requests], [expired])`` or
+        None.
 
         A queue is *ripe* once it holds a full batch or its head request
         has aged past ``max_latency_us`` (always, during shutdown
@@ -532,6 +637,11 @@ class ModelServer:
         no model starves; when nothing is ripe yet, wait only until the
         earliest forming-batch deadline — a full batch for one model
         never sits behind another model's hold window.
+
+        Requests whose end-to-end deadline already expired are split
+        out at the pop (the deadline contract: a dead request must not
+        consume a batch slot or device time) — the worker fails them
+        with ``DeadlineExceededError`` without dispatching them.
         """
         max_latency_s = self.config.max_latency_us / 1e6
         with self._cond:
@@ -544,7 +654,8 @@ class ModelServer:
                     deadline = q[0].t_enq + max_latency_s
                     now = time.monotonic()
                     if self._stopping or now >= deadline \
-                            or sum(r.rows for r in q) >= cap:
+                            or sum(r.rows for r in q) >= cap \
+                            or any(r.deadline.expired(now) for r in q):
                         if ripe is None or q[0].t_enq < ripe[1][0].t_enq:
                             ripe = (entry, q)
                     elif earliest is None or deadline < earliest:
@@ -564,23 +675,102 @@ class ModelServer:
                     continue
                 entry, q = ripe
                 cap = entry.max_rows(self.config.max_batch_size)
-                reqs, rows = [], 0
+                reqs, expired, rows = [], [], 0
+                now = time.monotonic()
                 while q and rows + q[0].rows <= cap:
                     r = q.popleft()
+                    if r.deadline.expired(now):
+                        expired.append(r)   # no slot for the dead
+                        continue
                     reqs.append(r)
                     rows += r.rows
                 if not q:
                     self._queues.pop(entry.uid, None)
-                self._set_depth(self._depth - len(reqs))
+                self._set_depth(self._depth - len(reqs) - len(expired))
                 self._inflight += len(reqs)
-                return entry, reqs
+                if expired:
+                    self._stats["deadline_exceeded"] += len(expired)
+                return entry, reqs, expired
+
+    def _fail_expired(self, entry, expired):
+        """Fail requests whose deadline passed before batch assembly
+        (popped but never dispatched — the other half of the deadline
+        contract next to the caller-side withdrawal)."""
+        for r in expired:
+            r.queue_span.end(error="deadline")
+            if _rm._ENABLED:
+                _rm.SERVING_DEADLINE_EXCEEDED.inc(model=entry.name)
+            r.error = DeadlineExceededError(
+                f"serving predict({entry.name!r})", r.deadline.timeout,
+                "deadline expired in queue, request cancelled before "
+                "batch assembly")
+            r.event.set()
+
+    def _group_deadline(self, reqs):
+        """The tightest member deadline — the retry policy must not
+        sleep past the first caller's budget."""
+        times = [r.deadline.t for r in reqs if r.deadline.t is not None]
+        return Deadline(min(times)) if times else Deadline()
+
+    def _note_retry(self, entry, attempt, exc):
+        with self._cond:
+            self._stats["retries"] += 1
+        if _rm._ENABLED:
+            _rm.SERVING_RETRIES.inc(model=entry.name)
+        _LOG.warning("serving: transient failure for %s:%s (retry "
+                     "%d/%d): %s", entry.name, entry.version, attempt,
+                     self.config.retry_max, exc)
+
+    def _dispatch_group(self, entry, reqs):
+        """Execute one request group with bounded transient retries;
+        on persistent failure BISECT so one poisoned request fails
+        alone instead of killing its coalesced batchmates.  Returns
+        ``(succeeded_requests, [(failed_request, error), ...])``;
+        results are assigned onto the requests, events are NOT set
+        (the worker publishes outcomes after breaker accounting)."""
+        try:
+            results = retry_call(
+                lambda: self.batcher.run_batch(
+                    entry, [r.inputs for r in reqs]),
+                retries=self.config.retry_max,
+                backoff_ms=self.config.retry_backoff_ms,
+                deadline=self._group_deadline(reqs),
+                rng=self._retry_rng,
+                on_retry=lambda n, e: self._note_retry(entry, n, e))
+        except Exception as e:      # noqa: BLE001 — isolate the poison
+            if len(reqs) == 1:
+                # also log it: a caller that already timed out will
+                # never read req.error, and a compile failure must not
+                # be diagnosable only as caller-side timeouts
+                _LOG.warning("serving: request for %s:%s failed: %s",
+                             entry.name, entry.version, e)
+                return [], [(reqs[0], e)]
+            _LOG.warning("serving: batch of %d request(s) for %s:%s "
+                         "failed (%s); bisecting to isolate the "
+                         "poisoned request", len(reqs), entry.name,
+                         entry.version, e)
+            with self._cond:
+                self._stats["bisected"] += 1
+            _tr.tag("bisected", len(reqs))
+            mid = len(reqs) // 2
+            ok_lo, bad_lo = self._dispatch_group(entry, reqs[:mid])
+            ok_hi, bad_hi = self._dispatch_group(entry, reqs[mid:])
+            return ok_lo + ok_hi, bad_lo + bad_hi
+        with self._cond:
+            self._stats["batches"] += 1
+        for r, out in zip(reqs, results):
+            r.result = out
+        return list(reqs), []
 
     def _worker_loop(self):
         while True:
             batch = self._next_batch()
             if batch is None:
                 return
-            entry, reqs = batch
+            entry, reqs, expired = batch
+            self._fail_expired(entry, expired)
+            if not reqs:
+                continue
             # queue-wait spans end at the pop (outside _cond — the
             # tracer lock is never taken while a serving lock is held)
             for r in reqs:
@@ -610,35 +800,31 @@ class ModelServer:
                                 dict(bspan.tags or {},
                                      shared_with=bspan.trace_id))
 
-            try:
-                with bspan:
-                    results = self.batcher.run_batch(
-                        entry, [r.inputs for r in reqs])
-            except Exception as e:        # noqa: BLE001 — fail the batch
-                # also log it: a caller that already timed out will
-                # never read req.error, and a compile failure must not
-                # be diagnosable only as caller-side timeouts
-                _LOG.warning("serving: batch of %d request(s) for "
-                             "%s:%s failed: %s", len(reqs), entry.name,
-                             entry.version, e)
-                _share_batch_span()       # bspan ended by the with-exit
-                with self._cond:
-                    self._stats["errors"] += len(reqs)
-                    self._inflight -= len(reqs)
-                    self._cond.notify_all()
-                for r in reqs:
-                    r.error = e
-                    r.event.set()
-                continue
-            _share_batch_span()
+            with bspan:
+                ok, bad = self._dispatch_group(entry, reqs)
+                if bad:
+                    # failures no longer propagate out of the dispatch
+                    # (retry/bisection contains them) — tag the shared
+                    # batch span the way an escaping exception used to
+                    bspan.set_tag("error", type(bad[0][1]).__name__)
+                    bspan.set_tag("failed_requests", len(bad))
+            _share_batch_span()           # bspan ended by the with-exit
             done = time.monotonic()
+            breaker = self._breaker(entry)
             with self._cond:
-                self._stats["batches"] += 1
-                self._stats["completed"] += len(reqs)
+                self._stats["completed"] += len(ok)
+                self._stats["errors"] += len(bad)
                 self._inflight -= len(reqs)
                 self._cond.notify_all()
-            for r, out in zip(reqs, results):
-                r.result = out
+            # publish outcomes AFTER the shared bookkeeping: breaker
+            # records execute outcomes only (expired requests above
+            # never reached the model and say nothing about health)
+            for r, e in bad:
+                breaker.record(False)
+                r.error = e
+                r.event.set()
+            for r in ok:
+                breaker.record(True)
                 if _rm._ENABLED:
                     _rm.SERVING_REQUEST_SECONDS.observe(
                         done - r.t_enq, model=entry.name,

@@ -549,11 +549,20 @@ _INCIDENT_MIN_INTERVAL = 30.0
 def flight_record(state=None) -> dict:
     """The flight-recorder snapshot: tracer stats + the completed-trace
     ring, plus whatever server ``state`` the caller attaches
-    (``ModelServer.debug_state()``)."""
-    return {"wall_time": time.time(),
-            "tracer": TRACER.stats(),
-            "traces": TRACER.traces(),
-            "state": state}
+    (``ModelServer.debug_state()``).  Under an active chaos plan
+    (``MXNET_FAULTS``) the record also carries the plan spec and its
+    fired-fault counters — an incident dump from a chaos run must say
+    which injected faults the stack was absorbing at the time."""
+    record = {"wall_time": time.time(),
+              "tracer": TRACER.stats(),
+              "traces": TRACER.traces(),
+              "state": state}
+    from . import faults as _faults        # lazy: faults imports tracing
+    plan = _faults.active()
+    if plan is not None:
+        record["faults"] = {"spec": plan.spec,
+                            "fired": plan.counters()}
+    return record
 
 
 def record_incident(reason, state=None, path=None, min_interval=None):

@@ -1,0 +1,733 @@
+"""Chaos-hardened serving: deterministic fault injection + the
+resilience layer it proves (docs/serving.md §8).
+
+Everything here runs on numpy fakes / function entries — ZERO real XLA
+compiles — so deadline propagation, retry/bisection, decode
+quarantine, and the circuit-breaker lifecycle are tested at step
+granularity with seeded, replayable fault plans.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import faults, runtime_metrics as rm, serving
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.serving import resilience
+from mxnet_tpu.serving.decode import DecodeEngine
+from mxnet_tpu.serving.resilience import (CircuitBreaker,
+                                          CircuitOpenError, Deadline,
+                                          DeadlineExceededError,
+                                          retry_call)
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    faults.clear()
+    rm.reset()
+    rm.enable()
+    yield
+    faults.clear()
+    rm.disable()
+    rm.reset()
+
+
+SIG = [{"shape": [None, 2], "dtype": "float32"}]
+
+
+def _cfg(**kw):
+    kw.setdefault("max_batch_size", 8)
+    kw.setdefault("max_latency_us", 1)
+    kw.setdefault("retry_backoff_ms", 0)    # fast tests, same policy
+    return serving.ServingConfig(**kw)
+
+
+def _decode_cfg(**kw):
+    kw.setdefault("decode_page_size", 4)
+    kw.setdefault("decode_pool_pages", 9)   # 8 usable
+    kw.setdefault("decode_max_batch", 2)
+    kw.setdefault("decode_max_new_tokens", 4)
+    kw.setdefault("retry_backoff_ms", 0)
+    return serving.ServingConfig(**kw)
+
+
+class FakeModel:
+    """Decode-model protocol in plain numpy: next token = (last + 1)
+    mod vocab; prefill proposes the prompt's last token."""
+
+    vocab_size = 16
+    max_context = 32
+
+    def __init__(self):
+        self.prefills = 0
+        self.steps = 0
+
+    def prefill(self, tokens, length, block_table):
+        self.prefills += 1
+        logits = np.zeros((self.vocab_size,), np.float32)
+        logits[int(tokens[0, int(length) - 1]) % self.vocab_size] = 1.0
+        return logits
+
+    def decode_step(self, tokens, positions, block_tables):
+        self.steps += 1
+        logits = np.zeros((tokens.shape[0], self.vocab_size), np.float32)
+        logits[np.arange(tokens.shape[0]),
+               (tokens + 1) % self.vocab_size] = 1.0
+        return logits
+
+
+def _engine(model=None, **cfg_kw):
+    eng = DecodeEngine(model or FakeModel(), _decode_cfg(**cfg_kw),
+                       model_name="fake")
+    eng._started = True                 # manual stepping, no loop thread
+    return eng
+
+
+def _drive(eng, seqs, limit=64):
+    n = 0
+    while not all(s.event.is_set() for s in seqs):
+        eng.step()
+        n += 1
+        assert n < limit, "scheduler did not converge"
+    return n
+
+
+# --------------------------------------------------------------- the plan
+class TestFaultPlan:
+    def test_parse_roundtrip_and_defaults(self):
+        p = faults.FaultPlan.parse(
+            "serving.execute=fail,p=0.25,seed=7;"
+            "compile_cache.load=corrupt,times=1;"
+            "decode.step=delay,ms=5,after=2")
+        r0, r1, r2 = p.rules
+        assert (r0.pattern, r0.mode, r0.p, r0.seed) == \
+            ("serving.execute", "fail", 0.25, 7)
+        assert (r1.mode, r1.times) == ("corrupt", 1)
+        assert (r2.mode, r2.ms, r2.after) == ("delay", 5.0, 2)
+        assert r0.ms == 0.0 and r1.p == 1.0
+
+    @pytest.mark.parametrize("bad", [
+        "", "siteonly", "s=explode", "s=fail,p=2.0", "s=fail,zz=1",
+        "s=fail,after=-1", "s=fail,times=0", "s=fail,p=abc"])
+    def test_parse_rejects_malformed(self, bad):
+        with pytest.raises(MXNetError):
+            faults.FaultPlan.parse(bad)
+
+    def test_bad_env_spec_degrades_to_off(self, monkeypatch):
+        monkeypatch.setenv("MXNET_FAULTS", "not a spec")
+        assert faults._init_from_env() is None
+        monkeypatch.setenv("MXNET_FAULTS",
+                           "serving.execute=fail,times=1")
+        plan = faults._init_from_env()
+        assert plan is not None and plan.rules[0].times == 1
+
+    def test_off_path_is_identity(self):
+        assert faults.active() is None
+        assert faults.inject("anything") is None
+        payload = b"bytes"
+        assert faults.inject("anything", value=payload) is payload
+        assert faults.check("anything") is False
+        assert faults.counters() == {}
+
+    def test_fail_after_times_and_counters(self):
+        with faults.plan("s.x=fail,after=2,times=2"):
+            assert faults.inject("s.x") is None     # call 1: skipped
+            assert faults.inject("s.x") is None     # call 2: skipped
+            for _ in range(2):                      # calls 3-4: fire
+                with pytest.raises(faults.InjectedFault):
+                    faults.inject("s.x")
+            assert faults.inject("s.x") is None     # times exhausted
+            assert faults.counters() == {"s.x:fail": 2}
+        assert faults.active() is None              # scope restored
+
+    def test_seeded_probability_is_deterministic(self):
+        def firing_pattern():
+            plan = faults.FaultPlan.parse("s.p=fail,p=0.5,seed=42")
+            with faults.plan(plan):
+                out = []
+                for _ in range(32):
+                    try:
+                        faults.inject("s.p")
+                        out.append(0)
+                    except faults.InjectedFault:
+                        out.append(1)
+                return out
+
+        a, b = firing_pattern(), firing_pattern()
+        assert a == b                       # replayable
+        assert 0 < sum(a) < 32              # actually probabilistic
+
+    def test_glob_site_matching(self):
+        with faults.plan("serving.*=fail,times=1"):
+            with pytest.raises(faults.InjectedFault):
+                faults.inject("serving.execute")
+        with faults.plan("other.site=fail"):
+            assert faults.inject("serving.execute") is None
+
+    def test_corrupt_bytes_and_arrays(self):
+        with faults.plan("c.b=corrupt,times=1"):
+            out = faults.inject("c.b", value=b"\x00" * 8)
+            assert out != b"\x00" * 8 and len(out) == 8
+        with faults.plan("c.f=corrupt,times=1"):
+            arr = faults.inject("c.f", value=np.ones((4,), np.float32))
+            assert np.isnan(arr).sum() == 1
+        with faults.plan("c.n=corrupt,times=1"):
+            with pytest.raises(faults.InjectedFault):
+                faults.inject("c.n")        # nothing to corrupt
+
+    def test_fired_faults_counted_in_metrics(self):
+        with faults.plan("m.x=fail,times=1"):
+            with pytest.raises(faults.InjectedFault):
+                faults.inject("m.x")
+        assert rm.SERVING_FAULTS.value(site="m.x", mode="fail") == 1
+        assert "serving_faults" in rm.dump_prometheus()
+
+    def test_delay_mode_sleeps(self):
+        with faults.plan("d.x=delay,ms=30,times=1"):
+            t0 = time.perf_counter()
+            faults.inject("d.x")
+            assert time.perf_counter() - t0 >= 0.025
+
+
+# ---------------------------------------------------------- deadline unit
+class TestDeadline:
+    def test_unbounded(self):
+        d = Deadline()
+        assert d.unset and not d.expired() and d.remaining() is None
+
+    def test_countdown_and_expiry(self):
+        d = Deadline.start(0.05)
+        assert not d.unset and d.timeout == 0.05
+        assert 0 < d.remaining() <= 0.05
+        time.sleep(0.06)
+        assert d.expired() and d.remaining() == 0.0
+
+
+# ------------------------------------------------------------- retry unit
+class TestRetryCall:
+    def _flaky(self, fail_n, exc_factory):
+        state = {"calls": 0}
+
+        def fn():
+            state["calls"] += 1
+            if state["calls"] <= fail_n:
+                raise exc_factory()
+            return state["calls"]
+        return fn, state
+
+    def test_transient_retries_then_succeeds(self):
+        fn, state = self._flaky(2, lambda: faults.InjectedFault("s"))
+        notes = []
+        assert retry_call(fn, retries=2, backoff_ms=0,
+                          on_retry=lambda n, e: notes.append(n)) == 3
+        assert state["calls"] == 3 and notes == [1, 2]
+
+    def test_budget_exhausted_reraises(self):
+        fn, state = self._flaky(5, lambda: faults.InjectedFault("s"))
+        with pytest.raises(faults.InjectedFault):
+            retry_call(fn, retries=2, backoff_ms=0)
+        assert state["calls"] == 3
+
+    def test_non_transient_fails_immediately(self):
+        fn, state = self._flaky(5, lambda: ValueError("poisoned"))
+        with pytest.raises(ValueError):
+            retry_call(fn, retries=3, backoff_ms=0)
+        assert state["calls"] == 1
+
+    def test_deadline_stops_backoff_sleep(self):
+        fn, state = self._flaky(5, lambda: faults.InjectedFault("s"))
+        with pytest.raises(faults.InjectedFault):
+            retry_call(fn, retries=5, backoff_ms=10_000,
+                       deadline=Deadline.start(0.01))
+        assert state["calls"] == 1      # no 10s sleep against a 10ms budget
+
+
+# ----------------------------------------------------------- breaker unit
+class TestCircuitBreaker:
+    def test_open_probe_close_lifecycle(self):
+        br = CircuitBreaker(4, 0.5, 40, model="m", version=1)
+        for ok in (True, False, False, True):   # 50% errors, window full
+            br.record(ok)
+        assert br.state == resilience.OPEN
+        with pytest.raises(CircuitOpenError) as ei:
+            br.admit()
+        assert ei.value.retry_after_ms <= 40
+        time.sleep(0.05)
+        assert br.admit() is True           # the half-open probe
+        with pytest.raises(CircuitOpenError):
+            br.admit()                      # one probe at a time
+        br.record(True)
+        assert br.state == resilience.CLOSED
+        assert br.admit() is False          # closed admits freely
+        st = br.debug_state()
+        assert st["stats"]["opened"] == 1 and st["stats"]["closed"] == 1
+
+    def test_failed_probe_reopens(self):
+        br = CircuitBreaker(2, 0.5, 10, model="m", version=1)
+        br.record(False)
+        br.record(False)
+        assert br.state == resilience.OPEN
+        time.sleep(0.02)
+        assert br.admit() is True
+        br.record(False)                    # probe fails
+        assert br.state == resilience.OPEN
+
+    def test_abandoned_probe_self_heals(self):
+        """A probe whose outcome never comes back (shed by the queue
+        watermark before execute) must not wedge the breaker: after one
+        cooldown the next admission takes over as the probe."""
+        br = CircuitBreaker(2, 0.5, 20, model="m", version=1)
+        br.record(False)
+        br.record(False)
+        time.sleep(0.03)
+        assert br.admit() is True           # probe admitted...
+        with pytest.raises(CircuitOpenError):
+            br.admit()                      # ...one probe at a time
+        time.sleep(0.03)                    # a cooldown later: abandoned
+        assert br.admit() is True           # takeover probe
+        br.record(True)
+        assert br.state == resilience.CLOSED
+
+    def test_partial_window_cannot_trip(self):
+        br = CircuitBreaker(8, 0.5, 10, model="m", version=1)
+        for _ in range(7):
+            br.record(False)                # 100% errors, window NOT full
+        assert br.state == resilience.CLOSED
+
+    def test_window_zero_disables(self):
+        br = CircuitBreaker(0, 0.5, 10, model="m", version=1)
+        for _ in range(16):
+            br.record(False)
+        assert br.admit() is False
+        assert br.state == resilience.CLOSED
+
+    def test_state_gauge_published(self):
+        br = CircuitBreaker(2, 0.5, 10, model="gm", version=3)
+        br.record(False)
+        br.record(False)
+        assert rm.SERVING_CIRCUIT_STATE.value(
+            model="gm", version="3") == 2.0
+
+
+# -------------------------------------------------- predict-path e2e
+class TestPredictResilience:
+    def _server(self, fn, name="m", **cfg_kw):
+        repo = serving.ModelRepository()
+        repo.add_function(name, fn, SIG)
+        return serving.ModelServer(repo, _cfg(**cfg_kw))
+
+    def test_retry_then_success_parity(self):
+        """An injected transient execute fault is absorbed by the retry
+        policy: same outputs as a fault-free run, one retry counted."""
+        x = np.arange(6, dtype=np.float32).reshape(3, 2)
+        with self._server(lambda a: a * 3.0) as srv:
+            want = srv.predict("m", x, timeout=60)      # fault-free
+            with faults.plan("serving.execute=fail,times=1"):
+                got = srv.predict("m", x, timeout=60)
+            np.testing.assert_array_equal(got, want)
+            st = srv.stats()
+        assert st["retries"] == 1 and st["errors"] == 0
+        assert rm.SERVING_RETRIES.value(model="m") == 1
+        assert rm.SERVING_FAULTS.value(site="serving.execute",
+                                       mode="fail") == 1
+
+    def test_retries_exhausted_fail_typed(self):
+        with self._server(lambda a: a) as srv:
+            with faults.plan("serving.execute=fail"):
+                with pytest.raises(faults.InjectedFault):
+                    srv.predict("m", np.ones((1, 2), np.float32),
+                                timeout=60)
+            st = srv.stats()
+        assert st["errors"] == 1
+        assert st["retries"] == srv.config.retry_max
+
+    def test_bisection_isolates_poisoned_request(self):
+        """One poisoned request in a coalesced batch fails ALONE; its
+        batchmates are re-dispatched and succeed."""
+        def picky(a):
+            if np.isnan(a).any():
+                raise ValueError("poisoned row")
+            return a + 1.0
+
+        repo = serving.ModelRepository()
+        repo.add_function("m", picky, SIG)
+        srv = serving.ModelServer(repo, _cfg(), autostart=False)
+        entry = repo.get("m")
+        good = [np.full((1, 2), float(i), np.float32) for i in range(3)]
+        poison = np.full((1, 2), np.nan, np.float32)
+        reqs = [serving.server._Request(entry, (g,), 1) for g in good]
+        bad_req = serving.server._Request(entry, (poison,), 1)
+        ok, bad = srv._dispatch_group(entry,
+                                      reqs[:1] + [bad_req] + reqs[1:])
+        assert [r is bad_req for r, _e in bad] == [True]
+        assert isinstance(bad[0][1], ValueError)
+        assert set(ok) == set(reqs)
+        for r, g in zip(reqs, good):
+            np.testing.assert_array_equal(r.result[0], g + 1.0)
+        assert srv.stats()["bisected"] >= 1
+
+    def test_deadline_bounds_queue_wait(self):
+        """A request stuck behind a gated batch fails with the typed
+        deadline error at its timeout — and is withdrawn, not left
+        occupying queue depth."""
+        gate = threading.Event()
+        entered = threading.Event()
+
+        def gated(a):
+            entered.set()
+            assert gate.wait(30)
+            return a
+
+        srv = self._server(gated, num_workers=1)
+        try:
+            t = threading.Thread(
+                target=lambda: srv.predict(
+                    "m", np.ones((1, 2), np.float32), timeout=30))
+            t.start()
+            assert entered.wait(30)         # worker held inside batch 1
+            t0 = time.monotonic()
+            with pytest.raises(DeadlineExceededError,
+                               match="no result within"):
+                srv.predict("m", np.ones((1, 2), np.float32),
+                            timeout=0.1)
+            assert time.monotonic() - t0 < 5
+            assert srv.stats()["queue_depth"] == 0
+            assert srv.stats()["deadline_exceeded"] == 1
+            assert rm.SERVING_DEADLINE_EXCEEDED.value(model="m") == 1
+        finally:
+            gate.set()
+            t.join(30)
+            srv.stop()
+
+    def test_expired_request_never_dispatched(self):
+        """A request whose deadline passed while queued is failed at
+        batch assembly WITHOUT consuming a batch slot or model time."""
+        calls = []
+        gate = threading.Event()
+        entered = threading.Event()
+
+        def gated(a):
+            calls.append(a.shape)
+            entered.set()
+            assert gate.wait(30)
+            return a
+
+        srv = self._server(gated, num_workers=1)
+        results = []
+
+        def hold():
+            results.append(srv.predict(
+                "m", np.ones((1, 2), np.float32), timeout=30))
+
+        def doomed():
+            try:
+                srv.predict("m", np.ones((1, 2), np.float32),
+                            timeout=0.05)
+            except MXNetError as e:
+                results.append(e)
+
+        try:
+            t1 = threading.Thread(target=hold)
+            t1.start()
+            assert entered.wait(30)
+            t2 = threading.Thread(target=doomed)
+            t2.start()
+            t2.join(30)                     # fails via its own wait
+            time.sleep(0.05)                # now stale in the queue too
+            gate.set()                      # worker pops: must skip it
+            t1.join(30)
+            srv.stop()
+        finally:
+            gate.set()
+        # only the held request ever reached the model
+        assert len(calls) == 1
+        assert sum(isinstance(r, DeadlineExceededError)
+                   for r in results) == 1
+
+    def test_circuit_opens_sheds_probes_and_recovers(self):
+        state = {"fail": True, "calls": 0}
+
+        def flappy(a):
+            state["calls"] += 1
+            if state["fail"]:
+                raise ValueError("version is sick")
+            return a * 2.0
+
+        srv = self._server(flappy, circuit_window=4,
+                           circuit_threshold=0.5, circuit_cooldown_ms=80)
+        x = np.ones((1, 2), np.float32)
+        try:
+            for _ in range(4):              # fill the window with errors
+                with pytest.raises(ValueError):
+                    srv.predict("m", x, timeout=30)
+            # OPEN: instant typed shed, no model call
+            calls_before = state["calls"]
+            with pytest.raises(CircuitOpenError, match="circuit open"):
+                srv.predict("m", x, timeout=30)
+            assert state["calls"] == calls_before
+            assert srv.stats()["circuit_open_rejects"] == 1
+            dbg = srv.debug_state()
+            assert [c["state"] for c in dbg["circuits"].values()] \
+                == ["open"]
+            # cooldown -> half-open probe -> success -> CLOSED
+            state["fail"] = False
+            time.sleep(0.1)
+            np.testing.assert_array_equal(
+                srv.predict("m", x, timeout=30), x * 2.0)
+            np.testing.assert_array_equal(
+                srv.predict("m", x, timeout=30), x * 2.0)
+            dbg = srv.debug_state()
+            assert [c["state"] for c in dbg["circuits"].values()] \
+                == ["closed"]
+        finally:
+            srv.stop()
+
+    def test_unloaded_version_breaker_not_resurrected(self):
+        """A worker finishing an in-flight batch for an unloaded entry
+        must not re-insert the popped breaker (it would leak forever —
+        nothing evicts a retired uid twice)."""
+        repo = serving.ModelRepository()
+        repo.add_function("m", lambda a: a, SIG)
+        with serving.ModelServer(repo, _cfg()) as srv:
+            entry = repo.get("m")
+            assert srv._breaker(entry) is srv._breakers[entry.uid]
+            repo.unload("m")                # fires _on_unload
+            assert entry.uid not in srv._breakers
+            late = srv._breaker(entry)      # in-flight straggler path
+            late.record(True)               # usable...
+            assert entry.uid not in srv._breakers   # ...never stored
+
+    def test_circuit_shed_tags_admit_span(self):
+        """An open-circuit shed gets the same trace attribution every
+        other shed gets: an admit span tagged with the reason."""
+        from mxnet_tpu import tracing
+        tracing.enable(sample=1.0)
+        try:
+            srv = self._server(lambda a: a, circuit_window=2,
+                               circuit_threshold=0.5,
+                               circuit_cooldown_ms=60_000)
+            x = np.ones((1, 2), np.float32)
+            try:
+                with faults.plan("serving.execute=fail"):
+                    for _ in range(2):
+                        with pytest.raises(faults.InjectedFault):
+                            srv.predict("m", x, timeout=30)
+                with pytest.raises(CircuitOpenError):
+                    srv.predict("m", x, timeout=30)
+            finally:
+                srv.stop()
+            t = tracing.TRACER.last(root="serving.predict")
+            admits = [s for s in t["spans"]
+                      if s["name"] == "serving.admit"]
+            assert admits and "circuit open" in str(
+                admits[0]["tags"].get("shed")), admits
+        finally:
+            tracing.disable()
+            tracing.TRACER.reset()
+
+    def test_corrupt_artifact_load_under_traffic(self, tmp_path):
+        """A failing/corrupt artifact load is a typed operator-path
+        error; live traffic on the current version keeps serving."""
+        with self._server(lambda a: a + 1.0) as srv:
+            x = np.ones((2, 2), np.float32)
+            np.testing.assert_array_equal(
+                srv.predict("m", x, timeout=60), x + 1.0)
+            # injected pull failure (deterministic, no artifact needed)
+            with faults.plan("repository.load_artifact=fail"):
+                with pytest.raises(faults.InjectedFault):
+                    srv.repository.load_artifact(
+                        "m2", str(tmp_path / "nope.shlo"))
+            # real on-disk rot: garbage bytes under a valid-ish name
+            bad = tmp_path / "rotten.shlo"
+            bad.write_bytes(b"\x00garbage\xff" * 16)
+            (tmp_path / "rotten.json").write_text("{not json")
+            with pytest.raises(Exception):
+                srv.repository.load_artifact("m3", str(bad))
+            # the server never noticed either failed deploy
+            np.testing.assert_array_equal(
+                srv.predict("m", x, timeout=60), x + 1.0)
+            assert srv.repository.models() == ["m"]
+
+    def test_chaos_plan_spec_in_incident_dump(self, tmp_path,
+                                              monkeypatch):
+        from mxnet_tpu import tracing
+        tracing.enable(sample=1.0)
+        try:
+            with faults.plan("x.y=fail,times=1"):
+                with pytest.raises(faults.InjectedFault):
+                    faults.inject("x.y")
+                path = tracing.record_incident(
+                    "test.chaos", {"k": "v"},
+                    path=str(tmp_path / "dump.json"), min_interval=0)
+                import json
+                rec = json.load(open(path))
+                assert rec["faults"]["spec"] == "x.y=fail,times=1"
+                assert rec["faults"]["fired"] == {"x.y:fail": 1}
+        finally:
+            tracing.disable()
+            tracing.TRACER.reset()
+
+
+# ----------------------------------------------------- decode-path chaos
+class TestDecodeResilience:
+    def test_step_retry_then_success_parity(self):
+        ref_eng = _engine()
+        ref = ref_eng.submit([3], max_new_tokens=4)
+        _drive(ref_eng, [ref])
+        eng = _engine()
+        with faults.plan("decode.step=fail,times=1"):
+            s = eng.submit([3], max_new_tokens=4)
+            _drive(eng, [s])
+        assert s.finish_reason == "length"
+        assert s.tokens == ref.tokens       # byte-identical generation
+        assert eng.stats()["retries"] == 1
+        assert eng.stats()["quarantined"] == 0
+        eng.allocator.check_leaks()
+
+    def test_persistent_step_failure_quarantines_alone(self):
+        class Poison(FakeModel):
+            """decode_step blows up whenever the poisoned sequence's
+            token is active — deterministic, not transient."""
+
+            def decode_step(self, tokens, positions, block_tables):
+                if np.any(tokens == 13):
+                    raise ValueError("poisoned token in the batch")
+                return super().decode_step(tokens, positions,
+                                           block_tables)
+
+        eng = _engine(Poison())
+        good = eng.submit([3], max_new_tokens=4)
+        bad = eng.submit([12], max_new_tokens=4)    # prefill emits 12+1
+        _drive(eng, [good, bad])
+        assert good.finish_reason == "length"
+        assert good.tokens == [3, 4, 5, 6]
+        assert bad.finish_reason == "quarantined"
+        assert isinstance(bad.error, ValueError)
+        assert eng.stats()["quarantined"] == 1
+        assert rm.SERVING_DECODE_QUARANTINED.value(model="fake") == 1
+        eng.allocator.check_leaks()         # quarantine released pages
+        assert eng.allocator.used_pages == 0
+
+    def test_prefill_failure_quarantines_only_that_sequence(self):
+        class PoisonPrefill(FakeModel):
+            def prefill(self, tokens, length, block_table):
+                if int(tokens[0, 0]) == 7:
+                    raise ValueError("poisoned prompt")
+                return super().prefill(tokens, length, block_table)
+
+        eng = _engine(PoisonPrefill())
+        good = eng.submit([1], max_new_tokens=2)
+        bad = eng.submit([7], max_new_tokens=2)
+        _drive(eng, [good, bad])
+        assert good.finish_reason == "length"
+        assert bad.finish_reason == "quarantined"
+        eng.allocator.check_leaks()
+        assert eng.allocator.used_pages == 0
+
+    def test_allocator_exhaustion_admission_refusal(self):
+        # a request that can NEVER fit is refused at submit, instantly
+        eng = _engine(decode_pool_pages=5)          # 4 usable pages
+        with pytest.raises(MXNetError, match="KV pages"):
+            eng.submit([1], max_new_tokens=31)      # needs 8 pages
+        # injected exhaustion: the pool claims full; a deadlined
+        # request fails typed instead of waiting forever
+        with faults.plan("kv_cache.allocate=fail"):
+            s = eng.submit([1], max_new_tokens=4, timeout=0.05)
+            eng.step()                      # cannot admit (exhausted)
+            assert not s.event.is_set()
+            time.sleep(0.06)
+            eng.step()                      # deadline pruned the line
+        assert s.finish_reason == "deadline"
+        assert isinstance(s.error, DeadlineExceededError)
+        assert eng.stats()["waiting"] == 0
+        eng.allocator.check_leaks()
+
+    def test_check_only_honors_fail_mode(self):
+        """A latency-only plan (delay/stall) must never masquerade as
+        allocator exhaustion — check() fires fail rules only."""
+        eng = _engine()
+        with faults.plan("*=delay,ms=0"):
+            s = eng.submit([1], max_new_tokens=2)
+            _drive(eng, [s])
+        assert s.finish_reason == "length"  # admitted + generated fine
+        eng.allocator.check_leaks()
+
+    def test_decode_retry_backoff_respects_deadline(self):
+        """A transient step fault with a huge configured backoff must
+        not sleep the engine thread past the running sequences'
+        deadlines — the retry gives up and quarantine takes over."""
+        eng = _engine(retry_backoff_ms=60_000)
+        s = eng.submit([1], max_new_tokens=4, timeout=0.25)
+        eng.step()                          # prefill (no step fault yet)
+        with faults.plan("decode.step=fail"):
+            t0 = time.monotonic()
+            eng.step()                      # transient fail; no 60s sleep
+            assert time.monotonic() - t0 < 5
+        assert s.event.is_set()
+        assert s.finish_reason == "quarantined"
+        eng.allocator.check_leaks()
+
+    def test_deadline_expires_mid_generation(self):
+        eng = _engine()
+        s = eng.submit([1], max_new_tokens=64 // 4, timeout=0.05)
+        eng.step()                          # admitted + prefilled
+        assert s.tokens, "prefill should emit the first token"
+        time.sleep(0.06)
+        eng.step()                          # expiry observed -> evict
+        assert s.finish_reason == "deadline"
+        assert isinstance(s.error, DeadlineExceededError)
+        eng.allocator.check_leaks()
+        assert eng.allocator.used_pages == 0
+
+    def test_engine_stop_during_inflight_generate_with_deadline(self):
+        eng = DecodeEngine(FakeModel(), _decode_cfg(),
+                           model_name="fake", autostart=True)
+        with faults.plan("decode.step=delay,ms=20"):
+            results = {}
+
+            def gen():
+                try:
+                    results["out"] = eng.generate(
+                        [1], max_new_tokens=4, timeout=30)
+                except MXNetError as e:
+                    results["err"] = e
+
+            t = threading.Thread(target=gen)
+            t.start()
+            deadline = time.monotonic() + 30
+            while not eng.stats()["running"] \
+                    and time.monotonic() < deadline:
+                time.sleep(0.002)
+            assert eng.stop(timeout=30)     # stop mid-generation
+            t.join(30)
+        # the caller got a TYPED answer promptly — finished or stopped,
+        # never a hang past its deadline
+        assert results, "generate() hung through engine stop"
+        if "err" in results:
+            assert "stopped" in str(results["err"])
+        eng.allocator.check_leaks()
+        assert eng.allocator.used_pages == 0
+
+    def test_server_records_decode_outcomes_on_breaker(self):
+        repo = serving.ModelRepository()
+        repo.add_decoder("lm", FakeModel())
+        srv = serving.ModelServer(repo, _decode_cfg(
+            circuit_window=2, circuit_threshold=0.5,
+            circuit_cooldown_ms=50))
+        try:
+            with faults.plan("decode.prefill=fail"):    # beyond retries
+                for _ in range(2):
+                    with pytest.raises(faults.InjectedFault):
+                        srv.generate("lm", [1], max_new_tokens=2,
+                                     timeout=30)
+            with pytest.raises(CircuitOpenError):
+                srv.generate("lm", [1], max_new_tokens=2, timeout=30)
+            time.sleep(0.06)                # cooldown -> probe succeeds
+            out = srv.generate("lm", [2], max_new_tokens=2, timeout=30)
+            assert out.tolist() == [2, 3]
+            dbg = srv.debug_state()
+            assert [c["state"] for c in dbg["circuits"].values()] \
+                == ["closed"]
+        finally:
+            srv.stop()

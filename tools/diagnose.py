@@ -110,6 +110,17 @@ def diagnose(metrics_smoke=False):
           f"(MXNET_ENGINE_SANITIZE=1 to enable lock-order recording + "
           f"tracked-array assertions; docs/static_analysis.md)")
 
+    _section("Fault Injection")
+    from mxnet_tpu import faults
+    plan = faults.active()
+    if plan is None:
+        print("plan         : (off — set MXNET_FAULTS to chaos-test "
+              "the serving resilience layer; docs/serving.md §8)")
+    else:
+        print(f"plan         : {plan.spec}")
+        for key, fired in sorted(plan.counters().items()):
+            print(f"  fired      : {key} x{fired}")
+
     _section("Tracing / Flight Recorder")
     from mxnet_tpu import tracing
     st = tracing.TRACER.stats()

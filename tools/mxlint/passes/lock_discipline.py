@@ -1,9 +1,10 @@
 """lock-discipline: shared-state mutation, lock ordering, blocking calls.
 
 Scope: the threading-reachable modules (``engine``, ``serving/*``,
-``runtime_metrics``, ``tracing``, ``parallel/dist`` — the surfaces
-where worker pools, the metrics registry, the span tracer, and
-multi-process shutdown already shipped race fixes).  Four checks:
+``runtime_metrics``, ``tracing``, ``parallel/dist``, ``faults`` — the
+surfaces where worker pools, the metrics registry, the span tracer,
+fault-plan trigger state, and multi-process shutdown already shipped
+race fixes).  Four checks:
 
 1. **module-state**: a module-level mutable container (dict/list/set/
    deque/...) mutated inside a function without a held lock — the
@@ -37,6 +38,9 @@ _SCOPE_RES = [re.compile(p) for p in (
     r"(^|/)tracing\.py$",
     r"(^|/)serving/[^/]+\.py$",
     r"(^|/)parallel/dist\.py$",
+    # the fault-injection plan is mutated from every serving thread
+    # that hits an injection point — same discipline as serving/*
+    r"(^|/)faults\.py$",
 )]
 
 _LOCKISH = re.compile(r"lock|cond|mutex|_mu$", re.IGNORECASE)
