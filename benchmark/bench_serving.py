@@ -34,6 +34,22 @@ short request admitted mid-flight finishes before a long one admitted
 earlier) and total compiled programs stay <= prefill buckets + 1
 across the mixed-length run.
 
+``--decode --shared-prefix [P]`` (ISSUE-12) replays a production-shaped
+shared-prompt mix (fraction P of prompts share one long prefix, default
+0.8) through the decode engine TWICE — prefix cache off, then on — and
+reports TTFT p50/p99 and tokens/sec side by side with the hit ratio
+and tokens saved; ``--smoke`` asserts byte-identical outputs, a
+hit-ratio that matches the mix, leak-free shared pages, and the
+headline criterion: cached TTFT p50 at least 2x better.
+
+``--decode --speculative`` (ISSUE-12) drives speculative decoding on a
+deterministic fake pair whose per-call cost is real numpy matmul work
+(target heavy, draft ~5%% of it, ~90%% token agreement by
+construction) so the tokens/sec win comes from what speculation
+actually changes — fewer target calls per emitted token; reports
+accept rate and tokens/sec speculative vs plain (``--smoke`` asserts
+byte-identical outputs and >= 1.3x tokens/sec).
+
 ``--quantized`` (ISSUE-10, also run by serving_smoke) exports the SAME
 model as an f32 and an int8 artifact (docs/serving.md §7), serves both
 versions of one model through the bucket machinery, and reports req/s
@@ -324,7 +340,10 @@ def run_decode(args):
     plan = []
     for i in range(n_req):
         prompt = list(range(1, 2 + i % 6))          # lens 1..6
-        max_new = 12 if i == 0 else 2 + i % 4
+        # the long request must stay mid-flight while Poisson shorts
+        # arrive — 24 tokens keeps its window open on fast machines
+        # (12 was finishing before the first short landed)
+        max_new = 24 if i == 0 else 2 + i % 4
         plan.append((prompt, max_new))
 
     # warm the program families outside the timed window: prefill
@@ -441,6 +460,261 @@ def run_decode(args):
         for s in chained["spans"]:
             assert s["trace_id"] == chained["trace_id"], s
             assert s["parent_id"] is None or s["parent_id"] in ids, s
+    return result
+
+
+def run_prefix(args):
+    """ISSUE-12 shared-prefix tier: the SAME seeded shared-prompt
+    workload (fraction ``--shared-prefix`` of requests share one long
+    system-prompt-style prefix) served twice — prefix cache OFF then
+    ON — one BENCH JSON line with TTFT p50/p99 and tokens/sec side by
+    side, the hit ratio, and prefill tokens saved."""
+    mx.random.seed(7)
+    rm.enable()
+    from mxnet_tpu.models.transformer_blocks import TransformerDecoderLM
+    share = args.shared_prefix
+    n_req = args.decode_requests
+    lm = TransformerDecoderLM(64, units=64, hidden_size=128,
+                              num_layers=3, num_heads=4, max_length=64)
+    lm.initialize(mx.init.Xavier())
+
+    # workload: shared requests = 48-token common prefix + 1-2 private
+    # suffix tokens; the rest are distinct random prompts of the same
+    # length band (both runs pay identical non-prefix work)
+    rng = np.random.RandomState(0)
+    prefix = list(rng.randint(1, 64, size=48))
+    plan = []
+    for i in range(n_req):
+        if rng.rand() < share:
+            plan.append(prefix + list(rng.randint(1, 64,
+                                                  size=1 + i % 2)))
+        else:
+            plan.append(list(rng.randint(1, 64, size=48 + 1 + i % 2)))
+
+    def serve_round(prefix_cache):
+        repo = serving.ModelRepository()
+        repo.add_decoder("lm", lm)
+        cfg = serving.ServingConfig(
+            decode_page_size=4, decode_pool_pages=257,
+            decode_max_batch=4, decode_max_new_tokens=8,
+            prefix_cache=prefix_cache, queue_depth=max(64, n_req))
+        srv = serving.ModelServer(repo, cfg)
+        # warm every program family outside the timed window — misses
+        # measure the CACHE, not compile time.  The cache-on round also
+        # warms the HIT path (the width-1/2 verify programs the shared
+        # tails ride), which seeds the prefix tree as a side effect
+        srv.generate("lm", plan[0], max_new_tokens=2, timeout=600)
+        srv.generate("lm", plan[-1], max_new_tokens=2, timeout=600)
+        if prefix_cache:
+            srv.generate("lm", prefix + [63], max_new_tokens=2,
+                         timeout=600)           # seed/tail-1 verify
+            srv.generate("lm", prefix + [63, 62], max_new_tokens=2,
+                         timeout=600)           # tail-2 verify
+        outs, ttfts = [], []
+        t0 = time.perf_counter()
+        total = 0
+        for prompt in plan:
+            first = []
+            t_sub = time.perf_counter()
+            out = srv.generate(
+                "lm", prompt, max_new_tokens=4,
+                on_token=lambda t: first.append(time.perf_counter()),
+                timeout=600)
+            ttfts.append(1e3 * (first[0] - t_sub))
+            outs.append(out.tolist())
+            total += len(out)
+        wall = time.perf_counter() - t0
+        stats = srv.decode_stats("lm")
+        eng = list(srv._decoders.values())[0]
+        eng.allocator.check_leaks()     # exact under shared pages
+        srv.stop()
+        return outs, ttfts, total / wall, stats
+
+    outs_off, ttft_off, tps_off, st_off = serve_round(False)
+    outs_on, ttft_on, tps_on, st_on = serve_round(True)
+
+    pct = lambda xs, q: float(np.percentile(xs, q))     # noqa: E731
+    hits = st_on["prefix_hits"]
+    misses = st_on["prefix_misses"]
+    result = {
+        "metric": "serving.decode.prefix",
+        "value": round(pct(ttft_off, 50) / max(1e-9, pct(ttft_on, 50)),
+                       3),
+        "unit": "ttft_p50_speedup_x",
+        "requests": n_req,
+        "shared_prefix_mix": share,
+        "ttft_p50_ms_off": round(pct(ttft_off, 50), 3),
+        "ttft_p50_ms_on": round(pct(ttft_on, 50), 3),
+        "ttft_p99_ms_off": round(pct(ttft_off, 99), 3),
+        "ttft_p99_ms_on": round(pct(ttft_on, 99), 3),
+        "tokens_per_s_off": round(tps_off, 2),
+        "tokens_per_s_on": round(tps_on, 2),
+        "prefix_hits": hits,
+        "prefix_misses": misses,
+        "prefix_hit_ratio": round(hits / max(1, hits + misses), 4),
+        "prefix_tokens_saved": st_on["prefix_tokens_saved"],
+        "kv_shared_pages_final": st_on["shared_pages"],
+        "cached_pages": st_on["cached_pages"],
+        "programs": st_on["programs"],
+        "program_bound": st_on["program_bound"],
+    }
+    if args.smoke:
+        # byte-identical outputs cache on vs off — the cache may only
+        # move work, never tokens
+        assert outs_on == outs_off, "prefix cache changed outputs"
+        # the hit-ratio counter proves prefill was skipped: every
+        # shared request after the seeding miss hits
+        expected_hits = sum(p[:48] == prefix for p in plan) - 1
+        assert hits >= max(1, expected_hits), (hits, expected_hits)
+        assert result["prefix_tokens_saved"] >= 48 * hits, result
+        # the ISSUE-12 headline: TTFT p50 at least 2x better
+        assert result["value"] >= 2.0, result
+        assert st_on["programs"] <= st_on["program_bound"], st_on
+    return result
+
+
+class _HeavyPair:
+    """Deterministic target/draft fakes whose cost is REAL numpy matmul
+    work: the target burns ``work`` 192x192 GEMMs per call (verify ~a
+    third more), the draft ~1/20 of that, and the draft agrees with
+    the target's next-token rule except every 10th token value — so
+    the speculative tokens/sec win measured below comes exclusively
+    from what speculation changes: target calls per emitted token."""
+
+    vocab_size = 64
+    max_context = 96
+
+    def __init__(self, work=4, draft=False):
+        self.work = work
+        self.draft = draft
+        rs = np.random.RandomState(5)
+        self._a = rs.randn(192, 192).astype(np.float32)
+        self.calls = {"prefill": 0, "step": 0, "verify": 0}
+
+    def _burn(self, reps):
+        a = self._a
+        for _ in range(max(1, reps)):
+            # keep activations O(1): a decaying scale would drift into
+            # denormals and make later reps pathologically slow, which
+            # would skew the verify-vs-step cost ratio this fake exists
+            # to model
+            a = np.tanh(a @ self._a * 0.1)
+        return float(a[0, 0])
+
+    def _next(self, t):
+        t = int(t)
+        nxt = (t * 7 + 3) % self.vocab_size
+        if self.draft and t % 10 == 0:
+            nxt = (nxt + 1) % self.vocab_size   # deliberate disagreement
+        return nxt
+
+    def _rows(self, tokens):
+        logits = np.zeros((len(tokens), self.vocab_size), np.float32)
+        for i, t in enumerate(tokens):
+            logits[i, self._next(t)] = 1.0
+        return logits
+
+    def prefill(self, tokens, length, block_table):
+        self.calls["prefill"] += 1
+        self._burn(self.work // (20 if self.draft else 1))
+        return self._rows([tokens[0, int(length) - 1]])[0]
+
+    def decode_step(self, tokens, positions, block_tables):
+        self.calls["step"] += 1
+        self._burn(self.work // (20 if self.draft else 1))
+        return self._rows(list(tokens))
+
+    def verify(self, tokens, start, length, block_table):
+        self.calls["verify"] += 1
+        self._burn(self.work + self.work // 3)
+        return self._rows(list(tokens[0]))
+
+    def verify_batch(self, tokens, starts, lengths, block_tables):
+        # ONE device call judges every window — the shape the batched
+        # verify program has on the real adapter
+        self.calls["verify"] += 1
+        self._burn(self.work + self.work // 3)
+        return np.stack([self._rows(list(row)) for row in tokens])
+
+    def copy_page(self, src, dst):
+        pass
+
+
+def run_speculative(args):
+    """ISSUE-12 speculative tier: the same seeded workload decoded
+    plainly and speculatively (k=3, ~90%-agreeing cheap draft) over
+    cost-realistic fakes; one BENCH JSON line with tokens/sec side by
+    side and the draft acceptance rate."""
+    rm.enable()
+    n_req = args.decode_requests
+
+    rng = np.random.RandomState(2)
+    plan = [list(rng.randint(1, 64, size=2 + i % 5))
+            for i in range(n_req)]
+
+    def serve_round(spec_k):
+        repo = serving.ModelRepository()
+        target = _HeavyPair(work=16)
+        draft = _HeavyPair(work=16, draft=True)
+        repo.add_decoder("lm", target,
+                         draft=draft if spec_k else None)
+        cfg = serving.ServingConfig(
+            decode_page_size=4, decode_pool_pages=257,
+            decode_max_batch=4, decode_max_new_tokens=24,
+            spec_k=spec_k, queue_depth=max(64, n_req))
+        srv = serving.ModelServer(repo, cfg)
+        outs, errors = {}, []
+
+        def worker(i):
+            try:
+                outs[i] = srv.generate("lm", plan[i],
+                                       max_new_tokens=24,
+                                       timeout=600).tolist()
+            except Exception as e:          # noqa: BLE001
+                errors.append(e)
+
+        pool = [threading.Thread(target=worker, args=(i,))
+                for i in range(n_req)]
+        t0 = time.perf_counter()
+        for t in pool:
+            t.start()
+        for t in pool:
+            t.join(600)
+        wall = time.perf_counter() - t0
+        assert not errors, errors[:3]
+        total = sum(len(v) for v in outs.values())
+        stats = srv.decode_stats("lm")
+        eng = list(srv._decoders.values())[0]
+        eng.allocator.check_leaks()
+        srv.stop()
+        return [outs[i] for i in range(n_req)], total / wall, stats
+
+    outs_plain, tps_plain, _ = serve_round(0)
+    outs_spec, tps_spec, st = serve_round(3)
+
+    accept = st["spec_accepted"] / max(1, st["spec_proposed"])
+    result = {
+        "metric": "serving.decode.speculative",
+        "value": round(tps_spec / max(1e-9, tps_plain), 3),
+        "unit": "tokens_per_s_speedup_x",
+        "requests": n_req,
+        "spec_k": 3,
+        "tokens_per_s_plain": round(tps_plain, 2),
+        "tokens_per_s_spec": round(tps_spec, 2),
+        "spec_proposed": st["spec_proposed"],
+        "spec_accepted": st["spec_accepted"],
+        "accept_rate": round(accept, 4),
+        "spec_rounds": st["spec_rounds"],
+        "spec_fallbacks": st["spec_fallbacks"],
+    }
+    if args.smoke:
+        # rejection sampling in greedy mode is exact: byte-identical
+        # outputs with speculation on vs off
+        assert outs_spec == outs_plain, \
+            "speculation changed greedy outputs"
+        assert accept >= 0.5, result
+        # the ISSUE-12 headline: >= 1.3x tokens/sec on the smoke config
+        assert result["value"] >= 1.3, result
     return result
 
 
@@ -863,6 +1137,22 @@ def main():
                          "leak-free quarantine, and circuit "
                          "open->probe->close (docs/serving.md §8); "
                          "numpy fakes only, zero XLA compiles")
+    ap.add_argument("--shared-prefix", type=float, nargs="?",
+                    const=0.8, default=None, metavar="P",
+                    help="with --decode: shared-prefix traffic tier — "
+                         "fraction P of prompts share one long prefix "
+                         "(default 0.8); serves the mix with the "
+                         "prefix cache off then on and reports TTFT "
+                         "p50/p99 + hit ratio side by side (--smoke "
+                         "asserts byte-identical outputs and >= 2x "
+                         "TTFT p50)")
+    ap.add_argument("--speculative", action="store_true",
+                    help="with --decode: speculative-decoding tier — "
+                         "plain vs spec_k=3 over a cost-realistic "
+                         "fake target/draft pair; tokens/sec side by "
+                         "side + acceptance rate (--smoke asserts "
+                         "byte-identical outputs and >= 1.3x "
+                         "tokens/sec)")
     ap.add_argument("--decode-requests", type=int,
                     default=int(os.environ.get(
                         "BENCH_DECODE_REQUESTS", 20)))
@@ -904,6 +1194,18 @@ def main():
         print(json.dumps(run_faults(args)))
         print("serving chaos smoke ok (no hung requests, circuit "
               "recovered)", file=sys.stderr)
+        return
+
+    if args.decode and args.shared_prefix is not None:
+        print(json.dumps(run_prefix(args)))
+        if args.smoke:
+            print("serving shared-prefix smoke ok", file=sys.stderr)
+        return
+
+    if args.decode and args.speculative:
+        print(json.dumps(run_speculative(args)))
+        if args.smoke:
+            print("serving speculative smoke ok", file=sys.stderr)
         return
 
     if args.decode:

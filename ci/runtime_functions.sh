@@ -122,6 +122,16 @@ serving_smoke() {
     # programs stay <= prefill buckets + 1 across a 20-request
     # mixed-length run
     python benchmark/bench_serving.py --decode --smoke
+    # shared-prefix tier (ISSUE-12 acceptance): the 80%-shared-prefix
+    # mix served with the prefix cache off then on — byte-identical
+    # outputs, hit-ratio counter proves skipped prefill, TTFT p50 at
+    # least 2x better with the cache, leak-free shared pages
+    python benchmark/bench_serving.py --decode --shared-prefix --smoke
+    # speculative tier (ISSUE-12 acceptance): plain vs spec_k=3 over a
+    # cost-realistic fake target/draft pair — byte-identical greedy
+    # outputs (exact rejection sampling) and >= 1.3x tokens/sec, with
+    # the draft acceptance rate reported
+    python benchmark/bench_serving.py --decode --speculative --smoke
     # quantized round trip (ISSUE-10 acceptance): export int8 ->
     # tampered-scale manifest rejected at load -> predict through the
     # quantized version under load, with zero XLA programs beyond the

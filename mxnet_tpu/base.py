@@ -300,6 +300,33 @@ declare_env("MXNET_SERVING_DECODE_MAX_NEW_TOKENS", 32,
             "Decode engine: default cap on generated tokens per "
             "request (generate(max_new_tokens=...) overrides, bounded "
             "by the model's max_context).")
+declare_env("MXNET_SERVING_PREFIX_CACHE", "0",
+            "Decode engine: enable copy-on-write prefix caching "
+            "(docs/serving.md §9) — full prompt pages are "
+            "content-addressed in a radix tree, a request whose prefix "
+            "is cached aliases the shared (refcounted) KV pages and "
+            "skips that prefill; the one page it appends into is "
+            "copy-on-write duplicated.  Lookup failures degrade to a "
+            "plain prefill.")
+declare_env("MXNET_SERVING_PREFIX_CACHE_PAGES", 0,
+            "Decode engine: cap on KV pages the prefix cache may hold "
+            "(refcount-aware LRU evicts beyond it; cache-only pages "
+            "are also evicted on demand when admission needs the free "
+            "list).  0 (default) = bounded by the pool alone.")
+declare_env("MXNET_SERVING_SPEC_K", 0,
+            "Decode engine: speculative-decoding proposal depth — the "
+            "draft model proposes up to k tokens per sequence per "
+            "round and the target verifies all k+1 positions in ONE "
+            "program call (greedy acceptance is exact, so outputs are "
+            "byte-identical with speculation on or off).  0 (default) "
+            "disables; requires a draft model "
+            "(add_decoder(draft=...) or MXNET_SERVING_SPEC_DRAFT).")
+declare_env("MXNET_SERVING_SPEC_DRAFT", None,
+            "Decode engine: repository model name whose decode model "
+            "serves as the DEFAULT speculative-decoding draft for "
+            "decoder entries registered without an explicit "
+            "add_decoder(draft=...).  The named entry must be "
+            "registered before the first generate() call resolves it.")
 declare_env("MXNET_SERVING_DEADLINE_DEFAULT", None,
             "Serving: default end-to-end deadline (seconds, float) for "
             "predict()/generate() calls that pass no timeout.  The "

@@ -163,8 +163,11 @@ def export_stablehlo(block, *example_inputs, path, emit_text=False,
     ``decode`` field): a dict of the dimensions an autoregressive
     runtime needs to size a paged KV cache and drive the step loop —
     ``vocab_size``, ``num_layers``, ``num_heads``, ``head_dim``,
-    ``max_context``, optional ``eos_id``
-    (``TransformerDecoderLM.decode_meta()`` produces it).  The exported
+    ``max_context``, optional ``eos_id``, optional speculative-decoding
+    deployment metadata — a ``draft`` dims block (same field rules,
+    vocab must match the target's) and the tuned proposal depth
+    ``spec_k`` (``TransformerDecoderLM.decode_meta(draft=..,
+    spec_k=..)`` produces it; docs/serving.md §9).  The exported
     program itself stays the one-shot forward; the metadata is the
     contract for external decode runtimes and for
     ``serving.ModelRepository`` (which surfaces it as
@@ -564,6 +567,39 @@ def validate_manifest(manifest, where="manifest"):
             raise MXNetError(
                 f"{where}: decode metadata eos_id {eos!r} outside "
                 f"[0, vocab_size={dec['vocab_size']})")
+        # speculative-decoding deployment metadata (docs/serving.md
+        # §9): the draft model's cache-sizing dims next to the
+        # target's, and the proposal depth the verify programs were
+        # tuned for — same field rules as the target block
+        draft = dec.get("draft")
+        if draft is not None:
+            if not isinstance(draft, dict):
+                raise MXNetError(
+                    f"{where}: decode metadata 'draft' must be a dict "
+                    f"of draft-model dimensions")
+            for field in ("vocab_size", "num_layers", "num_heads",
+                          "head_dim", "max_context"):
+                v = draft.get(field)
+                if not isinstance(v, int) or v < 1:
+                    raise MXNetError(
+                        f"{where}: decode draft metadata field "
+                        f"{field!r} must be a positive int, got {v!r}")
+            if draft["vocab_size"] != dec["vocab_size"]:
+                raise MXNetError(
+                    f"{where}: decode draft vocab_size "
+                    f"{draft['vocab_size']} != target vocab_size "
+                    f"{dec['vocab_size']} — draft proposals must be "
+                    f"target token ids")
+        spec_k = dec.get("spec_k")
+        if spec_k is not None:
+            if not isinstance(spec_k, int) or spec_k < 1:
+                raise MXNetError(
+                    f"{where}: decode metadata spec_k must be a "
+                    f"positive int, got {spec_k!r}")
+            if spec_k + 1 > dec["max_context"]:
+                raise MXNetError(
+                    f"{where}: decode metadata spec_k {spec_k} + 1 "
+                    f"exceeds max_context {dec['max_context']}")
     if bool(manifest.get("dynamic_batch")):
         for i, spec in enumerate(manifest["inputs"]):
             if not spec["shape"] or spec["shape"][0] is not None:

@@ -11,6 +11,19 @@ the decode engine, and the page allocator) to one of four fault modes,
 with seeded-RNG probability and after-N-calls triggers, so a 5%%
 execute-fault chaos run replays byte-identically from its spec string.
 
+Site catalogue (fnmatch globs — ``decode.*`` matches the engine):
+``serving.execute`` / ``serving.compile`` (batcher),
+``deploy.execute``, ``compile_cache.load``,
+``repository.load_artifact``, ``decode.prefill``, ``decode.step``,
+``decode.prefix_lookup`` (prefix-cache radix lookup at admission — a
+failed/corrupted lookup must degrade to a plain prefill, never to
+wrong tokens; the site passes no value through, so ``corrupt`` raises
+like ``fail`` instead of silently handing back wrong pages),
+``decode.verify`` (speculative verification — a target-model failure,
+quarantining that sequence through the §8 path), and
+``kv_cache.allocate`` (fail-only: injected pool exhaustion is a
+refusal, not an exception).
+
 Spec grammar (``MXNET_FAULTS``, or :func:`install` / :func:`plan`)::
 
     plan  := rule (';' rule)*

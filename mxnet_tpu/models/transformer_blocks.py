@@ -21,7 +21,8 @@ from ..gluon.block import HybridBlock
 __all__ = ["PositionwiseFFN", "MultiHeadSelfAttention",
            "MultiHeadAttention", "TransformerEncoderCell",
            "TransformerDecoderCell", "TransformerDecoderLM",
-           "paged_lm_params", "paged_prefill", "paged_decode_step"]
+           "paged_lm_params", "paged_prefill", "paged_decode_step",
+           "paged_verify", "paged_verify_batch"]
 
 
 class PositionwiseFFN(HybridBlock):
@@ -326,11 +327,18 @@ class TransformerDecoderLM(HybridBlock):
         logits = self.proj(x)                               # (L, B, V)
         return F.transpose(logits, axes=(1, 0, 2))
 
-    def decode_meta(self, eos_id=None):
+    def decode_meta(self, eos_id=None, draft=None, spec_k=None):
         """The decode-capable metadata block a serving/deploy manifest
         carries (``deploy.export_stablehlo(decode=...)``): everything an
         external runtime needs to size the paged KV cache and drive the
-        step loop."""
+        step loop.
+
+        ``draft`` (another :class:`TransformerDecoderLM`, or a plain
+        dims dict) ships the speculative-decoding draft model's cache
+        sizing next to the target's, and ``spec_k`` the proposal depth
+        the deployment was tuned for (docs/serving.md §9) — so an
+        external runtime can pre-size BOTH pools and the verify-program
+        width before loading weights."""
         meta = {"vocab_size": self.vocab_size,
                 "num_layers": self.num_layers,
                 "num_heads": self.num_heads,
@@ -338,6 +346,11 @@ class TransformerDecoderLM(HybridBlock):
                 "max_context": self.max_context}
         if eos_id is not None:
             meta["eos_id"] = int(eos_id)
+        if draft is not None:
+            meta["draft"] = dict(draft) if isinstance(draft, dict) \
+                else draft.decode_meta()
+        if spec_k is not None:
+            meta["spec_k"] = int(spec_k)
         return meta
 
 
@@ -490,6 +503,130 @@ def paged_decode_step(params, tokens, positions, block_tables, k_pages,
             o = pk.ragged_paged_attention_reference(
                 q, k_pages[li], v_pages[li], block_tables, ctx)
         x = x + (o.reshape(B, C) @ cp["o_w"].T + cp["o_b"])
+        x = x + _f_ffn(_f_ln(x, cp["n2_g"], cp["n2_b"], layer_norm_eps),
+                       cp, activation)
+    x = _f_ln(x, params["fn_g"], params["fn_b"], layer_norm_eps)
+    return x @ params["proj_w"].T + params["proj_b"], k_pages, v_pages
+
+
+def paged_verify(params, tokens, start, length, block_table, k_pages,
+                 v_pages, *, num_heads, page_size, activation="relu",
+                 layer_norm_eps=1e-5, attention_impl="jax"):
+    """Multi-token window forward over a paged context: the ragged
+    verification shape of speculative decoding, and the tail prefill of
+    a prefix-cache hit (docs/serving.md §9).
+
+    ``tokens``: (1, W_bucket) int32 window, padded past ``length``;
+    ``start``: scalar global position of ``tokens[0, 0]`` (K/V of
+    positions ``< start`` already sit in cache pages); ``block_table``:
+    (pages_per_seq,) int32.  Writes K/V for the ``length`` valid window
+    positions through the block table (padded positions route to the
+    null page) and attends each window token causally over the FULL
+    paged context up to itself — the prefill/multi-token path of
+    ``ragged_paged_attention`` ("Ragged Paged Attention", PAPERS.md).
+    Returns ``(logits (W_bucket, V), k_pages, v_pages)``; rows past
+    ``length`` are zeros-in/garbage-out and must not be read.
+
+    Equivalences the decode engine leans on: with ``start == 0`` and
+    ``length == L`` this is :func:`paged_prefill` over a paged read
+    path; with ``W == 1`` it recovers the last-token logits of an
+    already-cached prefix; with the speculation window
+    ``[last_sampled, draft_1..draft_k]`` it verifies all k+1 positions
+    in ONE program call.
+    """
+    import jax.numpy as jnp
+
+    from ..ops import pallas_kernels as pk
+    H = num_heads
+    W = tokens.shape[1]
+    C = params["embed"].shape[1]
+    D = C // H
+    P = block_table.shape[0]
+    offs = jnp.arange(W)
+    pos = start + offs
+    valid = offs < length                                   # (W,)
+    max_pos = params["pos"].shape[0]
+    x = params["embed"][tokens[0]] * math.sqrt(C) \
+        + params["pos"][jnp.minimum(pos, max_pos - 1)]      # (W, C)
+    page_idx = jnp.where(
+        valid, block_table[jnp.minimum(pos // page_size, P - 1)], 0)
+    slot_idx = pos % page_size
+    starts = jnp.reshape(start, (1,)).astype(jnp.int32)
+    lengths = jnp.reshape(length, (1,)).astype(jnp.int32)
+    for li, cp in enumerate(params["cells"]):
+        h = _f_ln(x, cp["n1_g"], cp["n1_b"], layer_norm_eps)
+        qkv = (h @ cp["qkv_w"].T + cp["qkv_b"]).reshape(W, H, 3, D)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        k_pages = k_pages.at[li, page_idx, slot_idx].set(
+            k.astype(k_pages.dtype))
+        v_pages = v_pages.at[li, page_idx, slot_idx].set(
+            v.astype(v_pages.dtype))
+        if attention_impl == "pallas":
+            o = pk.ragged_paged_verify(
+                q[None], k_pages[li], v_pages[li], block_table[None],
+                starts, lengths)[0]
+        else:
+            o = pk.ragged_paged_verify_reference(
+                q[None], k_pages[li], v_pages[li], block_table[None],
+                starts, lengths)[0]
+        x = x + (o.reshape(W, C) @ cp["o_w"].T + cp["o_b"])
+        x = x + _f_ffn(_f_ln(x, cp["n2_g"], cp["n2_b"], layer_norm_eps),
+                       cp, activation)
+    x = _f_ln(x, params["fn_g"], params["fn_b"], layer_norm_eps)
+    return x @ params["proj_w"].T + params["proj_b"], k_pages, v_pages
+
+
+def paged_verify_batch(params, tokens, starts, lengths, block_tables,
+                       k_pages, v_pages, *, num_heads, page_size,
+                       activation="relu", layer_norm_eps=1e-5,
+                       attention_impl="jax"):
+    """Batched :func:`paged_verify`: one fixed-shape program verifies
+    every running sequence's speculation window in ONE device call —
+    the ragged multi-token decode shape (docs/serving.md §9).
+
+    ``tokens``: (B, W) int32 windows; ``starts``/``lengths``: (B,)
+    int32 per-slot window origin and valid width (0 = inactive slot:
+    null writes, zero rows); ``block_tables``: (B, pages_per_seq).
+    Returns ``(logits (B, W, V), k_pages, v_pages)``; rows past a
+    slot's ``lengths`` are garbage the engine never reads.
+    """
+    import jax.numpy as jnp
+
+    from ..ops import pallas_kernels as pk
+    H = num_heads
+    B, W = tokens.shape
+    C = params["embed"].shape[1]
+    D = C // H
+    P = block_tables.shape[1]
+    offs = jnp.arange(W)[None, :]
+    pos = starts[:, None] + offs                            # (B, W)
+    valid = offs < lengths[:, None]                         # (B, W)
+    max_pos = params["pos"].shape[0]
+    x = params["embed"][tokens] * math.sqrt(C) \
+        + params["pos"][jnp.minimum(pos, max_pos - 1)]      # (B, W, C)
+    page_idx = jnp.where(
+        valid,
+        jnp.take_along_axis(block_tables,
+                            jnp.minimum(pos // page_size, P - 1),
+                            axis=1), 0)                     # (B, W)
+    slot_idx = pos % page_size
+    for li, cp in enumerate(params["cells"]):
+        h = _f_ln(x, cp["n1_g"], cp["n1_b"], layer_norm_eps)
+        qkv = (h @ cp["qkv_w"].T + cp["qkv_b"]).reshape(B, W, H, 3, D)
+        q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
+        k_pages = k_pages.at[li, page_idx, slot_idx].set(
+            k.astype(k_pages.dtype))
+        v_pages = v_pages.at[li, page_idx, slot_idx].set(
+            v.astype(v_pages.dtype))
+        if attention_impl == "pallas":
+            o = pk.ragged_paged_verify(
+                q, k_pages[li], v_pages[li], block_tables, starts,
+                lengths)
+        else:
+            o = pk.ragged_paged_verify_reference(
+                q, k_pages[li], v_pages[li], block_tables, starts,
+                lengths)
+        x = x + (o.reshape(B, W, C) @ cp["o_w"].T + cp["o_b"])
         x = x + _f_ffn(_f_ln(x, cp["n2_g"], cp["n2_b"], layer_norm_eps),
                        cp, activation)
     x = _f_ln(x, params["fn_g"], params["fn_b"], layer_norm_eps)

@@ -40,7 +40,8 @@ except ImportError:  # pragma: no cover
 from .registry import register
 
 __all__ = ["flash_attention", "pallas_available",
-           "ragged_paged_attention", "ragged_paged_attention_reference"]
+           "ragged_paged_attention", "ragged_paged_attention_reference",
+           "ragged_paged_verify", "ragged_paged_verify_reference"]
 
 _NEG_INF = -1e30
 
@@ -617,6 +618,178 @@ def ragged_paged_attention_reference(q, k_pages, v_pages, block_tables,
     l = jnp.sum(e, axis=-1, keepdims=True)                  # (B, H, 1)
     out = jnp.einsum("bht,bthd->bhd", e, v.astype(jnp.float32))
     return (out / jnp.where(l == 0.0, 1.0, l)).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# ragged paged verify (multi-token window over a paged context: the
+# speculative-decoding verification shape — k+1 query tokens per
+# sequence, each attending causally over the full paged prefix — and
+# the tail prefill of a prefix-cache hit; docs/serving.md §9)
+# ---------------------------------------------------------------------------
+def _paged_verify_kernel(bt_ref, start_ref, len_ref, q_ref, k_ref,
+                         v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                         sm_scale, page_size, n_pages, width):
+    """One (sequence, head, page) grid step of windowed verify
+    attention.  Identical page-innermost online-softmax structure to
+    :func:`_paged_fwd_kernel`, but the query block is the whole (W, D)
+    window and the causal mask is per ROW: window row ``w`` (global
+    position ``start + w``) sees key ``j`` iff ``j <= start + w``.
+    Page 0 always holds valid keys for every valid row (all rows attend
+    from position 0), so a valid row's softmax statistics are finite
+    from its first processed block; rows past ``length`` accumulate
+    garbage the wrapper zeroes."""
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    start = start_ref[b]
+    n_valid = len_ref[b]
+    page_start = p * page_size
+
+    # skip pages entirely past the last valid row's causal horizon
+    # (start + n_valid - 1); an inactive slot (n_valid == 0) skips all
+    @pl.when(page_start < start + n_valid)
+    def _step():
+        q = q_ref[0, :, 0]                      # (W, D)
+        k = k_ref[0, :, 0]                      # (page_size, D)
+        v = v_ref[0, :, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # (W, ps)
+        idx = page_start + jax.lax.broadcasted_iota(
+            jnp.int32, (width, page_size), 1)
+        row = jax.lax.broadcasted_iota(
+            jnp.int32, (width, page_size), 0)
+        mask = jnp.logical_and(idx <= start + row, row < n_valid)
+        s = jnp.where(mask, s, _NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p_ = jnp.exp(s - m_new)                 # (W, ps)
+        l_scr[:] = l_scr[:] * corr + jnp.sum(p_, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p_.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+
+    @pl.when(p == n_pages - 1)
+    def _finish():
+        l = l_scr[:]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, :, 0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+
+
+def ragged_paged_verify(q, k_pages, v_pages, block_tables, starts,
+                        lengths, sm_scale=None, interpret=None):
+    """Multi-token verify attention over a paged KV cache (Pallas TPU
+    kernel).
+
+    - ``q``: (B, W, H, D) — a W-token window per sequence slot (the
+      speculative k+1 verification window, or a prefix-cache tail).
+    - ``k_pages`` / ``v_pages``: (num_pages, page_size, H, D) pool.
+    - ``block_tables``: (B, pages_per_seq) int32 — as in
+      :func:`ragged_paged_attention`.
+    - ``starts``: (B,) int32 — global position of each slot's window
+      row 0; K/V of positions below it are read from the cache pages,
+      and the window's own K/V must already be written THROUGH the same
+      block table (the verify forward writes before it attends).
+    - ``lengths``: (B,) int32 — valid rows per window (0 = inactive
+      slot).  Rows past ``lengths`` come back as zeros.
+
+    Window row ``w`` attends causally over positions
+    ``0 .. starts[b] + w`` — exactly prefill semantics when
+    ``starts == 0`` and decode semantics when ``W == 1``.  Returns
+    (B, W, H, D) in the query dtype; pure-jax twin:
+    :func:`ragged_paged_verify_reference`.
+    """
+    if not pallas_available():
+        from ..base import MXNetError
+        raise MXNetError(
+            "ragged_paged_verify requires jax.experimental.pallas.tpu "
+            "(check mx.runtime.Features()['PALLAS']); use "
+            "ragged_paged_verify_reference on other backends")
+    B, W, H, D = q.shape
+    n_pool, page_size, HK, DK = k_pages.shape
+    if (HK, DK) != (H, D) or v_pages.shape != k_pages.shape:
+        from ..base import MXNetError
+        raise MXNetError(
+            f"ragged_paged_verify: q (B,W,H,D)={q.shape} inconsistent "
+            f"with k_pages {k_pages.shape} / v_pages {v_pages.shape} "
+            f"(want (num_pages, page_size, {H}, {D}))")
+    n_pages = block_tables.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(D))
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    block_tables = block_tables.astype(jnp.int32)
+    starts = starts.astype(jnp.int32)
+    lengths = lengths.astype(jnp.int32)
+
+    q_spec = pl.BlockSpec((1, W, 1, D),
+                          lambda b, h, p, bt, st, ln: (b, 0, h, 0))
+    kv_spec = pl.BlockSpec(
+        (1, page_size, 1, D),
+        lambda b, h, p, bt, st, ln: (bt[b, p], 0, h, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, H, n_pages),
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=q_spec,
+        scratch_shapes=[_scratch((W, 1), jnp.float32),
+                        _scratch((W, 1), jnp.float32),
+                        _scratch((W, D), jnp.float32)],
+    )
+    kernel = functools.partial(_paged_verify_kernel,
+                               sm_scale=float(sm_scale),
+                               page_size=page_size, n_pages=n_pages,
+                               width=W)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, W, H, D), q.dtype),
+        interpret=bool(interpret),
+    )(block_tables, starts, lengths, q, k_pages, v_pages)
+    # defined semantics for padded rows (they accumulate garbage in the
+    # kernel — their every score is masked, so the online max never
+    # leaves the -inf floor and exp(s - m) degenerates to 1)
+    valid = jnp.arange(W)[None, :] < lengths[:, None]       # (B, W)
+    return jnp.where(valid[:, :, None, None], out,
+                     jnp.zeros((), out.dtype))
+
+
+def ragged_paged_verify_reference(q, k_pages, v_pages, block_tables,
+                                  starts, lengths, sm_scale=None):
+    """Pure-jax twin of :func:`ragged_paged_verify` — same signature
+    and semantics (rows past ``lengths`` yield zeros), used as the CPU
+    serving path and the kernel-parity oracle."""
+    B, W, H, D = q.shape
+    page_size = k_pages.shape[1]
+    n_pages = block_tables.shape[1]
+    T = n_pages * page_size
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(D))
+    block_tables = block_tables.astype(jnp.int32)
+    starts = starts.astype(jnp.int32)
+    lengths = lengths.astype(jnp.int32)
+    k = k_pages[block_tables].reshape(B, T, H, D)
+    v = v_pages[block_tables].reshape(B, T, H, D)
+    s = jnp.einsum("bwhd,bthd->bhwt", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    row_pos = starts[:, None] + jnp.arange(W)[None, :]      # (B, W)
+    mask = (jnp.arange(T)[None, None, :] <= row_pos[:, :, None]) \
+        & (jnp.arange(W)[None, :, None] < lengths[:, None, None])
+    s = jnp.where(mask[:, None], s, _NEG_INF)               # (B,H,W,T)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - m) * mask[:, None]
+    l = jnp.sum(e, axis=-1)                                 # (B, H, W)
+    out = jnp.einsum("bhwt,bthd->bwhd", e, v.astype(jnp.float32))
+    denom = jnp.where(l == 0.0, 1.0, l).transpose(0, 2, 1)  # (B, W, H)
+    return (out / denom[:, :, :, None]).astype(q.dtype)
 
 
 @register("_contrib_ragged_paged_attention", num_inputs=5,

@@ -755,6 +755,36 @@ SERVING_DECODE_KV_OCCUPANCY = gauge(
     "serving.decode.kv.occupancy",
     "Used fraction of the paged KV cache pool (allocated pages / "
     "usable pages), per decode engine.", labelnames=("engine",))
+SERVING_PREFIX_HITS = counter(
+    "serving.decode.prefix.hits",
+    "Prompts admitted with a prefix-cache hit (>= 1 full page of "
+    "prompt K/V aliased from the radix tree instead of prefilled), "
+    "per model (docs/serving.md §9).", labelnames=("model",))
+SERVING_PREFIX_MISSES = counter(
+    "serving.decode.prefix.misses",
+    "Prefix-cache lookups that matched nothing (the prompt prefilled "
+    "in full, then seeded the cache), per model.  hits/(hits+misses) "
+    "is the live hit ratio.", labelnames=("model",))
+SERVING_PREFIX_TOKENS_SAVED = counter(
+    "serving.decode.prefix.tokens_saved",
+    "Prompt tokens whose prefill was skipped by prefix-cache hits "
+    "(matched tokens minus the one re-run token of a full hit), per "
+    "model — the TTFT work the cache removed.", labelnames=("model",))
+SERVING_SPEC_PROPOSED = counter(
+    "serving.decode.spec.proposed",
+    "Draft tokens proposed by speculative decoding, per model "
+    "(docs/serving.md §9).", labelnames=("model",))
+SERVING_SPEC_ACCEPTED = counter(
+    "serving.decode.spec.accepted",
+    "Draft tokens accepted by target verification, per model.  "
+    "accepted/proposed is the draft acceptance rate; each round also "
+    "emits one non-speculative (correction or bonus) token.",
+    labelnames=("model",))
+KV_SHARED_PAGES = gauge(
+    "kv.shared_pages",
+    "KV pages currently referenced more than once (shared between "
+    "sequences and/or the prefix cache) in a decode engine's paged "
+    "pool, per engine.", labelnames=("engine",))
 SERVING_FAULTS = counter(
     "serving.faults",
     "Faults fired by the active fault-injection plan "

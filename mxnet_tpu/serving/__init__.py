@@ -24,7 +24,13 @@ the missing serving tier over it:
   (:mod:`~mxnet_tpu.serving.kv_cache`): admit/evict sequences every
   STEP, prompt-length-bucketed prefill + one fixed-shape decode
   program (ragged paged attention, ``ops/pallas_kernels.py``), and
-  streaming token callbacks (docs/serving.md §6);
+  streaming token callbacks (docs/serving.md §6) — plus the two
+  composable decode optimizations of docs/serving.md §9:
+  copy-on-write prefix caching (:class:`PrefixCache` radix tree over
+  refcounted KV pages; a cached prompt prefix skips its prefill) and
+  speculative decoding (a draft model proposes k tokens, the target
+  verifies all k+1 in ONE ``ragged_paged_verify`` call, greedy
+  acceptance exact);
 - the resilience layer (docs/serving.md §8): end-to-end request
   deadlines (:class:`DeadlineExceededError` instead of silent hangs),
   bounded jittered retries for transient execute failures,
@@ -44,7 +50,8 @@ from .batcher import DynamicBatcher, next_bucket, pad_batch, \
     unpad_outputs
 from .config import ServingConfig
 from .decode import DecodeEngine, GenerateRequest, PagedLMAdapter
-from .kv_cache import DeviceKVPool, PageAllocator, PageGeometry
+from .kv_cache import DeviceKVPool, PageAllocator, PageGeometry, \
+    PrefixCache
 from .repository import ModelEntry, ModelRepository
 from .resilience import (CircuitBreaker, CircuitOpenError, Deadline,
                          DeadlineExceededError)
@@ -54,6 +61,7 @@ __all__ = ["ModelRepository", "ModelEntry", "ModelServer",
            "DynamicBatcher", "ServingConfig", "ServerOverloadedError",
            "next_bucket", "pad_batch", "unpad_outputs",
            "DecodeEngine", "GenerateRequest", "PagedLMAdapter",
-           "PageGeometry", "PageAllocator", "DeviceKVPool",
+           "PageGeometry", "PageAllocator", "PrefixCache",
+           "DeviceKVPool",
            "Deadline", "DeadlineExceededError", "CircuitBreaker",
            "CircuitOpenError"]

@@ -34,6 +34,15 @@ class ServingConfig:
     page), ``decode_max_batch`` sequence slots in the fixed-shape
     decode step, ``decode_max_new_tokens`` default generation cap.
 
+    Decode optimizations (docs/serving.md §9): ``prefix_cache``
+    enables copy-on-write KV page sharing (a prompt whose prefix is
+    cached skips that prefill) with ``prefix_cache_pages`` capping
+    cache-held pages (0 = bounded by the pool alone); ``spec_k`` > 0
+    enables speculative decoding — a draft model proposes up to k
+    tokens per sequence, the target verifies them in one call —
+    with ``spec_draft`` naming the repository entry whose decode
+    model serves as the default draft.
+
     Resilience knobs (docs/serving.md §8): ``deadline_default``
     seconds applied when a call passes no timeout (None = unbounded),
     ``retry_max`` transient-failure re-executions with
@@ -51,7 +60,8 @@ class ServingConfig:
                  decode_max_new_tokens=None, deadline_default=None,
                  retry_max=None, retry_backoff_ms=None,
                  circuit_window=None, circuit_threshold=None,
-                 circuit_cooldown_ms=None):
+                 circuit_cooldown_ms=None, prefix_cache=None,
+                 prefix_cache_pages=None, spec_k=None, spec_draft=None):
         def pick(value, env, typ=int):
             if value is None:
                 value = get_env(env, typ=typ)
@@ -77,6 +87,14 @@ class ServingConfig:
                                      "MXNET_SERVING_DECODE_MAX_BATCH")
         self.decode_max_new_tokens = pick(
             decode_max_new_tokens, "MXNET_SERVING_DECODE_MAX_NEW_TOKENS")
+        # decode optimizations (docs/serving.md §9)
+        self.prefix_cache = bool(pick(prefix_cache,
+                                      "MXNET_SERVING_PREFIX_CACHE"))
+        self.prefix_cache_pages = pick(prefix_cache_pages,
+                                       "MXNET_SERVING_PREFIX_CACHE_PAGES")
+        self.spec_k = pick(spec_k, "MXNET_SERVING_SPEC_K")
+        self.spec_draft = spec_draft if spec_draft is not None \
+            else get_env("MXNET_SERVING_SPEC_DRAFT", typ=str)
         # resilience policy (docs/serving.md §8)
         self.deadline_default = pick(deadline_default,
                                      "MXNET_SERVING_DEADLINE_DEFAULT",
@@ -124,6 +142,14 @@ class ServingConfig:
         if self.decode_max_new_tokens < 1:
             raise MXNetError(
                 "ServingConfig: decode_max_new_tokens must be >= 1")
+        if self.prefix_cache_pages < 0:
+            raise MXNetError(
+                "ServingConfig: prefix_cache_pages must be >= 0 "
+                "(0 = bounded by the KV pool alone)")
+        if self.spec_k < 0:
+            raise MXNetError(
+                "ServingConfig: spec_k must be >= 0 (0 disables "
+                "speculative decoding)")
         if self.deadline_default is not None \
                 and self.deadline_default <= 0:
             raise MXNetError(
@@ -156,6 +182,10 @@ class ServingConfig:
                 f"decode_pool_pages={self.decode_pool_pages}, "
                 f"decode_max_batch={self.decode_max_batch}, "
                 f"decode_max_new_tokens={self.decode_max_new_tokens}, "
+                f"prefix_cache={self.prefix_cache}, "
+                f"prefix_cache_pages={self.prefix_cache_pages}, "
+                f"spec_k={self.spec_k}, "
+                f"spec_draft={self.spec_draft!r}, "
                 f"deadline_default={self.deadline_default}, "
                 f"retry_max={self.retry_max}, "
                 f"retry_backoff_ms={self.retry_backoff_ms}, "

@@ -38,6 +38,33 @@ all-or-nothing, so a running sequence can never hit pool exhaustion
 mid-flight and no preemption machinery is needed; eviction returns the
 pages, unblocking the admission queue.  (vLLM-style lazy allocation
 with preemption is a policy swap inside ``_admit_locked``.)
+
+Two composable optimizations ride the same paged substrate
+(docs/serving.md §9):
+
+- **prefix caching** (``config.prefix_cache``): prompts are looked up
+  in a radix tree over page-size token chunks at admission; a hit
+  aliases the cached (refcounted, immutable) pages instead of
+  re-running prefill — the one page the sequence must append into is
+  copy-on-write duplicated — and the last-token logits are recovered
+  through a width-1 (full hit) or tail-width (partial hit) call of the
+  **verify** program family.  Lookup/verify failures DEGRADE to a
+  plain prefill, never to wrong tokens.
+- **speculative decoding** (``config.spec_k`` + a draft model): the
+  draft proposes up to k tokens per running sequence (batched draft
+  decode steps over the SAME block tables, its K/V in a parallel
+  draft pool), the target verifies all k+1 positions in ONE call of
+  the verify family (the ragged multi-token shape
+  ``ragged_paged_verify`` exists for), greedy acceptance is exact
+  (the degenerate rejection-sampling case — byte-identical outputs
+  speculation on or off), and rejected positions roll back through
+  the block-table/context-length bookkeeping alone (their stale K/V
+  is never attended and is overwritten in place).
+
+Programs stay bounded: prefill buckets + 1 decode + the verify-width
+family (+ the draft's own prefill/decode/verify families when
+speculation is on) — asserted via ``_cache_size()`` like everything
+else.
 """
 from __future__ import annotations
 
@@ -85,7 +112,8 @@ class GenerateRequest:
                  "on_token", "tokens", "event", "error", "finish_reason",
                  "slot", "context_len", "t_submit", "t_first", "t_prev",
                  "cancelled", "trace", "root_span", "queue_span",
-                 "released_pages", "deadline")
+                 "released_pages", "deadline", "prefix_len", "cow",
+                 "draft_ctx", "no_cache", "no_spec")
 
     def __init__(self, prompt, max_new_tokens, eos_id, on_token,
                  deadline=None):
@@ -116,6 +144,25 @@ class GenerateRequest:
         self.root_span = None
         self.queue_span = _tr._NOOP
         self.released_pages = 0
+        # prefix-cache admission plan (set by the step loop): tokens of
+        # prompt covered by aliased cached pages, and the (src, dst)
+        # copy-on-write pair when the hit covers the whole prompt
+        self.prefix_len = 0
+        self.cow = None
+        # speculative decoding: positions with valid DRAFT K/V (lags
+        # context_len by <= 1 after a fully-accepted round)
+        self.draft_ctx = 0
+        # degrade flags: a failed cached-path prefill requeues with the
+        # cache bypassed; a failed draft prefill decodes plainly
+        self.no_cache = False
+        self.no_spec = False
+
+    def token_at(self, pos):
+        """The sequence's token at global position ``pos`` (prompt,
+        then generated ids)."""
+        if pos < self.prompt.size:
+            return int(self.prompt[pos])
+        return self.tokens[pos - self.prompt.size]
 
     @property
     def ttft(self):
@@ -137,8 +184,18 @@ class DecodeEngine:
     - ``decode_step(tokens (B,) i32, positions (B,) i32,
       block_tables (B, P) i32) -> logits (B, V)`` — inactive slots
       carry zeros and their logits are never read;
+    - optional ``verify(tokens (1, W) i32, start () i32, length () i32,
+      block_table (P,) i32) -> logits (W, V)`` — the multi-token
+      window forward prefix caching and speculative decoding need
+      (writes the window's K/V, judges every position in one call);
+    - optional ``copy_page(src, dst)`` — the copy-on-write page
+      duplication behind full prefix-cache hits;
     - optional ``setup(geometry)`` (allocate device pools) and
       ``programs()`` (compiled-program count, for the bound asserts).
+
+    A ``draft`` model (same protocol, smaller) plus ``config.spec_k``
+    turns decode rounds speculative; ``config.prefix_cache`` turns on
+    copy-on-write prefix sharing (both in docs/serving.md §9).
 
     The engine owns the HOST side only: waiting queue (bounded by
     ``config.queue_depth`` — submission past it sheds with
@@ -150,8 +207,9 @@ class DecodeEngine:
     """
 
     def __init__(self, model, config=None, model_name="decoder",
-                 autostart=False):
+                 autostart=False, draft=None):
         from .config import ServingConfig
+        from .kv_cache import PrefixCache
         self.model = model
         self.config = config or ServingConfig()
         self.model_name = model_name
@@ -169,11 +227,83 @@ class DecodeEngine:
         # predict path uses for batch rows, applied to the length axis —
         # at most len(bucket_set(max_context)) prefill programs
         self.prefill_buckets = bucket_set(max_context)
-        self.program_bound = len(self.prefill_buckets) + 1
+        # --- speculative decoding (docs/serving.md §9) ---------------
+        # a draft model + spec_k > 0 turns decode rounds into propose-k
+        # -> verify-(k+1)-in-one-call; both models need the protocol
+        # halves they play (the draft proposes via prefill/decode_step,
+        # the target judges via verify)
+        self.draft = draft
+        self.spec_k = int(self.config.spec_k or 0)
+        if self.spec_k and draft is None:
+            _LOG.warning(
+                "decode engine %s: spec_k=%d but no draft model — "
+                "speculative decoding disabled (register the draft via "
+                "add_decoder(draft=...) or MXNET_SERVING_SPEC_DRAFT)",
+                model_name, self.spec_k)
+            self.spec_k = 0
+        if self.spec_k and getattr(model, "verify", None) is None:
+            raise MXNetError(
+                f"decode engine {model_name!r}: speculative decoding "
+                f"needs the target model to implement verify() "
+                f"(multi-token window forward)")
+        if self.spec_k and self.spec_k + 1 > max_context:
+            raise MXNetError(
+                f"decode engine {model_name!r}: spec_k={self.spec_k} "
+                f"+ 1 exceeds max_context {max_context}")
+        self.draft_geometry = None
+        if self.spec_k:
+            # the draft's K/V lives in a PARALLEL pool with the same
+            # page layout, indexed by the SAME block tables — one
+            # allocator serves both models, and a cached prefix page
+            # carries both models' K/V for its chunk
+            self.draft_geometry = PageGeometry(
+                page_size=self.geometry.page_size,
+                pool_pages=self.geometry.pool_pages,
+                max_context=max_context,
+                num_layers=getattr(draft, "num_layers", 1),
+                num_heads=getattr(draft, "num_heads", 1),
+                head_dim=getattr(draft, "head_dim", 1))
+        # --- prefix cache (docs/serving.md §9) -----------------------
+        self.prefix_cache = None
+        if self.config.prefix_cache:
+            missing = [m for m in ("verify", "copy_page")
+                       if getattr(model, m, None) is None]
+            if missing:
+                _LOG.warning(
+                    "decode engine %s: prefix cache requested but the "
+                    "model lacks %s — disabled (plain prefill serves "
+                    "every prompt)", model_name, "/".join(missing))
+            else:
+                self.prefix_cache = PrefixCache(
+                    self.allocator,
+                    max_pages=self.config.prefix_cache_pages)
+        # program accounting: prefill buckets + 1 decode per model,
+        # + the verify-width family (shared by prefix-hit tails and
+        # speculation windows, <= the same bucket set) + 1 COW copy
+        # program when the prefix cache is on
+        bound = len(self.prefill_buckets) + 1
+        if self.prefix_cache is not None or self.spec_k:
+            bound += len(self.prefill_buckets)      # verify family
+        if self.prefix_cache is not None:
+            bound += 1                              # COW copy program
+        if self.spec_k:
+            bound += 1                  # ONE batched verify program
+            # draft: prefill buckets + 1 decode + its verify family
+            # (prefix-hit tail writes draft K/V through verify too)
+            bound += 2 * len(self.prefill_buckets) + 1
+            if self.prefix_cache is not None:
+                bound += 1                          # draft COW program
+        self.program_bound = bound
         setup = getattr(model, "setup", None)
         if setup is not None:
             setup(self.geometry)
         self._model_bound = setup is not None
+        self._draft_bound = False
+        if self.spec_k:
+            draft_setup = getattr(draft, "setup", None)
+            if draft_setup is not None:
+                draft_setup(self.draft_geometry)
+                self._draft_bound = True
         self._cond = _engine.make_condition("serving.DecodeEngine._cond")
         self._waiting = []                # FIFO of GenerateRequest
         self._running = {}                # slot -> GenerateRequest
@@ -184,7 +314,11 @@ class DecodeEngine:
         self._stats = {"steps": 0, "admitted": 0, "evicted": 0,
                        "generated_tokens": 0, "peak_running": 0,
                        "shed": 0, "retries": 0, "quarantined": 0,
-                       "deadline_exceeded": 0}
+                       "deadline_exceeded": 0, "prefix_hits": 0,
+                       "prefix_misses": 0, "prefix_tokens_saved": 0,
+                       "prefix_degraded": 0, "spec_rounds": 0,
+                       "spec_proposed": 0, "spec_accepted": 0,
+                       "spec_fallbacks": 0}
         # jitter source for transient-retry backoff — instance-owned so
         # tests can inject a seeded one; entropy-seeded by default so
         # replicas do not retry in lockstep against a shared backend
@@ -195,6 +329,8 @@ class DecodeEngine:
     # ----------------------------------------------------------- lifecycle
     def start(self):
         setup = getattr(self.model, "setup", None)
+        draft_setup = getattr(self.draft, "setup", None) \
+            if self.spec_k else None
         with self._cond:
             if self._started:
                 return self
@@ -203,6 +339,9 @@ class DecodeEngine:
             if setup is not None and not self._model_bound:
                 setup(self.geometry)
                 self._model_bound = True
+            if draft_setup is not None and not self._draft_bound:
+                draft_setup(self.draft_geometry)
+                self._draft_bound = True
             self._started = True
             self._stopping = False
             self._thread = threading.Thread(
@@ -236,12 +375,21 @@ class DecodeEngine:
             self._thread = None
         # unbind the model adapter (drops its device KV pool) so a
         # later engine — this one restarted, or a fresh server — can
-        # bind; only reached once the step loop is provably down
+        # bind; only reached once the step loop is provably down.  The
+        # prefix cache's page references go with it: a stopped engine
+        # must not pin pool pages (check_leaks stays exact at teardown)
         teardown = getattr(self.model, "teardown", None)
+        draft_teardown = getattr(self.draft, "teardown", None) \
+            if self.spec_k else None
         with self._cond:
+            if self.prefix_cache is not None:
+                self.prefix_cache.clear()
             if teardown is not None and self._model_bound:
                 teardown()
                 self._model_bound = False
+            if draft_teardown is not None and self._draft_bound:
+                draft_teardown()
+                self._draft_bound = False
         return True
 
     @property
@@ -439,17 +587,57 @@ class DecodeEngine:
             self._stats["steps"] += 1
             self._stats["generated_tokens"] += produced
             occupancy = self.allocator.occupancy
+            shared = self.allocator.shared_pages
         if _rm._ENABLED:
             _rm.SERVING_DECODE_STEPS.inc(model=self.model_name)
             _rm.SERVING_DECODE_KV_OCCUPANCY.set(
                 occupancy, engine=self.model_name)
+            _rm.KV_SHARED_PAGES.set(shared, engine=self.model_name)
         return produced
+
+    def _prefix_plan(self, seq):
+        """Admission-time prefix-cache lookup — called OUTSIDE the
+        engine condition (the fault site may sleep, and the radix walk
+        is single-writer step-loop state anyway).  Returns
+        ``(shared_pages, cow_src, hit_tokens, attempted)``; ANY lookup
+        failure — including an injected ``decode.prefix_lookup``
+        corruption — degrades to a miss, so the cache can cost a
+        prefill but never produce wrong tokens."""
+        cache = self.prefix_cache
+        L = int(seq.prompt.size)
+        ps = self.geometry.page_size
+        if cache is None or seq.no_cache or L < ps:
+            return [], None, 0, False
+        try:
+            _faults.inject("decode.prefix_lookup")
+            pages = cache.lookup(seq.prompt)
+        except Exception as e:      # noqa: BLE001 — degrade to a miss
+            _LOG.warning(
+                "decode engine %s: prefix lookup failed for seq %d "
+                "(%s); degrading to plain prefill", self.model_name,
+                seq.seq_id, e)
+            with self._cond:
+                self._stats["prefix_degraded"] += 1
+            return [], None, 0, True
+        if not pages:
+            return [], None, 0, True
+        hit = len(pages) * ps
+        if hit == L:
+            # full hit: the sequence must append into the last matched
+            # page (position L-1 is re-run to recover its logits) —
+            # copy-on-write that one, alias the rest read-only
+            return pages[:-1], pages[-1], hit, True
+        return pages, None, hit, True
 
     def _admit(self):
         """Move waiting sequences into free decode slots while both a
         slot AND the sequence's worst-case page reservation fit
         (all-or-nothing, FIFO — a too-big head blocks the line rather
-        than starving: pages freed by the next eviction admit it)."""
+        than starving: pages freed by the next eviction admit it).
+        With the prefix cache on, a cached prefix shrinks the fresh
+        reservation to the unmatched pages (the shared ones are
+        aliased), and cache-only pages are LRU-evicted on demand when
+        the free list cannot cover an admission."""
         admitted, dropped, expired = [], [], []
         with self._cond:
             # prune cancelled AND deadline-expired entries ANYWHERE in
@@ -469,12 +657,65 @@ class DecodeEngine:
             self._waiting = live
             if expired:
                 self._stats["deadline_exceeded"] += len(expired)
-            while self._waiting and self._free_slots:
-                seq = self._waiting[0]
-                pages = self.geometry.pages_for(
-                    seq.prompt.size + seq.max_new_tokens)
-                if not self.allocator.allocate(seq.seq_id, pages):
+        while True:
+            with self._cond:
+                if not self._waiting or not self._free_slots:
                     break
+                seq = self._waiting[0]
+            # the lookup runs between the lock holds: the step loop is
+            # the only consumer of the line, so the head is stable
+            shared, cow_src, hit, attempted = self._prefix_plan(seq)
+            with self._cond:
+                if not self._waiting or self._waiting[0] is not seq \
+                        or not self._free_slots:
+                    break
+                total = self.geometry.pages_for(
+                    seq.prompt.size + seq.max_new_tokens)
+                fresh = total - len(shared)
+                if not self.allocator.can_allocate(fresh) \
+                        and self.prefix_cache is not None:
+                    # refcount-aware LRU: only pages the cache alone
+                    # holds can free — and never the pages THIS
+                    # admission planned to alias or COW-copy from
+                    # (freeing them would strand a half-shared
+                    # sequence and fail the whole step)
+                    planned = set(shared)
+                    if cow_src is not None:
+                        planned.add(cow_src)
+                    self.prefix_cache.evict(
+                        fresh - self.allocator.free_pages,
+                        protect_pages=planned)
+                if not self.allocator.admit(seq.seq_id, shared, fresh):
+                    if shared or cow_src is not None:
+                        # the HIT plan is unservable under pool
+                        # pressure (the protected planned pages may be
+                        # the only evictable ones left): degrade to a
+                        # miss — now everything cache-only may evict —
+                        # rather than blocking the line on a plan the
+                        # pool cannot afford
+                        shared, cow_src, hit = [], None, 0
+                        fresh = total
+                        if not self.allocator.can_allocate(fresh):
+                            self.prefix_cache.evict(
+                                fresh - self.allocator.free_pages)
+                        if not self.allocator.admit(seq.seq_id, [],
+                                                    fresh):
+                            break
+                    else:
+                        break
+                seq.prefix_len = hit
+                if cow_src is not None:
+                    seq.cow = (cow_src, self.allocator.pages_of(
+                        seq.seq_id)[len(shared)])
+                # misses are counted here; a HIT is counted only once
+                # the cached prefill actually serves (_prefill_cached)
+                # — a demoted hit ran the full prefill and must not
+                # inflate the hit ratio or the tokens-saved counter
+                if attempted and not hit:
+                    self._stats["prefix_misses"] += 1
+                    if _rm._ENABLED:
+                        _rm.SERVING_PREFIX_MISSES.inc(
+                            model=self.model_name)
                 self._waiting.pop(0)
                 seq.slot = self._free_slots.pop()
                 self._running[seq.slot] = seq
@@ -534,9 +775,13 @@ class DecodeEngine:
 
     def _prefill_one(self, seq):
         """Run the (length-bucketed) prefill program for one admitted
-        sequence and sample its first token.  Transient failures retry
-        with backoff; a persistent failure quarantines THIS sequence
-        only (prefill is per-sequence, so no bisection is needed)."""
+        sequence and sample its first token — or, on a prefix-cache
+        hit, skip the matched work via :meth:`_prefill_cached`.
+        Transient failures retry with backoff; a persistent failure
+        quarantines THIS sequence only (prefill is per-sequence, so no
+        bisection is needed)."""
+        if seq.prefix_len:
+            return self._prefill_cached(seq)
         L = seq.prompt.size
         bucket = next_bucket(L, self.geometry.max_context)
         with _tr.span("decode.prefill", parent=seq.trace,
@@ -561,9 +806,144 @@ class DecodeEngine:
                 self._quarantine(seq, e, where="prefill")
                 return 0
             seq.context_len = L
+            seq.draft_ctx = L
+            self._draft_prefill(seq, tokens, L)
+            self._cache_insert(seq)
             self._emit(seq, int(np.argmax(logits)))
         self._maybe_evict(seq)
         return 1
+
+    def _prefill_cached(self, seq):
+        """Prefix-hit admission: copy-on-write the one page the
+        sequence appends into, then recover the last-token logits
+        through the VERIFY family — width 1 for a full hit (only the
+        last prompt token is re-run), the tail bucket for a partial hit
+        (unmatched tokens prefill while attending over the aliased
+        cached pages).  Any failure here demotes the sequence to a
+        plain prefill on the next step: the cache may cost time, never
+        correctness."""
+        L = int(seq.prompt.size)
+        m = seq.prefix_len
+        start = L - 1 if m == L else m
+        tail = seq.prompt[start:]
+        length = int(tail.size)
+        bucket = next_bucket(length, self.geometry.max_context)
+        with _tr.span("decode.prefill", parent=seq.trace,
+                      prompt_tokens=int(L), bucket=bucket,
+                      prefix_hit_tokens=int(m),
+                      cow=seq.cow is not None,
+                      kv_pages=len(self.allocator.pages_of(seq.seq_id))):
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :length] = tail
+            block_table = self.allocator.block_table(seq.seq_id)
+
+            def call():
+                if seq.cow is not None:
+                    # src is immutable, so re-copying on a retry is
+                    # harmless — clear the plan only after both copies
+                    # landed
+                    src, dst = seq.cow
+                    self.model.copy_page(src, dst)
+                    if self.spec_k and not seq.no_spec:
+                        self.draft.copy_page(src, dst)
+                    seq.cow = None
+                _faults.inject("decode.prefill")
+                return np.asarray(self.model.verify(
+                    tokens, np.int32(start), np.int32(length),
+                    block_table))
+
+            try:
+                logits = retry_call(
+                    call, retries=self.config.retry_max,
+                    backoff_ms=self.config.retry_backoff_ms,
+                    deadline=seq.deadline, rng=self._retry_rng,
+                    on_retry=self._note_retry)
+            except Exception as e:      # noqa: BLE001 — degrade
+                self._demote_to_plain(seq, e)
+                return 0
+            # the hit is real only now — the cached path SERVED.  A
+            # full hit still re-ran its last token, so it saves m-1
+            saved = m - 1 if m == L else m
+            with self._cond:
+                self._stats["prefix_hits"] += 1
+                self._stats["prefix_tokens_saved"] += saved
+            if _rm._ENABLED:
+                _rm.SERVING_PREFIX_HITS.inc(model=self.model_name)
+                _rm.SERVING_PREFIX_TOKENS_SAVED.inc(
+                    saved, model=self.model_name)
+            seq.context_len = L
+            seq.draft_ctx = L
+            if self.spec_k and not seq.no_spec:
+                # the draft's K/V for the tail rides the same verify
+                # shape (its logits are discarded); cached pages
+                # already hold the draft K/V their writer produced
+                try:
+                    self.draft.verify(tokens, np.int32(start),
+                                      np.int32(length), block_table)
+                except Exception as e:  # noqa: BLE001 — optimization
+                    self._spec_fallback(seq, e, where="draft tail")
+            self._cache_insert(seq)
+            self._emit(seq, int(np.argmax(logits[length - 1])))
+        self._maybe_evict(seq)
+        return 1
+
+    def _draft_prefill(self, seq, tokens, L):
+        """Write the prompt's DRAFT K/V (speculation needs the draft to
+        know the prefix).  A draft failure never fails the request —
+        the sequence just decodes plainly."""
+        if not self.spec_k or seq.no_spec:
+            return
+        try:
+            self.draft.prefill(tokens, np.int32(L),
+                               self.allocator.block_table(seq.seq_id))
+        except Exception as e:          # noqa: BLE001 — optimization
+            self._spec_fallback(seq, e, where="draft prefill")
+
+    def _spec_fallback(self, seq, error, where):
+        _LOG.warning(
+            "decode engine %s: %s failed for seq %d (%s); the "
+            "sequence decodes without speculation", self.model_name,
+            where, seq.seq_id, error)
+        seq.no_spec = True
+        with self._cond:
+            self._stats["spec_fallbacks"] += 1
+
+    def _cache_insert(self, seq):
+        """Admit the prompt's full-page chunks into the prefix cache,
+        backed by this sequence's (now fully written) pages.  Chunks
+        that were aliased at admission are already cached and skip."""
+        if self.prefix_cache is None or seq.no_cache:
+            return
+        with self._cond:
+            self.prefix_cache.insert(
+                seq.prompt, self.allocator.pages_of(seq.seq_id))
+
+    def _demote_to_plain(self, seq, error):
+        """Cached-path prefill failed: release everything the sequence
+        holds (aliased refs and fresh pages alike) and put it back at
+        the HEAD of the waiting line with the cache bypassed — the
+        next step admits it down the plain-prefill path.  Degradation,
+        not quarantine: the failure sits on the optimization path, so
+        the model itself is not implicated."""
+        _LOG.warning(
+            "decode engine %s: cached prefill failed for seq %d (%s); "
+            "demoting to plain prefill", self.model_name, seq.seq_id,
+            error)
+        with self._cond:
+            self._stats["prefix_degraded"] += 1
+            # undo the admission bookkeeping (it re-admits next step:
+            # counting it twice would break admitted-evicted==running)
+            self._stats["admitted"] -= 1
+            if seq.slot is not None:
+                self._running.pop(seq.slot, None)
+                self._free_slots.append(seq.slot)
+                seq.slot = None
+            self.allocator.release(seq.seq_id)
+            seq.prefix_len = 0
+            seq.cow = None
+            seq.no_cache = True
+            self._waiting.insert(0, seq)
+            self._cond.notify_all()
 
     def _decode_call(self, active):
         """One fixed-shape decode-step model call for the ``active``
@@ -621,8 +1001,11 @@ class DecodeEngine:
                 for seq in active]
 
     def _decode_step(self):
-        """One decode step over every running sequence (bisection-aware
-        model call via :meth:`_decode_call`)."""
+        """One decode round over every running sequence: speculative
+        sequences (draft available, >= 2 tokens of budget left) go
+        through :meth:`_spec_round`; everything else gets the plain
+        bisection-aware batched decode step.  The two groups share the
+        fixed-shape programs — each zeroes the other's slots."""
         with self._cond:
             running = [s for s in self._running.values()
                        if not s.cancelled]
@@ -636,6 +1019,27 @@ class DecodeEngine:
             return 0
         # deterministic bisection order: slot order, not dict order
         running.sort(key=lambda s: s.slot)
+        if not self.spec_k:
+            return self._plain_decode(running)
+        spec, plain = [], []
+        for s in running:
+            # a sequence one token from its cap gains nothing from a
+            # proposal round (the verify bonus token finishes it), and
+            # a draft-fallback sequence decodes plainly for good
+            if not s.no_spec and s.max_new_tokens - len(s.tokens) >= 2:
+                spec.append(s)
+            else:
+                plain.append(s)
+        produced = 0
+        if plain:
+            produced += self._plain_decode(plain)
+        if spec:
+            produced += self._spec_round(spec)
+        return produced
+
+    def _plain_decode(self, running):
+        """One non-speculative decode step for ``running`` (the
+        original bisection-aware path)."""
         produced = 0
         for seq, row, t0, t1, batch_n in self._decode_call(running):
             # per-sequence decode-step spans (first step, then every
@@ -656,6 +1060,212 @@ class DecodeEngine:
             produced += 1
             self._maybe_evict(seq)
         return produced
+
+    def _spec_round(self, seqs):
+        """One speculative round (docs/serving.md §9): the draft
+        proposes up to ``spec_k`` tokens per sequence via batched draft
+        decode steps over the SHARED block tables (writing its own
+        pool), then the target judges each sequence's whole window —
+        last sampled token + proposals — in ONE verify call, the
+        ragged multi-token shape ``ragged_paged_verify`` exists for
+        (one ``verify_batch`` program when the model has it, else one
+        width-bucketed call per window).  Greedy acceptance is exact
+        (the
+        zero-temperature limit of rejection sampling): proposal i
+        survives iff it equals the target argmax after position i, the
+        first mismatch is replaced by the target's own token, and a
+        fully accepted window earns the bonus token — so outputs are
+        byte-identical with speculation on or off.  Rejected positions
+        roll back through bookkeeping alone: their K/V sits beyond
+        ``context_len``, is never attended, and is overwritten in
+        place by later writes.
+
+        Failure containment: a draft failure degrades the ROUND to one
+        plain decode step (the draft is an optimization); a verify
+        failure is a target-model failure and quarantines that
+        sequence alone, like the prefill/decode paths (§8)."""
+        k = self.spec_k
+        B, P = self.max_batch, self.geometry.pages_per_seq
+        tables = {s.seq_id: self.allocator.block_table(s.seq_id)
+                  for s in seqs}
+        plan = []
+        for s in seqs:
+            ctx = s.context_len
+            # known tokens the draft consumes before free-running: the
+            # catch-up gap (a fully-accepted previous round leaves the
+            # last accepted proposal's draft K/V unwritten) + the last
+            # sampled token
+            feed = [s.token_at(p) for p in range(s.draft_ctx, ctx + 1)]
+            m = min(k, s.max_new_tokens - len(s.tokens) - 1)
+            plan.append({"seq": s, "feed": feed, "cur": feed.pop(0),
+                         "pos": s.draft_ctx, "proposals": [],
+                         "steps": m + len(feed)})
+        max_steps = max(p["steps"] for p in plan)
+        for st in range(max_steps):
+            tokens = np.zeros((B,), np.int32)
+            positions = np.zeros((B,), np.int32)
+            block_tables = np.zeros((B, P), np.int32)
+            active = [p for p in plan if st < p["steps"]]
+            for p in active:
+                slot = p["seq"].slot
+                tokens[slot] = p["cur"]
+                positions[slot] = p["pos"]
+                block_tables[slot] = tables[p["seq"].seq_id]
+            try:
+                logits = np.asarray(self.draft.decode_step(
+                    tokens, positions, block_tables))
+            except Exception as e:  # noqa: BLE001 — draft died
+                # proposals so far are unusable mid-round state; the
+                # round degrades to ONE plain target step (correct by
+                # construction) and the draft gets another chance next
+                # round — partially written draft K/V beyond draft_ctx
+                # is rolled back by never advancing the counter
+                _LOG.warning(
+                    "decode engine %s: draft step failed mid-round "
+                    "(%s); running this round without speculation",
+                    self.model_name, e)
+                with self._cond:
+                    self._stats["spec_fallbacks"] += len(seqs)
+                return self._plain_decode(seqs)
+            for p in active:
+                out = int(np.argmax(logits[p["seq"].slot]))
+                p["pos"] += 1
+                if p["feed"]:
+                    p["cur"] = p["feed"].pop(0)     # catch-up: discard
+                else:
+                    p["proposals"].append(out)
+                    p["cur"] = out
+        W = next_bucket(k + 1, self.geometry.max_context)
+        if getattr(self.model, "verify_batch", None) is not None:
+            judged = self._verify_batched(plan, tables, W)
+        else:
+            judged = self._verify_each(plan, tables, W)
+        produced = 0
+        for p, logits, t0, t1 in judged:
+            seq = p["seq"]
+            proposals = p["proposals"]
+            ctx = seq.context_len
+            # greedy-exact acceptance: row i of logits is the target's
+            # next-token distribution after consuming window[i]
+            accept = 0
+            while accept < len(proposals) \
+                    and proposals[accept] == int(np.argmax(logits[accept])):
+                accept += 1
+            emits = proposals[:accept] + [int(np.argmax(logits[accept]))]
+            with self._cond:
+                self._stats["spec_rounds"] += 1
+                self._stats["spec_proposed"] += len(proposals)
+                self._stats["spec_accepted"] += accept
+            if _rm._ENABLED:
+                _rm.SERVING_SPEC_PROPOSED.inc(len(proposals),
+                                              model=self.model_name)
+                _rm.SERVING_SPEC_ACCEPTED.inc(accept,
+                                              model=self.model_name)
+            # KV rollback of rejected positions = counter bookkeeping:
+            # target context covers the accepted prefix + the emitted
+            # correction/bonus token's predecessor; the draft rolls
+            # back to the target's context when it speculated past it
+            seq.context_len = ctx + accept + 1
+            seq.draft_ctx = min(p["pos"], seq.context_len)
+            if seq.trace is not None:
+                n_prior = len(seq.tokens)
+                if n_prior == 1 or n_prior % _STEP_SPAN_EVERY == 0:
+                    _tr.record_span(
+                        "decode.verify", seq.trace, t0, t1,
+                        {"proposed": len(proposals),
+                         "accepted": accept, "slot": seq.slot,
+                         "context_len": seq.context_len})
+            for t in emits:
+                self._emit(seq, int(t))
+                produced += 1
+                if self._maybe_evict(seq):
+                    break
+        return produced
+
+    def _verify_batched(self, entries, tables, W):
+        """ONE fixed-shape verify call judging every entry's window at
+        once (inactive slots zeroed — the padding contract of
+        ``paged_verify_batch``).  Transient failures retry with
+        backoff; a persistent failure BISECTS so the poisoned sequence
+        is quarantined alone while its batchmates' windows are
+        re-judged — the §8 containment applied to the verify family.
+        Re-running a subset re-writes the SAME K/V positions
+        (idempotent: a failed call never advanced context_len).
+        Returns ``(entry, logits (W, V), t0, t1)`` tuples."""
+        B, P = self.max_batch, self.geometry.pages_per_seq
+        tokens = np.zeros((B, W), np.int32)
+        starts = np.zeros((B,), np.int32)
+        lengths = np.zeros((B,), np.int32)
+        block_tables = np.zeros((B, P), np.int32)
+        for p in entries:
+            seq = p["seq"]
+            window = [seq.tokens[-1]] + p["proposals"]
+            tokens[seq.slot, :len(window)] = window
+            starts[seq.slot] = seq.context_len
+            lengths[seq.slot] = len(window)
+            block_tables[seq.slot] = tables[seq.seq_id]
+
+        def call():
+            _faults.inject("decode.verify")
+            return np.asarray(self.model.verify_batch(
+                tokens, starts, lengths, block_tables))
+
+        times = [p["seq"].deadline.t for p in entries
+                 if p["seq"].deadline.t is not None]
+        group_deadline = Deadline(min(times)) if times else Deadline()
+        t0 = time.perf_counter()
+        try:
+            logits = retry_call(
+                call, retries=self.config.retry_max,
+                backoff_ms=self.config.retry_backoff_ms,
+                deadline=group_deadline, rng=self._retry_rng,
+                on_retry=self._note_retry)
+        except Exception as e:          # noqa: BLE001 — isolate it
+            if len(entries) == 1:
+                self._quarantine(entries[0]["seq"], e, where="verify")
+                return []
+            _LOG.warning(
+                "decode engine %s: verify failed for %d window(s) "
+                "(%s); bisecting to quarantine the poisoned sequence",
+                self.model_name, len(entries), e)
+            mid = len(entries) // 2
+            return self._verify_batched(entries[:mid], tables, W) \
+                + self._verify_batched(entries[mid:], tables, W)
+        t1 = time.perf_counter()
+        return [(p, logits[p["seq"].slot], t0, t1) for p in entries]
+
+    def _verify_each(self, entries, tables, W):
+        """Per-sequence verify fallback for models without
+        ``verify_batch`` (fakes, external adapters): same judging, one
+        width-W call per window; a persistent failure quarantines that
+        sequence alone (already single, no bisection needed)."""
+        out = []
+        for p in entries:
+            seq = p["seq"]
+            window = [seq.tokens[-1]] + p["proposals"]
+            tokens = np.zeros((1, W), np.int32)
+            tokens[0, :len(window)] = window
+            block_table = tables[seq.seq_id]
+            length = len(window)
+
+            def call():
+                _faults.inject("decode.verify")
+                return np.asarray(self.model.verify(
+                    tokens, np.int32(seq.context_len),
+                    np.int32(length), block_table))
+
+            t0 = time.perf_counter()
+            try:
+                logits = retry_call(
+                    call, retries=self.config.retry_max,
+                    backoff_ms=self.config.retry_backoff_ms,
+                    deadline=seq.deadline, rng=self._retry_rng,
+                    on_retry=self._note_retry)
+            except Exception as e:      # noqa: BLE001 — isolate it
+                self._quarantine(seq, e, where="verify")
+                continue
+            out.append((p, logits, t0, time.perf_counter()))
+        return out
 
     # ----------------------------------------------------- token plumbing
     def _emit(self, seq, token):
@@ -759,10 +1369,21 @@ class DecodeEngine:
             out["running"] = len(self._running)
             out["waiting"] = len(self._waiting)
             out.update(self.allocator.stats())
+            if self.prefix_cache is not None:
+                out.update(self.prefix_cache.stats())
         out["program_bound"] = self.program_bound
+        out["spec_k"] = self.spec_k
+        if out.get("spec_proposed"):
+            out["spec_acceptance"] = (out["spec_accepted"]
+                                      / out["spec_proposed"])
         programs = getattr(self.model, "programs", None)
         if programs is not None:
-            out["programs"] = programs()
+            total = programs()
+            draft_programs = getattr(self.draft, "programs", None) \
+                if self.spec_k else None
+            if draft_programs is not None:
+                total += draft_programs()
+            out["programs"] = total
         return out
 
     def debug_state(self):
@@ -799,10 +1420,17 @@ class DecodeEngine:
                 "allocator": self.allocator.stats(),
                 "stats": dict(self._stats),
             }
+            if self.prefix_cache is not None:
+                state["prefix_cache"] = self.prefix_cache.stats()
         state["program_bound"] = self.program_bound
+        state["spec_k"] = self.spec_k
         programs = getattr(self.model, "programs", None)
         if programs is not None:
             state["programs"] = programs()
+            draft_programs = getattr(self.draft, "programs", None) \
+                if self.spec_k else None
+            if draft_programs is not None:
+                state["draft_programs"] = draft_programs()
         return state
 
 
@@ -813,9 +1441,10 @@ class PagedLMAdapter:
     """Decode-model protocol over a
     :class:`~mxnet_tpu.models.transformer_blocks.TransformerDecoderLM`.
 
-    Owns the device KV pools and compiles the two bounded program
-    families from the LM's pure-jax decode-mode forwards
-    (``paged_prefill`` / ``paged_decode_step``):
+    Owns the device KV pools and compiles the bounded program families
+    from the LM's pure-jax decode-mode forwards (``paged_prefill`` /
+    ``paged_decode_step`` / ``paged_verify``, plus the one COW
+    page-copy program):
 
     - with the persistent compile cache configured, programs go through
       ``compile_cache.aot_program`` keyed on the ARCHITECTURE (weights
@@ -875,7 +1504,10 @@ class PagedLMAdapter:
         import jax
 
         from ..models.transformer_blocks import (paged_decode_step,
-                                                 paged_prefill)
+                                                 paged_prefill,
+                                                 paged_verify,
+                                                 paged_verify_batch)
+        from .kv_cache import copy_page_arrays
         # one LIVE engine per adapter: the pool and program wrappers are
         # this adapter's state, and a second engine calling setup()
         # would zero the pool under the first one's feet (two servers
@@ -902,7 +1534,8 @@ class PagedLMAdapter:
                   layer_norm_eps=self.lm._eps)
         # donation lets XLA update the KV pools in place; the CPU
         # backend cannot honor it and would warn on every program
-        donate = (4, 5) if jax.default_backend() != "cpu" else ()
+        cpu = jax.default_backend() == "cpu"
+        donate = (4, 5) if not cpu else ()
         self._prefill_jit = jax.jit(
             functools.partial(paged_prefill, **kw),
             donate_argnums=donate)
@@ -910,6 +1543,20 @@ class PagedLMAdapter:
             functools.partial(paged_decode_step,
                               attention_impl=self.attention_impl, **kw),
             donate_argnums=donate)
+        # verify family (prefix-hit tails + speculative windows): the
+        # pools sit at argument positions 5/6; the COW page copy is one
+        # more (traced-scalar src/dst, so ONE program for every copy)
+        self._verify_jit = jax.jit(
+            functools.partial(paged_verify,
+                              attention_impl=self.attention_impl, **kw),
+            donate_argnums=(5, 6) if not cpu else ())
+        self._verify_batch_jit = jax.jit(
+            functools.partial(paged_verify_batch,
+                              attention_impl=self.attention_impl, **kw),
+            donate_argnums=(5, 6) if not cpu else ())
+        self._copy_jit = jax.jit(
+            copy_page_arrays,
+            donate_argnums=(0, 1) if not cpu else ())
 
     def _cache(self):
         from .. import compile_cache as _cc
@@ -965,12 +1612,17 @@ class PagedLMAdapter:
         return prog
 
     def programs(self):
-        """Compiled-program count across both families (the decode
-        engine's ``programs <= program_bound`` acceptance check)."""
+        """Compiled-program count across all families — prefill,
+        decode, verify, and the COW page copy (the decode engine's
+        ``programs <= program_bound`` acceptance check, via the jit
+        ``_cache_size()`` helper)."""
         if self._aot:
             return len(self._aot)
         return (self._prefill_jit._cache_size()
-                + self._decode_jit._cache_size())
+                + self._decode_jit._cache_size()
+                + self._verify_jit._cache_size()
+                + self._verify_batch_jit._cache_size()
+                + self._copy_jit._cache_size())
 
     # ------------------------------------------------------------ protocol
     def prefill(self, tokens, length, block_table):
@@ -1005,6 +1657,54 @@ class PagedLMAdapter:
         logits, k_pages, v_pages = prog(*args)
         pool.swap(k_pages, v_pages)
         return logits
+
+    def verify(self, tokens, start, length, block_table):
+        """Multi-token window forward (speculation verify / prefix-hit
+        tail): writes the window's K/V through the block table and
+        returns per-row logits (rows past ``length`` are garbage the
+        engine never reads).  One program per width bucket."""
+        pool = self.pool
+        args = (self.params, tokens, start, length, block_table,
+                pool.k_pages, pool.v_pages)
+        if self._cache() is not None:
+            prog = self._aot_for("verify", tokens.shape[1],
+                                 self._verify_jit, args)
+        else:
+            prog = self._verify_jit
+        with _tr.span("paged_lm.verify", bucket=int(tokens.shape[1])):
+            logits, k_pages, v_pages = prog(*args)
+        pool.swap(k_pages, v_pages)
+        return logits
+
+    def verify_batch(self, tokens, starts, lengths, block_tables):
+        """Batched verify: every running sequence's speculation window
+        judged in ONE fixed-shape device call (B and W are both
+        static, so this is ONE program)."""
+        pool = self.pool
+        args = (self.params, tokens, starts, lengths, block_tables,
+                pool.k_pages, pool.v_pages)
+        if self._cache() is not None:
+            prog = self._aot_for(f"verify_batch_w{tokens.shape[1]}",
+                                 tokens.shape[0],
+                                 self._verify_batch_jit, args)
+        else:
+            prog = self._verify_batch_jit
+        logits, k_pages, v_pages = prog(*args)
+        pool.swap(k_pages, v_pages)
+        return logits
+
+    def copy_page(self, src, dst):
+        """Copy-on-write page duplication across all layers of both
+        pools — ONE compiled program (``src``/``dst`` are traced
+        scalars)."""
+        pool = self.pool
+        args = (pool.k_pages, pool.v_pages, np.int32(src),
+                np.int32(dst))
+        if self._cache() is not None:
+            prog = self._aot_for("cow", 1, self._copy_jit, args)
+        else:
+            prog = self._copy_jit
+        pool.swap(*prog(*args))
 
 
 def as_decode_model(obj, attention_impl=None, eos_id=None):

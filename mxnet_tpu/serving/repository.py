@@ -47,7 +47,7 @@ class ModelEntry:
 
     def __init__(self, name, version, kind, signature, dynamic_batch,
                  make_program, fixed_batch=None, decode_model=None,
-                 decode_meta=None, quantization=None):
+                 decode_meta=None, quantization=None, draft_model=None):
         self.name = name
         self.version = version
         # "stablehlo" | "block" | "function" | "decoder"
@@ -61,6 +61,9 @@ class ModelEntry:
         # decode-capable metadata block (artifact exports)
         self.decode_model = decode_model
         self.decode_meta = decode_meta
+        # speculative-decoding draft attached to this decoder entry
+        # (docs/serving.md §9); the entry's engine owns its binding
+        self.draft_model = draft_model
         # manifest v4 quantization block for quantized artifacts
         # (mode, per-tensor scales, calibration error) — None for f32
         self.quantization = quantization
@@ -281,7 +284,7 @@ class ModelRepository:
         return self._register(entry, activate)
 
     def add_decoder(self, name, model, version=None, activate=True,
-                    attention_impl=None, eos_id=None):
+                    attention_impl=None, eos_id=None, draft=None):
         """Register an autoregressive decode model served through
         ``ModelServer.generate()`` (docs/serving.md §6).
 
@@ -294,10 +297,22 @@ class ModelRepository:
         rejects them with a pointer here.  Versioning/hot-swap semantics
         match every other entry kind: the decode engine resolves its
         entry at creation, requests admitted after a ``swap`` see the
-        new version's engine."""
+        new version's engine.
+
+        ``draft`` attaches a speculative-decoding draft model (same
+        protocol, typically much smaller) to this entry: with
+        ``spec_k`` > 0 the entry's engine has the draft propose k
+        tokens per sequence per round and the target verify them in
+        one call (docs/serving.md §9).  The draft gets its OWN adapter
+        (its pool/programs bind to this entry's engine), loaded and
+        compile-cached through the same machinery as the target."""
         from .decode import as_decode_model
         adapter = as_decode_model(model, attention_impl=attention_impl,
                                   eos_id=eos_id)
+        draft_adapter = None
+        if draft is not None:
+            draft_adapter = as_decode_model(
+                draft, attention_impl=attention_impl)
         sig = [{"shape": [None], "dtype": "int32"}]
 
         def make_program(bucket_rows):
@@ -306,7 +321,8 @@ class ModelRepository:
                 f"autoregressive generate(), not predict()")
 
         entry = ModelEntry(name, version, "decoder", sig, False,
-                           make_program, decode_model=adapter)
+                           make_program, decode_model=adapter,
+                           draft_model=draft_adapter)
         return self._register(entry, activate)
 
     def add_function(self, name, fn, signature, version=None,

@@ -438,8 +438,28 @@ class ModelServer:
                         raise not_accepting
                     eng = self._decoders.get(entry.uid)
                 if eng is None:
+                    # speculative draft: the entry's own attachment
+                    # wins; else MXNET_SERVING_SPEC_DRAFT names a
+                    # repository decoder entry whose decode model
+                    # drafts for everyone.  Every engine gets its OWN
+                    # adapter over the named entry's LM — an adapter
+                    # binds one live engine (its pool/programs are
+                    # engine state), so sharing the entry's adapter
+                    # across N targets would reject the second one
+                    draft = entry.draft_model
+                    if draft is None and self.config.spec_k \
+                            and self.config.spec_draft \
+                            and self.config.spec_draft != entry.name:
+                        from .decode import PagedLMAdapter
+                        draft = self.repository.get(
+                            self.config.spec_draft).decode_model
+                        if isinstance(draft, PagedLMAdapter):
+                            draft = PagedLMAdapter(
+                                draft.lm,
+                                attention_impl=draft.attention_impl)
                     fresh = DecodeEngine(entry.decode_model, self.config,
-                                         model_name=entry.name)
+                                         model_name=entry.name,
+                                         draft=draft)
                     reject = False
                     with self._cond:
                         if not self._started or self._stopping:

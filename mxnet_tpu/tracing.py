@@ -16,7 +16,13 @@ path — PAPERS.md).  This module is that plane:
   Every request gets ONE trace identity that survives all thread hops —
   contexts are handed across the batcher worker pool and the decode
   step loop explicitly (a span may be *started* in the caller's thread
-  and *ended* in a worker).
+  and *ended* in a worker).  Device calls that serve many traces at
+  once (the shared batch execute, the fixed-shape decode step, a
+  speculative ``decode.verify`` round) are recorded per interested
+  trace via :func:`record_span` with the SAME interval — each trace
+  keeps a complete private timeline (docs/observability.md lists the
+  span taxonomy, including the §9 ``decode.prefill`` prefix-hit tags
+  and ``decode.verify`` proposed/accepted tags).
 - **Head-based sampling**: the keep/drop decision is made once, when
   the root span starts (``MXNET_TRACE_SAMPLE``, deterministic stride so
   tests are exact).  An unsampled request carries no context and every

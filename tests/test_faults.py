@@ -77,9 +77,9 @@ class FakeModel:
         return logits
 
 
-def _engine(model=None, **cfg_kw):
+def _engine(model=None, draft=None, **cfg_kw):
     eng = DecodeEngine(model or FakeModel(), _decode_cfg(**cfg_kw),
-                       model_name="fake")
+                       model_name="fake", draft=draft)
     eng._started = True                 # manual stepping, no loop thread
     return eng
 
@@ -731,3 +731,123 @@ class TestDecodeResilience:
                 == ["closed"]
         finally:
             srv.stop()
+
+
+# ---------------------------------------------------- §9 chaos (ISSUE-12)
+from test_serving_decode import ChainModel as _SharedChainModel  # noqa: E402
+
+
+class ChainModel(_SharedChainModel):
+    """The shared self-consistent §9 fake (ONE protocol definition,
+    tests/test_serving_decode.py), narrowed to this file's vocab so
+    the existing mod-16 token chains keep reading literally."""
+
+    vocab_size = 16
+
+
+class AgreeingDraft(ChainModel):
+    pass
+
+
+class TestPrefixAndSpecChaos:
+    """The ISSUE-12 fault-injection satellite: the new decode paths
+    under the §8 machinery — a corrupted/failed prefix lookup degrades
+    to a plain prefill (never wrong tokens), and a failed speculative
+    verify quarantines through the PR-11 path, leak-free."""
+
+    def _fault_free_reference(self, prompts, n):
+        eng = _engine(ChainModel(), decode_pool_pages=33)
+        outs = []
+        for p in prompts:
+            s = eng.submit(p, max_new_tokens=n)
+            _drive(eng, [s])
+            outs.append(list(s.tokens))
+        return outs
+
+    @pytest.mark.parametrize("mode", ["fail", "corrupt", "stall"])
+    def test_prefix_lookup_fault_degrades_never_corrupts(self, mode):
+        """Every lookup fault mode ends in either a served hit or a
+        plain prefill with byte-identical tokens; the radix path can
+        cost latency, never correctness."""
+        prompts = [list(range(1, 9))] * 3 + [list(range(1, 9)) + [2]]
+        want = self._fault_free_reference(prompts, 3)
+        model = ChainModel()
+        eng = _engine(model, prefix_cache=True, decode_pool_pages=33)
+        spec = f"decode.prefix_lookup={mode},p=0.5,seed=3" \
+            if mode != "stall" else \
+            f"decode.prefix_lookup={mode},p=0.5,seed=3,ms=1"
+        with faults.plan(spec) as plan_obj:
+            for prompt, ref in zip(prompts, want):
+                s = eng.submit(prompt, max_new_tokens=3)
+                _drive(eng, [s])
+                assert list(s.tokens) == ref    # NEVER wrong tokens
+            fired = sum(plan_obj.counters().values())
+        st = eng.stats()
+        if mode in ("fail", "corrupt") and fired:
+            # a fired lookup fault is a counted degrade -> plain
+            # prefill; hits+misses+degraded covers every admission
+            assert st["prefix_degraded"] == fired
+        assert st["quarantined"] == 0
+        eng.allocator.check_leaks()
+
+    def test_verify_fault_quarantines_via_bisection_path_leak_free(self):
+        """A persistent decode.verify failure is a target-model
+        failure: the poisoned sequence quarantines alone (pages
+        released through the leak-guard path), batchmates finish with
+        correct tokens, and the engine keeps serving."""
+        model = ChainModel()
+        eng = _engine(model, draft=AgreeingDraft(), spec_k=2,
+                      decode_max_batch=2, decode_pool_pages=33,
+                      retry_max=0)
+        a = eng.submit([5], max_new_tokens=4)
+        b = eng.submit([9], max_new_tokens=4)
+        with faults.plan("decode.verify=fail,times=1"):
+            _drive(eng, [a, b])
+        reasons = {s.finish_reason for s in (a, b)}
+        assert reasons == {"quarantined", "length"}, reasons
+        ok = a if a.finish_reason == "length" else b
+        bad = b if ok is a else a
+        assert list(ok.tokens) == [(int(ok.prompt[0]) + i) % 16
+                                   for i in range(1, 5)]
+        assert isinstance(bad.error, faults.InjectedFault)
+        st = eng.stats()
+        assert st["quarantined"] == 1
+        assert rm.SERVING_DECODE_QUARANTINED.value(model="fake") == 1
+        eng.allocator.check_leaks()
+        assert eng.allocator.used_pages == eng.allocator.cached_pages
+        # the engine is not poisoned: a fresh request completes
+        c = eng.submit([3], max_new_tokens=2)
+        _drive(eng, [c])
+        assert list(c.tokens) == [4, 5]
+        eng.allocator.check_leaks()
+
+    def test_transient_verify_fault_retries_to_success(self):
+        """One transient verify fault under retry_max=2 is absorbed:
+        same tokens, one retry counted, no quarantine."""
+        model = ChainModel()
+        eng = _engine(model, draft=AgreeingDraft(), spec_k=2,
+                      decode_pool_pages=33, retry_max=2)
+        with faults.plan("decode.verify=fail,times=1"):
+            s = eng.submit([5], max_new_tokens=4)
+            _drive(eng, [s])
+        assert list(s.tokens) == [6, 7, 8, 9]
+        st = eng.stats()
+        assert st["retries"] >= 1 and st["quarantined"] == 0
+        eng.allocator.check_leaks()
+
+    def test_injected_pool_exhaustion_with_shared_pages(self):
+        """kv_cache.allocate refusal composes with prefix sharing: the
+        admission is refused whole (no half-aliased sequence), then
+        succeeds once the fault clears."""
+        model = ChainModel()
+        eng = _engine(model, prefix_cache=True, decode_pool_pages=33)
+        a = eng.submit(list(range(1, 9)), max_new_tokens=2)
+        _drive(eng, [a])
+        with faults.plan("kv_cache.allocate=fail,times=1"):
+            b = eng.submit(list(range(1, 9)), max_new_tokens=2)
+            eng.step()                  # refused admission this step
+            assert eng.stats()["running"] == 0
+            eng.allocator.check_leaks()
+            _drive(eng, [b])            # fault spent: admitted now
+        assert list(b.tokens) == list(a.tokens)
+        eng.allocator.check_leaks()

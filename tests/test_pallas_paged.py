@@ -9,7 +9,8 @@ import jax.numpy as jnp
 
 from mxnet_tpu.ndarray import op as opmod
 from mxnet_tpu.ops.pallas_kernels import (
-    ragged_paged_attention, ragged_paged_attention_reference)
+    ragged_paged_attention, ragged_paged_attention_reference,
+    ragged_paged_verify, ragged_paged_verify_reference)
 
 
 def _pool(seed, n_pages, page_size, H, D):
@@ -92,6 +93,86 @@ def test_block_table_indirection_is_honored():
     out = np.asarray(ragged_paged_attention(
         q, k_pages, v_pages, bt, lens, interpret=True))
     np.testing.assert_allclose(out[0], out[1], atol=1e-6)
+
+
+# ------------------------------------------------- multi-token verify
+def _dense_verify_oracle(q, k_pages, v_pages, bt, starts, lens):
+    """Per-(sequence, window-row) gather + causal masked softmax in
+    numpy: row w of sequence b attends over positions 0..starts[b]+w."""
+    q, k_pages, v_pages = map(np.asarray, (q, k_pages, v_pages))
+    bt, starts, lens = map(np.asarray, (bt, starts, lens))
+    B, W, H, D = q.shape
+    out = np.zeros_like(q)
+    for b in range(B):
+        k = k_pages[bt[b]].reshape(-1, H, D)
+        v = v_pages[bt[b]].reshape(-1, H, D)
+        for w in range(int(lens[b])):
+            L = int(starts[b]) + w + 1
+            s = np.einsum("hd,thd->ht", q[b, w], k[:L]) / np.sqrt(D)
+            p = np.exp(s - s.max(-1, keepdims=True))
+            p = p / p.sum(-1, keepdims=True)
+            out[b, w] = np.einsum("ht,thd->hd", p, v[:L])
+    return out
+
+
+@pytest.mark.parametrize("starts,lens,W,pages_per_seq,page_size", [
+    ([4, 0, 9], [3, 4, 0], 4, 4, 4),   # spec window / prefill / inactive
+    ([0, 3], [8, 1], 8, 2, 4),         # whole-context window / width 1
+    ([6, 5], [2, 3], 4, 3, 4),         # mid-page starts
+    ([7], [1], 1, 1, 8),               # degenerate W=1 recovery shape
+])
+def test_verify_kernel_matches_reference_and_dense(starts, lens, W,
+                                                   pages_per_seq,
+                                                   page_size):
+    rs = np.random.RandomState(17)
+    B, H, D, n_pool = len(lens), 2, 8, 11
+    q = jnp.asarray(rs.randn(B, W, H, D), jnp.float32)
+    k_pages, v_pages = _pool(5, n_pool, page_size, H, D)
+    bt = jnp.asarray(rs.randint(1, n_pool, (B, pages_per_seq)),
+                     jnp.int32)
+    st = jnp.asarray(starts, jnp.int32)
+    ln = jnp.asarray(lens, jnp.int32)
+    out_k = ragged_paged_verify(q, k_pages, v_pages, bt, st, ln,
+                                interpret=True)
+    out_r = ragged_paged_verify_reference(q, k_pages, v_pages, bt, st,
+                                          ln)
+    oracle = _dense_verify_oracle(q, k_pages, v_pages, bt, starts, lens)
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_r),
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out_k), oracle, atol=1e-5)
+    # rows past lens are defined zeros (kernel wrapper and reference)
+    for b, L in enumerate(lens):
+        assert np.all(np.asarray(out_k)[b, L:] == 0.0)
+        assert np.all(np.asarray(out_r)[b, L:] == 0.0)
+
+
+def test_verify_width1_equals_decode_attention():
+    """The W=1 verify window IS decode attention: start = ctx - 1,
+    length 1 reproduces ragged_paged_attention for the same query."""
+    rs = np.random.RandomState(23)
+    B, H, D, ps = 3, 2, 4, 4
+    q = jnp.asarray(rs.randn(B, H, D), jnp.float32)
+    k_pages, v_pages = _pool(9, 9, ps, H, D)
+    bt = jnp.asarray(rs.randint(1, 9, (B, 3)), jnp.int32)
+    ctx = jnp.asarray([5, 12, 1], jnp.int32)
+    dec = ragged_paged_attention(q, k_pages, v_pages, bt, ctx,
+                                 interpret=True)
+    ver = ragged_paged_verify(q[:, None], k_pages, v_pages, bt,
+                              ctx - 1, jnp.ones((B,), jnp.int32),
+                              interpret=True)
+    np.testing.assert_allclose(np.asarray(ver)[:, 0], np.asarray(dec),
+                               atol=1e-6)
+
+
+def test_verify_shape_guard():
+    from mxnet_tpu.base import MXNetError
+    q = jnp.ones((1, 2, 2, 4), jnp.float32)
+    k_pages, v_pages = _pool(11, 4, 2, 1, 4)    # heads mismatch
+    with pytest.raises(MXNetError, match="inconsistent"):
+        ragged_paged_verify(q, k_pages, v_pages,
+                            jnp.zeros((1, 2), jnp.int32),
+                            jnp.zeros((1,), jnp.int32),
+                            jnp.ones((1,), jnp.int32), interpret=True)
 
 
 def test_registry_frontend_dispatches_reference_on_cpu():

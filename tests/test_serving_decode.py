@@ -74,6 +74,56 @@ class FakeModel:
         return logits
 
 
+class ChainModel:
+    """Self-consistent decode-model protocol in plain numpy: next
+    token = (last + 1) mod vocab EVERYWHERE — prefill, decode_step,
+    and the multi-token verify window agree, so the prefix-cache and
+    speculative paths must reproduce the plain path byte-for-byte."""
+
+    vocab_size = 32
+    max_context = 64
+
+    def __init__(self):
+        self.prefills = 0
+        self.steps = 0
+        self.verifies = 0
+        self.copies = []
+
+    def _row(self, t):
+        row = np.zeros((self.vocab_size,), np.float32)
+        row[(int(t) + 1) % self.vocab_size] = 1.0
+        return row
+
+    def prefill(self, tokens, length, block_table):
+        self.prefills += 1
+        return self._row(tokens[0, int(length) - 1])
+
+    def decode_step(self, tokens, positions, block_tables):
+        self.steps += 1
+        return np.stack([self._row(t) for t in tokens])
+
+    def verify(self, tokens, start, length, block_table):
+        self.verifies += 1
+        return np.stack([self._row(t) for t in tokens[0]])
+
+    def copy_page(self, src, dst):
+        self.copies.append((int(src), int(dst)))
+
+
+class SkewDraft(ChainModel):
+    """Draft that proposes (t + skew) — skew=1 agrees with ChainModel
+    (full acceptance), skew=2 never agrees (zero acceptance)."""
+
+    def __init__(self, skew=1):
+        super().__init__()
+        self.skew = skew
+
+    def _row(self, t):
+        row = np.zeros((self.vocab_size,), np.float32)
+        row[(int(t) + self.skew) % self.vocab_size] = 1.0
+        return row
+
+
 def _drive(eng, seqs, limit=64):
     """Step until every sequence finished (bounded)."""
     n = 0
@@ -84,9 +134,9 @@ def _drive(eng, seqs, limit=64):
     return n
 
 
-def _engine(model=None, **cfg_kw):
+def _engine(model=None, draft=None, **cfg_kw):
     eng = DecodeEngine(model or FakeModel(), _cfg(**cfg_kw),
-                       model_name="fake")
+                       model_name="fake", draft=draft)
     eng._started = True                 # manual stepping, no loop thread
     return eng
 
@@ -180,6 +230,239 @@ class TestPageAllocator:
         assert g.pages_for(1) == 1
         assert g.pages_for(4) == 1
         assert g.pages_for(5) == 2
+
+
+# ----------------------------------------------------- refcounted sharing
+class TestRefcountedAllocator:
+    def _geom(self, **kw):
+        kw.setdefault("page_size", 4)
+        kw.setdefault("pool_pages", 17)
+        kw.setdefault("max_context", 64)
+        return PageGeometry(num_layers=1, num_heads=1, head_dim=1, **kw)
+
+    def test_share_refcounts_and_release_order(self):
+        a = PageAllocator(self._geom())
+        a.allocate("s1", 3)
+        pages = a.pages_of("s1")
+        a.share("s2", pages[:2])
+        assert a.refcount(pages[0]) == 2 and a.refcount(pages[2]) == 1
+        assert a.shared_pages == 2
+        a.check_leaks()
+        a.release("s1")                 # shared pages survive
+        assert a.refcount(pages[0]) == 1
+        assert a.used_pages == 2        # page[2] freed
+        a.check_leaks()
+        a.release("s2")
+        assert a.used_pages == 0
+        a.check_leaks()
+
+    def test_share_guards(self):
+        a = PageAllocator(self._geom())
+        a.allocate("s1", 1)
+        page = a.pages_of("s1")[0]
+        with pytest.raises(MXNetError, match="free or out of range"):
+            a.share("s2", [page + 1])       # never allocated
+        with pytest.raises(MXNetError, match="already in this"):
+            a.share("s1", [page])           # self re-alias
+        a.release("s1")
+        with pytest.raises(MXNetError, match="free or out of range"):
+            a.share("s2", [page])           # freed page cannot alias
+        a.check_leaks()
+
+    def test_cache_retain_outlives_writer_and_double_free_guards(self):
+        a = PageAllocator(self._geom())
+        a.allocate("s1", 2)
+        p0, p1 = a.pages_of("s1")
+        a.retain_cached(p0)
+        a.release("s1")
+        assert a.cached_pages == 1 and a.used_pages == 1
+        assert a.cache_only(p0)
+        a.check_leaks()
+        with pytest.raises(MXNetError, match="not cache-held"):
+            a.release_cached(p1)
+        a.release_cached(p0)
+        assert a.used_pages == 0
+        with pytest.raises(MXNetError, match="not cache-held"):
+            a.release_cached(p0)            # double eviction
+        a.check_leaks()
+
+    def test_admit_all_or_nothing_with_shared(self):
+        a = PageAllocator(self._geom(pool_pages=5))     # 4 usable
+        a.allocate("w", 3)
+        shared = a.pages_of("w")[:2]
+        for p in shared:
+            a.retain_cached(p)
+        a.release("w")                  # 2 cached + 1 freed -> 2 free
+        assert not a.admit("s", shared, 3)      # fresh 3 > 2 free
+        assert a.pages_of("s") == []            # nothing stranded
+        a.check_leaks()
+        assert a.admit("s", shared, 2)
+        assert a.pages_of("s")[:2] == shared
+        assert a.refcount(shared[0]) == 2
+        a.release("s")
+        a.check_leaks()
+
+    def test_random_shared_orders_never_leak(self):
+        """The ISSUE-12 satellite: check_leaks stays EXACT across ~300
+        random admit/finish/cancel/quarantine orders with shared pages
+        and cache retains in the mix."""
+        rng = np.random.RandomState(7)
+        a = PageAllocator(self._geom(pool_pages=33))
+        live, cached, next_id = {}, [], 0
+        for _ in range(300):
+            r = rng.rand()
+            if live and r < 0.30:       # finish/cancel/quarantine:
+                sid = rng.choice(sorted(live))      # all are release()
+                a.release(sid)
+                del live[sid]
+            elif cached and r < 0.40:   # cache eviction
+                idx = rng.randint(len(cached))
+                a.release_cached(cached.pop(idx))
+            elif live and cached and r < 0.55:      # shared admission
+                sid = next_id = next_id + 1
+                share = [p for p in cached if a.refcount(p)][:2]
+                share = [p for p in share
+                         if all(p not in a.pages_of(s) or s == sid
+                                for s in live)]
+                fresh = int(rng.randint(0, 3))
+                if a.admit(sid, share, fresh):
+                    live[sid] = len(share) + fresh
+            else:                       # plain admission (+ retain)
+                sid = next_id = next_id + 1
+                n = int(rng.randint(1, 5))
+                if a.allocate(sid, n):
+                    live[sid] = n
+                    if rng.rand() < 0.5:
+                        page = a.pages_of(sid)[0]
+                        if page not in cached:
+                            a.retain_cached(page)
+                            cached.append(page)
+            a.check_leaks()
+        for sid in sorted(live):
+            a.release(sid)
+        for page in cached:
+            a.release_cached(page)
+        a.check_leaks()
+        assert a.used_pages == 0
+
+
+# ------------------------------------------------------------- radix tree
+class TestPrefixCacheTree:
+    def _cache(self, pool_pages=33, max_pages=None, page_size=4):
+        geom = PageGeometry(page_size, pool_pages, 64, 1, 1, 1)
+        alloc = PageAllocator(geom)
+        from mxnet_tpu.serving.kv_cache import PrefixCache
+        return alloc, PrefixCache(alloc, max_pages=max_pages)
+
+    def _seed(self, alloc, cache, sid, prompt):
+        """Simulate one admission+prefill+insert for ``prompt``."""
+        n = alloc.geometry.pages_for(len(prompt))
+        assert alloc.allocate(sid, n)
+        cache.insert(np.asarray(prompt, np.int32), alloc.pages_of(sid))
+        return alloc.pages_of(sid)
+
+    def test_insert_lookup_roundtrip_and_partial(self):
+        alloc, cache = self._cache()
+        pages = self._seed(alloc, cache, "s1", list(range(1, 13)))
+        # 12 tokens = 3 full pages cached
+        assert cache.pages == 3
+        hit = cache.lookup(np.arange(1, 13, dtype=np.int32))
+        assert hit == pages[:3]
+        # longest-prefix semantics: shared 2 pages, then divergence
+        hit = cache.lookup(np.asarray(list(range(1, 9)) + [99] * 4,
+                                      np.int32))
+        assert hit == pages[:2]
+        # sub-page prompts and mismatches miss
+        assert cache.lookup(np.asarray([1, 2], np.int32)) == []
+        assert cache.lookup(np.asarray([9, 9, 9, 9], np.int32)) == []
+        alloc.check_leaks()
+
+    def test_branching_prefixes_share_the_trunk(self):
+        alloc, cache = self._cache()
+        a = self._seed(alloc, cache, "a", [1, 2, 3, 4, 5, 6, 7, 8])
+        b_pages = [1, 2, 3, 4, 9, 9, 9, 9]
+        n = alloc.geometry.pages_for(len(b_pages))
+        alloc.allocate("b", n)
+        cache.insert(np.asarray(b_pages, np.int32), alloc.pages_of("b"))
+        # trunk chunk [1,2,3,4] cached ONCE (first writer wins)
+        assert cache.pages == 3
+        assert cache.lookup(np.asarray(b_pages, np.int32)) \
+            == [a[0], alloc.pages_of("b")[1]]
+        alloc.check_leaks()
+
+    def test_refcount_aware_lru_eviction(self):
+        alloc, cache = self._cache()
+        live = self._seed(alloc, cache, "live", [1, 2, 3, 4])
+        dead = self._seed(alloc, cache, "dead", [5, 6, 7, 8])
+        alloc.release("dead")           # its page is now cache-only
+        cache.lookup(np.asarray([5, 6, 7, 8], np.int32))  # touch: MRU
+        # the LRU candidate [1,2,3,4] is pinned by the live sequence,
+        # so eviction must take the MRU-but-evictable page instead
+        assert cache.evict(1) == 1
+        assert cache.lookup(np.asarray([5, 6, 7, 8], np.int32)) == []
+        assert cache.lookup(np.asarray([1, 2, 3, 4], np.int32)) == live
+        alloc.check_leaks()
+        alloc.release("live")
+        assert cache.evict(1) == 1      # now free to go
+        assert alloc.used_pages == 0
+        alloc.check_leaks()
+
+    def test_leaf_first_eviction_keeps_inner_prefixes_sound(self):
+        alloc, cache = self._cache()
+        self._seed(alloc, cache, "s", list(range(1, 13)))
+        alloc.release("s")
+        assert cache.pages == 3
+        # evicting one page must take the DEEPEST chunk: the remaining
+        # tree still answers its prefix correctly
+        assert cache.evict(1) == 1
+        assert len(cache.lookup(np.arange(1, 13, dtype=np.int32))) == 2
+        cache.clear()
+        assert alloc.used_pages == 0
+        alloc.check_leaks()
+
+    def test_max_pages_cap(self):
+        alloc, cache = self._cache(max_pages=2)
+        self._seed(alloc, cache, "a", [1, 2, 3, 4, 5, 6, 7, 8])
+        assert cache.pages == 2
+        alloc.release("a")
+        self._seed(alloc, cache, "b", [9, 9, 9, 9])
+        # cap held: inserting b evicted an LRU page first
+        assert cache.pages == 2
+        alloc.check_leaks()
+
+    def test_random_tree_ops_property(self):
+        """Radix property test: lookups always equal the longest
+        cached chunk-prefix, never stale pages, never leaks."""
+        rng = np.random.RandomState(3)
+        alloc, cache = self._cache(pool_pages=65)
+        model = {}                      # tuple(chunks) path -> page
+        sid = 0
+        for _ in range(120):
+            prompt = list(rng.randint(0, 3, size=rng.randint(4, 17)))
+            chunks = [tuple(prompt[i * 4:(i + 1) * 4])
+                      for i in range(len(prompt) // 4)]
+            expect = []
+            for i in range(len(chunks)):
+                page = model.get(tuple(chunks[:i + 1]))
+                if page is None:
+                    break
+                expect.append(page)
+            got = cache.lookup(np.asarray(prompt, np.int32))
+            assert got == expect, (prompt, got, expect)
+            if rng.rand() < 0.6:
+                sid += 1
+                n = alloc.geometry.pages_for(len(prompt))
+                if alloc.allocate(sid, n):
+                    pages = alloc.pages_of(sid)
+                    cache.insert(np.asarray(prompt, np.int32), pages)
+                    for i in range(len(chunks)):
+                        model.setdefault(tuple(chunks[:i + 1]),
+                                         pages[i])
+                    alloc.release(sid)
+            alloc.check_leaks()
+        cache.clear()
+        alloc.check_leaks()
+        assert alloc.used_pages == 0
 
 
 # --------------------------------------------------------------- scheduler
@@ -371,6 +654,333 @@ class TestSchedulerInvariants:
         eng.allocator.check_leaks()
 
 
+# ----------------------------------------------------- prefix-cache engine
+class TestPrefixCacheEngine:
+    def _chain(self, prompt, n):
+        out, t = [], prompt[-1]
+        for _ in range(n):
+            t = (t + 1) % ChainModel.vocab_size
+            out.append(t)
+        return out
+
+    def test_full_hit_skips_prefill_and_cow_copies(self):
+        model = ChainModel()
+        eng = _engine(model, prefix_cache=True, decode_pool_pages=33)
+        prompt = list(range(1, 9))              # 2 full pages
+        a = eng.submit(prompt, max_new_tokens=3)
+        _drive(eng, [a])
+        assert a.tokens == self._chain(prompt, 3)
+        assert eng.stats()["prefix_misses"] == 1
+        prefills = model.prefills
+        b = eng.submit(prompt, max_new_tokens=3)
+        _drive(eng, [b])
+        assert b.tokens == a.tokens             # byte-identical
+        assert model.prefills == prefills       # prefill SKIPPED
+        assert model.copies, "full hit must COW its append page"
+        st = eng.stats()
+        assert st["prefix_hits"] == 1
+        assert st["prefix_tokens_saved"] == 7   # 8 matched - 1 re-run
+        eng.allocator.check_leaks()
+
+    def test_partial_hit_prefills_only_the_tail(self):
+        model = ChainModel()
+        eng = _engine(model, prefix_cache=True, decode_pool_pages=33)
+        a = eng.submit(list(range(1, 9)), max_new_tokens=2)
+        _drive(eng, [a])
+        prefills = model.prefills
+        prompt = list(range(1, 9)) + [20, 21]   # shared trunk + tail
+        b = eng.submit(prompt, max_new_tokens=2)
+        _drive(eng, [b])
+        assert b.tokens == self._chain(prompt, 2)
+        assert model.prefills == prefills       # tail via verify family
+        st = eng.stats()
+        assert st["prefix_hits"] == 1 and st["prefix_tokens_saved"] == 8
+        eng.allocator.check_leaks()
+
+    def test_shared_pages_counted_and_freed_exactly(self):
+        model = ChainModel()
+        eng = _engine(model, prefix_cache=True, decode_max_batch=2,
+                      decode_pool_pages=33)
+        prompt = list(range(1, 9))
+        a = eng.submit(prompt, max_new_tokens=8)
+        eng.step()                              # a running, 2 pages cached
+        b = eng.submit(prompt, max_new_tokens=8)
+        eng.step()                              # b aliases the trunk
+        assert eng.allocator.shared_pages >= 1
+        eng.allocator.check_leaks()
+        _drive(eng, [a, b])
+        eng.allocator.check_leaks()
+        # all sequence pages returned; only cache-held pages remain
+        st = eng.stats()
+        assert st["sequences"] == 0
+        assert st["used_pages"] == st["cached_pages"] > 0
+
+    def test_cache_eviction_unblocks_admission(self):
+        """A pool full of cache-only pages must yield to admissions
+        (refcount-aware LRU eviction on demand)."""
+        model = ChainModel()
+        eng = _engine(model, prefix_cache=True, decode_pool_pages=9)
+        # fill the cache: two distinct 2-page prompts = 4 cached pages
+        for base in (0, 8):
+            s = eng.submit([base + i for i in range(8)],
+                           max_new_tokens=1)
+            _drive(eng, [s])
+        assert eng.stats()["cached_pages"] == 4
+        eng.allocator.check_leaks()
+        # 8 usable pages, 4 cache-held: this request needs 5 fresh
+        s = eng.submit([20 + i for i in range(16)], max_new_tokens=3)
+        _drive(eng, [s])
+        assert s.finish_reason == "length"
+        assert eng.stats()["prefix_evicted_pages"] >= 1
+        eng.allocator.check_leaks()
+
+    def test_eviction_never_frees_the_planned_hit_pages(self):
+        """On-demand eviction under a pending HIT must take OTHER
+        cache-only pages, never the ones the admission planned to
+        alias/COW — freeing those would strand a half-shared sequence
+        and storm-fail the step."""
+        model = ChainModel()
+        eng = _engine(model, prefix_cache=True, decode_pool_pages=9)
+        a = eng.submit(list(range(1, 9)), max_new_tokens=1)   # 2 pages
+        _drive(eng, [a])
+        b = eng.submit([40, 41, 42, 43], max_new_tokens=1)    # 1 page
+        _drive(eng, [b])
+        assert eng.stats()["cached_pages"] == 3
+        # full hit on A needing fresh=6 of 5 free: eviction must take
+        # B's page (unprotected), keep A's two, and serve the hit
+        s = eng.submit(list(range(1, 9)), max_new_tokens=20)
+        _drive(eng, [s], limit=128)
+        assert s.finish_reason == "length" and s.error is None
+        assert list(s.tokens)[:3] == [9, 10, 11]
+        st = eng.stats()
+        assert st["prefix_hits"] == 1, st
+        assert st["prefix_evicted_pages"] >= 1
+        # B evicted, A still cached
+        assert eng.prefix_cache.lookup(
+            np.asarray([40, 41, 42, 43], np.int32)) == []
+        assert len(eng.prefix_cache.lookup(
+            np.asarray(list(range(1, 9)), np.int32))) == 2
+        eng.allocator.check_leaks()
+
+    def test_unservable_hit_plan_degrades_to_miss(self):
+        """When the ONLY evictable pages are the planned hit's own,
+        the plan is dropped (degrade to a miss, evict freely, plain
+        prefill) instead of blocking the line forever."""
+        model = ChainModel()
+        eng = _engine(model, prefix_cache=True, decode_pool_pages=9)
+        a = eng.submit(list(range(1, 9)), max_new_tokens=1)
+        _drive(eng, [a])
+        assert eng.stats()["cached_pages"] == 2
+        # full hit would alias/COW both cached pages, but the request
+        # needs all 8 usable pages fresh-or-shared: total=8, fresh=7 >
+        # 6 free with both candidates protected -> degrade
+        s = eng.submit(list(range(1, 9)), max_new_tokens=24)
+        _drive(eng, [s], limit=128)
+        assert s.finish_reason == "length" and s.error is None
+        assert list(s.tokens)[:3] == [9, 10, 11]
+        st = eng.stats()
+        assert st["prefix_hits"] == 0 and st["prefix_misses"] == 2, st
+        # the planned pages WERE freed for the degrade (the plain
+        # prefill then legitimately re-seeded the cache with its own)
+        assert st["prefix_evicted_pages"] == 2, st
+        eng.allocator.check_leaks()
+
+    def test_corrupt_lookup_degrades_to_plain_prefill(self):
+        """The §9 degrade contract: a failed/corrupted radix lookup is
+        a MISS — same tokens, prefill paid, nothing poisoned."""
+        from mxnet_tpu import faults
+        model = ChainModel()
+        eng = _engine(model, prefix_cache=True, decode_pool_pages=33)
+        prompt = list(range(1, 9))
+        a = eng.submit(prompt, max_new_tokens=3)
+        _drive(eng, [a])
+        prefills = model.prefills
+        with faults.plan("decode.prefix_lookup=corrupt,times=1"):
+            b = eng.submit(prompt, max_new_tokens=3)
+            _drive(eng, [b])
+        assert b.tokens == a.tokens             # never wrong tokens
+        assert model.prefills == prefills + 1   # degraded = plain path
+        st = eng.stats()
+        assert st["prefix_degraded"] == 1
+        eng.allocator.check_leaks()
+
+    def test_cached_path_failure_demotes_to_plain(self):
+        """A failing verify program on the cached-prefill path releases
+        the aliased pages and re-queues the request down the plain
+        path — degradation, not quarantine, and leak-free."""
+        from mxnet_tpu import faults
+        model = ChainModel()
+        eng = _engine(model, prefix_cache=True, decode_pool_pages=33,
+                      retry_max=0)
+        prompt = list(range(1, 9))
+        a = eng.submit(prompt, max_new_tokens=3)
+        _drive(eng, [a])
+        # the next decode.prefill injection fires inside the CACHED
+        # prefill (verify family) — after=0 hits the hit-path call
+        with faults.plan("decode.prefill=fail,times=1"):
+            b = eng.submit(prompt, max_new_tokens=3)
+            _drive(eng, [b])
+        assert b.tokens == a.tokens
+        assert b.finish_reason == "length"
+        st = eng.stats()
+        assert st["prefix_degraded"] == 1
+        assert st["quarantined"] == 0           # degrade, not quarantine
+        assert st["admitted"] == st["evicted"] == 2
+        # a demoted hit served NO cached work: it must not count as a
+        # hit nor keep phantom tokens_saved (hit ratio stays honest)
+        assert st["prefix_hits"] == 0
+        assert st["prefix_tokens_saved"] == 0
+        eng.allocator.check_leaks()
+
+    def test_random_cached_orders_never_leak(self):
+        """Engine-level half of the ISSUE-12 satellite: ~300 random
+        submit/step/cancel orders over a small shared-prompt pool with
+        the cache on — check_leaks() exact at every step."""
+        rng = np.random.RandomState(11)
+        model = ChainModel()
+        eng = _engine(model, prefix_cache=True, decode_max_batch=4,
+                      decode_pool_pages=33, queue_depth=256)
+        prompts = [list(range(1, 9)), list(range(1, 13)),
+                   list(range(1, 9)) + [9, 9], [5, 6, 7, 8]]
+        live = []
+        for _ in range(300):
+            r = rng.rand()
+            if r < 0.45:
+                s = eng.submit(prompts[rng.randint(len(prompts))],
+                               max_new_tokens=int(rng.randint(1, 5)))
+                live.append(s)
+            elif live and r < 0.6:
+                live[rng.randint(len(live))].cancelled = True
+            else:
+                eng.step()
+            eng.allocator.check_leaks()
+            live = [s for s in live if not s.event.is_set()]
+        _drive(eng, live, limit=256)
+        eng.allocator.check_leaks()
+        st = eng.stats()
+        assert st["sequences"] == 0
+        assert st["used_pages"] == st["cached_pages"]
+
+
+# ----------------------------------------------------- speculative engine
+class TestSpeculativeEngine:
+    def test_full_acceptance_compresses_steps(self):
+        """An agreeing draft emits k+1 tokens per round: 8 tokens land
+        in ~2 engine steps instead of 8."""
+        model = ChainModel()
+        eng = _engine(model, draft=SkewDraft(1), spec_k=3,
+                      decode_max_new_tokens=8, decode_pool_pages=33)
+        s = eng.submit([5], max_new_tokens=8)
+        n = _drive(eng, [s])
+        assert s.tokens == [(5 + i) % 32 for i in range(1, 9)]
+        assert n <= 4, n
+        st = eng.stats()
+        assert st["spec_accepted"] == st["spec_proposed"] > 0
+        assert st["spec_acceptance"] == 1.0
+        eng.allocator.check_leaks()
+
+    def test_zero_acceptance_is_byte_identical_to_plain(self):
+        """Rejection sampling in greedy mode is exact: even a draft
+        that never agrees yields the plain path's exact tokens."""
+        plain = _engine(ChainModel(), decode_max_new_tokens=8)
+        want = plain.submit([5], max_new_tokens=8)
+        _drive(plain, [want])
+        model = ChainModel()
+        eng = _engine(model, draft=SkewDraft(2), spec_k=3,
+                      decode_max_new_tokens=8, decode_pool_pages=33)
+        s = eng.submit([5], max_new_tokens=8)
+        _drive(eng, [s])
+        assert s.tokens == want.tokens
+        st = eng.stats()
+        assert st["spec_proposed"] > 0 and st["spec_accepted"] == 0
+        eng.allocator.check_leaks()
+
+    def test_eos_mid_window_stops_exactly(self):
+        model = ChainModel()
+        eng = _engine(model, draft=SkewDraft(1), spec_k=3,
+                      decode_max_new_tokens=16, decode_pool_pages=33)
+        # chain 5 -> 6 -> 7(eos): eos lands inside the first window
+        s = eng.submit([5], max_new_tokens=16, eos_id=7)
+        _drive(eng, [s])
+        assert s.tokens == [6, 7] and s.finish_reason == "eos"
+        eng.allocator.check_leaks()
+
+    def test_length_cap_never_overshoots(self):
+        model = ChainModel()
+        eng = _engine(model, draft=SkewDraft(1), spec_k=3,
+                      decode_max_new_tokens=16, decode_pool_pages=33)
+        for n in (1, 2, 3, 4, 5):
+            s = eng.submit([1], max_new_tokens=n)
+            _drive(eng, [s])
+            assert len(s.tokens) == n and s.finish_reason == "length"
+            eng.allocator.check_leaks()
+
+    def test_draft_failure_degrades_round_to_plain(self):
+        class FlakyDraft(SkewDraft):
+            def decode_step(self, tokens, positions, block_tables):
+                self.steps += 1
+                if self.steps == 1:
+                    raise ValueError("draft died")
+                return super().decode_step(tokens, positions,
+                                           block_tables)
+
+        model = ChainModel()
+        eng = _engine(model, draft=FlakyDraft(1), spec_k=2,
+                      decode_max_new_tokens=6, decode_pool_pages=33)
+        s = eng.submit([5], max_new_tokens=6)
+        _drive(eng, [s])
+        assert s.tokens == [(5 + i) % 32 for i in range(1, 7)]
+        assert eng.stats()["spec_fallbacks"] >= 1
+        eng.allocator.check_leaks()
+
+    def test_verify_failure_quarantines_leak_free(self):
+        """A persistent verify failure is a TARGET failure: the §8
+        quarantine path fires for that sequence alone; batchmates keep
+        decoding and every page comes back."""
+        from mxnet_tpu import faults
+        model = ChainModel()
+        eng = _engine(model, draft=SkewDraft(1), spec_k=2,
+                      decode_max_batch=2, decode_max_new_tokens=6,
+                      decode_pool_pages=33, retry_max=0)
+        a = eng.submit([5], max_new_tokens=6)
+        b = eng.submit([9], max_new_tokens=6)
+        with faults.plan("decode.verify=fail,times=1"):
+            _drive(eng, [a, b])
+        done = {s.finish_reason for s in (a, b)}
+        assert done == {"quarantined", "length"}, done
+        ok = a if a.finish_reason == "length" else b
+        assert ok.tokens == [(ok.prompt[0] + i) % 32
+                             for i in range(1, 7)]
+        assert eng.stats()["quarantined"] == 1
+        eng.allocator.check_leaks()
+        assert eng.stats()["used_pages"] == 0
+
+    def test_spec_composes_with_prefix_cache(self):
+        model = ChainModel()
+        eng = _engine(model, draft=SkewDraft(1), spec_k=3,
+                      prefix_cache=True, decode_max_new_tokens=8,
+                      decode_pool_pages=33)
+        prompt = list(range(1, 9))
+        a = eng.submit(prompt, max_new_tokens=8)
+        _drive(eng, [a])
+        prefills = model.prefills
+        b = eng.submit(prompt, max_new_tokens=8)
+        _drive(eng, [b])
+        assert b.tokens == a.tokens
+        assert model.prefills == prefills       # hit skipped prefill
+        st = eng.stats()
+        assert st["prefix_hits"] == 1
+        assert st["spec_accepted"] == st["spec_proposed"] > 0
+        eng.allocator.check_leaks()
+
+    def test_spec_without_draft_disabled_not_fatal(self):
+        eng = _engine(ChainModel(), spec_k=3)
+        assert eng.spec_k == 0
+        s = eng.submit([5], max_new_tokens=2)
+        _drive(eng, [s])
+        assert s.tokens == [6, 7]
+
+
 # ------------------------------------------------------------- end to end
 @pytest.fixture(scope="module")
 def tiny_lm_server():
@@ -499,3 +1109,117 @@ class TestGenerateEndToEnd:
         assert rm.SERVING_DECODE_TTFT_SECONDS.count(model="lm") == 1
         p99 = rm.SERVING_DECODE_TTFT_SECONDS.quantile(0.99, model="lm")
         assert np.isfinite(p99) and p99 > 0
+
+
+# ---------------------------------------------- §9 end to end (real LM)
+@pytest.fixture(scope="module")
+def spec_lm():
+    """One tiny target + one garbage draft (random weights: acceptance
+    is incidental, parity is the point)."""
+    mx.random.seed(7)
+    from mxnet_tpu.models.transformer_blocks import TransformerDecoderLM
+    lm = TransformerDecoderLM(13, units=8, hidden_size=16, num_layers=1,
+                              num_heads=2, max_length=32)
+    lm.initialize(mx.init.Xavier())
+    mx.random.seed(29)
+    draft = TransformerDecoderLM(13, units=8, hidden_size=16,
+                                 num_layers=1, num_heads=2,
+                                 max_length=32)
+    draft.initialize(mx.init.Xavier())
+    return lm, draft
+
+
+class TestSection9EndToEnd:
+    def _ref(self, lm, prompt, n):
+        toks = list(prompt)
+        for _ in range(n):
+            lg = lm(nd.NDArray(np.asarray([toks], np.int32))).asnumpy()
+            toks.append(int(np.argmax(lg[0, -1])))
+        return toks[len(prompt):]
+
+    def test_prefix_cache_parity_and_program_bound(self, spec_lm):
+        lm, _draft = spec_lm
+        repo = serving.ModelRepository()
+        repo.add_decoder("lm", lm)
+        cfg = serving.ServingConfig(
+            decode_page_size=4, decode_pool_pages=33,
+            decode_max_batch=2, decode_max_new_tokens=4,
+            prefix_cache=True)
+        with serving.ModelServer(repo, cfg) as srv:
+            prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+            want = self._ref(lm, prompt, 4)
+            a = srv.generate("lm", prompt, max_new_tokens=4,
+                             timeout=300).tolist()
+            b = srv.generate("lm", prompt, max_new_tokens=4,
+                             timeout=300).tolist()
+            tail = prompt + [2, 9]
+            c = srv.generate("lm", tail, max_new_tokens=4,
+                             timeout=300).tolist()
+            st = srv.decode_stats("lm")
+            adapter = list(srv._decoders.values())[0].model
+            # cached results byte-match the uncached reference
+            assert a == want and b == want
+            assert c == self._ref(lm, tail, 4)
+            assert st["prefix_hits"] == 2 and st["prefix_misses"] == 1
+            assert st["prefix_tokens_saved"] == 7 + 8
+            # the §9 program accounting, via the jit cache-size helper:
+            # width-1 (full hit) + width-2 (tail) verify programs and
+            # ONE COW copy program beside prefill/decode
+            assert st["programs"] <= st["program_bound"], st
+            assert adapter._verify_jit._cache_size() == 2
+            assert adapter._copy_jit._cache_size() == 1
+            assert adapter._decode_jit._cache_size() == 1
+            eng = list(srv._decoders.values())[0]
+            eng.allocator.check_leaks()
+
+    def test_spec_draft_env_serves_multiple_targets(self, spec_lm):
+        """MXNET_SERVING_SPEC_DRAFT names ONE draft entry for every
+        decoder — each target engine must get its OWN adapter over the
+        draft LM (a shared adapter binds one live engine and would
+        reject the second target)."""
+        lm, draft = spec_lm
+        repo = serving.ModelRepository()
+        repo.add_decoder("a", lm)
+        repo.add_decoder("b", lm)
+        repo.add_decoder("small", draft)
+        cfg = serving.ServingConfig(
+            decode_page_size=4, decode_pool_pages=33,
+            decode_max_batch=2, decode_max_new_tokens=4, spec_k=2,
+            spec_draft="small")
+        with serving.ModelServer(repo, cfg) as srv:
+            out_a = srv.generate("a", [1, 2, 3], max_new_tokens=4,
+                                 timeout=300).tolist()
+            out_b = srv.generate("b", [1, 2, 3], max_new_tokens=4,
+                                 timeout=300).tolist()
+            want = self._ref(lm, [1, 2, 3], 4)
+            assert out_a == want and out_b == want
+            assert srv.decode_stats("a")["spec_k"] == 2
+            assert srv.decode_stats("b")["spec_proposed"] > 0
+
+    def test_speculative_byte_identical_and_bound(self, spec_lm):
+        """The §9 acceptance criterion: greedy outputs with speculation
+        ON equal the plain path byte for byte (garbage draft — worst
+        case), programs stay within the spec-aware bound, and the
+        acceptance counters move."""
+        lm, draft = spec_lm
+        repo = serving.ModelRepository()
+        repo.add_decoder("lm", lm, draft=draft)
+        cfg = serving.ServingConfig(
+            decode_page_size=4, decode_pool_pages=33,
+            decode_max_batch=2, decode_max_new_tokens=6, spec_k=2)
+        with serving.ModelServer(repo, cfg) as srv:
+            for prompt in ([1, 2, 3], [5], [2, 4, 6, 8]):
+                got = srv.generate("lm", prompt, max_new_tokens=6,
+                                   timeout=300).tolist()
+                assert got == self._ref(lm, prompt, 6), prompt
+            st = srv.decode_stats("lm")
+            assert st["spec_proposed"] > 0
+            assert 0.0 <= st["spec_acceptance"] <= 1.0
+            assert st["programs"] <= st["program_bound"], st
+            # batched verification is ONE fixed-shape program (B fixed,
+            # width = the k+1 bucket); the per-seq family stays unused
+            adapter = list(srv._decoders.values())[0].model
+            assert adapter._verify_batch_jit._cache_size() == 1
+            assert adapter._verify_jit._cache_size() == 0
+            eng = list(srv._decoders.values())[0]
+            eng.allocator.check_leaks()
