@@ -1061,6 +1061,222 @@ def run_faults(args):
     return result
 
 
+def run_replicas(args):
+    """Replica tier (docs/serving.md §10): N replicas behind one
+    ModelServer, driven by closed-loop clients that HONOR the server's
+    retry-after hints with jitter (resilience.honor_retry_after — shed
+    storms must not come back as one synchronized wave).  With
+    ``--faults`` the full failover ladder runs deterministically:
+    kill one replica's executes (seeded plan) -> consecutive-failure
+    trip -> reroute under the original deadlines -> probe recovery;
+    then stall its heartbeat -> sibling detection -> dark window served
+    by the others -> prewarm-gated rejoin.  Asserts the ISSUE-13
+    acceptance: zero hung requests, typed failures only, outputs
+    byte-identical to a fault-free single-replica twin, bounded
+    latency, failovers fully accounted by metric AND trace tags, and
+    zero extra programs per replica beyond the per-replica bucket
+    bound.  Numpy function entries: zero XLA compiles."""
+    from mxnet_tpu import faults
+    from mxnet_tpu.serving.batcher import bucket_set
+    from mxnet_tpu.serving.resilience import Deadline, honor_retry_after
+
+    rm.enable()
+    tracing.enable(sample=1.0)
+    n_rep = args.replicas
+    sizes = (1, 2, 3)
+    rng = np.random.RandomState(0)
+    payloads = {n: rng.randn(n, 2).astype(np.float32) for n in sizes}
+    sig = [{"shape": [None, 2], "dtype": "float32"}]
+    fn = lambda a: a * 3.0 - 1.0                    # noqa: E731
+    n_req, threads, timeout_s = args.requests, 8, 30.0
+    plan_sizes = [sizes[i % len(sizes)] for i in range(n_req)]
+    max_batch = 4
+
+    def make_server(replicas):
+        repo = serving.ModelRepository()
+        repo.add_function("m", fn, sig)
+        cfg = serving.ServingConfig(
+            max_batch_size=max_batch, max_latency_us=500,
+            queue_depth=256, num_workers=2, retry_backoff_ms=1,
+            retry_max=2, replicas=replicas, replica_heartbeat_ms=20,
+            replica_heartbeat_window_ms=250, circuit_cooldown_ms=100)
+        return repo, serving.ModelServer(repo, cfg)
+
+    def drive(srv, monitor=None):
+        """One closed-loop round: every client honors retry-after with
+        per-client seeded jitter.  Returns (outs, errors, durs, wall).
+        """
+        import random as _random
+        outs = [None] * n_req
+        durs = [None] * n_req
+        errors = []
+
+        def worker(tid):
+            jrng = _random.Random(1000 + tid)
+            for i in range(tid, n_req, threads):
+                n = plan_sizes[i]
+                t0 = time.perf_counter()
+                try:
+                    outs[i] = honor_retry_after(
+                        lambda: srv.predict("m", payloads[n],
+                                            timeout=timeout_s),
+                        attempts=6, rng=jrng,
+                        deadline=Deadline.start(timeout_s))
+                except Exception as e:          # noqa: BLE001
+                    errors.append(e)
+                durs[i] = time.perf_counter() - t0
+                if monitor is not None:
+                    monitor()
+
+        pool = [threading.Thread(target=worker, args=(t,))
+                for t in range(threads)]
+        t0 = time.perf_counter()
+        for t in pool:
+            t.start()
+        for t in pool:
+            t.join(120)
+        wall = time.perf_counter() - t0
+        # zero hung requests: every slot resolved or typed error
+        done = sum(1 for o in outs if o is not None)
+        assert done + len(errors) == n_req, (done, len(errors))
+        from mxnet_tpu.base import MXNetError
+        assert all(isinstance(e, MXNetError) for e in errors), errors[:3]
+        # failed-over requests respect their ORIGINAL deadlines
+        assert max(d for d in durs if d is not None) < timeout_s, durs
+        return outs, errors, wall
+
+    def check_bytes(outs, refs):
+        for i, out in enumerate(outs):
+            if out is not None:
+                np.testing.assert_array_equal(out, refs[i])
+
+    # --- fault-free single-replica twin: the byte-identity oracle -----
+    _, twin = make_server(1)
+    with twin:
+        refs, twin_err, twin_wall = drive(twin)
+    assert not twin_err, twin_err[:3]
+
+    repo, srv = make_server(n_rep)
+    entry = repo.get("m")
+    result = {"metric": "serving.replicas", "replicas": n_rep,
+              "requests_per_phase": n_req,
+              "unit": "req/s_during_replica_kill"}
+    with srv:
+        # --- phase A: healthy load balance --------------------------
+        outs, errs, wall_a = drive(srv)
+        assert not errs, errs[:3]
+        check_bytes(outs, refs)
+        rset = srv._replica_sets[entry.uid]
+        st = rset.stats()
+        per_replica = {r: v["requests"] for r, v in
+                       st["replicas"].items()}
+        assert all(v > 0 for v in per_replica.values()), \
+            f"idle replica under load: {per_replica}"
+        # zero extra programs per replica beyond the per-replica bound
+        progs = {r: v["programs"]
+                 for r, v in rset.debug_state()["replicas"].items()}
+        bound = len(bucket_set(max_batch))
+        assert all(p <= bound for p in progs.values()), (progs, bound)
+        assert len(set(progs.values())) == 1, progs
+        result.update(healthy_req_s=round(n_req / wall_a, 2),
+                      healthy_load=per_replica,
+                      programs_per_replica=progs,
+                      program_bound_per_replica=bound)
+
+        if args.faults:
+            victim = sorted(per_replica)[1]     # a known, living rid
+            # --- phase B: execute-kill -> trip -> failover -> probe --
+            tracing.reset()
+            fo0 = rset.stats()["failovers"]
+            seen_unhealthy = []
+
+            def monitor():
+                if rset.replicas().get(victim) == "unhealthy" \
+                        and not seen_unhealthy:
+                    seen_unhealthy.append(time.perf_counter())
+
+            with faults.plan(
+                    f"replica.{victim}.execute=fail,times=18,seed=3"):
+                t_kill = time.perf_counter()
+                outs, errs, wall_b = drive(srv, monitor=monitor)
+                check_bytes(outs, refs)
+                assert not errs, errs[:3]       # failover absorbed all
+                assert seen_unhealthy, \
+                    f"{victim} was never detected unhealthy"
+                fo1 = rset.stats()["failovers"]
+                assert fo1 > fo0, "no failovers recorded"
+                # every rerouted request is accounted: the shared batch
+                # span's failover_from tag is copied into each
+                # coalesced member's trace, so tagged TRACES count
+                # rerouted REQUESTS — at least one per failover of a
+                # dispatch group (the counter's unit)
+                tagged = sum(
+                    1 for tr in tracing.TRACER.traces()
+                    if any((s.get("tags") or {}).get("failover_from")
+                           for s in tr["spans"]))
+                assert tagged >= fo1 - fo0 > 0, (tagged, fo1 - fo0)
+                # drained: nothing stuck in flight on the dead replica
+                assert rset.replica(victim).inflight == 0
+                # bounded goodput dip: the kill phase still completed
+                # every request in comparable wall time
+                assert wall_b < max(20 * wall_a, 10.0), (wall_a, wall_b)
+                # recovery: once the fail budget exhausts, the breaker
+                # probe re-closes the replica
+                deadline = time.monotonic() + 20
+                while rset.replicas()[victim] != "healthy":
+                    assert time.monotonic() < deadline, \
+                        rset.debug_state()
+                    honor_retry_after(
+                        lambda: srv.predict(
+                            "m", payloads[1], timeout=timeout_s),
+                        attempts=6)
+                    time.sleep(0.01)
+            result.update(
+                chaos_req_s=round(n_req / wall_b, 2),
+                value=round(n_req / wall_b, 2),
+                detect_ms=round(
+                    1e3 * (seen_unhealthy[0] - t_kill), 1),
+                failovers=fo1 - fo0,
+                failover_trace_tags=tagged)
+
+            # --- phase C: heartbeat stall -> dark -> prewarm rejoin --
+            p0 = rset.replica(victim).prewarms
+            r0 = rset.replica(victim).requests
+            with faults.plan(
+                    f"replica.{victim}.heartbeat=stall,ms=1500,times=1"):
+                deadline = time.monotonic() + 10
+                while rset.replicas()[victim] != "unhealthy":
+                    assert time.monotonic() < deadline, \
+                        rset.debug_state()
+                    srv.predict("m", payloads[1], timeout=timeout_s)
+                    time.sleep(0.005)
+                # dark window: the set keeps serving byte-identical
+                outs, errs, _ = drive(srv)
+                assert not errs, errs[:3]
+                check_bytes(outs, refs)
+            # rejoin ONLY after a fresh prewarm pass
+            deadline = time.monotonic() + 20
+            while rset.replicas()[victim] != "healthy":
+                assert time.monotonic() < deadline, rset.debug_state()
+                time.sleep(0.02)
+            rep = rset.replica(victim)
+            assert rep.prewarms == p0 + 1, (p0, rep.prewarms)
+            # recovered: the rejoined replica takes traffic again
+            deadline = time.monotonic() + 20
+            while rset.replica(victim).requests <= r0:
+                assert time.monotonic() < deadline, rset.stats()
+                drive(srv)
+            result.update(
+                rejoin_prewarms=rep.prewarms,
+                heartbeat_detected=True,
+                recovered_requests=rset.replica(victim).requests - r0)
+        final = rset.stats()
+        result["final_states"] = {r: v["state"]
+                                  for r, v in final["replicas"].items()}
+    result.setdefault("value", result["healthy_req_s"])
+    return result
+
+
 def cache_roundtrip(args):
     """ISSUE-6 CI criterion: serve -> kill the process -> restart on
     the same cache dir -> the warm restart compiles ZERO new XLA
@@ -1137,6 +1353,16 @@ def main():
                          "leak-free quarantine, and circuit "
                          "open->probe->close (docs/serving.md §8); "
                          "numpy fakes only, zero XLA compiles")
+    ap.add_argument("--replicas", type=int, default=None, metavar="N",
+                    help="replica tier (docs/serving.md §10): serve "
+                         "through N replicas with health-checked "
+                         "least-loaded routing; closed-loop clients "
+                         "honor retry-after hints with jitter.  With "
+                         "--faults, runs the deterministic failover "
+                         "ladder (kill -> detect -> reroute -> probe "
+                         "recovery -> heartbeat stall -> prewarm-gated "
+                         "rejoin) and asserts the ISSUE-13 criteria; "
+                         "numpy fakes, zero XLA compiles")
     ap.add_argument("--shared-prefix", type=float, nargs="?",
                     const=0.8, default=None, metavar="P",
                     help="with --decode: shared-prefix traffic tier — "
@@ -1188,6 +1414,13 @@ def main():
 
     if args.cache_roundtrip:
         cache_roundtrip(args)
+        return
+
+    if args.replicas:
+        print(json.dumps(run_replicas(args)))
+        print("serving replica smoke ok (failover ladder green)"
+              if args.faults else "serving replica smoke ok",
+              file=sys.stderr)
         return
 
     if args.faults:

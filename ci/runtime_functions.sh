@@ -151,13 +151,23 @@ serving_smoke() {
     # the fault-free twin workload byte-matches with zero extra
     # programs.  Numpy fakes: no XLA compiles in this tier.
     python benchmark/bench_serving.py --faults
+    # replica tier (ISSUE-13 acceptance): 3 replicas under load with a
+    # seeded kill-a-replica plan — consecutive-failure trip, failover
+    # under original deadlines (byte-identical to the fault-free
+    # single-replica twin), heartbeat-stall detection by siblings, and
+    # prewarm-gated rejoin; zero hung requests, typed failures only,
+    # failovers accounted by metric AND trace tags, zero extra
+    # programs per replica beyond the per-replica bucket bound.
+    # Closed-loop clients honor retry-after with jitter.  Numpy fakes:
+    # no XLA compiles in this tier.
+    python benchmark/bench_serving.py --replicas 3 --faults
     # the decode scheduler + paged-attention kernel + tracer tests
     # double as race tests under the concurrency sanitizer, and the
-    # fault/resilience tests join them (deadline/retry/bisection paths
-    # cross the same locks)
+    # fault/resilience/replica tests join them (deadline/retry/
+    # bisection/failover paths cross the same locks)
     MXNET_ENGINE_SANITIZE=1 python -m pytest tests/test_serving_decode.py \
         tests/test_pallas_paged.py tests/test_tracing.py \
-        tests/test_faults.py -x -q
+        tests/test_faults.py tests/test_serving_replica.py -x -q
 }
 
 bench_cpu() {

@@ -357,14 +357,46 @@ declare_env("MXNET_SERVING_CIRCUIT_COOLDOWN_MS", 1000,
             "Serving circuit breaker: how long an OPEN circuit sheds "
             "before admitting ONE half-open probe request (probe "
             "success re-closes, failure re-opens).")
+declare_env("MXNET_SERVING_REPLICAS", 1,
+            "Serving: number of replicas per model version "
+            "(mxnet_tpu.serving.replica, docs/serving.md §10).  With "
+            "N > 1 the server builds a ReplicaSet — N data-parallel "
+            "replicas on disjoint device groups of the mesh, each with "
+            "its own program cache / decode engine / KV pool — and "
+            "routes least-loaded among HEALTHY replicas; a failed "
+            "replica's requests fail over to siblings under their "
+            "original deadlines.  1 (default) = the single-replica "
+            "path, byte-identical to pre-replica behavior.")
+declare_env("MXNET_SERVING_REPLICA_HEARTBEAT_MS", 50,
+            "Serving replicas: heartbeat interval per replica worker "
+            "(milliseconds).  Each replica's heartbeat thread beats, "
+            "then sweeps the whole set for stale siblings, so a "
+            "stalled replica is detected by its peers even with zero "
+            "traffic.")
+declare_env("MXNET_SERVING_REPLICA_HEARTBEAT_WINDOW_MS", 500,
+            "Serving replicas: a replica whose last heartbeat is older "
+            "than this window is marked UNHEALTHY (unroutable) until "
+            "beats resume AND it re-passes prewarm (the rolling-"
+            "recovery gate: a rejoining replica never serves a cold "
+            "program).")
+declare_env("MXNET_SERVING_REPLICA_FAILURE_THRESHOLD", 3,
+            "Serving replicas: consecutive typed execute failures that "
+            "trip one replica's circuit breaker (UNHEALTHY, sheds to "
+            "siblings) without waiting for the sliding error-rate "
+            "window to fill — the dead-replica fast path.  After "
+            "MXNET_SERVING_CIRCUIT_COOLDOWN_MS one probe request may "
+            "re-close it.  0 = windowed error rate only.")
 declare_env("MXNET_FAULTS", None,
             "Deterministic fault-injection plan for chaos testing "
             "(mxnet_tpu.faults): 'site=mode[,k=v...][;...]' with mode "
             "in fail|delay|corrupt|stall and keys p/after/times/ms/"
             "seed, e.g. 'serving.execute=fail,p=0.05,seed=7'.  Sites "
             "thread through deploy, compile_cache, the serving "
-            "batcher, the decode engine, and the KV page allocator.  "
-            "Unset (default) = injection off at zero cost.")
+            "batcher, the decode engine, the KV page allocator, and "
+            "the replica layer (replica.<rid>.{execute,heartbeat,"
+            "decode.*} — kill/stall one replica by id, or every "
+            "replica via the replica.* glob).  Unset (default) = "
+            "injection off at zero cost.")
 declare_env("MXNET_SERVING_QUANT_REQUIRE_DIGEST", "1",
             "Serving admission of quantized artifacts "
             "(ModelRepository.load_artifact): 1 (default) rejects a "

@@ -20,9 +20,15 @@ failed/corrupted lookup must degrade to a plain prefill, never to
 wrong tokens; the site passes no value through, so ``corrupt`` raises
 like ``fail`` instead of silently handing back wrong pages),
 ``decode.verify`` (speculative verification — a target-model failure,
-quarantining that sequence through the §8 path), and
+quarantining that sequence through the §8 path),
 ``kv_cache.allocate`` (fail-only: injected pool exhaustion is a
-refusal, not an exception).
+refusal, not an exception), and the replica-scoped family
+(docs/serving.md §10): ``replica.<rid>.execute`` (one replica's
+dispatch), ``replica.<rid>.heartbeat`` (its beat loop — ``stall`` is
+the wedged-worker shape siblings must detect), and
+``replica.<rid>.decode.{prefill,step,verify,prefix_lookup}`` (a
+replica-owned decode engine's §8 sites under its own prefix) — kill
+ONE replica by id, or every replica at once via ``replica.*`` globs.
 
 Spec grammar (``MXNET_FAULTS``, or :func:`install` / :func:`plan`)::
 

@@ -17,6 +17,7 @@ inserting the ICI/DCN collectives.  This package supplies:
 - ring attention (context parallelism over the ICI ring via ppermute).
 """
 from .mesh import make_mesh, mesh_axis_size
+from .placement import replica_groups, replica_mesh
 from .functional import functionalize
 from .sharding import ShardingRules, MEGATRON_RULES, partition_params
 from .optim import sgd_init, sgd_update, adamw_init, adamw_update
@@ -27,7 +28,8 @@ from .checkpoint import CheckpointManager, save_checkpoint, \
 from .pipeline import pipeline_apply, make_pipeline_mesh
 from . import dist
 
-__all__ = ["make_mesh", "mesh_axis_size", "functionalize",
+__all__ = ["make_mesh", "mesh_axis_size", "replica_groups",
+           "replica_mesh", "functionalize",
            "ShardingRules", "MEGATRON_RULES", "partition_params",
            "sgd_init", "sgd_update", "adamw_init", "adamw_update",
            "ShardedTrainer", "ring_attention", "ring_self_attention",

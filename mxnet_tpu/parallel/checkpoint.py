@@ -13,10 +13,28 @@ mesh — no gather, no host bottleneck.
     mngr.save(step, trainer)               # async sharded write
     mngr.restore(trainer)                  # latest; or restore(t, step=n)
     mngr.wait()                            # barrier before exit
+
+Two crash-safety pieces on top of the async writes:
+
+- **Atomic last-step marker.**  ``save`` is asynchronous, so "the
+  newest step directory exists" does NOT mean "that checkpoint is
+  durable" — a preemption mid-write leaves a torn step that the
+  backend's ``latest_step()`` may still report.  The manager therefore
+  keeps its own ``LATEST`` marker file, written via tmp + fsync +
+  rename (atomic on POSIX) only AFTER the write barrier confirms
+  durability.  ``restore()`` prefers the marker, so a kill mid-save
+  restores the last *verified* checkpoint, never the torn one.
+- **``save_on_signal``** — a SIGTERM/preemption hook: the cluster
+  scheduler's eviction notice triggers one synchronous save + barrier
+  + marker commit before the previous handler (or default
+  termination) runs, so an evicted job resumes from its final step
+  instead of its last periodic checkpoint.
 """
 from __future__ import annotations
 
+import logging
 import os
+import signal as _signal
 from typing import Optional
 
 import jax
@@ -24,6 +42,10 @@ import jax
 from ..base import MXNetError
 
 __all__ = ["CheckpointManager", "save_checkpoint", "load_checkpoint"]
+
+_LOG = logging.getLogger("mxnet_tpu")
+
+_MARKER = "LATEST"
 
 
 def _ocp():
@@ -52,7 +74,9 @@ class CheckpointManager:
 
     Writes OCDBT/TensorStore checkpoints where every process stores only
     its local shards; ``restore`` re-creates arrays with the trainer's
-    own shardings.
+    own shardings.  The ``LATEST`` marker (module docstring) makes the
+    latest-pointer torn-write-safe; ``save_on_signal`` turns a
+    preemption notice into one final durable checkpoint.
     """
 
     def __init__(self, directory, max_to_keep: int = 3,
@@ -64,16 +88,26 @@ class CheckpointManager:
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep,
                 enable_async_checkpointing=async_write))
+        self._pending = []              # steps saved, durability unknown
+        self._signal_prev = {}          # signum -> previous handler
 
+    # ----------------------------------------------------------- save/load
     def save(self, step: int, trainer):
         ocp = _ocp()
-        self._mngr.save(int(step),
+        step = int(step)
+        self._mngr.save(step,
                         args=ocp.args.StandardSave(
                             _trainer_state(trainer)))
+        # the marker only advances at the durability barrier (wait/
+        # close/signal-save) — an async save is not yet a fact
+        self._pending.append(step)
 
     def restore(self, trainer, step: Optional[int] = None) -> int:
         """Restore ``trainer``'s params/opt_state in place; returns the
-        restored step."""
+        restored step.  ``step=None`` restores the newest VERIFIED
+        step: the atomic marker wins over the backend's directory
+        listing, so a checkpoint torn by a mid-save kill is never
+        auto-restored (address it explicitly via ``step=`` to try)."""
         ocp = _ocp()
         if step is None:
             step = self.latest_step()
@@ -88,23 +122,128 @@ class CheckpointManager:
         return int(step)
 
     def latest_step(self) -> Optional[int]:
+        """Newest restorable step: the verified marker when present
+        AND still retained (crash-safe), else whatever the backend
+        lists.  The fallback matters twice: pre-marker checkpoint
+        directories stay restorable, and a marker step that
+        ``max_to_keep`` retention already garbage-collected (saves
+        landed after the last barrier, then a kill) must not wedge
+        restore while newer durable steps exist — in that case the
+        backend listing is the best available answer (the pre-marker
+        guarantee, no worse than before)."""
+        verified = self.latest_verified_step()
+        if verified is not None:
+            try:
+                retained = verified in set(self._mngr.all_steps())
+            except Exception:       # noqa: BLE001 — listing best-effort
+                retained = True
+            if retained:
+                return verified
         return self._mngr.latest_step()
 
     def all_steps(self):
         return sorted(self._mngr.all_steps())
 
+    # --------------------------------------------------- the atomic marker
+    @property
+    def _marker_path(self):
+        return os.path.join(self._dir, _MARKER)
+
+    def latest_verified_step(self) -> Optional[int]:
+        """The step the marker points at — i.e. the newest checkpoint
+        PROVEN durable by a completed write barrier — or None (no
+        marker yet: nothing verified, or pre-marker directory)."""
+        try:
+            with open(self._marker_path) as f:
+                text = f.read().strip()
+            return int(text) if text else None
+        except (OSError, ValueError):
+            return None
+
+    def _commit_marker(self, step):
+        """Atomically repoint the marker: write a tmp file, fsync it,
+        rename over the marker.  A kill at ANY instant leaves either
+        the old marker or the new one — never a torn pointer."""
+        tmp = self._marker_path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(f"{int(step)}\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._marker_path)
+
     def wait(self):
-        """Block until pending async writes are durable."""
+        """Block until pending async writes are durable, then advance
+        the verified-latest marker to the newest of them."""
         self._mngr.wait_until_finished()
+        if self._pending:
+            self._commit_marker(max(self._pending))
+            self._pending = []
 
     def close(self):
         self.wait()
         self._mngr.close()
 
+    # ------------------------------------------------------ signal handling
+    def save_on_signal(self, trainer, step_fn,
+                       signals=(_signal.SIGTERM,)):
+        """Install a preemption hook: on any of ``signals`` (default
+        SIGTERM — what cluster schedulers send before eviction), run
+        ONE synchronous save of ``trainer`` at ``step_fn()`` —
+        save, write barrier, marker commit — then chain to the
+        previously installed handler (or the default action), so the
+        process still terminates the way its supervisor expects.
+
+        ``step_fn`` is a zero-arg callable returning the step to stamp
+        (e.g. ``lambda: trainer_loop.step``); it is evaluated at
+        signal time, not install time.  Returns this manager so the
+        call chains.  Must run on the main thread (CPython signal
+        rule).  ``remove_signal_handlers()`` undoes the install."""
+        if not callable(step_fn):
+            raise MXNetError(
+                "save_on_signal: step_fn must be a zero-arg callable "
+                "returning the step to save at signal time")
+
+        def handler(signum, frame):
+            try:
+                step = int(step_fn())
+                _LOG.warning(
+                    "checkpoint: signal %s — saving final checkpoint "
+                    "at step %d to %s", signum, step, self._dir)
+                self.save(step, trainer)
+                self.wait()             # barrier + marker commit
+            except Exception as e:      # noqa: BLE001 — still terminate
+                _LOG.error(
+                    "checkpoint: signal-save failed (%s); the last "
+                    "verified checkpoint is step %s", e,
+                    self.latest_verified_step())
+            prev = self._signal_prev.get(signum)
+            if callable(prev):
+                prev(signum, frame)
+            elif prev != _signal.SIG_IGN:
+                # SIG_DFL — or None, i.e. a handler installed at the C
+                # level that Python cannot re-invoke: re-raise with the
+                # default action so the process still terminates and
+                # the exit status reflects the signal (supervisors key
+                # on it); swallowing it would leave a zombie the
+                # supervisor has to SIGKILL
+                _signal.signal(signum, _signal.SIG_DFL)
+                _signal.raise_signal(signum)
+
+        for signum in signals:
+            self._signal_prev[signum] = _signal.signal(signum, handler)
+        return self
+
+    def remove_signal_handlers(self):
+        """Restore the handlers ``save_on_signal`` displaced."""
+        for signum, prev in self._signal_prev.items():
+            _signal.signal(signum, prev)
+        self._signal_prev = {}
+
     def __enter__(self):
         return self
 
     def __exit__(self, *exc):
+        self.remove_signal_handlers()
         self.close()
 
 

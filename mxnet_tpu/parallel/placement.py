@@ -1,0 +1,89 @@
+"""Replica placement over the device mesh (docs/serving.md §10).
+
+The serving replica layer (``mxnet_tpu.serving.replica``) maps one
+model version to N data-parallel replicas, each owning a **disjoint
+device group** of the mesh — a replica is the unit of both throughput
+(replicas serve concurrently) and availability (a dead replica's group
+takes nothing else down with it).  A replica's group may itself be a
+sub-mesh (``tp`` > 1) when the model is tensor-sharded *within* the
+replica — the "TensorFlow: A system for large-scale machine learning"
+production shape (PAPERS.md): replicate across groups, shard within
+one.
+
+These helpers are pure list/shape math over whatever ``jax.devices()``
+returns (or any explicit device list — tests pass plain objects), so
+placement policy is decided and testable without touching a backend:
+
+- :func:`replica_groups` — split a device list into N disjoint,
+  contiguous groups of ``tp`` devices each (contiguous indices ride
+  ICI neighbors on real toruses, mirroring ``make_mesh``'s axis-order
+  advice).  With fewer devices than replicas ask for,
+  ``oversubscribe=True`` shares devices round-robin — the CPU/test
+  topology, where replicas are logical (scheduling + failure-isolation
+  units) rather than physical.
+- :func:`replica_mesh` — a per-replica (dp=1, tp) sub-``Mesh`` over
+  one group, for tensor-sharded execution inside the replica.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["replica_groups", "replica_mesh"]
+
+
+def replica_groups(n_replicas, devices=None, tp=1, oversubscribe=None):
+    """Split ``devices`` into ``n_replicas`` disjoint groups of ``tp``.
+
+    Returns a list of ``n_replicas`` tuples of devices.  ``devices``
+    defaults to ``jax.devices()``.  Groups are contiguous slices of
+    the device order (torus-neighbor-friendly) and strictly disjoint
+    when the device count covers ``n_replicas * tp``.
+
+    ``oversubscribe`` controls the under-provisioned case (fewer than
+    ``n_replicas * tp`` devices): ``True`` assigns groups round-robin
+    so several logical replicas share physical devices; ``False``
+    raises; ``None`` (default) oversubscribes only when the whole pool
+    is a single device — the CPU test topology — and raises otherwise,
+    so a real mesh never silently loses replica fault isolation.
+    """
+    n_replicas = int(n_replicas)
+    tp = int(tp)
+    if n_replicas < 1:
+        raise MXNetError(
+            f"replica_groups: n_replicas must be >= 1, got {n_replicas}")
+    if tp < 1:
+        raise MXNetError(f"replica_groups: tp must be >= 1, got {tp}")
+    if devices is None:
+        import jax
+        devices = jax.devices()
+    devices = list(devices)
+    need = n_replicas * tp
+    if len(devices) < need:
+        if oversubscribe is None:
+            oversubscribe = len(devices) == 1
+        if not oversubscribe:
+            raise MXNetError(
+                f"replica_groups: {n_replicas} replica(s) x tp={tp} "
+                f"need {need} devices, only {len(devices)} available — "
+                f"shrink the replica count, or pass oversubscribe=True "
+                f"to share devices (logical replicas lose physical "
+                f"fault isolation)")
+        return [tuple(devices[(r * tp + i) % len(devices)]
+                      for i in range(tp))
+                for r in range(n_replicas)]
+    return [tuple(devices[r * tp:(r + 1) * tp])
+            for r in range(n_replicas)]
+
+
+def replica_mesh(group, axis_name="tp"):
+    """A (1, tp) sub-``Mesh`` over ONE replica's device group, axes
+    ``("dp", axis_name)`` — the mesh a tensor-sharded model executes
+    against *inside* its replica.  Sharding rules written for the
+    training mesh's ``tp`` axis apply unchanged."""
+    import numpy as np
+    from jax.sharding import Mesh
+    group = tuple(group)
+    if not group:
+        raise MXNetError("replica_mesh: empty device group")
+    arr = np.array(group, dtype=object).reshape(1, len(group))
+    return Mesh(arr, axis_names=("dp", axis_name))

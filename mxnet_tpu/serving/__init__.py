@@ -31,6 +31,14 @@ the missing serving tier over it:
   speculative decoding (a draft model proposes k tokens, the target
   verifies all k+1 in ONE ``ragged_paged_verify`` call, greedy
   acceptance exact);
+- :class:`ReplicaSet` — multi-replica serving on the device mesh
+  (docs/serving.md §10): N data-parallel replicas of one model version
+  on disjoint device groups, each with its own program cache / decode
+  engine / KV pool; heartbeat + consecutive-failure health checks,
+  least-loaded routing among HEALTHY replicas only, failover under the
+  request's original deadline (byte-identical results), and
+  prewarm-gated rolling add/remove/rejoin — active whenever
+  ``ServingConfig(replicas=N > 1)`` (``MXNET_SERVING_REPLICAS``);
 - the resilience layer (docs/serving.md §8): end-to-end request
   deadlines (:class:`DeadlineExceededError` instead of silent hangs),
   bounded jittered retries for transient execute failures,
@@ -52,9 +60,10 @@ from .config import ServingConfig
 from .decode import DecodeEngine, GenerateRequest, PagedLMAdapter
 from .kv_cache import DeviceKVPool, PageAllocator, PageGeometry, \
     PrefixCache
+from .replica import Replica, ReplicaSet
 from .repository import ModelEntry, ModelRepository
 from .resilience import (CircuitBreaker, CircuitOpenError, Deadline,
-                         DeadlineExceededError)
+                         DeadlineExceededError, honor_retry_after)
 from .server import ModelServer, ServerOverloadedError
 
 __all__ = ["ModelRepository", "ModelEntry", "ModelServer",
@@ -64,4 +73,5 @@ __all__ = ["ModelRepository", "ModelEntry", "ModelServer",
            "PageGeometry", "PageAllocator", "PrefixCache",
            "DeviceKVPool",
            "Deadline", "DeadlineExceededError", "CircuitBreaker",
-           "CircuitOpenError"]
+           "CircuitOpenError", "honor_retry_after",
+           "Replica", "ReplicaSet"]

@@ -111,8 +111,13 @@ class DynamicBatcher:
     cache.  Stateless with respect to queuing — the ModelServer worker
     pool decides *what* to coalesce; this decides *how* it runs."""
 
-    def __init__(self, config):
+    def __init__(self, config, device=None):
         self.config = config
+        # replica placement (docs/serving.md §10): when set, programs
+        # build AND execute under jax.default_device(device) so each
+        # replica's batcher lands on its own device group; None (the
+        # default, and the whole non-replica path) changes nothing
+        self.device = device
         self._lock = engine.make_lock("serving.DynamicBatcher._lock")
         self._progs = {}            # (entry.uid, bucket) -> callable
         self._building = {}         # key -> Event (in-flight builds)
@@ -120,6 +125,16 @@ class DynamicBatcher:
         self.bucket_hits = 0        # in-memory program reused
         self.bucket_disk_hits = 0   # deserialized from the compile cache
         self.bucket_misses = 0      # freshly compiled
+
+    def _placed(self):
+        """Context placing builds/executes on this batcher's device
+        (no-op without one — fakes and the single-replica path never
+        import jax here)."""
+        import contextlib
+        if self.device is None:
+            return contextlib.nullcontext()
+        import jax
+        return jax.default_device(self.device)
 
     # ------------------------------------------------------------- cache
     def program_for(self, entry, bucket_rows):
@@ -150,7 +165,8 @@ class DynamicBatcher:
             # worker-level retry policy re-enters program_for, and the
             # waiter-wake contract below hands the build to a retrier
             _faults.inject("serving.compile")
-            prog = entry.make_program(bucket_rows)
+            with self._placed():
+                prog = entry.make_program(bucket_rows)
         except BaseException:
             # wake waiters so one of them retries as the next builder
             with self._lock:
@@ -219,7 +235,8 @@ class DynamicBatcher:
             # chaos site: device-execute fail/delay/stall — what the
             # serving retry + bisection + deadline machinery absorbs
             _faults.inject("serving.execute")
-            outs = prog(*padded)
+            with self._placed():
+                outs = prog(*padded)
             # bounded sync point: block on THIS batch (async errors
             # surface here, engine rethrow-at-sync-point contract)
             engine.sync_outputs(outs, site="serving")

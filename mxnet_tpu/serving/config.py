@@ -43,6 +43,17 @@ class ServingConfig:
     with ``spec_draft`` naming the repository entry whose decode
     model serves as the default draft.
 
+    Replica knobs (docs/serving.md §10): ``replicas`` > 1 serves each
+    model version through a :class:`~mxnet_tpu.serving.replica.
+    ReplicaSet` — N data-parallel replicas on disjoint device groups,
+    least-loaded routing among HEALTHY replicas, failover under the
+    original deadline, prewarm-gated rolling recovery.  Health policy:
+    ``replica_heartbeat_ms`` beat interval,
+    ``replica_heartbeat_window_ms`` staleness bound past which a
+    replica is unroutable, ``replica_failure_threshold`` consecutive
+    typed failures that trip its breaker without filling the windowed
+    error rate.
+
     Resilience knobs (docs/serving.md §8): ``deadline_default``
     seconds applied when a call passes no timeout (None = unbounded),
     ``retry_max`` transient-failure re-executions with
@@ -61,7 +72,10 @@ class ServingConfig:
                  retry_max=None, retry_backoff_ms=None,
                  circuit_window=None, circuit_threshold=None,
                  circuit_cooldown_ms=None, prefix_cache=None,
-                 prefix_cache_pages=None, spec_k=None, spec_draft=None):
+                 prefix_cache_pages=None, spec_k=None, spec_draft=None,
+                 replicas=None, replica_heartbeat_ms=None,
+                 replica_heartbeat_window_ms=None,
+                 replica_failure_threshold=None):
         def pick(value, env, typ=int):
             if value is None:
                 value = get_env(env, typ=typ)
@@ -111,6 +125,17 @@ class ServingConfig:
         self.circuit_cooldown_ms = pick(
             circuit_cooldown_ms, "MXNET_SERVING_CIRCUIT_COOLDOWN_MS",
             typ=float)
+        # replica layer (docs/serving.md §10)
+        self.replicas = pick(replicas, "MXNET_SERVING_REPLICAS")
+        self.replica_heartbeat_ms = pick(
+            replica_heartbeat_ms, "MXNET_SERVING_REPLICA_HEARTBEAT_MS",
+            typ=float)
+        self.replica_heartbeat_window_ms = pick(
+            replica_heartbeat_window_ms,
+            "MXNET_SERVING_REPLICA_HEARTBEAT_WINDOW_MS", typ=float)
+        self.replica_failure_threshold = pick(
+            replica_failure_threshold,
+            "MXNET_SERVING_REPLICA_FAILURE_THRESHOLD")
 
         if self.max_batch_size < 1:
             raise MXNetError("ServingConfig: max_batch_size must be >= 1")
@@ -170,6 +195,21 @@ class ServingConfig:
         if self.circuit_cooldown_ms < 0:
             raise MXNetError(
                 "ServingConfig: circuit_cooldown_ms must be >= 0")
+        if self.replicas < 1:
+            raise MXNetError("ServingConfig: replicas must be >= 1")
+        if self.replica_heartbeat_ms <= 0:
+            raise MXNetError(
+                "ServingConfig: replica_heartbeat_ms must be > 0")
+        if self.replica_heartbeat_window_ms <= self.replica_heartbeat_ms:
+            raise MXNetError(
+                f"ServingConfig: replica_heartbeat_window_ms "
+                f"({self.replica_heartbeat_window_ms}) must exceed the "
+                f"beat interval ({self.replica_heartbeat_ms}) — a "
+                f"window under one beat marks every replica dead")
+        if self.replica_failure_threshold < 0:
+            raise MXNetError(
+                "ServingConfig: replica_failure_threshold must be >= 0 "
+                "(0 = windowed error rate only)")
 
     def __repr__(self):
         return (f"ServingConfig(max_batch_size={self.max_batch_size}, "
@@ -191,4 +231,10 @@ class ServingConfig:
                 f"retry_backoff_ms={self.retry_backoff_ms}, "
                 f"circuit_window={self.circuit_window}, "
                 f"circuit_threshold={self.circuit_threshold}, "
-                f"circuit_cooldown_ms={self.circuit_cooldown_ms})")
+                f"circuit_cooldown_ms={self.circuit_cooldown_ms}, "
+                f"replicas={self.replicas}, "
+                f"replica_heartbeat_ms={self.replica_heartbeat_ms}, "
+                f"replica_heartbeat_window_ms="
+                f"{self.replica_heartbeat_window_ms}, "
+                f"replica_failure_threshold="
+                f"{self.replica_failure_threshold})")

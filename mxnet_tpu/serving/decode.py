@@ -207,12 +207,18 @@ class DecodeEngine:
     """
 
     def __init__(self, model, config=None, model_name="decoder",
-                 autostart=False, draft=None):
+                 autostart=False, draft=None, fault_scope="decode"):
         from .config import ServingConfig
         from .kv_cache import PrefixCache
         self.model = model
         self.config = config or ServingConfig()
         self.model_name = model_name
+        # fault-injection site prefix: "decode" for a plain engine
+        # (sites decode.prefill / decode.step / ...), scoped to
+        # "replica.<rid>.decode" for a replica-owned engine so a chaos
+        # plan can kill ONE replica's step loop deterministically
+        # (docs/serving.md §10)
+        self.fault_scope = str(fault_scope)
         max_context = int(model.max_context)
         self.geometry = PageGeometry(
             page_size=self.config.decode_page_size,
@@ -609,7 +615,7 @@ class DecodeEngine:
         if cache is None or seq.no_cache or L < ps:
             return [], None, 0, False
         try:
-            _faults.inject("decode.prefix_lookup")
+            _faults.inject(self.fault_scope + ".prefix_lookup")
             pages = cache.lookup(seq.prompt)
         except Exception as e:      # noqa: BLE001 — degrade to a miss
             _LOG.warning(
@@ -791,7 +797,7 @@ class DecodeEngine:
             tokens[0, :L] = seq.prompt
 
             def call():
-                _faults.inject("decode.prefill")
+                _faults.inject(self.fault_scope + ".prefill")
                 return np.asarray(self.model.prefill(
                     tokens, np.int32(L),
                     self.allocator.block_table(seq.seq_id)))
@@ -847,7 +853,7 @@ class DecodeEngine:
                     if self.spec_k and not seq.no_spec:
                         self.draft.copy_page(src, dst)
                     seq.cow = None
-                _faults.inject("decode.prefill")
+                _faults.inject(self.fault_scope + ".prefill")
                 return np.asarray(self.model.verify(
                     tokens, np.int32(start), np.int32(length),
                     block_table))
@@ -967,7 +973,7 @@ class DecodeEngine:
                 seq.seq_id)
 
         def call():
-            _faults.inject("decode.step")
+            _faults.inject(self.fault_scope + ".step")
             return np.asarray(self.model.decode_step(
                 tokens, positions, block_tables))
 
@@ -1206,7 +1212,7 @@ class DecodeEngine:
             block_tables[seq.slot] = tables[seq.seq_id]
 
         def call():
-            _faults.inject("decode.verify")
+            _faults.inject(self.fault_scope + ".verify")
             return np.asarray(self.model.verify_batch(
                 tokens, starts, lengths, block_tables))
 
@@ -1249,7 +1255,7 @@ class DecodeEngine:
             length = len(window)
 
             def call():
-                _faults.inject("decode.verify")
+                _faults.inject(self.fault_scope + ".verify")
                 return np.asarray(self.model.verify(
                     tokens, np.int32(seq.context_len),
                     np.int32(length), block_table))

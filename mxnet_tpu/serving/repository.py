@@ -28,7 +28,8 @@ import numpy as np
 from .. import engine, faults as _faults
 from ..base import MXNetError
 
-__all__ = ["ModelEntry", "ModelRepository"]
+__all__ = ["ModelEntry", "ModelRepository", "prewarm_buckets",
+           "synth_inputs"]
 
 _LOG = logging.getLogger("mxnet_tpu")
 
@@ -47,7 +48,8 @@ class ModelEntry:
 
     def __init__(self, name, version, kind, signature, dynamic_batch,
                  make_program, fixed_batch=None, decode_model=None,
-                 decode_meta=None, quantization=None, draft_model=None):
+                 decode_meta=None, quantization=None, draft_model=None,
+                 decode_model_factory=None, draft_model_factory=None):
         self.name = name
         self.version = version
         # "stablehlo" | "block" | "function" | "decoder"
@@ -64,6 +66,13 @@ class ModelEntry:
         # speculative-decoding draft attached to this decoder entry
         # (docs/serving.md §9); the entry's engine owns its binding
         self.draft_model = draft_model
+        # replica serving (docs/serving.md §10): callables yielding a
+        # FRESH decode model / draft per replica — each replica's
+        # engine owns its model's device state (KV pool binding), so N
+        # replicas cannot share one stateful model object.  None and
+        # the replica layer clones PagedLMAdapters itself.
+        self.decode_model_factory = decode_model_factory
+        self.draft_model_factory = draft_model_factory
         # manifest v4 quantization block for quantized artifacts
         # (mode, per-tensor scales, calibration error) — None for f32
         self.quantization = quantization
@@ -94,6 +103,37 @@ def _as_tuple(out):
     if isinstance(out, list):
         return tuple(out)
     return (out,)
+
+
+def prewarm_buckets(entry, max_batch_size):
+    """The shape buckets a prewarm of ``entry`` must cover — ONE
+    definition shared by :meth:`ModelRepository.prewarm` and the
+    replica layer's per-replica prewarm (docs/serving.md §10), so a
+    replica can never rejoin "warm" against a different bucket set
+    than the dispatcher will use."""
+    from .batcher import bucket_set
+    if entry.dynamic_batch:
+        return bucket_set(max_batch_size)
+    if entry.fixed_batch is None:
+        raise MXNetError(
+            f"prewarm({entry.name!r}): static signature without a "
+            f"batch dimension cannot be batch-served")
+    return [entry.fixed_batch]
+
+
+def synth_inputs(entry, rows):
+    """Zero-filled inputs matching ``entry``'s signature at ``rows``
+    batch rows — the prewarm payload that forces an XLA compile (or
+    cached-executable load) without real data."""
+    from ..deploy import _resolve_dtype
+    inputs = []
+    for spec in entry.signature:
+        shape = [1 if d is None else d for d in spec["shape"]]
+        if entry.dynamic_batch and shape:
+            shape[0] = rows
+        inputs.append(np.zeros(tuple(shape),
+                               _resolve_dtype(spec["dtype"])))
+    return inputs
 
 
 def _block_signature(example_inputs, dynamic_batch):
@@ -284,7 +324,8 @@ class ModelRepository:
         return self._register(entry, activate)
 
     def add_decoder(self, name, model, version=None, activate=True,
-                    attention_impl=None, eos_id=None, draft=None):
+                    attention_impl=None, eos_id=None, draft=None,
+                    model_factory=None, draft_factory=None):
         """Register an autoregressive decode model served through
         ``ModelServer.generate()`` (docs/serving.md §6).
 
@@ -305,7 +346,15 @@ class ModelRepository:
         tokens per sequence per round and the target verify them in
         one call (docs/serving.md §9).  The draft gets its OWN adapter
         (its pool/programs bind to this entry's engine), loaded and
-        compile-cached through the same machinery as the target."""
+        compile-cached through the same machinery as the target.
+
+        ``model_factory`` / ``draft_factory`` (callables returning a
+        fresh decode-model / draft object) serve multi-replica
+        deployments (docs/serving.md §10): each replica's engine needs
+        its OWN model instance because the model binds replica-local
+        device state (KV pool, compiled programs).  Unneeded for
+        ``TransformerDecoderLM`` — the replica layer clones its
+        adapter automatically."""
         from .decode import as_decode_model
         adapter = as_decode_model(model, attention_impl=attention_impl,
                                   eos_id=eos_id)
@@ -320,9 +369,19 @@ class ModelRepository:
                 f"model {name!r} is a decoder entry — it serves "
                 f"autoregressive generate(), not predict()")
 
+        def wrap_factory(factory):
+            if factory is None:
+                return None
+            return lambda: as_decode_model(
+                factory(), attention_impl=attention_impl, eos_id=eos_id)
+
         entry = ModelEntry(name, version, "decoder", sig, False,
                            make_program, decode_model=adapter,
-                           draft_model=draft_adapter)
+                           draft_model=draft_adapter,
+                           decode_model_factory=wrap_factory(
+                               model_factory),
+                           draft_model_factory=wrap_factory(
+                               draft_factory))
         return self._register(entry, activate)
 
     def add_function(self, name, fn, signature, version=None,
@@ -394,19 +453,10 @@ class ModelRepository:
         (buckets warmed, compile/disk-hit counts from the batcher
         delta).
         """
-        from ..deploy import _resolve_dtype
-        from .batcher import bucket_set
         entry = self._resolve(name, version)
         if max_batch_size is None:
             max_batch_size = batcher.config.max_batch_size
-        if entry.dynamic_batch:
-            buckets = bucket_set(max_batch_size)
-        else:
-            if entry.fixed_batch is None:
-                raise MXNetError(
-                    f"prewarm({name!r}): static signature without a "
-                    f"batch dimension cannot be batch-served")
-            buckets = [entry.fixed_batch]
+        buckets = prewarm_buckets(entry, max_batch_size)
         compiled = disk_hits = 0
         for rows in buckets:
             # attribute builds to THIS entry (the global batcher
@@ -422,13 +472,7 @@ class ModelRepository:
             # force the XLA compile (or executable load) NOW: a
             # jit-backed program otherwise compiles lazily on the first
             # real request — exactly the cliff prewarm exists to remove
-            inputs = []
-            for spec in entry.signature:
-                shape = [1 if d is None else d for d in spec["shape"]]
-                if entry.dynamic_batch and shape:
-                    shape[0] = rows
-                inputs.append(np.zeros(tuple(shape),
-                                       _resolve_dtype(spec["dtype"])))
+            inputs = synth_inputs(entry, rows)
             try:
                 outs = prog(*inputs)
                 engine.sync_outputs(

@@ -41,7 +41,7 @@ from ..base import MXNetError
 
 __all__ = ["Deadline", "DeadlineExceededError", "ServerOverloadedError",
            "CircuitOpenError", "CircuitBreaker", "is_transient",
-           "retry_call"]
+           "retry_call", "honor_retry_after"]
 
 
 class ServerOverloadedError(MXNetError):
@@ -160,6 +160,50 @@ def retry_call(fn, *, retries, backoff_ms, deadline=None, rng=None,
                 time.sleep(delay)
 
 
+def honor_retry_after(fn, *, attempts=4, deadline=None, rng=None,
+                      on_backoff=None):
+    """Client-side twin of the server's ``retry_after_ms`` hint: run
+    ``fn()``, and on :class:`ServerOverloadedError` (including
+    :class:`CircuitOpenError`) sleep the server's hint **scaled by a
+    jitter factor of U[1.0, 1.5)** before retrying, up to ``attempts``
+    re-executions.
+
+    The jitter is the point.  A shed storm hits every closed-loop
+    client at once; clients that all sleep exactly ``retry_after_ms``
+    come back as one synchronized wave and shed again — the hint alone
+    *causes* the retry storm it exists to prevent.  Multiplicative
+    jitter spreads the wave, and honoring the server's hint (instead of
+    a client-invented backoff) keeps the retry rate matched to what the
+    server said it can absorb.
+
+    ``deadline`` (a :class:`Deadline`) bounds the whole loop: a sleep
+    that cannot fit in the remaining budget re-raises the overload
+    error instead of burning the budget asleep.  ``on_backoff(attempt,
+    delay_s, exc)`` observes each sleep (bench/client metrics).  Errors
+    other than the overload family propagate immediately — this helper
+    honors backpressure; it is not a general retry policy
+    (:func:`retry_call` is).
+    """
+    rng = rng or random
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except ServerOverloadedError as e:
+            if attempt >= attempts:
+                raise
+            delay = (max(0, e.retry_after_ms) / 1e3) \
+                * (1.0 + rng.random() / 2.0)
+            if deadline is not None and deadline.t is not None \
+                    and deadline.remaining() <= delay:
+                raise
+            attempt += 1
+            if on_backoff is not None:
+                on_backoff(attempt, delay, e)
+            if delay > 0:
+                time.sleep(delay)
+
+
 # ---------------------------------------------------------------------------
 # circuit breaker
 # ---------------------------------------------------------------------------
@@ -182,21 +226,34 @@ class CircuitBreaker:
       admissions shed.  Probe success -> CLOSED (window cleared),
       probe failure -> OPEN for another cooldown.
 
-    ``window <= 0`` disables the breaker (admit() is a no-op).
-    Outcome recording is the caller's job and should count EXECUTE
-    outcomes only — sheds, deadline expiries, and validation rejects
-    say nothing about the model version's health.
+    ``consecutive`` (0 = off) adds a second, faster trip rule on top of
+    the windowed error rate: N consecutive failures open the circuit
+    even before the window fills.  The replica layer (docs/serving.md
+    §10) uses it as its dead-replica detector — a replica that fails
+    every request since some instant is *down*, and waiting for a
+    20-outcome window to fill against a corpse just queues more
+    casualties.  A single success resets the run.
+
+    ``window <= 0`` disables the windowed error-rate rule; the breaker
+    as a whole (admit/record no-ops) is off only when ``consecutive``
+    is ALSO 0 — a replica layer running with the windowed breaker
+    disabled still needs its dead-replica fast trip.  Outcome
+    recording is the caller's job and should count EXECUTE outcomes
+    only — sheds, deadline expiries, and validation rejects say
+    nothing about the model version's health.
     """
 
     def __init__(self, window, threshold, cooldown_ms, model="?",
-                 version=None):
+                 version=None, consecutive=0):
         self.window = int(window)
         self.threshold = float(threshold)
         self.cooldown_ms = float(cooldown_ms)
+        self.consecutive = int(consecutive or 0)
         self.model = model
         self.version = version
         self._lock = engine.make_lock("serving.CircuitBreaker._lock")
         self._outcomes = deque(maxlen=max(1, self.window))
+        self._consec_failures = 0       # current run of failures
         self._state = CLOSED
         self._opened_at = None          # monotonic of last trip
         self._probing = False
@@ -218,6 +275,11 @@ class CircuitBreaker:
         with self._lock:
             return self._state
 
+    @property
+    def _disabled(self):
+        # mxlint: disable=lock-discipline (reads two immutable ints)
+        return self.window <= 0 and self.consecutive <= 0
+
     # ---------------------------------------------------------- admission
     def admit(self):
         """Gate one admission.  Raises :class:`CircuitOpenError` when
@@ -226,7 +288,7 @@ class CircuitBreaker:
         its outcome via :meth:`record` or the breaker stays stuck in
         HALF_OPEN — record() is called for every execute outcome, so
         the existing bookkeeping covers it)."""
-        if self.window <= 0:
+        if self._disabled:
             return False
         with self._lock:
             if self._state == CLOSED:
@@ -275,7 +337,7 @@ class CircuitBreaker:
         """Record one EXECUTE outcome.  Returns the state after the
         update so callers can fire incident dumps on a trip without
         re-locking."""
-        if self.window <= 0:
+        if self._disabled:
             return CLOSED
         tripped = False
         with self._lock:
@@ -284,6 +346,7 @@ class CircuitBreaker:
                 if ok:
                     self._state = CLOSED
                     self._outcomes.clear()
+                    self._consec_failures = 0
                     self._stats["closed"] += 1
                 else:
                     self._state = OPEN
@@ -294,14 +357,23 @@ class CircuitBreaker:
                 state = self._state
             elif self._state == CLOSED:
                 self._outcomes.append(bool(ok))
+                self._consec_failures = 0 if ok \
+                    else self._consec_failures + 1
+                trip = False
                 if len(self._outcomes) == self.window:
                     errs = sum(1 for o in self._outcomes if not o)
-                    if errs / self.window >= self.threshold:
-                        self._state = OPEN
-                        self._opened_at = time.monotonic()
-                        self._stats["opened"] += 1
-                        tripped = True
-                        self._publish()
+                    trip = errs / self.window >= self.threshold
+                # the fast dead-backend rule: N-in-a-row failures open
+                # the circuit without waiting for the window to fill
+                if self.consecutive \
+                        and self._consec_failures >= self.consecutive:
+                    trip = True
+                if trip:
+                    self._state = OPEN
+                    self._opened_at = time.monotonic()
+                    self._stats["opened"] += 1
+                    tripped = True
+                    self._publish()
                 state = self._state
             else:
                 # OPEN: a straggler from before the trip — ignore
@@ -321,6 +393,8 @@ class CircuitBreaker:
                     "state": self._state, "window": self.window,
                     "threshold": self.threshold,
                     "cooldown_ms": self.cooldown_ms,
+                    "consecutive": self.consecutive,
+                    "consec_failures": self._consec_failures,
                     "recent_errors": sum(
                         1 for ok in self._outcomes if not ok),
                     "recent": len(self._outcomes),

@@ -95,6 +95,14 @@ class ModelServer:
         # racers must not both run setup() on one shared adapter
         self._decoder_build = engine.make_lock(
             "serving.ModelServer._decoder_build")
+        # replica layer (docs/serving.md §10): with config.replicas > 1
+        # each entry serves through a lazily built ReplicaSet instead
+        # of the shared batcher / single decode engine.  Same build
+        # discipline as decoders: construction (N prewarms) runs under
+        # its own lock, never under _cond
+        self._replica_sets = OrderedDict()  # entry.uid -> ReplicaSet
+        self._replica_build = engine.make_lock(
+            "serving.ModelServer._replica_build")
         self._depth = 0
         self._inflight = 0              # admitted, popped, not finished
         self._started = False
@@ -179,22 +187,31 @@ class ModelServer:
         alive = [t for t in self._workers if t.is_alive()]
         if alive:
             return False
-        # decode engines go down with the worker pool; outstanding
-        # generate() calls fail with finish_reason="stopped"
+        # decode engines and replica sets go down with the worker pool;
+        # outstanding generate() calls fail with finish_reason="stopped"
         with self._cond:
             decoders = dict(self._decoders)
             self._decoders.clear()
+            rsets = dict(self._replica_sets)
+            self._replica_sets.clear()
         stuck = {}
         for uid, eng in decoders.items():
             if not eng.stop(timeout=None if deadline is None
                             else max(0.0, deadline - time.monotonic())):
                 stuck[uid] = eng
-        if stuck:
+        stuck_sets = {}
+        for uid, rset in rsets.items():
+            if not rset.stop(timeout=None if deadline is None
+                             else max(0.0,
+                                      deadline - time.monotonic())):
+                stuck_sets[uid] = rset
+        if stuck or stuck_sets:
             # same contract as a stuck worker: keep the references so a
             # later stop() can finish the job, stay in the stopping
             # state, report failure — never leak a live step loop
             with self._cond:
                 self._decoders.update(stuck)
+                self._replica_sets.update(stuck_sets)
             return False
         with self._cond:
             self._started = False
@@ -208,15 +225,19 @@ class ModelServer:
         """Repository unload hook: drop the batcher's cached programs,
         the version's circuit breaker (a retired uid's error history
         must not pin memory across hot-swap churn), AND stop/drop the
-        entry's decode engine (its KV pool must not pin device memory
-        for a retired version)."""
+        entry's decode engine and replica set (their KV pools and
+        per-replica program caches must not pin device memory for a
+        retired version)."""
         self.batcher.evict(entry)
         with self._cond:
             eng = self._decoders.pop(entry.uid, None)
+            rset = self._replica_sets.pop(entry.uid, None)
             self._breakers.pop(entry.uid, None)
             self._retired_uids.add(entry.uid)
         if eng is not None:
             eng.stop()
+        if rset is not None:
+            rset.stop()
 
     def __enter__(self):
         return self.start()
@@ -410,6 +431,86 @@ class ModelServer:
             raise req.error
         return req.result if len(req.result) > 1 else req.result[0]
 
+    # ------------------------------------------------------------- replicas
+    def _replicated(self, entry):
+        """Whether this entry serves through a ReplicaSet.  The
+        single-replica configuration keeps the pre-replica path
+        byte-for-byte (shared batcher / one decode engine), so
+        replicas=1 cannot regress anything."""
+        return self.config.replicas > 1
+
+    def _replica_devices(self, entry):
+        """Best-effort device placement for one entry's replicas:
+        disjoint groups of the visible devices when they cover the
+        replica count, shared devices otherwise (the CPU/test
+        topology).  Function entries get no placement — there is no
+        device work to place."""
+        if entry.kind in ("function", "decoder"):
+            return None
+        try:
+            from ..parallel.placement import replica_groups
+            return replica_groups(self.config.replicas,
+                                  oversubscribe=None)
+        except Exception as e:      # noqa: BLE001 — placement optional
+            _LOG.warning(
+                "serving: replica placement unavailable for %s (%s); "
+                "replicas share default placement", entry.name, e)
+            return None
+
+    def _replica_set(self, entry):
+        """The (lazily built) ReplicaSet of one entry uid.  Build —
+        which prewarms every replica — runs under the dedicated build
+        lock so admissions never stall behind it, with the same
+        start-vs-stop re-check discipline as decode engines."""
+        from .replica import ReplicaSet
+        not_accepting = MXNetError(
+            "ModelServer is not accepting requests "
+            "(not started, or shutting down)")
+        with self._cond:
+            if not self._started or self._stopping:
+                raise not_accepting
+            rset = self._replica_sets.get(entry.uid)
+        if rset is not None:
+            return rset
+        with self._replica_build:
+            with self._cond:
+                if not self._started or self._stopping:
+                    raise not_accepting
+                rset = self._replica_sets.get(entry.uid)
+            if rset is not None:
+                return rset
+            fresh = ReplicaSet(entry, self.config,
+                               devices=self._replica_devices(entry))
+            reject = False
+            with self._cond:
+                if not self._started or self._stopping \
+                        or entry.uid in self._retired_uids:
+                    reject = True
+                else:
+                    self._replica_sets[entry.uid] = fresh
+            if reject:
+                fresh.stop()
+                raise not_accepting
+            # close the build-vs-unload race the decode engines also
+            # guard: an unload that popped the map between our insert
+            # and here has already "stopped" a set it never saw — stop
+            # the orphan and reject rather than leak its threads
+            with self._cond:
+                tracked = self._replica_sets.get(entry.uid) is fresh
+            if not tracked:
+                fresh.stop()
+                raise not_accepting
+            return fresh
+
+    def _execute_batch(self, entry, inputs, deadline):
+        """One batch execution: through the entry's ReplicaSet
+        (least-loaded healthy replica, deadline-preserving failover)
+        when replicas are configured, else the shared batcher."""
+        if self._replicated(entry):
+            return self._replica_set(entry).run_batch(
+                inputs, deadline=deadline)
+        return self.batcher.run_batch(entry, inputs)
+
     # ------------------------------------------------------------- generate
     def _decoder_engine(self, entry):
         """The (lazily created) decode engine of a decoder entry.  One
@@ -525,6 +626,19 @@ class ModelServer:
             if timeout is None:
                 timeout = self.config.deadline_default
             self._admit_circuit(entry)
+            if self._replicated(entry):
+                # replica path (docs/serving.md §10): the set routes
+                # to the least-loaded healthy replica's engine and
+                # fails a dead replica's sequence over to a sibling as
+                # a fresh request under this SAME deadline.  Health
+                # lives in the per-replica breakers — the version-level
+                # breaker stays admission-only here (a version is as
+                # healthy as its replicas; a fully-dark set sheds as
+                # ServerOverloadedError from the router).
+                return self._replica_set(entry).generate(
+                    prompt, max_new_tokens=max_new_tokens,
+                    eos_id=eos_id, on_token=on_token, timeout=timeout,
+                    _trace_ctx=root.context)
             eng = self._decoder_engine(entry)
             # pass the (already made) sampling decision down: a
             # sampled-out request must NOT re-enter head sampling in
@@ -547,10 +661,14 @@ class ModelServer:
     def decode_stats(self, model):
         """The decode engine's scheduler/pool counters for ``model``
         (steps, generated tokens, admissions/evictions, KV-pool
-        occupancy, compiled programs vs bound)."""
+        occupancy, compiled programs vs bound).  With replicas
+        configured, one entry per replica id."""
         entry = self.repository.get(model)
         with self._cond:
             eng = self._decoders.get(entry.uid)
+            rset = self._replica_sets.get(entry.uid)
+        if rset is not None:
+            return rset.decode_stats()
         if eng is None:
             raise MXNetError(
                 f"decode_stats({model!r}): no decode engine yet "
@@ -572,7 +690,18 @@ class ModelServer:
         every bucket's program is already in the batcher's memory cache
         (deserialized from the persistent compile cache when
         ``MXNET_COMPILE_CACHE_DIR`` is set, freshly compiled otherwise).
-        Returns the repository's summary dict."""
+        Returns the repository's summary dict.
+
+        With replicas configured, prewarming builds the whole
+        ReplicaSet instead — EVERY replica's program cache is built
+        and executed before any of them is routable, so the staged
+        version's swap admits traffic against N warm replicas."""
+        entry = self.repository._resolve(model, version)
+        if self._replicated(entry):
+            rset = self._replica_set(entry)
+            return {"model": model, "version": entry.version,
+                    "replicas": rset.replicas(),
+                    "stats": rset.stats()}
         return self.repository.prewarm(
             model, version, batcher=self.batcher,
             max_batch_size=self.config.max_batch_size)
@@ -589,6 +718,20 @@ class ModelServer:
         out["bucket_disk_hits"] = self.batcher.bucket_disk_hits
         out["bucket_misses"] = self.batcher.bucket_misses
         out["programs"] = self.batcher.programs()
+        with self._cond:
+            rsets = dict(self._replica_sets)
+        if rsets:
+            # keyed by model name; when TWO versions of one model are
+            # live (staged prewarm during a hot-swap window) the later
+            # uid disambiguates as "name@vN" instead of silently
+            # shadowing the serving version's counters
+            sets = {}
+            for rset in rsets.values():
+                key = rset.name
+                if key in sets:
+                    key = f"{rset.name}@v{rset.entry.version}"
+                sets[key] = rset.stats()
+            out["replica_sets"] = sets
         return out
 
     def debug_state(self):
@@ -610,6 +753,7 @@ class ModelServer:
                     "head_age_s": None if not q
                     else round(now - q[0].t_enq, 6)})
             decoders = dict(self._decoders)
+            rsets = dict(self._replica_sets)
             state = {
                 "server": self.name,
                 "started": self._started,
@@ -625,6 +769,8 @@ class ModelServer:
         # only after _cond is released (one-way acquisition order)
         state["decoders"] = {str(uid): eng.debug_state()
                              for uid, eng in decoders.items()}
+        state["replica_sets"] = {str(uid): rset.debug_state()
+                                 for uid, rset in rsets.items()}
         state["circuits"] = {str(uid): br.debug_state()
                              for uid, br in breakers.items()}
         state["batcher"] = {
@@ -748,13 +894,14 @@ class ModelServer:
         ``(succeeded_requests, [(failed_request, error), ...])``;
         results are assigned onto the requests, events are NOT set
         (the worker publishes outcomes after breaker accounting)."""
+        group_deadline = self._group_deadline(reqs)
         try:
             results = retry_call(
-                lambda: self.batcher.run_batch(
-                    entry, [r.inputs for r in reqs]),
+                lambda: self._execute_batch(
+                    entry, [r.inputs for r in reqs], group_deadline),
                 retries=self.config.retry_max,
                 backoff_ms=self.config.retry_backoff_ms,
-                deadline=self._group_deadline(reqs),
+                deadline=group_deadline,
                 rng=self._retry_rng,
                 on_retry=lambda n, e: self._note_retry(entry, n, e))
         except Exception as e:      # noqa: BLE001 — isolate the poison

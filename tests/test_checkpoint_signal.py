@@ -1,0 +1,331 @@
+"""CheckpointManager crash safety: the atomic last-step marker and the
+SIGTERM/preemption save hook (parallel/checkpoint.py).
+
+A fake orbax backend (plain JSON files + an explicit "durable" switch)
+drives the torn-save scenarios deterministically: a kill mid-async-save
+must never leave the latest-pointer at a checkpoint that was not yet
+durable, and the signal hook must produce one synchronous save + marker
+commit before chaining to the previous handler.
+"""
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import mxnet_tpu.parallel.checkpoint as cp
+from mxnet_tpu.base import MXNetError
+
+
+class FakeManager:
+    """Mimics orbax.checkpoint.CheckpointManager closely enough for the
+    marker/signal logic: save() records the step IMMEDIATELY (the torn
+    window — the directory exists before the data is durable);
+    wait_until_finished() makes pending saves durable."""
+
+    def __init__(self, directory, options=None):
+        self.dir = str(directory)
+        os.makedirs(self.dir, exist_ok=True)
+        self.pending = []               # saved, not yet durable
+        self.waits = 0
+        self.closed = False
+
+    def _steps_file(self):
+        return os.path.join(self.dir, "steps.json")
+
+    def _durable_steps(self):
+        try:
+            with open(self._steps_file()) as f:
+                return {int(k): v for k, v in json.load(f).items()}
+        except OSError:
+            return {}
+
+    def save(self, step, args=None):
+        self.pending.append((int(step), args.state))
+
+    def wait_until_finished(self):
+        self.waits += 1
+        steps = self._durable_steps()
+        for step, state in self.pending:
+            steps[step] = state
+        self.pending = []
+        with open(self._steps_file(), "w") as f:
+            json.dump({str(k): v for k, v in steps.items()}, f)
+
+    def latest_step(self):
+        steps = set(self._durable_steps())
+        # orbax's directory listing ALSO sees in-flight (torn) steps —
+        # exactly the hazard the marker exists to close
+        steps |= {s for s, _ in self.pending}
+        return max(steps) if steps else None
+
+    def all_steps(self):
+        return sorted(self._durable_steps())
+
+    def restore(self, step, args=None):
+        steps = self._durable_steps()
+        if step not in steps:
+            raise AssertionError(
+                f"restore({step}): torn/unknown step (durable: "
+                f"{sorted(steps)})")
+        return steps[step]
+
+    def close(self):
+        self.closed = True
+
+
+class FakeArgs:
+    def __init__(self, state):
+        self.state = state
+
+
+class FakeOcp:
+    CheckpointManager = FakeManager
+
+    class CheckpointManagerOptions:
+        def __init__(self, **kw):
+            self.kw = kw
+
+    class args:                          # noqa: N801 — orbax shape
+        StandardSave = FakeArgs
+        StandardRestore = FakeArgs
+
+
+class FakeTrainer:
+    def __init__(self, val=1.0):
+        self.params = {"w": val}
+        self.opt_state = {"m": 0.0}
+
+
+@pytest.fixture
+def fake_ocp(monkeypatch):
+    monkeypatch.setattr(cp, "_ocp", lambda: FakeOcp)
+    # the fake state is a plain dict, not an array pytree
+    monkeypatch.setattr(cp, "_abstract_like", lambda tree: tree)
+    monkeypatch.setattr(
+        cp, "_trainer_state",
+        lambda t: {"params": dict(t.params),
+                   "opt_state": dict(t.opt_state)})
+    return FakeOcp
+
+
+class TestMarker:
+    def test_marker_advances_only_at_the_barrier(self, fake_ocp,
+                                                 tmp_path):
+        m = cp.CheckpointManager(tmp_path)
+        m.save(1, FakeTrainer())
+        # async save in flight: backend lists step 1, marker does not
+        assert m._mngr.latest_step() == 1
+        assert m.latest_verified_step() is None
+        m.wait()
+        assert m.latest_verified_step() == 1
+        assert m.latest_step() == 1
+
+    def test_kill_mid_save_restores_last_verified(self, fake_ocp,
+                                                  tmp_path):
+        """The regression: a kill between save(2) and its durability
+        barrier must leave restore() on step 1 — the backend's listing
+        says 2 (torn), the marker says 1 (verified)."""
+        m = cp.CheckpointManager(tmp_path)
+        m.save(1, FakeTrainer(1.0))
+        m.wait()
+        m.save(2, FakeTrainer(2.0))     # ... killed here: no wait()
+
+        # a fresh process opens the same directory
+        m2 = cp.CheckpointManager(tmp_path)
+        assert m2._mngr.latest_step() == 1      # fake: torn 2 vanished
+        t = FakeTrainer(0.0)
+        step = m2.restore(t)
+        assert step == 1
+        assert t.params["w"] == 1.0
+
+    def test_marker_beats_backend_listing(self, fake_ocp, tmp_path):
+        """Even when the torn step SURVIVES in the directory listing
+        (the real orbax hazard), the marker pins restore to the
+        verified step."""
+        m = cp.CheckpointManager(tmp_path)
+        m.save(1, FakeTrainer(1.0))
+        m.wait()
+        m.save(2, FakeTrainer(2.0))
+        # torn: the backend still lists step 2 via pending
+        assert m._mngr.latest_step() == 2
+        assert m.latest_step() == 1     # marker wins
+        t = FakeTrainer(0.0)
+        assert m.restore(t) == 1 and t.params["w"] == 1.0
+
+    def test_marker_write_is_atomic(self, fake_ocp, tmp_path):
+        m = cp.CheckpointManager(tmp_path)
+        m.save(3, FakeTrainer())
+        m.wait()
+        # no tmp leftovers; content is exactly the step
+        assert not os.path.exists(m._marker_path + ".tmp")
+        with open(m._marker_path) as f:
+            assert f.read().strip() == "3"
+        # a corrupted marker degrades to the backend listing
+        with open(m._marker_path, "w") as f:
+            f.write("garbage")
+        assert m.latest_verified_step() is None
+        assert m.latest_step() == 3
+
+
+class TestSaveOnSignal:
+    def test_sigterm_saves_then_chains(self, fake_ocp, tmp_path):
+        chained = []
+        prev = signal.signal(signal.SIGTERM,
+                             lambda s, f: chained.append(s))
+        try:
+            m = cp.CheckpointManager(tmp_path)
+            trainer = FakeTrainer(7.0)
+            m.save_on_signal(trainer, step_fn=lambda: 42)
+            signal.raise_signal(signal.SIGTERM)
+            # one synchronous save + barrier + marker, then the chain
+            assert m.latest_verified_step() == 42
+            t = FakeTrainer(0.0)
+            assert m.restore(t) == 42 and t.params["w"] == 7.0
+            assert chained == [signal.SIGTERM]
+            # uninstall restores the previous handler
+            m.remove_signal_handlers()
+            signal.raise_signal(signal.SIGTERM)
+            assert chained == [signal.SIGTERM, signal.SIGTERM]
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+
+    def test_step_fn_evaluated_at_signal_time(self, fake_ocp, tmp_path):
+        prev = signal.signal(signal.SIGTERM, lambda s, f: None)
+        try:
+            m = cp.CheckpointManager(tmp_path)
+            box = {"step": 0}
+            m.save_on_signal(FakeTrainer(), step_fn=lambda: box["step"])
+            box["step"] = 9
+            signal.raise_signal(signal.SIGTERM)
+            assert m.latest_verified_step() == 9
+            m.remove_signal_handlers()
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+
+    def test_failed_signal_save_still_chains(self, fake_ocp, tmp_path):
+        chained = []
+        prev = signal.signal(signal.SIGTERM,
+                             lambda s, f: chained.append(s))
+        try:
+            m = cp.CheckpointManager(tmp_path)
+            m.save(1, FakeTrainer(1.0))
+            m.wait()
+
+            def bad_step():
+                raise RuntimeError("no step available")
+
+            m.save_on_signal(FakeTrainer(), step_fn=bad_step)
+            signal.raise_signal(signal.SIGTERM)
+            # marker untouched, previous handler still ran
+            assert m.latest_verified_step() == 1
+            assert chained == [signal.SIGTERM]
+            m.remove_signal_handlers()
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+
+    def test_step_fn_must_be_callable(self, fake_ocp, tmp_path):
+        m = cp.CheckpointManager(tmp_path)
+        with pytest.raises(MXNetError, match="zero-arg callable"):
+            m.save_on_signal(FakeTrainer(), step_fn=5)
+
+    def test_context_exit_removes_handlers(self, fake_ocp, tmp_path):
+        prev = signal.getsignal(signal.SIGTERM)
+        with cp.CheckpointManager(tmp_path) as m:
+            m.save_on_signal(FakeTrainer(), step_fn=lambda: 1)
+            assert signal.getsignal(signal.SIGTERM) is not prev
+        assert signal.getsignal(signal.SIGTERM) is prev
+
+
+class TestRealBackendMarker:
+    """One thin end-to-end pass over the REAL orbax backend (skipped
+    when orbax is absent): the marker rides an actual async save."""
+
+    def test_roundtrip_marker(self, tmp_path):
+        pytest.importorskip("orbax.checkpoint")
+        import jax
+        import jax.numpy as jnp
+
+        class T:
+            params = {"w": jnp.ones((2,))}
+            opt_state = {"m": jnp.zeros((2,))}
+
+        t = T()
+        with cp.CheckpointManager(tmp_path, async_write=False) as m:
+            m.save(5, t)
+            m.wait()
+            assert m.latest_verified_step() == 5
+            t.params = {"w": jnp.zeros((2,))}
+            assert m.restore(t) == 5
+            np.testing.assert_array_equal(
+                np.asarray(t.params["w"]), np.ones((2,)))
+        del jax
+
+
+class TestReviewHardening:
+    def test_gc_collected_marker_falls_back_to_backend(self, fake_ocp,
+                                                       tmp_path):
+        """Review fix: max_to_keep retention may delete the marker's
+        step after later saves landed without a barrier — restore must
+        fall back to the backend's newest listed step, not wedge on
+        the vanished one."""
+        m = cp.CheckpointManager(tmp_path)
+        m.save(5, FakeTrainer(5.0))
+        m.wait()                        # marker = 5
+        m.save(6, FakeTrainer(6.0))
+        m.wait()                        # durable 5, 6; marker = 5? no: 6
+        assert m.latest_verified_step() == 6
+        # simulate retention GC of step 6 leaving only 5... instead:
+        # marker at 6, backend loses 6 and gains 7 (saved elsewhere)
+        steps = m._mngr._durable_steps()
+        state7 = steps[6]
+        del steps[6]
+        steps[7] = state7
+        with open(m._mngr._steps_file(), "w") as f:
+            import json as _json
+            _json.dump({str(k): v for k, v in steps.items()}, f)
+        # marker says 6, backend has {5, 7}: fall back to the listing
+        assert m.latest_step() == 7
+        t = FakeTrainer(0.0)
+        assert m.restore(t) == 7
+
+    def test_none_previous_disposition_still_terminates(self, fake_ocp,
+                                                        tmp_path,
+                                                        monkeypatch):
+        """Review fix: signal.signal() returns None for a C-installed
+        handler; the chain must re-raise with the default action (the
+        process terminates), never swallow the signal."""
+        actions = []
+        prev = signal.signal(signal.SIGTERM, lambda s, f: None)
+        try:
+            m = cp.CheckpointManager(tmp_path)
+            m.save_on_signal(FakeTrainer(3.0), step_fn=lambda: 7)
+            handler = signal.getsignal(signal.SIGTERM)
+            m._signal_prev[signal.SIGTERM] = None   # C-level unknown
+            monkeypatch.setattr(
+                cp._signal, "signal",
+                lambda s, h: actions.append(("reset", h)))
+            monkeypatch.setattr(
+                cp._signal, "raise_signal",
+                lambda s: actions.append(("raise", s)))
+            handler(signal.SIGTERM, None)
+            assert m.latest_verified_step() == 7    # save still ran
+            assert ("reset", signal.SIG_DFL) in actions
+            assert ("raise", signal.SIGTERM) in actions
+            m._signal_prev[signal.SIGTERM] = prev
+            m.remove_signal_handlers()
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+
+    def test_sig_ign_previous_disposition_is_respected(self, fake_ocp,
+                                                       tmp_path):
+        prev = signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        try:
+            m = cp.CheckpointManager(tmp_path)
+            m.save_on_signal(FakeTrainer(), step_fn=lambda: 1)
+            signal.raise_signal(signal.SIGTERM)     # must NOT kill us
+            assert m.latest_verified_step() == 1
+            m.remove_signal_handlers()
+        finally:
+            signal.signal(signal.SIGTERM, prev)

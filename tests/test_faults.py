@@ -851,3 +851,146 @@ class TestPrefixAndSpecChaos:
             _drive(eng, [b])            # fault spent: admitted now
         assert list(b.tokens) == list(a.tokens)
         eng.allocator.check_leaks()
+
+
+# ------------------------------------------- client-side retry-after honor
+class TestHonorRetryAfter:
+    """resilience.honor_retry_after — the client twin of the server's
+    retry_after_ms hint: jittered sleeps (U[1.0, 1.5) x hint) so a shed
+    storm's clients do not come back as one synchronized wave."""
+
+    class _Clock:
+        def __init__(self):
+            self.sleeps = []
+
+        def __call__(self, s):
+            self.sleeps.append(s)
+
+    def _shedding(self, fail_n, retry_after_ms=40):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) <= fail_n:
+                raise resilience.ServerOverloadedError(
+                    "m", retry_after_ms, "queue full")
+            return "served"
+
+        return fn, calls
+
+    def test_honors_hint_with_multiplicative_jitter(self, monkeypatch):
+        clock = self._Clock()
+        monkeypatch.setattr(resilience.time, "sleep", clock)
+        fn, calls = self._shedding(3)
+
+        class SeededRng:
+            def __init__(self):
+                import random
+                self._r = random.Random(7)
+
+            def random(self):
+                return self._r.random()
+
+        out = resilience.honor_retry_after(fn, attempts=5,
+                                           rng=SeededRng())
+        assert out == "served" and len(calls) == 4
+        assert len(clock.sleeps) == 3
+        for s in clock.sleeps:
+            # hint * U[1.0, 1.5): never shorter than the server asked,
+            # never more than 1.5x — the desynchronization band
+            assert 0.040 <= s < 0.060, clock.sleeps
+
+    def test_attempts_exhausted_reraises_typed(self, monkeypatch):
+        monkeypatch.setattr(resilience.time, "sleep", self._Clock())
+        fn, calls = self._shedding(100)
+        with pytest.raises(resilience.ServerOverloadedError):
+            resilience.honor_retry_after(fn, attempts=2)
+        assert len(calls) == 3          # initial + 2 retries
+
+    def test_circuit_open_is_honored_too(self, monkeypatch):
+        monkeypatch.setattr(resilience.time, "sleep", self._Clock())
+        calls = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) == 1:
+                raise CircuitOpenError("m", 10, "circuit open")
+            return "ok"
+
+        assert resilience.honor_retry_after(fn) == "ok"
+        assert len(calls) == 2
+
+    def test_deadline_bounds_the_sleep(self, monkeypatch):
+        clock = self._Clock()
+        monkeypatch.setattr(resilience.time, "sleep", clock)
+        fn, calls = self._shedding(5, retry_after_ms=10_000)
+        # a 10s hint cannot fit in a 50ms budget: raise, don't sleep
+        with pytest.raises(resilience.ServerOverloadedError):
+            resilience.honor_retry_after(
+                fn, attempts=5, deadline=Deadline.start(0.05))
+        assert len(calls) == 1 and not clock.sleeps
+
+    def test_other_errors_propagate_immediately(self, monkeypatch):
+        monkeypatch.setattr(resilience.time, "sleep", self._Clock())
+
+        def fn():
+            raise ValueError("not an overload")
+
+        with pytest.raises(ValueError):
+            resilience.honor_retry_after(fn)
+
+    def test_on_backoff_observer(self, monkeypatch):
+        monkeypatch.setattr(resilience.time, "sleep", self._Clock())
+        fn, _ = self._shedding(2)
+        seen = []
+        resilience.honor_retry_after(
+            fn, attempts=3,
+            on_backoff=lambda n, d, e: seen.append((n, d > 0)))
+        assert seen == [(1, True), (2, True)]
+
+    def test_end_to_end_against_a_shedding_server(self):
+        """A saturated bounded queue sheds; the honoring client backs
+        off and lands once capacity frees — zero client-side races."""
+        gate = threading.Event()
+        entered = threading.Event()
+
+        def gated(a):
+            entered.set()
+            assert gate.wait(30)
+            return a
+
+        repo = serving.ModelRepository()
+        repo.add_function("g", gated, SIG)
+        cfg = _cfg(max_batch_size=1, queue_depth=2, shed_watermark=1,
+                   num_workers=1, retry_after_ms=5)
+        x = np.ones((1, 2), np.float32)
+        with serving.ModelServer(repo, cfg) as srv:
+            t = threading.Thread(
+                target=lambda: srv.predict("g", x, timeout=30))
+            t.start()
+            assert entered.wait(30)
+            deadline = time.monotonic() + 30
+            while srv.stats()["queue_depth"] > 0:
+                assert time.monotonic() < deadline
+                time.sleep(0.005)
+            t2 = threading.Thread(
+                target=lambda: srv.predict("g", x, timeout=30))
+            t2.start()
+            deadline = time.monotonic() + 30
+            while srv.stats()["queue_depth"] < 1:
+                assert time.monotonic() < deadline
+                time.sleep(0.005)
+            # the queue is saturated: a bare call sheds typed;
+            # the honoring client retries through the release
+            with pytest.raises(resilience.ServerOverloadedError):
+                srv.predict("g", x, timeout=30)
+            released = threading.Timer(0.05, gate.set)
+            released.start()
+            out = resilience.honor_retry_after(
+                lambda: srv.predict("g", x, timeout=30),
+                attempts=20, deadline=Deadline.start(30))
+            np.testing.assert_array_equal(out, x)
+            t.join(30)
+            t2.join(30)
+            released.join()
+        assert srv.stats()["shed"] >= 1

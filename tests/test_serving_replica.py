@@ -1,0 +1,780 @@
+"""Multi-replica serving: placement, health-checked routing, failover,
+and rolling recovery (docs/serving.md §10).
+
+Everything runs on numpy fakes / function entries — ZERO XLA compiles —
+with millisecond heartbeats, so the full kill -> detect -> reroute ->
+recover -> rejoin ladder is tested at step granularity.  CI re-runs
+this file under MXNET_ENGINE_SANITIZE=1 (the router, heartbeat threads,
+and request workers cross the set condition from three thread
+families).
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import faults, runtime_metrics as rm, serving
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.parallel.placement import replica_groups, replica_mesh
+from mxnet_tpu.serving.batcher import bucket_set
+from mxnet_tpu.serving.decode import DecodeEngine
+from mxnet_tpu.serving.replica import (DRAINING, HEALTHY, STOPPED,
+                                       UNHEALTHY, ReplicaSet)
+from mxnet_tpu.serving.resilience import (CircuitBreaker, Deadline,
+                                          ServerOverloadedError)
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    faults.clear()
+    rm.reset()
+    rm.enable()
+    yield
+    faults.clear()
+    rm.disable()
+    rm.reset()
+
+
+SIG = [{"shape": [None, 2], "dtype": "float32"}]
+
+
+def _fn(a):
+    return a * 2.0 + 1.0
+
+
+def _cfg(**kw):
+    kw.setdefault("replicas", 3)
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("max_latency_us", 1)
+    kw.setdefault("retry_backoff_ms", 0)
+    kw.setdefault("replica_heartbeat_ms", 10)
+    kw.setdefault("replica_heartbeat_window_ms", 80)
+    kw.setdefault("circuit_cooldown_ms", 30)
+    return serving.ServingConfig(**kw)
+
+
+def _entry(fn=_fn, name="m"):
+    repo = serving.ModelRepository()
+    repo.add_function(name, fn, SIG)
+    return repo.get(name)
+
+
+def _rset(fn=_fn, **cfg_kw):
+    return ReplicaSet(_entry(fn), _cfg(**cfg_kw))
+
+
+def _wait_state(rset, rid, state, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while rset.replicas()[rid] != state:
+        assert time.monotonic() < deadline, \
+            (rid, state, rset.debug_state())
+        time.sleep(0.005)
+
+
+X = {n: np.arange(2 * n, dtype=np.float32).reshape(n, 2)
+     for n in (1, 2, 3)}
+
+
+# ------------------------------------------------------------- placement
+class TestPlacement:
+    def test_disjoint_groups(self):
+        devs = [f"d{i}" for i in range(8)]
+        groups = replica_groups(4, devices=devs, tp=2)
+        assert groups == [("d0", "d1"), ("d2", "d3"), ("d4", "d5"),
+                          ("d6", "d7")]
+        flat = [d for g in groups for d in g]
+        assert len(set(flat)) == len(flat)          # strictly disjoint
+
+    def test_subset_when_devices_exceed_need(self):
+        groups = replica_groups(2, devices=list("abcdef"), tp=2)
+        assert groups == [("a", "b"), ("c", "d")]
+
+    def test_single_device_oversubscribes_by_default(self):
+        groups = replica_groups(3, devices=["cpu0"])
+        assert groups == [("cpu0",)] * 3
+
+    def test_multi_device_shortfall_raises_by_default(self):
+        with pytest.raises(MXNetError, match="fault isolation"):
+            replica_groups(4, devices=["a", "b"])
+
+    def test_explicit_oversubscribe_round_robins(self):
+        groups = replica_groups(4, devices=["a", "b"],
+                                oversubscribe=True)
+        assert groups == [("a",), ("b",), ("a",), ("b",)]
+
+    @pytest.mark.parametrize("bad", [dict(n_replicas=0),
+                                     dict(n_replicas=1, tp=0)])
+    def test_validation(self, bad):
+        with pytest.raises(MXNetError):
+            replica_groups(devices=["a"], **bad)
+
+    def test_replica_mesh_axes(self):
+        import jax
+        mesh = replica_mesh(jax.devices()[:1])
+        assert mesh.axis_names == ("dp", "tp")
+        assert mesh.shape["dp"] == 1 and mesh.shape["tp"] == 1
+        with pytest.raises(MXNetError):
+            replica_mesh([])
+
+
+# ------------------------------------------- breaker consecutive fast trip
+class TestConsecutiveTrip:
+    def test_trips_before_window_fills(self):
+        br = CircuitBreaker(20, 0.5, 1000, consecutive=3)
+        br.record(True)
+        for _ in range(3):
+            br.record(False)
+        assert br.state == "open"
+
+    def test_success_resets_the_run(self):
+        # threshold high enough that the 2/3 windowed error rate never
+        # trips — only the consecutive rule is in play here
+        br = CircuitBreaker(20, 0.95, 1000, consecutive=3)
+        for _ in range(10):
+            br.record(False)
+            br.record(False)
+            br.record(True)             # never 3 in a row
+        assert br.state == "closed"
+
+    def test_zero_keeps_windowed_semantics(self):
+        br = CircuitBreaker(20, 0.5, 1000, consecutive=0)
+        for _ in range(5):
+            br.record(False)
+        assert br.state == "closed"     # window not full yet
+
+    def test_probe_success_clears_run(self):
+        br = CircuitBreaker(20, 0.5, 1, consecutive=2)
+        br.record(False)
+        br.record(False)
+        assert br.state == "open"
+        time.sleep(0.005)
+        assert br.admit() is True       # the half-open probe
+        br.record(True)
+        assert br.state == "closed"
+        assert br.debug_state()["consec_failures"] == 0
+
+
+# ------------------------------------------------------- predict replicas
+class TestReplicaSetPredict:
+    def test_prewarm_gates_routability(self):
+        with _rset() as rset:
+            assert set(rset.replicas().values()) == {HEALTHY}
+            st = rset.stats()
+            bound = len(bucket_set(4))
+            for rid, info in st["replicas"].items():
+                assert info["prewarms"] == 1
+                assert rset.replica(rid).batcher.programs() == bound
+
+    def test_outputs_and_load_balance(self):
+        with _rset() as rset:
+            for i in range(30):
+                n = (i % 3) + 1
+                (out,) = rset.run_batch([(X[n],)])
+                np.testing.assert_array_equal(out[0], _fn(X[n]))
+            reqs = [v["requests"]
+                    for v in rset.stats()["replicas"].values()]
+            assert all(r > 0 for r in reqs), reqs
+            assert sum(reqs) == 30
+
+    def test_transient_failure_fails_over_byte_identical(self):
+        with _rset() as rset:
+            (ref,) = rset.run_batch([(X[2],)])
+            with faults.plan("replica.*.execute=fail,times=1"):
+                (out,) = rset.run_batch([(X[2],)])
+            np.testing.assert_array_equal(out[0], ref[0])
+            st = rset.stats()
+            assert st["failovers"] == 1
+            assert rm.SERVING_REPLICA_FAILOVERS.value(model="m") == 1
+
+    def test_deterministic_failure_raises_without_failover(self):
+        def picky(a):
+            if np.any(a == 99.0):       # value-poisoned, prewarm-safe
+                raise ValueError("poisoned")
+            return _fn(a)
+
+        poison = np.full((2, 2), 99.0, np.float32)
+        with ReplicaSet(_entry(picky), _cfg()) as rset:
+            with pytest.raises(ValueError):
+                rset.run_batch([(poison,)])
+            assert rset.stats()["failovers"] == 0
+
+    def test_consecutive_failures_trip_then_probe_recovers(self):
+        rset = _rset(replica_failure_threshold=2)
+        try:
+            rep = rset.replica("r0")
+            rset._record_outcome(rep, False)
+            rset._record_outcome(rep, False)
+            assert rset.replicas()["r0"] == UNHEALTHY
+            assert rep.unhealthy_reason == "failures"
+            # routing avoids it while the breaker cools down
+            picked = {rset._select().rid for _ in range(10)}
+            assert "r0" not in picked
+            # after the cooldown the router offers it the half-open
+            # probe FIRST; a success re-heals the state machine
+            time.sleep(0.05)
+            probe = rset._select()
+            assert probe.rid == "r0"
+            rset._record_outcome(rep, True)
+            assert rset.replicas()["r0"] == HEALTHY
+        finally:
+            rset.stop()
+
+    def test_all_dark_sheds_typed(self):
+        with _rset(replica_failure_threshold=1,
+                   circuit_cooldown_ms=60000) as rset:
+            for rid in list(rset.replicas()):
+                rset._record_outcome(rset.replica(rid), False)
+            assert set(rset.replicas().values()) == {UNHEALTHY}
+            with pytest.raises(ServerOverloadedError, match="no healthy"):
+                rset.run_batch([(X[1],)])
+            assert rset.stats()["no_healthy_rejects"] == 1
+
+    def test_expired_deadline_stops_failover(self):
+        with _rset() as rset:
+            dead = Deadline(time.monotonic() - 1.0, 0.001)
+            with faults.plan("replica.*.execute=fail"):
+                with pytest.raises(faults.InjectedFault):
+                    rset.run_batch([(X[1],)], deadline=dead)
+            assert rset.stats()["failovers"] == 0
+
+
+# ---------------------------------------------------- heartbeats + rejoin
+class TestHeartbeats:
+    def test_stall_detect_dark_serve_prewarm_rejoin(self):
+        with _rset() as rset:
+            p0 = rset.replica("r1").prewarms
+            with faults.plan("replica.r1.heartbeat=stall,ms=400,times=1"):
+                _wait_state(rset, "r1", UNHEALTHY, timeout=5)
+                assert rset.replica("r1").unhealthy_reason.startswith(
+                    "heartbeat")
+                # the dark window serves byte-identically via siblings
+                for _ in range(5):
+                    (out,) = rset.run_batch([(X[1],)])
+                    np.testing.assert_array_equal(out[0], _fn(X[1]))
+            # beats resume -> rejoin gated on a FRESH prewarm pass
+            _wait_state(rset, "r1", HEALTHY, timeout=10)
+            assert rset.replica("r1").prewarms == p0 + 1
+            st = rset.stats()
+            assert st["rejoins"] >= 1 and st["unhealthy_marks"] >= 1
+
+    def test_detection_needs_no_traffic(self):
+        # the sweep rides sibling heartbeats, not requests
+        with _rset() as rset:
+            with faults.plan("replica.r2.heartbeat=stall,ms=400,times=1"):
+                _wait_state(rset, "r2", UNHEALTHY, timeout=5)
+            _wait_state(rset, "r2", HEALTHY, timeout=10)
+
+    def test_heartbeat_age_gauge_published(self):
+        with _rset() as rset:
+            time.sleep(0.05)
+            age = rm.SERVING_REPLICA_HEARTBEAT_AGE.value(
+                model="m", replica="r0")
+            assert age is not None and age < 5.0
+
+
+# -------------------------------------------------------------- rolling ops
+class TestRollingOps:
+    def test_add_replica_prewarms_before_routable(self):
+        with _rset(replicas=2) as rset:
+            rid = rset.add_replica()
+            assert rset.replicas()[rid] == HEALTHY
+            rep = rset.replica(rid)
+            assert rep.prewarms == 1
+            assert rep.batcher.programs() == len(bucket_set(4))
+            # and it takes traffic
+            for _ in range(12):
+                rset.run_batch([(X[1],)])
+            assert rset.replica(rid).requests > 0
+
+    def test_remove_replica_drains(self):
+        gate = threading.Event()
+        entered = threading.Event()
+
+        def gated(a):
+            entered.set()
+            assert gate.wait(30)
+            return _fn(a)
+
+        gate.set()                          # prewarm passes through
+        with ReplicaSet(_entry(gated), _cfg(replicas=2)) as rset:
+            gate.clear()
+            entered.clear()
+            done = []
+            t = threading.Thread(
+                target=lambda: done.append(
+                    rset.run_batch([(X[1],)])))
+            t.start()
+            assert entered.wait(30)
+            victim = next(rid for rid, rep in rset._replicas.items()
+                          if rep.inflight > 0)
+            remover = threading.Thread(
+                target=rset.remove_replica, args=(victim,),
+                kwargs=dict(timeout=30))
+            remover.start()
+            deadline = time.monotonic() + 5
+            while rset.replicas().get(victim) != DRAINING:
+                assert time.monotonic() < deadline
+                time.sleep(0.005)
+            gate.set()                      # in-flight finishes
+            remover.join(30)
+            t.join(30)
+            assert done and victim not in rset.replicas()
+            assert rset.stats()["drained"] == 1
+
+    def test_remove_last_replica_refused(self):
+        with _rset(replicas=1) as rset:
+            with pytest.raises(MXNetError, match="last replica"):
+                rset.remove_replica("r0")
+
+    def test_restart_fresh_state_through_prewarm(self):
+        with _rset(replicas=2) as rset:
+            rep = rset.replica("r0")
+            rset._record_outcome(rep, False)
+            assert rep.failures == 1
+            rset.restart("r0", timeout=10)
+            fresh = rset.replica("r0")
+            assert fresh is not rep
+            assert fresh.failures == 0 and fresh.prewarms == 1
+            assert rset.replicas()["r0"] == HEALTHY
+            (out,) = rset.run_batch([(X[1],)])
+            np.testing.assert_array_equal(out[0], _fn(X[1]))
+
+
+# --------------------------------------------------------- decode replicas
+class FakeLM:
+    """Decode-model protocol in plain numpy: next token = (last + 1)
+    mod vocab; prefill proposes the prompt's last token."""
+
+    vocab_size = 16
+    max_context = 32
+
+    def prefill(self, tokens, length, block_table):
+        logits = np.zeros((self.vocab_size,), np.float32)
+        logits[int(tokens[0, int(length) - 1]) % self.vocab_size] = 1.0
+        return logits
+
+    def decode_step(self, tokens, positions, block_tables):
+        logits = np.zeros((tokens.shape[0], self.vocab_size),
+                          np.float32)
+        logits[np.arange(tokens.shape[0]),
+               (tokens + 1) % self.vocab_size] = 1.0
+        return logits
+
+
+def _decode_entry(model_factory=FakeLM, name="lm"):
+    repo = serving.ModelRepository()
+    repo.add_decoder(name, model_factory(),
+                     model_factory=model_factory)
+    return repo.get(name)
+
+
+def _decode_cfg(**kw):
+    kw.setdefault("replicas", 2)
+    kw.setdefault("decode_page_size", 4)
+    kw.setdefault("decode_pool_pages", 17)
+    kw.setdefault("decode_max_batch", 4)
+    kw.setdefault("decode_max_new_tokens", 8)
+    kw.setdefault("retry_backoff_ms", 0)
+    kw.setdefault("retry_max", 2)
+    kw.setdefault("replica_heartbeat_ms", 10)
+    kw.setdefault("replica_heartbeat_window_ms", 80)
+    kw.setdefault("circuit_cooldown_ms", 30)
+    return serving.ServingConfig(**kw)
+
+
+class TestReplicaSetDecode:
+    def test_generate_parity_and_leak_free(self):
+        with ReplicaSet(_decode_entry(), _decode_cfg()) as rset:
+            out = rset.generate([3], max_new_tokens=4, timeout=30)
+            assert out.tolist() == [3, 4, 5, 6]
+            rset.check_leaks()
+
+    def test_kill_mid_generate_quarantines_then_fails_over(self):
+        """ISSUE-13 chaos criterion: a replica dying mid-generate()
+        quarantines the sequence leak-free and the request is
+        re-admitted fresh on a sibling — byte-identical tokens."""
+        with ReplicaSet(_decode_entry(), _decode_cfg()) as rset:
+            ref = rset.generate([3], max_new_tokens=4, timeout=30)
+            # 3 fail firings: the serving replica burns its 2 retries
+            # and quarantines; the sibling runs clean
+            with faults.plan("replica.*.decode.step=fail,times=3"):
+                out = rset.generate([3], max_new_tokens=4, timeout=30)
+            assert out.tolist() == ref.tolist()
+            st = rset.stats()
+            assert st["failovers"] == 1
+            quarantined = sum(s["quarantined"]
+                              for s in rset.decode_stats().values())
+            assert quarantined == 1
+            rset.check_leaks()          # quarantine released every page
+            used = sum(s["used_pages"]
+                       for s in rset.decode_stats().values())
+            assert used == 0
+
+    def test_failover_budget_exhausts_typed(self):
+        with ReplicaSet(_decode_entry(),
+                        _decode_cfg(retry_max=1)) as rset:
+            with faults.plan("replica.*.decode.step=fail"):
+                with pytest.raises(MXNetError):
+                    rset.generate([3], max_new_tokens=4, timeout=30)
+            rset.check_leaks()
+
+    def test_non_adapter_model_without_factory_rejected(self):
+        repo = serving.ModelRepository()
+        repo.add_decoder("lm", FakeLM())            # no factory
+        with pytest.raises(MXNetError, match="model_factory"):
+            ReplicaSet(repo.get("lm"), _decode_cfg(replicas=2))
+
+    def test_single_replica_set_owns_the_model(self):
+        repo = serving.ModelRepository()
+        repo.add_decoder("lm", FakeLM())
+        with ReplicaSet(repo.get("lm"),
+                        _decode_cfg(replicas=1)) as rset:
+            out = rset.generate([3], max_new_tokens=2, timeout=30)
+            assert out.tolist() == [3, 4]
+
+
+# ------------------------------------------------- scoped decode fault sites
+class TestDecodeFaultScope:
+    def _engine(self, scope):
+        eng = DecodeEngine(FakeLM(), _decode_cfg(replicas=1),
+                           model_name="fake", fault_scope=scope)
+        eng._started = True             # manual stepping
+        return eng
+
+    def _run(self, eng):
+        seq = eng.submit([3], max_new_tokens=2)
+        n = 0
+        while not seq.event.is_set():
+            eng.step()
+            n += 1
+            assert n < 32
+        return seq
+
+    def test_scoped_engine_ignores_plain_decode_sites(self):
+        eng = self._engine("replica.r7.decode")
+        with faults.plan("decode.step=fail"):
+            seq = self._run(eng)
+        assert seq.finish_reason == "length"
+        assert seq.tokens == [3, 4]
+
+    def test_scoped_engine_honors_its_own_sites(self):
+        eng = self._engine("replica.r7.decode")
+        with faults.plan("replica.r7.decode.step=fail"):
+            seq = self._run(eng)
+        assert seq.finish_reason == "quarantined"
+
+    def test_default_scope_unchanged(self):
+        eng = self._engine("decode")
+        with faults.plan("decode.step=fail"):
+            seq = self._run(eng)
+        assert seq.finish_reason == "quarantined"
+
+
+# ------------------------------------------------------ server integration
+class TestServerIntegration:
+    def _server(self, fn=_fn, **cfg_kw):
+        repo = serving.ModelRepository()
+        repo.add_function("m", fn, SIG)
+        return repo, serving.ModelServer(repo, _cfg(**cfg_kw))
+
+    def test_predict_parity_with_single_replica(self):
+        _, single = self._server(replicas=1)
+        _, multi = self._server(replicas=3)
+        with single, multi:
+            for n in (1, 2, 3):
+                a = single.predict("m", X[n], timeout=30)
+                b = multi.predict("m", X[n], timeout=30)
+                np.testing.assert_array_equal(a, b)
+            st = multi.stats()
+            assert "replica_sets" in st
+            assert sum(v["requests"] for v in
+                       st["replica_sets"]["m"]["replicas"].values()) \
+                == 3
+
+    def test_failover_under_threaded_load(self):
+        repo, srv = self._server(replicas=3)
+        errors, outs = [], []
+
+        def worker(tid):
+            for i in range(8):
+                n = (tid + i) % 3 + 1
+                try:
+                    outs.append(
+                        (n, srv.predict("m", X[n], timeout=30)))
+                except Exception as e:          # noqa: BLE001
+                    errors.append(e)
+
+        with srv:
+            with faults.plan("replica.r1.execute=fail,times=6,seed=2"):
+                pool = [threading.Thread(target=worker, args=(t,))
+                        for t in range(6)]
+                for t in pool:
+                    t.start()
+                for t in pool:
+                    t.join(60)
+            assert not errors, errors[:3]       # failover absorbed all
+            for n, out in outs:
+                np.testing.assert_array_equal(out, _fn(X[n]))
+            assert len(outs) == 48
+
+    def test_generate_through_server_with_failover(self):
+        repo = serving.ModelRepository()
+        repo.add_decoder("lm", FakeLM(), model_factory=FakeLM)
+        with serving.ModelServer(repo, _decode_cfg()) as srv:
+            ref = srv.generate("lm", [3], max_new_tokens=4, timeout=30)
+            with faults.plan("replica.*.decode.step=fail,times=3"):
+                out = srv.generate("lm", [3], max_new_tokens=4,
+                                   timeout=30)
+            assert out.tolist() == ref.tolist() == [3, 4, 5, 6]
+            stats = srv.decode_stats("lm")
+            assert set(stats) == {"r0", "r1"}
+            entry = repo.get("lm")
+            srv._replica_sets[entry.uid].check_leaks()
+
+    def test_prewarm_builds_all_replicas_before_traffic(self):
+        repo, srv = self._server(replicas=2)
+        with srv:
+            summary = srv.prewarm("m")
+            assert set(summary["replicas"].values()) == {HEALTHY}
+            rs = summary["stats"]["replicas"]
+            assert all(v["prewarms"] == 1 for v in rs.values())
+            assert all(v["requests"] == 0 for v in rs.values())
+
+    def test_unload_stops_replica_set(self):
+        repo, srv = self._server(replicas=2)
+        with srv:
+            srv.predict("m", X[1], timeout=30)
+            entry = repo.get("m")
+            rset = srv._replica_sets[entry.uid]
+            repo.unload("m")
+            assert entry.uid not in srv._replica_sets
+            assert set(rset.replicas().values()) == {STOPPED}
+
+    def test_debug_state_serializable(self):
+        import json
+        repo, srv = self._server(replicas=2)
+        with srv:
+            srv.predict("m", X[1], timeout=30)
+            state = srv.debug_state()
+            assert state["replica_sets"]
+            (rset_state,) = state["replica_sets"].values()
+            assert set(rset_state["replicas"]) == {"r0", "r1"}
+            json.dumps(state)           # flight-recorder contract
+
+    def test_server_stop_stops_replicas(self):
+        repo, srv = self._server(replicas=2)
+        srv.predict("m", X[1], timeout=30)
+        entry = repo.get("m")
+        rset = srv._replica_sets[entry.uid]
+        assert srv.stop(timeout=30)
+        assert set(rset.replicas().values()) == {STOPPED}
+
+    def test_replica_traffic_tagged_in_traces(self):
+        from mxnet_tpu import tracing
+        tracing.enable(sample=1.0)
+        try:
+            repo, srv = self._server(replicas=2)
+            with srv:
+                with faults.plan("replica.*.execute=fail,times=1"):
+                    srv.predict("m", X[1], timeout=30)
+                fo = srv.stats()["replica_sets"]["m"]["failovers"]
+                assert fo == 1
+                tagged = [
+                    s for tr in tracing.TRACER.traces()
+                    for s in tr["spans"]
+                    if (s.get("tags") or {}).get("failover_from")]
+                assert tagged, "no failover_from trace tag recorded"
+                assert all((s["tags"] or {}).get("replica")
+                           for s in tagged)
+        finally:
+            tracing.disable()
+            tracing.reset()
+
+
+# -------------------------------------------- sanitizer-mode router stress
+class TestRouterStress:
+    def test_threaded_routing_with_chaos_consistent_counters(self):
+        """8 client threads x 10 requests against 3 replicas while a
+        seeded plan kills one replica's executes AND stalls its
+        heartbeat: every request resolves (typed or served), counters
+        reconcile, and — under MXNET_ENGINE_SANITIZE=1 in CI — no
+        lock-order inversion fires across the router / heartbeat /
+        worker lock families."""
+        with _rset() as rset:
+            errors, served = [], []
+
+            def worker(tid):
+                for i in range(10):
+                    n = (tid + i) % 3 + 1
+                    try:
+                        (out,) = rset.run_batch(
+                            [(X[n],)],
+                            deadline=Deadline.start(30))
+                        np.testing.assert_array_equal(
+                            out[0], _fn(X[n]))
+                        served.append(n)
+                    except MXNetError as e:
+                        errors.append(e)
+
+            plan = ("replica.r0.execute=fail,times=10,seed=5;"
+                    "replica.r0.heartbeat=stall,ms=200,times=1")
+            with faults.plan(plan):
+                pool = [threading.Thread(target=worker, args=(t,))
+                        for t in range(8)]
+                for t in pool:
+                    t.start()
+                for t in pool:
+                    t.join(60)
+            assert len(served) + len(errors) == 80
+            assert not errors, errors[:3]
+            st = rset.stats()
+            assert sum(v["requests"]
+                       for v in st["replicas"].values()) \
+                == st["dispatched"]
+            assert all(v["inflight"] == 0
+                       for v in st["replicas"].values())
+
+
+# ----------------------------------------- one AOT miss, N warm replicas
+class TestReplicaCompileSharing:
+    def test_sibling_replicas_deserialize_the_first_miss(
+            self, tmp_path, monkeypatch):
+        """The §10 compile contract: per-replica program caches go
+        through the persistent compile cache, so replica count never
+        multiplies cold compiles — replica r0's misses store
+        executables that r1 deserializes (disk hits), bucket for
+        bucket."""
+        import mxnet_tpu as mx
+        from mxnet_tpu import compile_cache as cc
+        from mxnet_tpu import nd
+        from mxnet_tpu.gluon import nn
+
+        monkeypatch.setenv("MXNET_COMPILE_CACHE_DIR",
+                           str(tmp_path / "cache"))
+        mx.random.seed(7)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(4, in_units=8))
+        net.initialize(mx.init.Xavier())
+        net.hybridize()
+        x = nd.random.uniform(shape=(1, 8))
+        art = net.export_stablehlo(x, path=str(tmp_path / "m"),
+                                   dynamic_batch=True)
+
+        repo = serving.ModelRepository()
+        repo.load_artifact("m", art)
+        cache = cc.get_default()
+        h0, m0 = cache.hits, cache.misses
+        with ReplicaSet(repo.get("m"),
+                        _cfg(replicas=2, max_batch_size=2)) as rset:
+            buckets = len(bucket_set(2))
+            progs = {rid: info["programs"] for rid, info
+                     in rset.debug_state()["replicas"].items()}
+            assert progs == {"r0": buckets, "r1": buckets}
+            # cold compiles happened ONCE per bucket; the sibling
+            # replica loaded executables, not the compiler
+            assert cache.misses - m0 == buckets, \
+                (cache.misses - m0, buckets)
+            assert cache.hits - h0 >= buckets, (cache.hits - h0)
+            # and both replicas serve byte-identically
+            xb = np.arange(8, dtype=np.float32).reshape(1, 8)
+            outs = [rset.run_batch([(xb,)])[0][0] for _ in range(4)]
+            for out in outs[1:]:
+                np.testing.assert_array_equal(out, outs[0])
+
+
+# ----------------------------------------------- review-hardening fixes
+class TestReviewHardening:
+    def test_failed_rejoin_prewarm_retries_after_cooldown(self):
+        """Review fix: one transient prewarm failure during a
+        heartbeat rejoin must not strand the replica dark forever —
+        the beat loop retries the bring-up after the breaker
+        cooldown."""
+        poison = {"on": False}
+
+        def flaky(a):
+            if poison["on"]:
+                raise RuntimeError("transient backend outage")
+            return _fn(a)
+
+        with ReplicaSet(_entry(flaky),
+                        _cfg(replicas=2,
+                             circuit_cooldown_ms=30)) as rset:
+            with faults.plan(
+                    "replica.r0.heartbeat=stall,ms=300,times=1"):
+                poison["on"] = True     # the rejoin prewarm will fail
+                _wait_state(rset, "r0", UNHEALTHY, timeout=5)
+            # beats are back; the first rejoin attempt fails and the
+            # reason becomes "prewarm failed: ..."
+            deadline = time.monotonic() + 5
+            while not (rset.replica("r0").unhealthy_reason or "") \
+                    .startswith("prewarm failed"):
+                assert time.monotonic() < deadline, \
+                    rset.debug_state()["replicas"]["r0"]
+                time.sleep(0.005)
+            poison["on"] = False        # outage clears
+            _wait_state(rset, "r0", HEALTHY, timeout=10)
+            assert rset.replica("r0").prewarms >= 1
+
+    def test_initial_prewarm_failure_self_heals(self):
+        """Review fix: a replica whose FIRST prewarm fails still gets
+        a beat thread, so it recovers on its own once the failure
+        clears — no operator restart() required."""
+        poison = {"left": 100}
+
+        def flaky(a):
+            if poison["left"] > 0:
+                poison["left"] -= 1
+                raise RuntimeError("cold backend")
+            return _fn(a)
+
+        rset = ReplicaSet(_entry(flaky),
+                          _cfg(replicas=1, circuit_cooldown_ms=20))
+        try:
+            assert rset.replicas()["r0"] == UNHEALTHY
+            poison["left"] = 0
+            _wait_state(rset, "r0", HEALTHY, timeout=10)
+            (out,) = rset.run_batch([(X[1],)])
+            np.testing.assert_array_equal(out[0], _fn(X[1]))
+        finally:
+            rset.stop()
+
+    def test_window_zero_keeps_consecutive_fast_trip(self):
+        """Review fix: disabling the windowed breaker
+        (circuit_window=0) must NOT disable the replica layer's
+        consecutive-failure dead-replica detector."""
+        br = CircuitBreaker(0, 0.5, 20, consecutive=2)
+        br.record(False)
+        assert br.record(False) == "open"
+        with pytest.raises(ServerOverloadedError):
+            br.admit()
+        time.sleep(0.03)
+        assert br.admit() is True       # half-open probe still works
+        br.record(True)
+        assert br.state == "closed"
+        # and fully-off stays fully-off
+        off = CircuitBreaker(0, 0.5, 20, consecutive=0)
+        for _ in range(10):
+            assert off.record(False) == "closed"
+        assert off.admit() is False
+
+    def test_window_zero_replica_set_still_marks_unhealthy(self):
+        with _rset(circuit_window=0,
+                   replica_failure_threshold=2) as rset:
+            rep = rset.replica("r0")
+            rset._record_outcome(rep, False)
+            rset._record_outcome(rep, False)
+            assert rset.replicas()["r0"] == UNHEALTHY
+            assert rep.unhealthy_reason == "failures"
+
+    def test_stats_disambiguates_two_live_versions(self):
+        repo = serving.ModelRepository()
+        repo.add_function("m", _fn, SIG)                 # v1, active
+        repo.add_function("m", lambda a: a * 5.0, SIG,
+                          version=2, activate=False)     # staged
+        with serving.ModelServer(repo, _cfg(replicas=2)) as srv:
+            srv.predict("m", X[1], timeout=30)           # builds v1 set
+            srv.prewarm("m", version=2)                  # builds v2 set
+            keys = set(srv.stats()["replica_sets"])
+            assert keys == {"m", "m@v2"}, keys

@@ -121,6 +121,33 @@ def diagnose(metrics_smoke=False):
         for key, fired in sorted(plan.counters().items()):
             print(f"  fired      : {key} x{fired}")
 
+    _section("Replica Serving")
+    from mxnet_tpu.base import get_env
+    n_rep = get_env("MXNET_SERVING_REPLICAS", typ=int)
+    print(f"replicas     : {n_rep}  (MXNET_SERVING_REPLICAS; > 1 "
+          f"serves every model through a health-checked ReplicaSet; "
+          f"docs/serving.md §10)")
+    print(f"heartbeat    : every "
+          f"{get_env('MXNET_SERVING_REPLICA_HEARTBEAT_MS', typ=float)}"
+          f"ms, stale past "
+          f"{get_env('MXNET_SERVING_REPLICA_HEARTBEAT_WINDOW_MS', typ=float)}"
+          f"ms -> UNHEALTHY")
+    print(f"failure trip : "
+          f"{get_env('MXNET_SERVING_REPLICA_FAILURE_THRESHOLD', typ=int)}"
+          f" consecutive typed failures -> UNHEALTHY (probe after "
+          f"cooldown)")
+    try:
+        import jax
+        n_dev = len(jax.devices())
+        from mxnet_tpu.parallel.placement import replica_groups
+        groups = replica_groups(max(1, n_rep), oversubscribe=None)
+        print(f"placement    : {n_dev} device(s) -> "
+              f"{len(groups)} group(s)"
+              + ("  (oversubscribed: logical replicas)"
+                 if n_dev < max(1, n_rep) else ""))
+    except Exception as e:      # noqa: BLE001 — diagnostics best-effort
+        print(f"placement    : unavailable ({e})")
+
     _section("Tracing / Flight Recorder")
     from mxnet_tpu import tracing
     st = tracing.TRACER.stats()

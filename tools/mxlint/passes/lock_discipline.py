@@ -1,6 +1,8 @@
 """lock-discipline: shared-state mutation, lock ordering, blocking calls.
 
-Scope: the threading-reachable modules (``engine``, ``serving/*``,
+Scope: the threading-reachable modules (``engine``, ``serving/*`` —
+including ``serving/replica.py``, where heartbeat threads, the
+replica router, and request workers all cross the set condition —
 ``runtime_metrics``, ``tracing``, ``parallel/dist``, ``faults`` — the
 surfaces where worker pools, the metrics registry, the span tracer,
 fault-plan trigger state, and multi-process shutdown already shipped
