@@ -170,6 +170,25 @@ serving_smoke() {
         tests/test_faults.py tests/test_serving_replica.py -x -q
 }
 
+training_smoke() {
+    # training-plane chaos tier (ISSUE-14 acceptance;
+    # docs/training_resilience.md §6): a supervised ShardedTrainer run
+    # under a seeded fault plan (1 mid-step kill + 1 corrupted
+    # checkpoint payload at the newest VERIFIED step) against a
+    # fault-free twin — bit-identical loss trajectory, restarts ==
+    # injected kills, the corrupt payload detected by the integrity
+    # manifest and never restored (verified-step fallback), and a
+    # wedged fake collective raising TrainStepTimeoutError within the
+    # configured deadline instead of hanging the job
+    python benchmark/bench_train_resilience.py --smoke
+    # the watchdog/supervisor/checkpoint suites double as race tests:
+    # the deadline worker thread, the fault plan's trigger state, and
+    # the incident dumps cross the same locks the sanitizer guards
+    MXNET_ENGINE_SANITIZE=1 python -m pytest \
+        tests/test_faults_train.py tests/test_faults.py \
+        tests/test_checkpoint_sharded.py -x -q
+}
+
 bench_cpu() {
     # tiny-config bench harness end-to-end (no TPU required): the full
     # per-phase orchestrator, not just one child phase

@@ -30,6 +30,18 @@ the wedged-worker shape siblings must detect), and
 replica-owned decode engine's §8 sites under its own prefix) — kill
 ONE replica by id, or every replica at once via ``replica.*`` globs.
 
+Training-plane family (docs/training_resilience.md §2):
+``train.step`` (one ``ShardedTrainer.step`` — ``stall`` is the wedged
+collective the step watchdog must bound), ``train.data.next`` (the
+data iterator's batch handoff), ``kvstore.push`` / ``kvstore.pull``
+(classic tiers) and ``kvstore.pushpull`` (the fused XLA collective
+launch on the 'xla' tier), ``checkpoint.save`` (``corrupt`` fires at
+the durability barrier and bit-flips one byte of the just-verified
+payload — the silent-rot/torn-write shape the integrity manifest must
+catch) and ``checkpoint.restore`` (``corrupt`` bit-flips the
+candidate payload before it is read, forcing the verified-step
+fallback).  Kill the whole training plane at once with ``train.*``.
+
 Spec grammar (``MXNET_FAULTS``, or :func:`install` / :func:`plan`)::
 
     plan  := rule (';' rule)*
@@ -260,9 +272,15 @@ class FaultPlan:
     # ------------------------------------------------------------ readers
     def counters(self):
         """{'site-pattern:mode': fired} — what actually happened, for
-        chaos-smoke assertions and incident dumps."""
+        chaos-smoke assertions and incident dumps.  Multiple rules
+        sharing a pattern+mode (staged kills: two ``after=N`` clauses
+        on one site) aggregate into one entry."""
         with self._lock:
-            return {f"{r.pattern}:{r.mode}": r.fired for r in self.rules}
+            out = {}
+            for r in self.rules:
+                key = f"{r.pattern}:{r.mode}"
+                out[key] = out.get(key, 0) + r.fired
+            return out
 
     def debug_state(self):
         with self._lock:

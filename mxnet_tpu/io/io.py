@@ -21,6 +21,7 @@ from collections import namedtuple
 import numpy as np
 
 from ..base import MXNetError
+from .. import faults as _faults
 from .. import ndarray as nd
 from ..ndarray import NDArray
 from .. import recordio
@@ -77,6 +78,7 @@ class DataIter:
         pass
 
     def next(self) -> DataBatch:
+        _faults.inject("train.data.next")
         if self.iter_next():
             if _rm._ENABLED:
                 _rm.IO_BATCHES.inc()
@@ -224,6 +226,7 @@ class PrefetchingIter(DataIter):
         self._start()
 
     def next(self):
+        _faults.inject("train.data.next")
         if self._done:
             raise StopIteration
         got = self._queue.get()
@@ -278,11 +281,19 @@ def _init_data(data, allow_empty, default_name):
 
 class NDArrayIter(DataIter):
     """Batches over in-memory arrays with pad/discard/roll_over handling
-    (reference: io.NDArrayIter)."""
+    (reference: io.NDArrayIter).
+
+    ``seed`` opts into DETERMINISTIC epochs: epoch e's shuffle order is
+    a pure function of (seed, e) instead of the global numpy RNG, which
+    is what makes the iterator checkpointable — :meth:`get_cursor`
+    captures (epoch, position, seed) and :meth:`set_cursor` replays the
+    order chain so a supervised resume sees exactly the batch the
+    killed run would have seen next, neither replaying nor skipping
+    data (docs/training_resilience.md §3)."""
 
     def __init__(self, data, label=None, batch_size=1, shuffle=False,
                  last_batch_handle="pad", data_name="data",
-                 label_name="softmax_label"):
+                 label_name="softmax_label", seed=None):
         super().__init__(batch_size)
         self.data = _init_data(data, False, data_name)
         self.label = _init_data(label, True, label_name)
@@ -299,6 +310,8 @@ class NDArrayIter(DataIter):
             raise MXNetError("not enough data for even one batch")
         self.shuffle = shuffle
         self.last_batch_handle = last_batch_handle
+        self._seed = None if seed is None else int(seed)
+        self._epoch = -1    # reset() increments; first epoch is 0
         self._carry = None  # roll_over: sample indices left from last epoch
         self._order = np.arange(self.num_data)
         self.cursor = -batch_size
@@ -314,10 +327,21 @@ class NDArrayIter(DataIter):
         return [DataDesc(name, (self.batch_size,) + arr.shape[1:],
                          arr.dtype) for name, arr in self.label]
 
-    def reset(self):
+    def _epoch_perm(self, epoch):
+        """Epoch ``epoch``'s permutation — pure in (seed, epoch)."""
         idx = np.arange(self.num_data)
         if self.shuffle:
-            np.random.shuffle(idx)
+            np.random.RandomState([self._seed, epoch]).shuffle(idx)
+        return idx
+
+    def reset(self):
+        self._epoch += 1
+        if self._seed is not None:
+            idx = self._epoch_perm(self._epoch)
+        else:
+            idx = np.arange(self.num_data)
+            if self.shuffle:
+                np.random.shuffle(idx)
         if self.last_batch_handle == "roll_over" and self._carry is not None:
             # leftover samples from the previous epoch lead this one
             self._order = np.concatenate([self._carry, idx])
@@ -325,6 +349,68 @@ class NDArrayIter(DataIter):
         else:
             self._order = idx
         self.cursor = -self.batch_size
+
+    # ------------------------------------------------- checkpointable cursor
+    def get_cursor(self):
+        """Checkpointable position: exactly what :meth:`set_cursor`
+        needs to make the NEXT ``next()`` return the same batch an
+        uninterrupted run would have returned.  Requires ``seed=``
+        when shuffling (the global-RNG order cannot be replayed)."""
+        if self.shuffle and self._seed is None:
+            raise MXNetError(
+                "NDArrayIter.get_cursor: a shuffling iterator is only "
+                "checkpointable with seed= (epoch order must be a "
+                "pure function of (seed, epoch) to replay on resume)")
+        return {"epoch": int(self._epoch), "cursor": int(self.cursor),
+                "seed": self._seed, "shuffle": bool(self.shuffle),
+                "num_data": int(self.num_data),
+                "batch_size": int(self.batch_size),
+                "last_batch_handle": self.last_batch_handle}
+
+    def set_cursor(self, state):
+        """Rewind/fast-forward to a :meth:`get_cursor` snapshot by
+        replaying the deterministic epoch-order chain (roll_over
+        carries included).  Refuses a snapshot from a differently
+        configured iterator — resuming against different data is the
+        silent replay/skip bug this cursor exists to prevent."""
+        expected = {"seed": self._seed,
+                    "shuffle": bool(self.shuffle),
+                    "num_data": int(self.num_data),
+                    "batch_size": int(self.batch_size),
+                    "last_batch_handle": self.last_batch_handle}
+        for key, mine in expected.items():
+            if state.get(key) != mine:
+                raise MXNetError(
+                    f"NDArrayIter.set_cursor: snapshot {key}="
+                    f"{state.get(key)!r} does not match this "
+                    f"iterator's {mine!r} — refusing a cursor from a "
+                    f"different data configuration")
+        if self.shuffle and self._seed is None:
+            raise MXNetError(
+                "NDArrayIter.set_cursor requires seed= when shuffling")
+        epoch = int(state["epoch"])
+        # replay the order chain from epoch 0: with roll_over, epoch
+        # e's head is epoch e-1's leftover tail, so the chain is the
+        # only faithful reconstruction
+        carry = None
+        order = np.arange(self.num_data)
+        for e in range(epoch + 1):
+            idx = self._epoch_perm(e) if self._seed is not None \
+                else np.arange(self.num_data)
+            order = np.concatenate([carry, idx]) \
+                if (self.last_batch_handle == "roll_over"
+                    and carry is not None) else idx
+            carry = None
+            if self.last_batch_handle == "roll_over":
+                leftover = len(order) % self.batch_size
+                if leftover:
+                    carry = order[len(order) - leftover:]
+        self._epoch = epoch
+        self._order = order
+        # live iteration regenerates the roll_over carry itself at the
+        # epoch boundary; a between-steps snapshot never holds one
+        self._carry = None
+        self.cursor = int(state["cursor"])
 
     def iter_next(self):
         self.cursor += self.batch_size
@@ -364,6 +450,7 @@ class NDArrayIter(DataIter):
         return 0
 
     def next(self):
+        _faults.inject("train.data.next")
         if not self.iter_next():
             raise StopIteration
         if _rm._ENABLED:
@@ -629,6 +716,7 @@ class ImageRecordIter(DataIter):
         self._producer = None
 
     def next(self):
+        _faults.inject("train.data.next")
         if self._done:
             raise StopIteration
         got = self._queue.get()

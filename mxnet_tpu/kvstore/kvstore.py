@@ -42,6 +42,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..base import MXNetError, get_env
 from ..context import cpu
+from .. import faults as _faults
 from ..ndarray import NDArray
 from .. import optimizer as opt
 from .. import quantize as qz
@@ -189,6 +190,7 @@ class KVStore(KVStoreBase):
 
     # ---------------------------------------------------------------- push
     def push(self, key, value, priority=0):
+        _faults.inject("kvstore.push")
         for k, vals in _normalize(key, value):
             if _rm._ENABLED:
                 _rm.KV_PUSH.inc()
@@ -227,6 +229,7 @@ class KVStore(KVStoreBase):
 
     # ---------------------------------------------------------------- pull
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        _faults.inject("kvstore.pull")
         if out is None:
             raise MXNetError("kvstore.pull requires out=")
         for k, outs in _normalize(key, out):
@@ -424,10 +427,13 @@ class XLA(KVStore):
         if any(len(v) == 1 for _, v in pairs) or self._updater is not None \
                 or isinstance(self._compressor, _TwoBitCompressor):
             # degenerate / host-compressed path: classic push+pull via
-            # the store (which carries its own push/pull accounting);
-            # int8/fp8 quantization stays ON the fused path below —
-            # it runs inside the jitted collective
+            # the store (which carries its own push/pull accounting
+            # and fault sites); int8/fp8 quantization stays ON the
+            # fused path below — it runs inside the jitted collective
             return super().pushpull(key, value, out, priority)
+        # the fused XLA collective call site: a chaos plan kills or
+        # stalls the whole bucketed allreduce launch here
+        _faults.inject("kvstore.pushpull")
         if _rm._ENABLED:
             for _k, vals in pairs:
                 _rm.KV_PUSH.inc()

@@ -14,7 +14,12 @@ inserting the ICI/DCN collectives.  This package supplies:
 - pure pytree optimizers (sgd/adamw/lamb) for inside compiled steps;
 - ``ShardedTrainer``: one compiled train step = fwd + bwd + update with
   dp/tp shardings (replaces Trainer+kvstore at pod scale);
-- ring attention (context parallelism over the ICI ring via ppermute).
+- ring attention (context parallelism over the ICI ring via ppermute);
+- the self-healing layer (docs/training_resilience.md): step watchdog
+  (``TrainStepTimeoutError`` instead of a wedged-collective hang),
+  ``CheckpointManager`` with verified-marker + integrity-manifest
+  restore fallback, and ``TrainingSupervisor`` — bounded restarts
+  that resume bit-exactly (RNG + data-cursor checkpointing).
 """
 from .mesh import make_mesh, mesh_axis_size
 from .placement import replica_groups, replica_mesh
@@ -22,6 +27,8 @@ from .functional import functionalize
 from .sharding import ShardingRules, MEGATRON_RULES, partition_params
 from .optim import sgd_init, sgd_update, adamw_init, adamw_update
 from .trainer import ShardedTrainer
+from .supervisor import TrainingSupervisor, TrainStepTimeoutError, \
+    CrashLoopError, StepWatchdog, run_with_deadline
 from .ring_attention import ring_attention, ring_self_attention
 from .checkpoint import CheckpointManager, save_checkpoint, \
     load_checkpoint
@@ -32,7 +39,10 @@ __all__ = ["make_mesh", "mesh_axis_size", "replica_groups",
            "replica_mesh", "functionalize",
            "ShardingRules", "MEGATRON_RULES", "partition_params",
            "sgd_init", "sgd_update", "adamw_init", "adamw_update",
-           "ShardedTrainer", "ring_attention", "ring_self_attention",
+           "ShardedTrainer", "TrainingSupervisor",
+           "TrainStepTimeoutError", "CrashLoopError", "StepWatchdog",
+           "run_with_deadline",
+           "ring_attention", "ring_self_attention",
            "CheckpointManager", "save_checkpoint", "load_checkpoint",
            "pipeline_apply", "make_pipeline_mesh",
            "dist"]

@@ -14,7 +14,7 @@ mesh — no gather, no host bottleneck.
     mngr.restore(trainer)                  # latest; or restore(t, step=n)
     mngr.wait()                            # barrier before exit
 
-Two crash-safety pieces on top of the async writes:
+Crash-safety pieces on top of the async writes:
 
 - **Atomic last-step marker.**  ``save`` is asynchronous, so "the
   newest step directory exists" does NOT mean "that checkpoint is
@@ -24,21 +24,49 @@ Two crash-safety pieces on top of the async writes:
   rename (atomic on POSIX) only AFTER the write barrier confirms
   durability.  ``restore()`` prefers the marker, so a kill mid-save
   restores the last *verified* checkpoint, never the torn one.
+- **Per-step integrity manifest.**  The marker says a barrier
+  completed; it cannot say the bytes are still good.  At each barrier
+  the manager also records a ``VERIFY-<step>.json`` manifest (relative
+  path -> sha256 over the step directory), and auto-``restore()``
+  re-hashes against it first: a bit-flipped or torn payload at the
+  marker step is DETECTED and restore **falls back to the previous
+  verified step with a warning** instead of raising or silently
+  loading rot — symmetric with the retention-GC fallback in
+  ``latest_step()``.  An explicitly requested ``step=`` skips the
+  fallback (you asked for those bytes; you get the error).
+- **Extra payload.**  ``save(step, trainer, extra=...)`` persists a
+  small JSON side-state (``EXTRA-<step>.json``, atomic write at the
+  barrier) next to the array tree — the supervisor stores the eager
+  RNG snapshot, the data-iterator cursor, and the loss trajectory
+  there, which is what makes resume bit-exact rather than merely
+  weight-correct.  ``load_extra(step)`` reads it back.
 - **``save_on_signal``** — a SIGTERM/preemption hook: the cluster
   scheduler's eviction notice triggers one synchronous save + barrier
   + marker commit before the previous handler (or default
   termination) runs, so an evicted job resumes from its final step
   instead of its last periodic checkpoint.
+
+Fault-injection sites (``mxnet_tpu.faults``): ``checkpoint.save``
+(fail/delay/stall at save; **corrupt** fires at the barrier and
+bit-flips one payload byte of the just-verified step — the
+silent-rot/torn-write shape the manifest exists to catch) and
+``checkpoint.restore`` (fail/delay/stall at restore; **corrupt**
+bit-flips the candidate step's payload before reading, which the
+manifest check must turn into a fallback, never wrong weights).
 """
 from __future__ import annotations
 
+import hashlib
+import json
 import logging
 import os
 import signal as _signal
+import time
 from typing import Optional
 
 import jax
 
+from .. import faults as _faults
 from ..base import MXNetError
 
 __all__ = ["CheckpointManager", "save_checkpoint", "load_checkpoint"]
@@ -58,15 +86,67 @@ def _ocp():
 
 
 def _trainer_state(trainer):
-    return {"params": dict(trainer.params),
-            "opt_state": trainer.opt_state}
+    state = {"params": dict(trainer.params),
+             "opt_state": trainer.opt_state}
+    # quantized-collective error-feedback residuals are step state: a
+    # resume without them diverges from the uninterrupted trajectory
+    residuals = getattr(trainer, "residuals", None)
+    if residuals:
+        state["residuals"] = dict(residuals)
+    return state
 
 
 def _abstract_like(tree):
+    # sharding is optional so numpy-fake trainers (tests, supervisor
+    # unit coverage) round-trip without a device mesh
     return jax.tree_util.tree_map(
         lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
-                                       sharding=a.sharding),
+                                       sharding=getattr(a, "sharding",
+                                                        None)),
         tree)
+
+
+def _inject(site, modes):
+    """Checkpoint-site fault hook.  fail raises, delay/stall sleep;
+    a fired ``corrupt`` rule is RETURNED for the caller to apply to
+    real bytes on disk (this is the torn/bit-flipped-payload site —
+    nothing useful flows through the call itself)."""
+    plan = _faults.active()
+    if plan is None:
+        return None
+    rule = plan.fire(site, modes=modes)
+    if rule is None:
+        return None
+    if rule.mode == "fail":
+        raise _faults.InjectedFault(site)
+    if rule.mode in ("delay", "stall"):
+        time.sleep(rule.ms / 1e3)
+        return None
+    return rule                         # corrupt
+
+
+def _flip_payload_byte(root):
+    """Bit-flip one byte of the largest payload file under ``root`` —
+    the injected silent-rot / torn-write.  Returns the mutated path
+    (or None when the directory holds nothing to corrupt)."""
+    victim, size = None, -1
+    for dirpath, _dirs, files in os.walk(root):
+        for name in files:
+            path = os.path.join(dirpath, name)
+            try:
+                n = os.path.getsize(path)
+            except OSError:
+                continue
+            if n > size:
+                victim, size = path, n
+    if victim is None or size <= 0:
+        return None
+    with open(victim, "r+b") as f:
+        f.seek(size // 2)
+        byte = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    return victim
 
 
 class CheckpointManager:
@@ -89,37 +169,104 @@ class CheckpointManager:
                 max_to_keep=max_to_keep,
                 enable_async_checkpointing=async_write))
         self._pending = []              # steps saved, durability unknown
+        self._pending_extra = {}        # step -> extra payload (JSON)
         self._signal_prev = {}          # signum -> previous handler
 
     # ----------------------------------------------------------- save/load
-    def save(self, step: int, trainer):
+    def save(self, step: int, trainer, extra=None):
+        """Queue one async sharded save.  ``extra`` (JSON-serializable
+        dict: RNG snapshot, iterator cursor, ...) is persisted at the
+        durability barrier alongside the step."""
         ocp = _ocp()
         step = int(step)
+        _inject("checkpoint.save", modes=("fail", "delay", "stall"))
         self._mngr.save(step,
                         args=ocp.args.StandardSave(
                             _trainer_state(trainer)))
         # the marker only advances at the durability barrier (wait/
         # close/signal-save) — an async save is not yet a fact
         self._pending.append(step)
+        if extra is not None:
+            self._pending_extra[step] = extra
 
     def restore(self, trainer, step: Optional[int] = None) -> int:
-        """Restore ``trainer``'s params/opt_state in place; returns the
-        restored step.  ``step=None`` restores the newest VERIFIED
-        step: the atomic marker wins over the backend's directory
-        listing, so a checkpoint torn by a mid-save kill is never
-        auto-restored (address it explicitly via ``step=`` to try)."""
+        """Restore ``trainer``'s state in place; returns the restored
+        step.  ``step=None`` walks the newest-verified-first candidate
+        list: the atomic marker's step, then older retained steps —
+        each integrity-checked against its barrier manifest before any
+        bytes are trusted, so a corrupt/torn payload at the marker
+        step FALLS BACK to the previous verified step with a warning
+        instead of raising (or worse, loading rot).  An explicit
+        ``step=`` restores exactly that step and raises on damage."""
+        corrupt = _inject("checkpoint.restore",
+                          modes=("fail", "delay", "stall", "corrupt"))
+        if step is not None:
+            if corrupt is not None:
+                flipped = _flip_payload_byte(self._step_dir(int(step)))
+                _LOG.warning("checkpoint: injected payload corruption "
+                             "at step %d (%s)", int(step), flipped)
+            return self._restore_exact(trainer, int(step))
+        candidates = self._candidate_steps()
+        if not candidates:
+            raise MXNetError(
+                f"no checkpoint found under {self._dir}")
+        if corrupt is not None:
+            flipped = _flip_payload_byte(self._step_dir(candidates[0]))
+            _LOG.warning("checkpoint: injected payload corruption at "
+                         "step %d (%s)", candidates[0], flipped)
+        verified = self.latest_verified_step()
+        # while the marker step is still retained, any NEWER step
+        # without a manifest never completed a barrier (kill mid-save)
+        # — "no manifest" there means torn, not legacy, and restoring
+        # it would also skip its extra payload (RNG/cursor), breaking
+        # bit-exact resume.  A STALE marker (its step already
+        # retention-GC'd) proves nothing about newer steps, so the
+        # legacy best-available fallback applies there.
+        marker_retained = verified is not None and verified in candidates
+        failures = []
+        for cand in candidates:
+            require = marker_retained and cand > verified
+            ok, why = self._verify_step(cand,
+                                        require_manifest=require)
+            if not ok:
+                _LOG.warning(
+                    "checkpoint: step %d payload corrupt/torn (%s) — "
+                    "falling back to the previous verified step", cand,
+                    why)
+                failures.append((cand, why))
+                continue
+            try:
+                return self._restore_exact(trainer, cand)
+            except Exception as e:  # noqa: BLE001 — try older steps
+                _LOG.warning(
+                    "checkpoint: restore of step %d failed (%s) — "
+                    "falling back to the previous verified step",
+                    cand, e)
+                failures.append((cand, repr(e)))
+        raise MXNetError(
+            f"no restorable checkpoint under {self._dir}: every "
+            f"candidate failed verification or restore: {failures}")
+
+    def _restore_exact(self, trainer, step: int) -> int:
         ocp = _ocp()
-        if step is None:
-            step = self.latest_step()
-            if step is None:
-                raise MXNetError(
-                    f"no checkpoint found under {self._dir}")
         target = _abstract_like(_trainer_state(trainer))
         restored = self._mngr.restore(
             int(step), args=ocp.args.StandardRestore(target))
         trainer.params = dict(restored["params"])
         trainer.opt_state = restored["opt_state"]
+        if "residuals" in restored and hasattr(trainer, "residuals"):
+            trainer.residuals = dict(restored["residuals"])
         return int(step)
+
+    def _candidate_steps(self):
+        """Auto-restore order: the verified-marker step first, then
+        every other retained step newest-first."""
+        steps = sorted(self._mngr.all_steps(), reverse=True)
+        verified = self.latest_verified_step()
+        if verified is not None and verified in steps:
+            steps.remove(verified)
+            steps.insert(0, verified)
+        return steps
 
     def latest_step(self) -> Optional[int]:
         """Newest restorable step: the verified marker when present
@@ -161,23 +308,132 @@ class CheckpointManager:
             return None
 
     def _commit_marker(self, step):
-        """Atomically repoint the marker: write a tmp file, fsync it,
-        rename over the marker.  A kill at ANY instant leaves either
-        the old marker or the new one — never a torn pointer."""
-        tmp = self._marker_path + ".tmp"
+        """Atomically repoint the marker (tmp + fsync + rename): a
+        kill at ANY instant leaves either the old marker or the new
+        one — never a torn pointer."""
+        self._atomic_write(self._marker_path, f"{int(step)}\n")
+
+    # ------------------------------------------- integrity manifest + extra
+    def _step_dir(self, step):
+        return os.path.join(self._dir, str(int(step)))
+
+    def _manifest_path(self, step):
+        return os.path.join(self._dir, f"VERIFY-{int(step)}.json")
+
+    def _extra_path(self, step):
+        return os.path.join(self._dir, f"EXTRA-{int(step)}.json")
+
+    @staticmethod
+    def _atomic_write(path, text):
+        tmp = path + ".tmp"
         with open(tmp, "w") as f:
-            f.write(f"{int(step)}\n")
+            f.write(text)
             f.flush()
             os.fsync(f.fileno())
-        os.replace(tmp, self._marker_path)
+        os.replace(tmp, path)
+
+    def _hash_step(self, step):
+        """{relative path: sha256} over the step directory."""
+        root = self._step_dir(step)
+        digests = {}
+        for dirpath, _dirs, files in os.walk(root):
+            for name in sorted(files):
+                path = os.path.join(dirpath, name)
+                h = hashlib.sha256()
+                try:
+                    with open(path, "rb") as f:
+                        for chunk in iter(lambda: f.read(1 << 20), b""):
+                            h.update(chunk)
+                except OSError:
+                    continue            # transient tmp file mid-rename
+                digests[os.path.relpath(path, root)] = h.hexdigest()
+        return digests
+
+    def _write_manifest(self, step):
+        self._atomic_write(
+            self._manifest_path(step),
+            json.dumps({"step": int(step),
+                        "files": self._hash_step(step)}))
+
+    def _verify_step(self, step, require_manifest=False):
+        """(ok, why) integrity verdict for one step.  Without
+        ``require_manifest``, no manifest (a pre-manifest legacy
+        directory) counts as ok — the restore itself is then the only
+        available check, and its failure still falls back."""
+        try:
+            with open(self._manifest_path(step)) as f:
+                manifest = json.load(f)
+        except OSError:
+            if require_manifest:
+                return False, ("no manifest — the step never "
+                               "completed a durability barrier")
+            return True, "no manifest (pre-manifest step)"
+        except ValueError as e:
+            return False, f"manifest unreadable: {e}"
+        expect = manifest.get("files", {})
+        got = self._hash_step(step)
+        if got != expect:
+            changed = sorted(
+                set(expect) ^ set(got)
+                | {p for p in expect
+                   if p in got and got[p] != expect[p]})
+            return False, f"payload digest mismatch: {changed[:4]}"
+        return True, "verified"
+
+    def load_extra(self, step):
+        """The ``extra`` payload saved with ``step`` (or None)."""
+        try:
+            with open(self._extra_path(step)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _gc_sidecars(self):
+        """Drop VERIFY-/EXTRA- files for steps the backend's retention
+        already garbage-collected."""
+        try:
+            live = {int(s) for s in self._mngr.all_steps()}
+            names = os.listdir(self._dir)
+        except Exception:   # noqa: BLE001 — housekeeping, best effort
+            return
+        for name in names:
+            for prefix in ("VERIFY-", "EXTRA-"):
+                if name.startswith(prefix) and name.endswith(".json"):
+                    try:
+                        step = int(name[len(prefix):-len(".json")])
+                    except ValueError:
+                        continue
+                    if step not in live:
+                        try:
+                            os.remove(os.path.join(self._dir, name))
+                        except OSError:
+                            pass
 
     def wait(self):
-        """Block until pending async writes are durable, then advance
-        the verified-latest marker to the newest of them."""
+        """Block until pending async writes are durable, then record
+        each pending step's integrity manifest (+ extra payload) and
+        advance the verified-latest marker to the newest of them."""
         self._mngr.wait_until_finished()
         if self._pending:
-            self._commit_marker(max(self._pending))
+            newest = max(self._pending)
+            for step in sorted(set(self._pending)):
+                extra = self._pending_extra.pop(step, None)
+                if extra is not None:
+                    self._atomic_write(self._extra_path(step),
+                                       json.dumps(extra))
+                self._write_manifest(step)
+            self._commit_marker(newest)
             self._pending = []
+            self._gc_sidecars()
+            # the torn/bit-rot injection site: corrupt AFTER the
+            # barrier verified the step, so restore must detect it
+            # via the manifest and fall back
+            if _inject("checkpoint.save", modes=("corrupt",)) \
+                    is not None:
+                flipped = _flip_payload_byte(self._step_dir(newest))
+                _LOG.warning(
+                    "checkpoint: injected payload corruption at "
+                    "verified step %d (%s)", newest, flipped)
 
     def close(self):
         self.wait()

@@ -13,7 +13,34 @@ import re
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["ShardingRules", "MEGATRON_RULES", "partition_params"]
+__all__ = ["ShardingRules", "MEGATRON_RULES", "partition_params",
+           "global_device_put"]
+
+
+def global_device_put(value, sharding):
+    """``jax.device_put`` that also works when ``sharding`` spans
+    devices this process cannot address (a multi-process global mesh).
+
+    Plain ``device_put`` of a host value onto a non-addressable
+    sharding lowers to cross-host transfer collectives, which the gloo
+    CPU transport aborts with a mismatched-size ``EnforceNotMet``
+    (the tests/test_dist two-process SPMD failure).  In the SPMD
+    program model every process already holds the same host value, so
+    the local shards can be sliced out directly and assembled with
+    ``make_array_from_callback`` — zero wire traffic, and the only
+    path jax guarantees for building global arrays from host data.
+    """
+    if getattr(value, "sharding", None) == sharding:
+        return value
+    devices = getattr(sharding, "device_set", None)
+    if devices is None \
+            or all(d.process_index == jax.process_index()
+                   for d in devices):
+        return jax.device_put(value, sharding)
+    import numpy as np
+    host = np.asarray(value)
+    return jax.make_array_from_callback(host.shape, sharding,
+                                        lambda idx: host[idx])
 
 
 class ShardingRules:
@@ -77,5 +104,5 @@ MEGATRON_RULES = ShardingRules([
 def partition_params(params, mesh, rules=MEGATRON_RULES):
     """Device-put a params dict with rule-derived NamedShardings."""
     shardings = rules.shardings(mesh, params)
-    return {n: jax.device_put(a, shardings[n]) for n, a in params.items()}, \
-        shardings
+    return {n: global_device_put(a, shardings[n])
+            for n, a in params.items()}, shardings

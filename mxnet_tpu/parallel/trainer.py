@@ -21,13 +21,15 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import faults as _faults
 from .. import quantize as qz
 from .. import runtime_metrics as _rm
 from .._jax_compat import shard_map_unchecked
 from ..base import MXNetError
 from . import optim as _optim
 from .functional import functionalize
-from .sharding import MEGATRON_RULES, partition_params
+from .sharding import MEGATRON_RULES, global_device_put, partition_params
+from .supervisor import StepWatchdog
 
 __all__ = ["ShardedTrainer"]
 
@@ -57,12 +59,18 @@ class ShardedTrainer:
     def __init__(self, block, loss_fn, mesh: Mesh, optimizer="adamw",
                  optimizer_params=None, rules=MEGATRON_RULES,
                  example_inputs=(), n_labels=1, dtype=None,
-                 compression=None):
+                 compression=None, step_timeout_ms=None,
+                 slow_step_factor=None):
         if optimizer not in _OPTIMS:
             raise MXNetError(f"unknown optimizer {optimizer!r}; "
                              f"known: {sorted(_OPTIMS)}")
         self.mesh = mesh
         self.block = block
+        # step deadline + straggler detection (defaults from
+        # MXNET_TRAIN_STEP_TIMEOUT_MS / MXNET_TRAIN_SLOW_STEP_FACTOR;
+        # both off = step() dispatches directly, zero wrapper cost)
+        self.watchdog = StepWatchdog(timeout_ms=step_timeout_ms,
+                                     slow_factor=slow_step_factor)
         self.compression = qz.CompressionSpec.parse(compression)
         if self.compression is not None:
             if "dp" not in mesh.shape:
@@ -118,8 +126,7 @@ class ShardedTrainer:
         # misses the jit cache and RECOMPILES the whole step
         opt_shardings = opt_shard(self.param_shardings, repl)
         self.opt_state = jax.tree_util.tree_map(
-            lambda a, s: jax.device_put(a, s), self.opt_state,
-            opt_shardings)
+            global_device_put, self.opt_state, opt_shardings)
 
         if self.compression is None:
             def train_step(params, opt_state, *batch):
@@ -173,7 +180,7 @@ class ShardedTrainer:
         # residual leading axis = dp (each device's rounding error);
         # f32 regardless of param dtype (the EF accumulate-wide rule)
         self.residuals = {
-            n: jax.device_put(
+            n: global_device_put(
                 jnp.zeros((ndp,) + tuple(self.params[n].shape),
                           jnp.float32), res_sharding)
             for n in comp_names}
@@ -249,24 +256,75 @@ class ShardedTrainer:
         out = []
         for a in arrays:
             spec = P(*(["dp"] + [None] * (a.ndim - 1)))
-            out.append(jax.device_put(a, NamedSharding(self.mesh, spec)))
+            out.append(global_device_put(
+                a, NamedSharding(self.mesh, spec)))
         return tuple(out)
 
     def step(self, *batch):
-        """One compiled step; returns the (replicated) scalar loss."""
+        """One compiled step; returns the (replicated) scalar loss.
+
+        Under an active watchdog (``MXNET_TRAIN_STEP_TIMEOUT_MS`` /
+        ``MXNET_TRAIN_SLOW_STEP_FACTOR``) the dispatch runs to DEVICE
+        COMPLETION on a deadline thread: a wedged collective raises
+        :class:`~.supervisor.TrainStepTimeoutError` inside the
+        configured deadline instead of hanging the loop, and stragglers
+        fire ``train.slow_steps``.  ``faults.inject("train.step")`` is
+        the chaos hook for the whole step."""
         batch = self.shard_batch(*[getattr(b, "_data", b) for b in batch])
-        if self.compression is None:
-            self.params, self.opt_state, loss = self._step(
-                self.params, self.opt_state, *batch)
+        if self.watchdog.active:
+            out = self.watchdog.watch(
+                lambda: self._dispatch_step(batch, sync=True))
         else:
-            self._quant_step += 1
-            key = jax.random.PRNGKey(self._quant_step)
-            self.params, self.opt_state, self.residuals, loss = \
-                self._step(self.params, self.opt_state, self.residuals,
-                           key, *batch)
+            out = self._dispatch_step(batch, sync=False)
+        # commit on the CALLING thread only: after a watchdog timeout
+        # the abandoned worker may eventually finish, and its output
+        # must never clobber state the supervisor has since restored
+        # from a checkpoint (run_with_deadline discards it instead)
+        self.params, self.opt_state, residuals, quant_step, loss = out
+        if residuals is not None:
+            self.residuals = residuals
+        if quant_step is not None:
+            self._quant_step = quant_step
             if _rm._ENABLED:
                 _rm.KV_WIRE_BYTES.inc(self.wire_bytes_per_step)
         return loss
+
+    def _dispatch_step(self, batch, sync):
+        """Pure with respect to trainer attributes — runs on the
+        watchdog worker thread when a deadline is set, so it must only
+        COMPUTE the new state and return it; ``step()`` commits."""
+        # the fault site lives inside the watched call: a ``stall``
+        # here is the wedged-collective shape the deadline must bound
+        _faults.inject("train.step")
+        if self.compression is None:
+            params, opt_state, loss = self._step(
+                self.params, self.opt_state, *batch)
+            residuals = quant_step = None
+        else:
+            quant_step = self._quant_step + 1
+            key = jax.random.PRNGKey(quant_step)
+            params, opt_state, residuals, loss = \
+                self._step(self.params, self.opt_state, self.residuals,
+                           key, *batch)
+        if sync:
+            # the deadline must cover execution, not just dispatch —
+            # async dispatch would "beat" any timeout while the wedged
+            # collective hangs the NEXT host sync instead
+            jax.block_until_ready(loss)
+        return params, opt_state, residuals, quant_step, loss
+
+    def extra_state(self):
+        """Non-array step state for checkpoint ``extra`` payloads —
+        the quantized-collective step counter seeds each step's
+        stochastic-rounding key, so bit-exact resume must restore it."""
+        if self.compression is not None:
+            return {"quant_step": int(self._quant_step)}
+        return {}
+
+    def set_extra_state(self, state):
+        if self.compression is not None and state \
+                and "quant_step" in state:
+            self._quant_step = int(state["quant_step"])
 
     def write_back(self):
         """Copy trained params back into the Block's Parameters."""

@@ -25,8 +25,8 @@ import jax.numpy as jnp
 from .base import get_env
 from .ops.registry import register
 
-__all__ = ["seed", "next_key", "trace_key_scope", "uniform", "normal",
-           "randint", "randn"]
+__all__ = ["seed", "next_key", "trace_key_scope", "get_state",
+           "set_state", "uniform", "normal", "randint", "randn"]
 
 
 class _RandState(threading.local):
@@ -50,6 +50,26 @@ def seed(seed_state: int, ctx: str = "all"):
     """Reference: mx.random.seed — reseed the global generator."""
     _STATE.key = jax.random.PRNGKey(int(seed_state))
     _STATE.trace_counter = 0
+
+
+def get_state():
+    """Snapshot of this thread's eager PRNG stream as plain host data
+    (JSON-serializable), for checkpoint/resume: restoring it with
+    :func:`set_state` makes the subsequent draw sequence bit-identical
+    to what an uninterrupted run would have produced.  Counter-based
+    threefry makes this tiny — the whole stream is one key."""
+    import numpy as np
+    # the global key is a raw uint32 PRNGKey array (threefry data)
+    return {"key": [int(v) for v in np.asarray(_global_key()).ravel()],
+            "trace_counter": _STATE.trace_counter}
+
+
+def set_state(state):
+    """Restore a :func:`get_state` snapshot into this thread's eager
+    PRNG (the checkpoint-resume half of the bit-exact contract)."""
+    import numpy as np
+    _STATE.key = jnp.asarray(np.array(state["key"], dtype=np.uint32))
+    _STATE.trace_counter = int(state.get("trace_counter", 0))
 
 
 def next_key():

@@ -683,6 +683,26 @@ TRAINER_GRAD_NORM = gauge(
 TRAINER_SAMPLES_PER_SEC = gauge(
     "trainer.samples_per_sec",
     "Training throughput published by callback.Speedometer.")
+TRAIN_RESTARTS = counter(
+    "train.restarts",
+    "TrainingSupervisor restore+restart cycles after a transient "
+    "train-loop failure (injected kill, step timeout, device blip).  "
+    "Under a chaos plan this must equal the injected kill count.")
+TRAIN_RECOVERY_SECONDS = histogram(
+    "train.recovery.seconds",
+    "Wall-clock cost of one supervised recovery: checkpoint restore + "
+    "RNG/data-cursor rewind, from failure acceptance to the loop "
+    "being ready to re-step (backoff sleep excluded).")
+TRAIN_STEP_TIMEOUTS = counter(
+    "train.step.timeouts",
+    "ShardedTrainer steps killed by the MXNET_TRAIN_STEP_TIMEOUT_MS "
+    "watchdog deadline (wedged collective / stuck device) — each one "
+    "raised a TrainStepTimeoutError instead of hanging the loop.")
+TRAIN_SLOW_STEPS = counter(
+    "train.slow_steps",
+    "Straggler steps: watched step time exceeded "
+    "MXNET_TRAIN_SLOW_STEP_FACTOR x the rolling median (flight-"
+    "recorder incident dumped per detection).")
 MEMORY_LIVE_BYTES = gauge(
     "memory.live_bytes",
     "Live accelerator bytes per device (host RSS fallback when the "

@@ -170,17 +170,17 @@ class TestMultiHostSPMD:
     differently across process boundaries (SURVEY §4 multi-node,
     §5.8)."""
 
-    @pytest.mark.skip(reason=(
-        "known pre-existing failure, quarantined for tier-1 signal: "
-        "the 2-process x 4-device global-mesh ShardedTrainer step "
-        "fails byte-identically on pristine HEAD (reproduce with "
-        "`python -m pytest tests/test_dist.py -k "
-        "two_process_global_mesh` on any commit >= PR 9; the workers "
-        "burn the full 420s launch timeout), so it both fails AND "
-        "truncates the tier-1 tail under the 870s budget.  Root cause "
-        "is in the multi-process mesh bootstrap, not any serving/"
-        "resilience change — unskip when fixing ROADMAP item 1's "
-        "multi-host path."))
+    # Root cause of the long-standing failure (fixed): plain
+    # jax.device_put of host values onto shardings spanning
+    # NON-ADDRESSABLE devices lowers to cross-host transfer
+    # collectives, and the gloo TCP transport aborts on them with
+    # `gloo::EnforceNotMet: op.preamble.length <= op.nbytes` (worker-0
+    # SIGABRT -> the peer then burned the launch timeout in the
+    # coordination barrier — the "hang" was the symptom, the abort the
+    # disease).  parallel.sharding.global_device_put now assembles
+    # global arrays from locally-sliced host shards
+    # (make_array_from_callback) instead, which needs no wire traffic;
+    # ShardedTrainer uses it for params/opt-state/residuals/batches.
     def test_two_process_global_mesh_trainer_step(self, tmp_path):
         script = _write(tmp_path, "w.py", """
             import numpy as np

@@ -121,8 +121,34 @@ def diagnose(metrics_smoke=False):
         for key, fired in sorted(plan.counters().items()):
             print(f"  fired      : {key} x{fired}")
 
-    _section("Replica Serving")
+    _section("Training Resilience")
     from mxnet_tpu.base import get_env
+    timeout_ms = get_env("MXNET_TRAIN_STEP_TIMEOUT_MS", typ=float)
+    slow = get_env("MXNET_TRAIN_SLOW_STEP_FACTOR", typ=float)
+    print(f"step deadline: "
+          + (f"{timeout_ms:g}ms (TrainStepTimeoutError past it)"
+             if timeout_ms else
+             "(off — set MXNET_TRAIN_STEP_TIMEOUT_MS to bound a "
+             "wedged collective; docs/training_resilience.md §3)"))
+    print(f"straggler    : "
+          + (f"step > {slow:g}x rolling median -> train.slow_steps + "
+             f"incident dump" if slow else
+             "(off — set MXNET_TRAIN_SLOW_STEP_FACTOR)"))
+    print(f"supervisor   : crash-loop breaker after "
+          f"{get_env('MXNET_TRAIN_MAX_RESTARTS', typ=int)} consecutive "
+          f"restarts; backoff "
+          f"{get_env('MXNET_TRAIN_RESTART_BACKOFF_MS', typ=float):g}ms "
+          f"doubling, cap "
+          f"{get_env('MXNET_TRAIN_RESTART_BACKOFF_MAX_MS', typ=float):g}"
+          f"ms (jitter U[0.5, 1.0))")
+    from mxnet_tpu import runtime_metrics as _trm
+    if _trm.enabled():
+        print(f"restarts     : {_trm.TRAIN_RESTARTS.value():g} "
+              f"(+ {_trm.TRAIN_STEP_TIMEOUTS.value():g} step "
+              f"timeout(s), {_trm.TRAIN_SLOW_STEPS.value():g} slow "
+              f"step(s) this process)")
+
+    _section("Replica Serving")
     n_rep = get_env("MXNET_SERVING_REPLICAS", typ=int)
     print(f"replicas     : {n_rep}  (MXNET_SERVING_REPLICAS; > 1 "
           f"serves every model through a health-checked ReplicaSet; "

@@ -3,7 +3,9 @@
 Scope: the threading-reachable modules (``engine``, ``serving/*`` —
 including ``serving/replica.py``, where heartbeat threads, the
 replica router, and request workers all cross the set condition —
-``runtime_metrics``, ``tracing``, ``parallel/dist``, ``faults`` — the
+``runtime_metrics``, ``tracing``, ``parallel/dist``,
+``parallel/supervisor`` (the step-watchdog deadline worker vs the
+train loop), ``faults`` — the
 surfaces where worker pools, the metrics registry, the span tracer,
 fault-plan trigger state, and multi-process shutdown already shipped
 race fixes).  Four checks:
@@ -43,6 +45,9 @@ _SCOPE_RES = [re.compile(p) for p in (
     # the fault-injection plan is mutated from every serving thread
     # that hits an injection point — same discipline as serving/*
     r"(^|/)faults\.py$",
+    # the training supervisor's watchdog crosses threads (the deadline
+    # worker vs the train loop) — same discipline
+    r"(^|/)parallel/supervisor\.py$",
 )]
 
 _LOCKISH = re.compile(r"lock|cond|mutex|_mu$", re.IGNORECASE)
