@@ -51,6 +51,16 @@ sanity_lint() {
         --baseline ci/mxlint_baseline.json --update-baseline \
         mxnet_tpu/ tools/
     git diff --exit-code -- ci/mxlint_baseline.json
+    # chaos specs live in tests/benches too: a typo'd MXNET_FAULTS
+    # pattern there is a chaos test that tests nothing — hold them to
+    # the declared fault-site registry (the other 12 passes stay
+    # scoped to the product tree)
+    python -m tools.mxlint --format json --select fault-site-soundness \
+        tests/ benchmark/
+    # the fault-site tables in docs/serving.md §8 and
+    # docs/training_resilience.md §2 are generated from the registry —
+    # stale tables fail the job (same discipline as env_vars.md)
+    python tools/gen_fault_docs.py --check
     # then the dynamic half: engine+serving tests double as race tests
     # under the concurrency sanitizer (lock-order recording + tracked-
     # array assertions)

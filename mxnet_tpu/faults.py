@@ -11,36 +11,21 @@ the decode engine, and the page allocator) to one of four fault modes,
 with seeded-RNG probability and after-N-calls triggers, so a 5%%
 execute-fault chaos run replays byte-identically from its spec string.
 
-Site catalogue (fnmatch globs — ``decode.*`` matches the engine):
-``serving.execute`` / ``serving.compile`` (batcher),
-``deploy.execute``, ``compile_cache.load``,
-``repository.load_artifact``, ``decode.prefill``, ``decode.step``,
-``decode.prefix_lookup`` (prefix-cache radix lookup at admission — a
-failed/corrupted lookup must degrade to a plain prefill, never to
-wrong tokens; the site passes no value through, so ``corrupt`` raises
-like ``fail`` instead of silently handing back wrong pages),
-``decode.verify`` (speculative verification — a target-model failure,
-quarantining that sequence through the §8 path),
-``kv_cache.allocate`` (fail-only: injected pool exhaustion is a
-refusal, not an exception), and the replica-scoped family
-(docs/serving.md §10): ``replica.<rid>.execute`` (one replica's
-dispatch), ``replica.<rid>.heartbeat`` (its beat loop — ``stall`` is
-the wedged-worker shape siblings must detect), and
-``replica.<rid>.decode.{prefill,step,verify,prefix_lookup}`` (a
-replica-owned decode engine's §8 sites under its own prefix) — kill
-ONE replica by id, or every replica at once via ``replica.*`` globs.
-
-Training-plane family (docs/training_resilience.md §2):
-``train.step`` (one ``ShardedTrainer.step`` — ``stall`` is the wedged
-collective the step watchdog must bound), ``train.data.next`` (the
-data iterator's batch handoff), ``kvstore.push`` / ``kvstore.pull``
-(classic tiers) and ``kvstore.pushpull`` (the fused XLA collective
-launch on the 'xla' tier), ``checkpoint.save`` (``corrupt`` fires at
-the durability barrier and bit-flips one byte of the just-verified
-payload — the silent-rot/torn-write shape the integrity manifest must
-catch) and ``checkpoint.restore`` (``corrupt`` bit-flips the
-candidate payload before it is read, forcing the verified-step
-fallback).  Kill the whole training plane at once with ``train.*``.
+Site catalogue: every injection point is **declared** via
+:func:`declare_fault_site` at the bottom of this module — the single
+source of truth for the tables in docs/serving.md §8 and
+docs/training_resilience.md §2 (rendered by ``tools/gen_fault_docs.py
+--check`` in CI) and for the ``fault-site-soundness`` mxlint pass,
+which statically validates every ``inject()``/``check()`` site literal
+and every ``MXNET_FAULTS`` spec pattern in tests/benches/CI against it
+(a typo'd site silently never fires — a chaos test that tests
+nothing).  Dynamic scopes (one site name per replica id) are declared
+as templates with ``<placeholder>`` segments:
+``replica.<rid>.heartbeat`` covers ``replica.r0.heartbeat``.  fnmatch
+globs in plan specs match across the whole catalogue (``decode.*``
+matches the engine, ``train.*`` the training plane,
+``replica.<rid>.*`` one replica); :func:`FaultPlan.parse` warns on a
+rule whose pattern can match no declared site.
 
 Spec grammar (``MXNET_FAULTS``, or :func:`install` / :func:`plan`)::
 
@@ -83,18 +68,139 @@ from __future__ import annotations
 
 import fnmatch
 import logging
+import re as _re
 import threading
 import time
 
 from .base import MXNetError, get_env
 
-__all__ = ["FaultRule", "FaultPlan", "InjectedFault", "install",
+__all__ = ["FaultRule", "FaultPlan", "InjectedFault", "FaultSite",
+           "declare_fault_site", "declared_sites",
+           "pattern_matches_declared", "install",
            "clear", "active", "plan", "inject", "check", "counters"]
 
 _LOG = logging.getLogger("mxnet_tpu")
 
 _MODES = ("fail", "delay", "corrupt", "stall")
 _DEFAULT_MS = {"delay": 10.0, "stall": 1000.0}
+
+
+# ---------------------------------------------------------------------------
+# declared-site registry (the single source of truth for injection points)
+# ---------------------------------------------------------------------------
+_SITE_SEGMENT = _re.compile(r"^(?:[a-z0-9_]+|<[a-z0-9_]+>)$")
+
+
+class FaultSite:
+    """One declared injection point.  ``name`` may carry
+    ``<placeholder>`` segments for dynamic scopes
+    (``replica.<rid>.heartbeat``); ``modes`` are the fault modes the
+    site honors (``kv_cache.allocate`` is fail-only: exhaustion is a
+    refusal, not an exception); ``plane``/``where``/``notes`` feed the
+    generated doc tables (tools/gen_fault_docs.py)."""
+
+    __slots__ = ("name", "modes", "plane", "where", "notes")
+
+    def __init__(self, name, modes, plane, where, notes):
+        self.name = name
+        self.modes = tuple(modes)
+        self.plane = plane
+        self.where = where
+        self.notes = notes
+
+    def glob(self):
+        """The site as an fnmatch glob: placeholders become ``*``."""
+        return _re.sub(r"<[a-z0-9_]+>", "*", self.name)
+
+    def __repr__(self):
+        return f"FaultSite({self.name!r}, modes={self.modes})"
+
+
+FAULT_SITES = {}
+
+
+def declare_fault_site(name, modes=_MODES, *, plane="serving", where="",
+                       notes=""):
+    """Register one injection point (or ``<placeholder>`` template).
+    Call sites (``inject``/``check``) and ``MXNET_FAULTS`` spec
+    patterns are validated against this registry — statically by the
+    ``fault-site-soundness`` mxlint pass, and at plan-parse time by the
+    unmatched-pattern warning in :meth:`FaultPlan.parse`."""
+    name = str(name)
+    if not name or not all(_SITE_SEGMENT.match(seg)
+                           for seg in name.split(".")):
+        raise MXNetError(
+            f"fault site {name!r}: expected dotted lowercase segments "
+            f"(dynamic parts as <placeholder>), e.g. "
+            f"'replica.<rid>.heartbeat'")
+    bad = [m for m in modes if m not in _MODES]
+    if bad:
+        raise MXNetError(
+            f"fault site {name!r}: unknown mode(s) {bad} "
+            f"(expected subset of {'/'.join(_MODES)})")
+    # mxlint: disable=lock-discipline (contract: sites are declared at
+    # import time — the module-bottom catalogue and plugin import
+    # bodies — before any chaos plan can run; at runtime the registry
+    # is read-only)
+    FAULT_SITES[name] = FaultSite(name, modes, plane, where, notes)
+    return name
+
+
+def declared_sites():
+    """{name: FaultSite} — the registry snapshot (doc generation,
+    diagnose, tests)."""
+    return dict(FAULT_SITES)
+
+
+def _globs_intersect(a, b):
+    """Whether two fnmatch globs can match a common string (``*`` any
+    sequence, ``?``/``[...]`` any one char — the char-class
+    overapproximation can only say "maybe" where the truth is "no",
+    which keeps every consumer on the stay-quiet side)."""
+    a = _re.sub(r"\[[^\]]*\]", "?", a)
+    b = _re.sub(r"\[[^\]]*\]", "?", b)
+    seen = set()
+    stack = [(0, 0)]
+    while stack:
+        i, j = stack.pop()
+        if (i, j) in seen:
+            continue
+        seen.add((i, j))
+        if i == len(a) and j == len(b):
+            return True
+        if i < len(a) and a[i] == "*":
+            stack.append((i + 1, j))            # * matches empty
+            if j < len(b):
+                stack.append((i, j + 1))        # * absorbs one char of b
+            continue
+        if j < len(b) and b[j] == "*":
+            stack.append((i, j + 1))
+            if i < len(a):
+                stack.append((i + 1, j))
+            continue
+        if i < len(a) and j < len(b) \
+                and (a[i] == "?" or b[j] == "?" or a[i] == b[j]):
+            stack.append((i + 1, j + 1))
+    return False
+
+
+def pattern_matches_declared(pattern, mode=None):
+    """Whether an fnmatch site ``pattern`` can match at least one
+    declared site (template placeholders wild) — and, with ``mode``,
+    one that honors that mode.  A pattern failing this is a chaos rule
+    that can never fire."""
+    pattern = str(pattern)
+    if "<" in pattern or ">" in pattern:
+        # a copy-pasted template name ("replica.<rid>.heartbeat"): the
+        # literal placeholder never fnmatches a runtime site, but glob
+        # intersection against the template would wave it through —
+        # the site-name grammar forbids angle brackets, so reject here
+        return False
+    for site in FAULT_SITES.values():
+        if _globs_intersect(str(pattern), site.glob()) \
+                and (mode is None or mode in site.modes):
+            return True
+    return False
 
 
 class InjectedFault(MXNetError):
@@ -231,7 +337,24 @@ class FaultPlan:
             raise MXNetError(
                 f"fault spec {spec!r} holds no rules — expected "
                 f"'site=mode[,k=v...][;...]'")
-        return cls([_parse_rule(c) for c in clauses], spec=str(spec))
+        rules = [_parse_rule(c) for c in clauses]
+        # the PR-11 bug class: a typo'd site/pattern silently never
+        # fires, and the chaos run "passes" while testing nothing.  A
+        # warning (not an error): faults are a test harness, and the
+        # registry must never make the harness itself the failure.
+        for r in rules:
+            if not pattern_matches_declared(r.pattern):
+                _LOG.warning(
+                    "faults: rule %r matches no declared fault site — "
+                    "it can never fire (catalogue: "
+                    "faults.declared_sites(), docs/serving.md §8)",
+                    r.spec())
+            elif not pattern_matches_declared(r.pattern, mode=r.mode):
+                _LOG.warning(
+                    "faults: rule %r: no site matching %r honors mode "
+                    "%r — it can never fire", r.spec(), r.pattern,
+                    r.mode)
+        return cls(rules, spec=str(spec))
 
     # ------------------------------------------------------------- firing
     def fire(self, site, modes=None):
@@ -412,6 +535,9 @@ def inject(site, value=None):
         raise InjectedFault(site)
     if rule.mode == "corrupt":
         return _corrupt_value(site, value)
+    # mxlint: disable=deadline-soundness (contract: this sleep IS the
+    # injected delay/stall fault — the unbounded hang under test that
+    # the runtime deadline machinery must bound from the outside)
     time.sleep(rule.ms / 1e3)           # delay | stall
     return value
 
@@ -428,5 +554,103 @@ def check(site):
         return False
     return fp.fire(site, modes=("fail",)) is not None
 
+
+# ---------------------------------------------------------------------------
+# the declared-site catalogue (tools/gen_fault_docs.py renders this into
+# docs/serving.md §8 and docs/training_resilience.md §2; the
+# fault-site-soundness mxlint pass validates every call site and spec
+# pattern against it).  Declared BEFORE the env plan parses so a typo'd
+# MXNET_FAULTS pattern warns at import.
+# ---------------------------------------------------------------------------
+declare_fault_site(
+    "serving.execute", where="DynamicBatcher.run_batch device execute",
+    notes="what the serving retry + bisection + deadline machinery "
+          "absorbs")
+declare_fault_site(
+    "serving.compile", where="DynamicBatcher.program_for bucket build",
+    notes="transient build failure; waiters hand the build to a "
+          "retrier, `stall` is the wedged-builder shape the deadline "
+          "bound covers")
+declare_fault_site(
+    "deploy.execute", where="StableHLOModel.call direct artifact call")
+declare_fault_site(
+    "compile_cache.load", where="persistent compile-cache blob read",
+    notes="`corrupt` flips a byte so the checksum tier must catch it; "
+          "all modes degrade to a counted miss — the cache never "
+          "raises")
+declare_fault_site(
+    "repository.load_artifact", where="ModelRepository deploy-path pull")
+declare_fault_site(
+    "decode.prefill", where="DecodeEngine prefill model call")
+declare_fault_site(
+    "decode.step", where="DecodeEngine fixed-batch decode step")
+declare_fault_site(
+    "decode.verify", where="speculative verification (target model)",
+    notes="failure bisects, then quarantines the poisoned sequence "
+          "through the §8 path")
+declare_fault_site(
+    "decode.prefix_lookup", where="prefix-cache radix lookup at "
+                                  "admission",
+    notes="degrades to a plain prefill, never wrong tokens; no value "
+          "flows through, so `corrupt` raises like `fail`")
+declare_fault_site(
+    "kv_cache.allocate", modes=("fail",),
+    where="PageAllocator page grant",
+    notes="fail-only: injected pool exhaustion is a refusal, not an "
+          "exception")
+declare_fault_site(
+    "replica.<rid>.execute", where="one replica's dispatch "
+                                   "(docs/serving.md §10)",
+    notes="kill ONE replica by id, or all at once via `replica.*`")
+declare_fault_site(
+    "replica.<rid>.heartbeat", where="one replica's beat loop",
+    notes="`stall` is the wedged-worker shape siblings must detect")
+declare_fault_site(
+    "replica.<rid>.decode.prefill",
+    where="a replica-owned decode engine's prefill")
+declare_fault_site(
+    "replica.<rid>.decode.step",
+    where="a replica-owned decode engine's decode step")
+declare_fault_site(
+    "replica.<rid>.decode.verify",
+    where="a replica-owned decode engine's speculative verify")
+declare_fault_site(
+    "replica.<rid>.decode.prefix_lookup",
+    where="a replica-owned decode engine's prefix-cache lookup")
+
+declare_fault_site(
+    "train.step", plane="training",
+    where="ShardedTrainer.step() entry "
+          "(docs/training_resilience.md §2)",
+    notes="`stall` is the wedged-collective shape the step watchdog "
+          "must bound; `fail` the mid-step kill; `corrupt` raises "
+          "(nothing flows through)")
+declare_fault_site(
+    "train.data.next", modes=("fail", "delay", "stall"), plane="training",
+    where="every DataIter.next() batch handoff",
+    notes="fires before the cursor advances — a killed fetch never "
+          "half-consumes a batch")
+declare_fault_site(
+    "kvstore.push", modes=("fail", "delay", "stall"), plane="training",
+    where="classic kvstore push tier",
+    notes="covers gluon.Trainer's sync path")
+declare_fault_site(
+    "kvstore.pull", modes=("fail", "delay", "stall"), plane="training",
+    where="classic kvstore pull tier")
+declare_fault_site(
+    "kvstore.pushpull", modes=("fail", "delay", "stall"), plane="training",
+    where="fused XLA collective launch (kvstore('xla'))",
+    notes="one bucketed allreduce = one site")
+declare_fault_site(
+    "checkpoint.save", plane="training",
+    where="CheckpointManager.save; the durability barrier (corrupt)",
+    notes="`corrupt` bit-flips one byte of the just-verified step's "
+          "payload — post-barrier silent rot the integrity manifest "
+          "must detect, never load")
+declare_fault_site(
+    "checkpoint.restore", plane="training",
+    where="CheckpointManager.restore",
+    notes="`corrupt` flips the candidate payload before it is read, "
+          "forcing the verified-step fallback")
 
 _ACTIVE = _init_from_env()

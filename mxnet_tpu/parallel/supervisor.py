@@ -379,6 +379,10 @@ class TrainingSupervisor:
         # the barrier makes save_every the VERIFIED cadence: each
         # periodic save is durable (manifest + marker) before the loop
         # continues, so it is always a legal restore target
+        # mxlint: disable=deadline-soundness (contract: the durability
+        # barrier must complete before the marker advances — a deadline
+        # here would tear the checkpoint; the job tier (dist.Watchdog /
+        # the launcher) bounds a wedged backend)
         self.manager.wait()
 
     def _recover(self):
@@ -431,6 +435,10 @@ class TrainingSupervisor:
             self._restarts, self._consec, self._max_restarts,
             delay * 1e3)
         if delay > 0:
+            # mxlint: disable=deadline-soundness (contract: restart
+            # backoff, bounded by MXNET_TRAIN_RESTART_BACKOFF_MAX_MS
+            # per sleep and by the crash-loop breaker in total — the
+            # training plane has no request deadline to consume)
             time.sleep(delay)
         t0 = time.perf_counter()
         self._recover()

@@ -26,6 +26,7 @@ import numpy as np
 from .. import engine, faults as _faults, runtime_metrics as _rm, \
     tracing as _tr
 from ..base import MXNetError
+from .resilience import DeadlineExceededError
 
 __all__ = ["DynamicBatcher", "next_bucket", "bucket_set", "pad_batch",
            "unpad_outputs"]
@@ -137,14 +138,21 @@ class DynamicBatcher:
         return jax.default_device(self.device)
 
     # ------------------------------------------------------------- cache
-    def program_for(self, entry, bucket_rows):
+    def program_for(self, entry, bucket_rows, deadline=None):
         """The cached program for one (entry, bucket) — built (compiled
         or deserialized from the persistent compile cache) on first
         lookup.  The build runs OUTSIDE the batcher lock: an XLA
         compile can take seconds, and holding the lock through it would
         stall every other model's mem-hit lookups.  Concurrent lookups
         of the SAME key wait on the builder instead of compiling twice,
-        so misses stay == compiled programs."""
+        so misses stay == compiled programs.
+
+        ``deadline`` (a :class:`~.resilience.Deadline`) bounds the
+        builder wait: a wedged builder (the ``serving.compile`` stall
+        fault) must surface as ``DeadlineExceededError`` within the
+        request's budget, not hang the worker forever — the §8
+        no-silent-hangs contract.  Deadline-less callers (prewarm,
+        tests) keep the unbounded wait."""
         key = (entry.uid, bucket_rows)
         while True:
             with self._lock:
@@ -159,7 +167,16 @@ class DynamicBatcher:
                 if pending is None:
                     self._building[key] = threading.Event()
                     break               # this thread builds
-            pending.wait()              # builder done (or failed): recheck
+            # builder done (or failed): recheck.  wait(None) is the
+            # unbounded legacy wait for deadline-less callers.
+            remaining = None if deadline is None else deadline.remaining()
+            if not pending.wait(remaining) and deadline is not None \
+                    and deadline.expired():
+                raise DeadlineExceededError(
+                    f"serving program build ({entry.name!r}, bucket "
+                    f"{bucket_rows})", deadline.timeout,
+                    "another thread's bucket build did not complete "
+                    "within the request deadline")
         try:
             # chaos site: a transient compile/build failure — the
             # worker-level retry policy re-enters program_for, and the
@@ -220,9 +237,10 @@ class DynamicBatcher:
                 f"batch dimension cannot be batch-served")
         return entry.fixed_batch
 
-    def run_batch(self, entry, request_inputs):
+    def run_batch(self, entry, request_inputs, deadline=None):
         """Pad, execute, sync, un-pad one coalesced batch.  Returns the
-        list of per-request output tuples."""
+        list of per-request output tuples.  ``deadline`` bounds the
+        bucket-program build wait (see :meth:`program_for`)."""
         rows = sum(req[0].shape[0] for req in request_inputs)
         bucket = self.bucket_for(entry, rows)
         # annotate whatever span the dispatching worker entered (the
@@ -230,7 +248,7 @@ class DynamicBatcher:
         _tr.tag("bucket", bucket)
         _tr.tag("rows", rows)
         padded, offsets = pad_batch(request_inputs, bucket)
-        prog = self.program_for(entry, bucket)
+        prog = self.program_for(entry, bucket, deadline=deadline)
         with _tr.span("serving.execute", bucket=bucket, rows=rows):
             # chaos site: device-execute fail/delay/stall — what the
             # serving retry + bisection + deadline machinery absorbs

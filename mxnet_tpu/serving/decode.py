@@ -556,6 +556,10 @@ class DecodeEngine:
             with self._cond:
                 while not self._stopping and not self._waiting \
                         and not self._running:
+                    # mxlint: disable=deadline-soundness (contract:
+                    # idle park — no sequence is admitted, so there is
+                    # no deadline to consume; every submit/stop
+                    # notifies)
                     self._cond.wait()
                 if self._stopping:
                     return

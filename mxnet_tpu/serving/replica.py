@@ -68,7 +68,8 @@ from .. import engine as _engine, faults as _faults, \
 from ..base import MXNetError
 from .batcher import DynamicBatcher
 from .repository import prewarm_buckets, synth_inputs
-from .resilience import (CircuitBreaker, Deadline, ServerOverloadedError,
+from .resilience import (CircuitBreaker, Deadline,
+                         DeadlineExceededError, ServerOverloadedError,
                          is_transient)
 
 __all__ = ["Replica", "ReplicaSet", "STARTING", "PREWARMING", "HEALTHY",
@@ -596,9 +597,17 @@ class ReplicaSet:
             try:
                 _faults.inject(f"replica.{rep.rid}.execute")
                 results = rep.batcher.run_batch(self.entry,
-                                                request_inputs)
+                                                request_inputs,
+                                                deadline=deadline)
             except Exception as e:      # noqa: BLE001 — policy below
                 self._note_done(rep)
+                if isinstance(e, DeadlineExceededError):
+                    # a deadline that expired waiting (e.g. on another
+                    # thread's bucket build) says nothing about THIS
+                    # replica's health — same exclusion the model-level
+                    # breaker applies; the budget is burned, so no
+                    # sibling can serve it either
+                    raise
                 self._record_outcome(rep, False)
                 # only retryable failures reroute: a deterministic
                 # error (malformed request, poisoned input) fails

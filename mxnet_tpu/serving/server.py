@@ -509,7 +509,7 @@ class ModelServer:
         if self._replicated(entry):
             return self._replica_set(entry).run_batch(
                 inputs, deadline=deadline)
-        return self.batcher.run_batch(entry, inputs)
+        return self.batcher.run_batch(entry, inputs, deadline=deadline)
 
     # ------------------------------------------------------------- generate
     def _decoder_engine(self, entry):
@@ -837,6 +837,9 @@ class ModelServer:
                         return None
                     # idle: block until an enqueue/stop notifies (every
                     # state change that creates work calls notify_all)
+                    # mxlint: disable=deadline-soundness (contract:
+                    # idle park — the queues are empty, so no admitted
+                    # request's deadline is burning)
                     self._cond.wait()
                     continue
                 entry, q = ripe
@@ -904,6 +907,22 @@ class ModelServer:
                 deadline=group_deadline,
                 rng=self._retry_rng,
                 on_retry=lambda n, e: self._note_retry(entry, n, e))
+        except DeadlineExceededError as e:
+            # a group-deadline expiry (wedged bucket build, or the
+            # retry budget burned against the tightest member) says
+            # nothing about a poisoned request — don't bisect or count
+            # it as one.  Fail the members whose own budget is gone
+            # and re-dispatch the rest under their looser deadlines
+            # (program_for raises only after the group deadline truly
+            # expired, so at least one member leaves on every pass).
+            alive, gone = [], []
+            for r in reqs:
+                (gone if r.deadline.expired() else alive).append(r)
+            gone = [(r, e) for r in gone]
+            if not alive or not gone:   # no-gone: unknown raise site —
+                return [], gone + [(r, e) for r in alive]  # never loop
+            ok, bad = self._dispatch_group(entry, alive)
+            return ok, bad + gone
         except Exception as e:      # noqa: BLE001 — isolate the poison
             if len(reqs) == 1:
                 # also log it: a caller that already timed out will
@@ -978,16 +997,26 @@ class ModelServer:
             _share_batch_span()           # bspan ended by the with-exit
             done = time.monotonic()
             breaker = self._breaker(entry)
+            n_deadline = sum(1 for _r, e in bad
+                             if isinstance(e, DeadlineExceededError))
             with self._cond:
                 self._stats["completed"] += len(ok)
                 self._stats["errors"] += len(bad)
+                self._stats["deadline_exceeded"] += n_deadline
                 self._inflight -= len(reqs)
                 self._cond.notify_all()
             # publish outcomes AFTER the shared bookkeeping: breaker
             # records execute outcomes only (expired requests above
-            # never reached the model and say nothing about health)
+            # never reached the model and say nothing about health —
+            # and neither does a deadline that expired waiting on a
+            # bucket build, so those skip the breaker too)
             for r, e in bad:
-                breaker.record(False)
+                if isinstance(e, DeadlineExceededError):
+                    if _rm._ENABLED:
+                        _rm.SERVING_DEADLINE_EXCEEDED.inc(
+                            model=entry.name)
+                else:
+                    breaker.record(False)
                 r.error = e
                 r.event.set()
             for r in ok:

@@ -33,3 +33,10 @@ def _fixed_seed():
     mx.random.seed(seed)
     np.random.seed(seed)
     yield
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 `-m 'not slow'` budget run "
+        "(ROADMAP.md); the full suite still runs them")

@@ -166,6 +166,10 @@ def test_fpn_level_routing():
     assert list(lvl) == [0, 0, 1, 2], list(lvl)
 
 
+# ISSUE-15 tier-1 relief: the two-stage convergence run costs ~38s;
+# tier-1 keeps the RPN training test and the shape/target assertions,
+# and examples/faster_rcnn.py carries the full convergence gate.
+@pytest.mark.slow
 def test_rcnn_targets_and_second_stage_trains():
     """Second-stage targets assign the right class, and the full
     two-stage loss (RPN + ROI head) decreases on a fixed scene."""

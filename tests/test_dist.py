@@ -79,6 +79,10 @@ class TestLauncher:
                                env_extra=_worker_env(), timeout=240)
         assert rc == 0
 
+    # ISSUE-15 tier-1 relief: two spawned processes + detection window
+    # cost ~28s; tier-1 keeps the in-process watchdog-abort test, the
+    # slow tier keeps this full two-process ladder.
+    @pytest.mark.slow
     def test_failure_detection_aborts_job(self, tmp_path):
         """§5.3: one dead worker must take the job down, not hang it."""
         script = _write(tmp_path, "w.py", """

@@ -6,6 +6,10 @@ compiles — so deadline propagation, retry/bisection, decode
 quarantine, and the circuit-breaker lifecycle are tested at step
 granularity with seeded, replayable fault plans.
 """
+# mxlint: disable-file=fault-site-soundness (this file unit-tests the
+# FaultPlan machinery itself on deliberately synthetic sites ('s.x',
+# 'c.b', ...); the real-site specs below assert their own firing, so a
+# typo'd real site fails the test rather than silently testing nothing)
 import threading
 import time
 
@@ -567,6 +571,126 @@ class TestPredictResilience:
         finally:
             tracing.disable()
             tracing.TRACER.reset()
+
+
+class TestBuildWaitDeadline:
+    """ISSUE-15 sweep fix: the bucket-program build wait in
+    DynamicBatcher.program_for was the one unbounded blocking call on
+    the predict path (flagged by the deadline-soundness lint pass) — a
+    wedged builder (the serving.compile stall fault) hung every waiter
+    of that key forever.  The wait now drains the request Deadline."""
+
+    def _blocked_entry(self):
+        repo = serving.ModelRepository()
+        repo.add_function("m", lambda a: a, SIG)
+        entry = repo.get("m")
+        in_build, release = threading.Event(), threading.Event()
+        real = entry.make_program
+
+        def blocking_make_program(rows):
+            in_build.set()
+            assert release.wait(30)
+            return real(rows)
+        entry.make_program = blocking_make_program
+        return repo, entry, in_build, release
+
+    def test_program_build_wait_honors_deadline(self):
+        _repo, entry, in_build, release = self._blocked_entry()
+        batcher = serving.DynamicBatcher(_cfg())
+        builder = threading.Thread(
+            target=lambda: batcher.program_for(entry, 1))
+        builder.start()
+        try:
+            assert in_build.wait(10)        # the build is wedged
+            t0 = time.monotonic()
+            with pytest.raises(DeadlineExceededError,
+                               match="bucket build"):
+                batcher.program_for(entry, 1,
+                                    deadline=Deadline.start(0.2))
+            assert time.monotonic() - t0 < 5    # typed failure, no hang
+        finally:
+            release.set()
+            builder.join(30)
+        # the builder completed normally; the key now mem-hits and a
+        # deadline-less lookup keeps the legacy unbounded path
+        assert batcher.program_for(entry, 1) is not None
+
+    def test_build_wait_deadline_skips_breaker(self):
+        """A deadline that expired waiting on another thread's build
+        says nothing about the model version's health: it must count
+        into serving.deadline_exceeded, never into the circuit window
+        (window=1/threshold=1.0 would trip on a single recorded
+        failure and shed the NEXT request)."""
+        repo, _entry, in_build, release = self._blocked_entry()
+        x = np.zeros((1, 2), dtype=np.float32)
+        with serving.ModelServer(repo, _cfg(
+                num_workers=2, circuit_window=1,
+                circuit_threshold=1.0)) as srv:
+            done = []
+            first = threading.Thread(
+                target=lambda: done.append(
+                    srv.predict("m", x, timeout=60)))
+            first.start()
+            try:
+                assert in_build.wait(10)    # worker A wedged building
+                # worker B pops this one, reaches program_for, and
+                # must fail it typed within the 0.3s budget
+                with pytest.raises(DeadlineExceededError):
+                    srv.predict("m", x, timeout=0.3)
+                # poll for the count while the builder is STILL wedged
+                # (the worker publishes asynchronously after the
+                # caller raised; releasing first would let its wait
+                # succeed and legitimately count nothing)
+                t0 = time.monotonic()
+                while time.monotonic() - t0 < 10 and \
+                        rm.SERVING_DEADLINE_EXCEEDED.value(
+                            model="m") < 1:
+                    time.sleep(0.01)
+                assert rm.SERVING_DEADLINE_EXCEEDED.value(
+                    model="m") >= 1
+            finally:
+                release.set()
+                first.join(30)
+            assert len(done) == 1           # the builder's request won
+            assert srv.stats()["deadline_exceeded"] >= 1
+            # breaker never saw the deadline expiry: a fresh request
+            # is admitted (an open circuit would shed it instantly)
+            np.testing.assert_array_equal(
+                srv.predict("m", x, timeout=60), x)
+
+    def test_group_deadline_expiry_is_not_bisection(self):
+        """Review fix: a group-deadline expiry (wedged bucket build)
+        says nothing about a poisoned request — the expired coalesced
+        members fail typed WITHOUT the bisection stat, and a member
+        with budget left is re-dispatched and completes."""
+        repo, entry, in_build, release = self._blocked_entry()
+        srv = serving.ModelServer(repo, _cfg(), autostart=False)
+        x = np.zeros((1, 2), dtype=np.float32)
+        # wedge the 4-row bucket this 3-request group coalesces into
+        bucket = srv.batcher.bucket_for(entry, 3)
+        builder = threading.Thread(
+            target=lambda: srv.batcher.program_for(entry, bucket))
+        builder.start()
+        try:
+            assert in_build.wait(10)
+            expired = [serving.server._Request(
+                entry, (x,), 1, deadline=Deadline.start(0.0))
+                for _ in range(2)]
+            alive = serving.server._Request(
+                entry, (x,), 1, deadline=Deadline.start(30.0))
+            # the alive member's solo re-dispatch builds the 1-row
+            # bucket itself and would wedge too — un-wedge it shortly
+            threading.Timer(0.3, release.set).start()
+            ok, bad = srv._dispatch_group(entry, expired + [alive])
+        finally:
+            release.set()
+            builder.join(30)
+        assert ok == [alive]
+        np.testing.assert_array_equal(alive.result[0], x)
+        assert sorted(id(r) for r, _e in bad) \
+            == sorted(id(r) for r in expired)
+        assert all(isinstance(e, DeadlineExceededError) for _r, e in bad)
+        assert srv.stats()["bisected"] == 0
 
 
 # ----------------------------------------------------- decode-path chaos

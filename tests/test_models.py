@@ -143,6 +143,10 @@ def test_model_zoo_inception_forward():
     assert out.shape == (1, 7)
 
 
+# ISSUE-15 tier-1 relief: training the deepest zoo model costs ~60s;
+# the slow tier keeps it, tier-1 keeps densenet121's forward test plus
+# the cheaper zoo train coverage below.
+@pytest.mark.slow
 def test_model_zoo_densenet_trains():
     net = gluon.model_zoo.get_model("densenet121", classes=4)
     net.initialize()

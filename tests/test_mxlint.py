@@ -31,7 +31,8 @@ def test_pass_catalogue_complete():
                            "metrics-misuse", "env-registry",
                            "collective-soundness", "resource-leak",
                            "shape-soundness", "dtype-promotion",
-                           "recompile-churn"}
+                           "recompile-churn", "fault-site-soundness",
+                           "deadline-soundness", "telemetry-drift"}
 
 
 # ---------------------------------------------------------------- jit-retrace

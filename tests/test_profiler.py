@@ -135,33 +135,40 @@ class TestProfiler:
 
     def test_concurrent_record_vs_dump_reset(self):
         """Satellite regression: event appends racing dumps(reset=True)
-        must neither crash nor corrupt the trace structure."""
+        must neither crash nor corrupt the trace structure.
+
+        The writers are BOUNDED (ISSUE-15 tier-1 relief): the original
+        free-running version raced unbounded appends against a fixed
+        200-round dump loop — whenever 4 spinning producers out-ran one
+        json-encoding consumer (any loaded CI box), the backlog grew
+        every round and the encode diverged into a multi-minute hang
+        that truncated the whole tier-1 tail.  A fixed per-writer event
+        budget keeps the interleaving (appends land mid-swap on every
+        run) while capping total work at well under a second."""
         import threading
         profiler.set_config(filename="/tmp/_race.json")
         profiler.start()
-        stop_evt = threading.Event()
         errs = []
 
         def writer():
             c = profiler.Counter(name="race")
-            i = 0
             try:
-                while not stop_evt.is_set():
+                for i in range(4000):
                     c.set_value(i)
                     profiler._record("spin", "user", profiler._now_us(),
                                      1.0)
-                    i += 1
             except Exception as e:      # noqa: BLE001
                 errs.append(e)
 
         threads = [threading.Thread(target=writer) for _ in range(4)]
         for t in threads:
             t.start()
-        for _ in range(200):
+        # dump-reset continuously while the writers drain their budgets
+        while any(t.is_alive() for t in threads):
             json.loads(profiler.dumps(reset=True))
-        stop_evt.set()
         for t in threads:
             t.join()
+        json.loads(profiler.dumps(reset=True))      # the racing tail
         profiler.stop()
         assert not errs
 

@@ -199,6 +199,51 @@ class TestReplicaSetPredict:
                 rset.run_batch([(poison,)])
             assert rset.stats()["failovers"] == 0
 
+    def test_build_wait_deadline_never_counts_against_replica(self):
+        """Review fix (ISSUE-15): a deadline that expires waiting on
+        another thread's bucket build says nothing about the replica's
+        health — with threshold=1 a single recorded failure would mark
+        it UNHEALTHY, so the expiry must skip the replica breaker
+        (mirroring the model-level breaker's exclusion)."""
+        from mxnet_tpu.serving.resilience import DeadlineExceededError
+        rset = _rset(replicas=1, replica_failure_threshold=1)
+        try:
+            entry = rset.entry
+            in_build, release = threading.Event(), threading.Event()
+            real = entry.make_program
+
+            def blocking_make_program(rows):
+                in_build.set()
+                assert release.wait(30)
+                return real(rows)
+            # prewarm already built every bucket: evict so the next
+            # dispatch rebuilds through the wedged builder
+            rset.replica("r0").batcher.evict(entry)
+            entry.make_program = blocking_make_program
+            x = np.ones((1, 2), np.float32)
+            done = []
+            builder = threading.Thread(
+                target=lambda: done.append(rset.run_batch([(x,)])))
+            builder.start()
+            try:
+                assert in_build.wait(10)
+                with pytest.raises(DeadlineExceededError):
+                    rset.run_batch([(x,)], deadline=Deadline.start(0.2))
+                # no outcome recorded: the replica stays routable
+                assert rset.replicas()["r0"] == HEALTHY
+                assert rset.stats()["failovers"] == 0
+            finally:
+                release.set()
+                builder.join(30)
+            assert len(done) == 1
+            entry.make_program = real
+            # and the replica still serves
+            np.testing.assert_allclose(
+                rset.run_batch([(x,)])[0][0], _fn(x))
+            assert rset.replicas()["r0"] == HEALTHY
+        finally:
+            rset.stop()
+
     def test_consecutive_failures_trip_then_probe_recovers(self):
         rset = _rset(replica_failure_threshold=2)
         try:

@@ -112,12 +112,25 @@ def diagnose(metrics_smoke=False):
 
     _section("Fault Injection")
     from mxnet_tpu import faults
+    sites = faults.declared_sites()
+    print(f"declared     : {len(sites)} sites "
+          f"(faults.declared_sites(); tables in docs/serving.md §8 + "
+          f"docs/training_resilience.md §2)")
     plan = faults.active()
     if plan is None:
         print("plan         : (off — set MXNET_FAULTS to chaos-test "
               "the serving resilience layer; docs/serving.md §8)")
     else:
         print(f"plan         : {plan.spec}")
+        for rule in plan.rules:
+            if not faults.pattern_matches_declared(rule.pattern):
+                print(f"  DEAD RULE  : {rule.spec()} matches no "
+                      f"declared site — it can never fire")
+            elif not faults.pattern_matches_declared(rule.pattern,
+                                                     mode=rule.mode):
+                print(f"  DEAD RULE  : {rule.spec()}: no site matching "
+                      f"{rule.pattern!r} honors mode {rule.mode!r} — "
+                      f"it can never fire")
         for key, fired in sorted(plan.counters().items()):
             print(f"  fired      : {key} x{fired}")
 

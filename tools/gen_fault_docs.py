@@ -1,0 +1,64 @@
+"""Regenerate the fault-site tables in docs/serving.md §8 and
+docs/training_resilience.md §2 from the single-source registry
+(``mxnet_tpu.faults.declare_fault_site`` — the same
+declare-once-render-everywhere discipline as tools/gen_env_docs.py).
+
+Usage: python tools/gen_fault_docs.py [--check]
+  --check: exit 1 if a committed doc is out of date (CI mode; run by
+  the ``sanity_lint`` job and tests/test_mxlint_contracts.py).
+"""
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOCS = {
+    "serving": os.path.join(REPO, "docs", "serving.md"),
+    "training": os.path.join(REPO, "docs", "training_resilience.md"),
+}
+BEGIN = "<!-- BEGIN generated fault-site table (tools/gen_fault_docs.py) -->"
+END = "<!-- END generated fault-site table -->"
+
+
+def render_table(plane):
+    sys.path.insert(0, REPO)
+    from mxnet_tpu import faults
+    rows = ["| site | where | modes | notes |", "|---|---|---|---|"]
+    for name, site in faults.declared_sites().items():
+        if site.plane != plane:
+            continue
+        modes = "/".join(site.modes)
+        notes = site.notes.replace("|", "\\|")
+        where = site.where.replace("|", "\\|")
+        rows.append(f"| `{name}` | {where} | {modes} | {notes} |")
+    return "\n".join(rows)
+
+
+def main(check=False):
+    rc = 0
+    for plane, doc in DOCS.items():
+        with open(doc) as f:
+            text = f.read()
+        if BEGIN not in text:
+            sys.stderr.write(f"{doc}: missing {BEGIN!r} marker\n")
+            return 2
+        head, rest = text.split(BEGIN, 1)
+        if END not in rest:
+            sys.stderr.write(f"{doc}: missing {END!r} marker\n")
+            return 2
+        _old, tail = rest.split(END, 1)
+        new = head + BEGIN + "\n" + render_table(plane) + "\n" + END \
+            + tail
+        if check:
+            if new != text:
+                sys.stderr.write(
+                    f"{os.path.relpath(doc, REPO)} fault-site table is "
+                    f"stale — run tools/gen_fault_docs.py\n")
+                rc = 1
+            continue
+        with open(doc, "w") as f:
+            f.write(new)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(check="--check" in sys.argv[1:]))

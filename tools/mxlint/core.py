@@ -117,12 +117,33 @@ class Project:
     ``env_declared``: MXNET_* names declared via ``declare_env`` anywhere
     in the scanned tree; ``env_documented``: names appearing in
     docs/env_vars.md (covers prose-documented test/launcher knobs).
-    Tests construct this directly to exercise passes against fixtures.
+    ``fault_sites``: fault injection points declared via
+    ``declare_fault_site`` ({name: modes-tuple or None for all};
+    ``<placeholder>`` templates included) — the fault-site-soundness
+    pass falls back to parsing the repo's ``mxnet_tpu/faults.py`` when
+    the scanned set declares none.  ``ci_shell_texts``: {path: text}
+    of CI shell scripts whose ``MXNET_FAULTS=`` specs are validated
+    too (None = load ``ci/*.sh`` from the repo at harvest).
+    ``doc_metrics`` / ``doc_spans``: {documented name: doc line} for
+    the telemetry-drift pass (None = parse docs/observability.md at
+    harvest).  Tests construct this directly to exercise passes
+    against fixtures.
     """
 
-    def __init__(self, env_declared=None, env_documented=None):
+    def __init__(self, env_declared=None, env_documented=None,
+                 fault_sites=None, ci_shell_texts=None,
+                 doc_metrics=None, doc_spans=None):
         self.env_declared = set(env_declared or ())
         self.env_documented = set(env_documented or ())
+        self.fault_sites: Dict[str, Optional[tuple]] = dict(
+            fault_sites or {})
+        # explicit = a test injected its own registry (authoritative);
+        # otherwise the fault-site pass merges the repo's faults.py
+        # catalogue under whatever the scanned files declare
+        self.fault_sites_explicit = fault_sites is not None
+        self.ci_shell_texts = ci_shell_texts
+        self.doc_metrics = doc_metrics
+        self.doc_spans = doc_spans
         self.files: List[SourceFile] = []
         self._callgraph = None
         self._summaries = None
@@ -156,12 +177,20 @@ class Project:
         self._summaries = None
         for f in self.files:
             for node in ast.walk(f.tree):
-                if isinstance(node, ast.Call) \
-                        and _call_name(node).endswith("declare_env") \
+                if not isinstance(node, ast.Call):
+                    continue
+                name = _call_name(node)
+                if name.endswith("declare_env") \
                         and node.args \
                         and isinstance(node.args[0], ast.Constant) \
                         and isinstance(node.args[0].value, str):
                     self.env_declared.add(node.args[0].value)
+                elif name.endswith("declare_fault_site") \
+                        and node.args \
+                        and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str):
+                    self.fault_sites[node.args[0].value] = \
+                        _literal_modes(node)
         doc = os.path.join(self._repo_root(), "docs", "env_vars.md")
         if os.path.exists(doc):
             with open(doc) as fh:
@@ -174,6 +203,22 @@ def _call_name(node: ast.Call) -> str:
     """Dotted name of a call target (``jax.block_until_ready`` ->
     'jax.block_until_ready'); empty string for non-name callees."""
     return dotted_name(node.func)
+
+
+def _literal_modes(call: ast.Call) -> Optional[tuple]:
+    """The ``modes=(...)`` literal of a ``declare_fault_site`` call
+    (second positional accepted too); None = all modes."""
+    expr = None
+    if len(call.args) > 1:
+        expr = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "modes":
+            expr = kw.value
+    if isinstance(expr, (ast.Tuple, ast.List)) and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, str)
+            for e in expr.elts):
+        return tuple(e.value for e in expr.elts)
+    return None
 
 
 def dotted_name(node) -> str:

@@ -15,3 +15,6 @@ from . import resource_leak         # noqa: F401
 from . import shape_soundness       # noqa: F401
 from . import dtype_promotion       # noqa: F401
 from . import recompile_churn       # noqa: F401
+from . import fault_site            # noqa: F401
+from . import deadline_soundness    # noqa: F401
+from . import telemetry_drift       # noqa: F401

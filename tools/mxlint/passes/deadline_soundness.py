@@ -1,0 +1,224 @@
+"""deadline-soundness: no silent hangs on deadline-carrying paths.
+
+PR 11's invariant — *a caller sees bounded latency or a typed, fast
+failure, never a hang* — is enforced at runtime by ``Deadline``
+threading (docs/serving.md §8).  Nothing enforced it statically: one
+``time.sleep``, one ``Condition.wait()`` without a timeout, or one
+``retry_call`` that forgets ``deadline=`` anywhere under the dispatch
+path silently reintroduces the unbounded wait the runtime machinery
+exists to kill.  This pass is the static twin of that invariant.
+
+**Blocking sinks** (what can wait forever):
+
+- ``time.sleep(x)`` — unguarded unless the enclosing function is
+  *deadline-aware*: it reads a parameter named ``deadline``/``timeout``
+  or consults ``.remaining()`` / ``.expired()`` / ``Deadline.start``
+  (the ``retry_call`` shape: the backoff is checked against the budget
+  before sleeping);
+- ``<x>.wait()`` with **no arguments** — a ``Condition``/``Event`` wait
+  with no timeout; any argument (``wait(deadline.remaining())``) is the
+  bounded form;
+- ``<queue>.get()`` with **no arguments** on a queue-named receiver
+  (``self._queue.get()``) — the blocking pop; ``get(timeout=...)`` is
+  bounded (``dict.get`` always takes a key and never matches);
+- ``retry_call(...)`` / ``honor_retry_after(...)`` without
+  ``deadline=`` — the retry loop would happily back off past every
+  caller's budget.
+
+**Deadline-carrying entry points** (where a request's budget is live):
+``ModelServer.predict`` / ``generate`` / ``_worker_loop``,
+``DynamicBatcher.run_batch`` / ``program_for``, ``DecodeEngine._loop``
+/ ``step``, ``ReplicaSet.run_batch`` / ``generate``, and
+``TrainingSupervisor.run`` / ``_run_loop`` (the restart loop a wedged
+recovery would hang).  Reachability runs over the PR-4 call graph, so
+a sleep buried N helpers deep is flagged *at the sleep* with the
+``via helper (file:line)`` chain from the entry point — and a finding
+fires through unchanged helpers in ``--changed`` mode.
+
+An intentional unbounded wait (an idle worker parked on its condition
+until work arrives; the fault injector's stall mode, which *is* the
+hang under test) carries a ``# mxlint: disable=deadline-soundness``
+suppression whose prose states the contract — grep for the pass id to
+audit every exemption.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import LintPass, SourceFile, dotted_name, register_pass
+
+# class name -> deadline-carrying methods (fixtures name their classes
+# the same way; the set is the ISSUE-15 contract surface)
+ENTRY_METHODS = {
+    "ModelServer": {"predict", "generate", "_worker_loop"},
+    "DynamicBatcher": {"run_batch", "program_for"},
+    "DecodeEngine": {"_loop", "step"},
+    "ReplicaSet": {"run_batch", "generate"},
+    "TrainingSupervisor": {"run", "_run_loop"},
+}
+
+_RETRY_HELPERS = {"retry_call", "honor_retry_after"}
+_DEADLINE_PARAMS = {"deadline", "timeout", "timeout_s", "timeout_ms"}
+_DEADLINE_METHODS = {"remaining", "expired"}
+
+
+def _is_queue_name(expr) -> bool:
+    if isinstance(expr, ast.Attribute):
+        name = expr.attr
+    elif isinstance(expr, ast.Name):
+        name = expr.id
+    else:
+        return False
+    return "queue" in name.lower() or name in ("q", "_q")
+
+
+def _deadline_aware(fn_node, params) -> bool:
+    """Whether a function's body consults a deadline at all: reads a
+    deadline/timeout parameter, calls ``.remaining()``/``.expired()``,
+    or starts a ``Deadline``.  Coarse by design — the fine-grained
+    bound lives at the sink (a wait with a timeout argument is always
+    bounded); this rule only covers the ``retry_call`` shape where the
+    sleep is guarded by a budget check on a neighboring line."""
+    budget_params = _DEADLINE_PARAMS & set(params)
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load) \
+                and node.id in budget_params:
+            return True
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            term = name.rsplit(".", 1)[-1]
+            if term in _DEADLINE_METHODS and "." in name:
+                return True
+            if name.endswith("Deadline.start"):
+                return True
+    return False
+
+
+class _Sink:
+    __slots__ = ("node", "kind", "detail")
+
+    def __init__(self, node, kind, detail):
+        self.node = node
+        self.kind = kind
+        self.detail = detail
+
+
+@register_pass
+class DeadlineSoundnessPass(LintPass):
+    id = "deadline-soundness"
+    doc = ("blocking call (time.sleep, no-timeout Condition/Event "
+           ".wait(), blocking queue .get(), retry_call/"
+           "honor_retry_after without deadline=) reachable from a "
+           "deadline-carrying entry point without consuming the "
+           "Deadline — the static twin of the no-silent-hangs "
+           "invariant (docs/serving.md §8)")
+
+    def __init__(self, project):
+        super().__init__(project)
+        self._reach = None      # qname -> (entry description, hops)
+
+    # -------------------------------------------------------- reachability
+    def _reachable(self):
+        """{qname: (entry label, ((fn, path, line), ...))} — BFS from
+        every entry method over the call graph; shortest chain wins."""
+        if self._reach is not None:
+            return self._reach
+        graph = self.project.callgraph()
+        reach = {}
+        frontier = []
+        for qname, fn in graph.functions.items():
+            cls = fn.cls
+            if cls is None or fn.parent is not None:
+                continue
+            methods = ENTRY_METHODS.get(cls.name)
+            if methods and fn.node.name in methods:
+                label = f"{cls.name}.{fn.node.name}"
+                reach[qname] = (label, ())
+                frontier.append(qname)
+        while frontier:
+            nxt = []
+            for qname in frontier:
+                label, hops = reach[qname]
+                for site in graph.calls.get(qname, ()):
+                    cq = site.callee.qname
+                    if cq in reach:
+                        continue
+                    hop = (site.callee.node.name,
+                           graph.functions[qname].src.path,
+                           site.node.lineno)
+                    reach[cq] = (label, hops + (hop,))
+                    nxt.append(cq)
+            frontier = nxt
+        self._reach = reach
+        return reach
+
+    # ------------------------------------------------------------- checks
+    def check_file(self, src: SourceFile):
+        graph = self.project.callgraph()
+        reach = self._reachable()
+        for fn_node in ast.walk(src.tree):
+            if not isinstance(fn_node, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                continue
+            info = graph.function_at(fn_node)
+            if info is None or info.qname not in reach:
+                continue
+            label, hops = reach[info.qname]
+            sinks = self._sinks(info)
+            for sink in sinks:
+                yield self._report(src, info, sink, label, hops)
+
+    def _sinks(self, info):
+        """Unguarded blocking sinks in one function's own body."""
+        aware = None        # computed lazily: only sleep needs it
+        for node in self._local_nodes(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            term = name.rsplit(".", 1)[-1]
+            if name == "time.sleep":
+                if aware is None:
+                    aware = _deadline_aware(info.node, info.params)
+                if not aware:
+                    yield _Sink(node, "time.sleep()",
+                                "an unbounded host sleep")
+            elif term == "wait" and "." in name and not node.args \
+                    and not node.keywords:
+                yield _Sink(
+                    node, f"{name}()",
+                    "a Condition/Event wait with no timeout")
+            elif term == "get" and isinstance(node.func, ast.Attribute) \
+                    and not node.args and not node.keywords \
+                    and _is_queue_name(node.func.value):
+                yield _Sink(node, f"{name}()",
+                            "a blocking queue pop with no timeout")
+            elif term in _RETRY_HELPERS:
+                if not any(kw.arg == "deadline" for kw in node.keywords):
+                    yield _Sink(
+                        node, f"{term}(...)",
+                        "a retry loop without deadline= backs off "
+                        "past every caller's budget")
+
+    @staticmethod
+    def _local_nodes(fn_node):
+        stack = list(ast.iter_child_nodes(fn_node))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            yield n
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _report(self, src, info, sink, label, hops):
+        if hops:
+            chain = " -> ".join(f"{name} ({path}:{line})"
+                                for name, path, line in hops)
+            where = f"reachable from {label} via {chain}"
+        else:
+            where = f"in deadline-carrying entry point {label}"
+        return self.issue(
+            src, sink.node,
+            f"blocking {sink.kind} {where}: {sink.detail} — consume "
+            f"the request Deadline (wait(deadline.remaining()), "
+            f"deadline=) or document the contract with a suppression")

@@ -1,0 +1,388 @@
+"""fault-site-soundness: every fault-injection site and chaos-plan
+pattern must resolve against the declared registry.
+
+The resilience plane (docs/serving.md §8, docs/training_resilience.md
+§2) is keyed by *strings*: ``faults.inject("decode.step")`` fires only
+if a plan rule's fnmatch pattern matches that exact name.  Nothing at
+runtime connects the two ends — a typo'd site never fires and a typo'd
+``MXNET_FAULTS`` pattern matches nothing, so the chaos test silently
+tests nothing (the bug class PR 11's review round hit at runtime).
+``mxnet_tpu.faults.declare_fault_site`` is now the single source of
+truth; this pass is its static enforcement:
+
+- every ``faults.inject(...)`` / ``faults.check(...)`` /
+  ``faults.InjectedFault(...)`` **site argument** must match a declared
+  site.  Dynamic names built by f-string / ``+``-concatenation (the
+  decode engine's ``self.fault_scope + ".step"``, the replica layer's
+  ``f"replica.{rid}.heartbeat"``) are checked as globs (dynamic parts
+  wild) against the declared templates (``replica.<rid>.heartbeat``).
+- **helper-routed sites** are validated too: a function whose parameter
+  flows into a faults primitive (``_inject(site, ...)`` in
+  ``parallel/checkpoint.py``) makes every *call site's* literal a fault
+  site, found via the PR-4 call graph with a ``via helper (file:line)``
+  witness.
+- every ``MXNET_FAULTS``-grammar **spec string** — ``faults.plan(...)``
+  / ``faults.install(...)`` / ``FaultPlan.parse(...)`` /
+  ``monkeypatch.setenv("MXNET_FAULTS", ...)`` / ``environ["MXNET_FAULTS"]
+  = ...`` in tests and benches, plus ``MXNET_FAULTS=`` assignments in
+  ``ci/*.sh`` — must hold rules whose site pattern can match ≥ 1
+  declared site *and* whose mode at least one matching site honors
+  (``kv_cache.allocate=corrupt`` can never fire: the site is
+  fail-only).
+
+Glob-vs-template matching uses glob *intersection* (can the two
+patterns match a common string?), so ``replica.r1.*`` unifies with
+``replica.<rid>.decode.step`` and ``serving.*`` with every serving
+site.  Unresolvable site expressions (a bare variable) stay quiet.
+
+The registry is harvested from ``declare_fault_site`` literals in the
+scanned files; when the scanned set declares none (linting ``tests/``
+or ``benchmark/`` alone), the repo's ``mxnet_tpu/faults.py`` is parsed
+as the authoritative fallback.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from ..core import (LintPass, Project, SourceFile, dotted_name,
+                    register_pass)
+
+_FAULT_HEADS = {"faults", "_faults"}
+_PRIMITIVES = {"inject", "check", "InjectedFault"}
+_SPEC_TERMS = {"plan", "install"}
+_MODES = ("fail", "delay", "corrupt", "stall")
+
+# A quoted value may carry whitespace between clauses
+# ("a=fail; b=stall" is legal — FaultPlan.parse strips clauses), so
+# quoted specs capture to the closing quote, bare ones to whitespace.
+_SH_SPEC = re.compile(
+    r"""MXNET_FAULTS=(?:'([^']*)'|"([^"]*)"|([^'"\s]+))""")
+
+
+def _is_faults_name(name: str) -> bool:
+    parts = name.split(".")
+    return len(parts) >= 2 and (parts[-2] in _FAULT_HEADS
+                                or "faults" in parts[:-1])
+
+
+def globs_intersect(a: str, b: str) -> bool:
+    """Lint-side twin of ``faults._globs_intersect`` (the linter never
+    imports the analyzed code): can two fnmatch globs match a common
+    string?  ``[...]`` overapproximates to ``?`` — it can only say
+    "maybe" where the truth is "no", the stay-quiet direction."""
+    a = re.sub(r"\[[^\]]*\]", "?", a)
+    b = re.sub(r"\[[^\]]*\]", "?", b)
+    seen, stack = set(), [(0, 0)]
+    while stack:
+        i, j = stack.pop()
+        if (i, j) in seen:
+            continue
+        seen.add((i, j))
+        if i == len(a) and j == len(b):
+            return True
+        if i < len(a) and a[i] == "*":
+            stack.append((i + 1, j))
+            if j < len(b):
+                stack.append((i, j + 1))
+            continue
+        if j < len(b) and b[j] == "*":
+            stack.append((i, j + 1))
+            if i < len(a):
+                stack.append((i + 1, j))
+            continue
+        if i < len(a) and j < len(b) \
+                and (a[i] == "?" or b[j] == "?" or a[i] == b[j]):
+            stack.append((i + 1, j + 1))
+    return False
+
+
+def _site_glob(expr) -> str:
+    """A site expression as an fnmatch glob: string literal verbatim,
+    f-string / ``+``-concat with dynamic parts as ``*``; None when the
+    expression carries no literal structure at all (stay quiet)."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value
+    if isinstance(expr, ast.JoinedStr):
+        out = []
+        for part in expr.values:
+            if isinstance(part, ast.Constant):
+                out.append(str(part.value))
+            else:
+                out.append("*")
+        return "".join(out) if any(p != "*" for p in out) else None
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+        left = _site_glob(expr.left) or "*"
+        right = _site_glob(expr.right) or "*"
+        if left == "*" and right == "*":
+            return None
+        return left + right
+    return None
+
+
+def _template_glob(name: str) -> str:
+    return re.sub(r"<[a-z0-9_]+>", "*", name)
+
+
+@register_pass
+class FaultSitePass(LintPass):
+    id = "fault-site-soundness"
+    doc = ("faults.inject/check site names (incl. f-string/concat "
+           "scopes and helper-routed literals) and MXNET_FAULTS spec "
+           "patterns in tests/benches/CI must match a declared "
+           "fault site — a typo'd site or pattern is a chaos test "
+           "that tests nothing")
+
+    def __init__(self, project: Project):
+        super().__init__(project)
+        self._sites = dict(project.fault_sites)
+        if not project.fault_sites_explicit:
+            # merge (not replace): scanned files may declare plugin
+            # sites on top of the repo catalogue, and a run over
+            # tests/ or benchmark/ alone harvests none at all — the
+            # repo's faults.py stays the authority either way
+            for name, modes in self._repo_registry().items():
+                self._sites.setdefault(name, modes)
+        self._globs = {name: _template_glob(name) for name in self._sites}
+        self._site_params = None        # qname -> {idx: (helper, path, line)}
+
+    # ------------------------------------------------------------ registry
+    @staticmethod
+    def _repo_registry():
+        """Authoritative fallback: parse ``declare_fault_site`` literals
+        out of the repo's faults.py (linting tests/ or benchmark/ alone
+        must still validate against the real catalogue)."""
+        path = os.path.join(Project._repo_root(), "mxnet_tpu",
+                            "faults.py")
+        if not os.path.exists(path):
+            return {}
+        with open(path) as fh:
+            try:
+                tree = ast.parse(fh.read(), filename=path)
+            except SyntaxError:
+                return {}
+        sites = {}
+        from ..core import _call_name, _literal_modes
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and _call_name(node).endswith("declare_fault_site") \
+                    and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                sites[node.args[0].value] = _literal_modes(node)
+        return sites
+
+    def _declared(self, pattern: str, mode=None) -> bool:
+        # lint twin of faults.pattern_matches_declared: a literal
+        # "<placeholder>" in a pattern is a copy-pasted template name —
+        # it never fnmatches a runtime site, so it is always dead
+        if "<" in pattern or ">" in pattern:
+            return False
+        for name, glob in self._globs.items():
+            if not globs_intersect(pattern, glob):
+                continue
+            modes = self._sites.get(name)
+            if mode is None or modes is None or mode in modes:
+                return True
+        return False
+
+    # ----------------------------------------------------- helper routing
+    def _fault_site_params(self):
+        """{function qname: {param index: (primitive-name, path, line)}}
+        — parameters that flow into a faults primitive's site position,
+        iterated over the call graph so a wrapper of a wrapper still
+        routes (``_inject(site)`` -> ``InjectedFault(site)``)."""
+        if self._site_params is not None:
+            return self._site_params
+        graph = self.project.callgraph()
+        params = {}
+        # round 0: direct flows into faults primitives
+        for qname, fn in graph.functions.items():
+            for node in graph._local_nodes(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                if name.rsplit(".", 1)[-1] not in _PRIMITIVES \
+                        or not _is_faults_name(name):
+                    continue
+                if node.args and isinstance(node.args[0], ast.Name):
+                    idx = fn.param_index(node.args[0].id)
+                    if idx is not None:
+                        params.setdefault(qname, {})[idx] = (
+                            name, fn.src.path, node.lineno)
+        # fixpoint: a param handed to a site param is a site param
+        changed = True
+        while changed:
+            changed = False
+            for qname, sites in graph.calls.items():
+                fn = graph.functions[qname]
+                for site in sites:
+                    callee_params = params.get(site.callee.qname)
+                    if not callee_params:
+                        continue
+                    for idx, origin in callee_params.items():
+                        arg = site.arg_map.get(idx)
+                        if isinstance(arg, ast.Name):
+                            pidx = fn.param_index(arg.id)
+                            if pidx is not None \
+                                    and pidx not in params.get(qname, {}):
+                                params.setdefault(qname, {})[pidx] = (
+                                    site.callee.node.name,
+                                    site.callee.src.path,
+                                    site.node.lineno)
+                                changed = True
+        self._site_params = params
+        return params
+
+    # ------------------------------------------------------------- checks
+    def check_file(self, src: SourceFile):
+        graph = self.project.callgraph()
+        site_params = self._fault_site_params()
+        for enclosing, node in self._nodes_with_scope(src, graph):
+            if isinstance(node, ast.Call):
+                yield from self._check_call(src, node, enclosing,
+                                            graph, site_params)
+            elif isinstance(node, ast.Assign) \
+                    and node.targets \
+                    and isinstance(node.targets[0], ast.Subscript) \
+                    and isinstance(node.targets[0].slice, ast.Constant) \
+                    and node.targets[0].slice.value == "MXNET_FAULTS":
+                yield from self._check_spec(src, node, node.value)
+
+    @staticmethod
+    def _nodes_with_scope(src, graph):
+        """(enclosing FunctionInfo or None, node) for every node, one
+        walk — the enclosing function is what resolves helper calls."""
+        def walk(node, fn_info):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    yield from walk(child,
+                                    graph.function_at(child) or fn_info)
+                elif isinstance(child, ast.ClassDef):
+                    yield from walk(child, fn_info)
+                else:
+                    yield fn_info, child
+                    yield from walk(child, fn_info)
+        yield from walk(src.tree, None)
+
+    def _check_call(self, src, node, enclosing, graph, site_params):
+        name = dotted_name(node.func)
+        term = name.rsplit(".", 1)[-1]
+        # 1. direct faults primitives
+        if term in _PRIMITIVES and _is_faults_name(name) and node.args:
+            yield from self._check_site(src, node, node.args[0])
+            return
+        # 2. spec strings: faults.plan/install, FaultPlan.parse,
+        #    monkeypatch.setenv("MXNET_FAULTS", spec)
+        if term in _SPEC_TERMS and _is_faults_name(name) and node.args:
+            yield from self._check_spec(src, node, node.args[0])
+            return
+        if term == "parse" and "FaultPlan" in name and node.args:
+            yield from self._check_spec(src, node, node.args[0])
+            return
+        if term == "setenv" and len(node.args) >= 2 \
+                and isinstance(node.args[0], ast.Constant) \
+                and node.args[0].value == "MXNET_FAULTS":
+            yield from self._check_spec(src, node, node.args[1])
+            return
+        # 3. helper-routed: a call handing a literal to a site param
+        callee = graph.resolve_call(node, enclosing) \
+            if enclosing is not None else None
+        if callee is None:
+            return
+        callee_params = site_params.get(callee.qname)
+        if not callee_params:
+            return
+        from ..callgraph import CallGraph
+        amap = CallGraph.arg_map(node, callee)
+        for idx, (_prim, ppath, pline) in callee_params.items():
+            arg = amap.get(idx)
+            if arg is None:
+                continue
+            yield from self._check_site(
+                src, node, arg,
+                via=f" via {callee.node.name} ({ppath}:{pline})")
+
+    def _check_site(self, src, node, expr, via=""):
+        pattern = _site_glob(expr)
+        if pattern is None:
+            return
+        if self._declared(pattern):
+            return
+        kind = "site" if "*" not in pattern and "?" not in pattern \
+            else "site pattern"
+        yield self.issue(
+            src, node,
+            f"fault {kind} {pattern!r}{via} matches no declared fault "
+            f"site — it can never fire; fix the typo or declare it via "
+            f"faults.declare_fault_site (catalogue: mxnet_tpu/faults.py"
+            f", docs/serving.md §8)")
+
+    # ------------------------------------------------------- spec strings
+    def _check_spec(self, src, node, expr):
+        spec = _site_glob(expr)
+        if spec is None:
+            return
+        for clause in spec.split(";"):
+            clause = clause.strip()
+            if not clause:
+                continue
+            head = clause.split(",", 1)[0]
+            site, sep, mode = head.partition("=")
+            site, mode = site.strip(), mode.strip()
+            if not sep or not site:
+                continue                # runtime parse errors loudly
+            if not self._declared(site):
+                yield self.issue(
+                    src, node,
+                    f"MXNET_FAULTS pattern {site!r} matches no "
+                    f"declared fault site — a chaos rule that can "
+                    f"never fire (catalogue: mxnet_tpu/faults.py, "
+                    f"docs/serving.md §8)")
+            elif mode in _MODES and not self._declared(site, mode):
+                yield self.issue(
+                    src, node,
+                    f"MXNET_FAULTS rule {head!r}: no site matching "
+                    f"{site!r} honors mode {mode!r} — it can never "
+                    f"fire")
+
+    # ------------------------------------------------------------ finalize
+    def finalize(self):
+        """Validate ``MXNET_FAULTS=`` specs in CI shell scripts — the
+        third place a dead pattern hides.  ``Project.ci_shell_texts``
+        overrides (tests); None loads ``ci/*.sh`` from the repo."""
+        texts = self.project.ci_shell_texts
+        if texts is None:
+            texts = {}
+            ci_dir = os.path.join(Project._repo_root(), "ci")
+            if os.path.isdir(ci_dir):
+                for fn in sorted(os.listdir(ci_dir)):
+                    if fn.endswith(".sh"):
+                        with open(os.path.join(ci_dir, fn)) as fh:
+                            texts[f"ci/{fn}"] = fh.read()
+        from ..core import Issue
+        for path, text in texts.items():
+            for lineno, line in enumerate(text.splitlines(), start=1):
+                for m in _SH_SPEC.finditer(line):
+                    spec = next(g for g in m.groups() if g is not None)
+                    for clause in spec.split(";"):
+                        head = clause.split(",", 1)[0]
+                        site, sep, mode = head.partition("=")
+                        site, mode = site.strip(), mode.strip()
+                        if not sep or not site:
+                            continue
+                        if not self._declared(site):
+                            yield Issue(
+                                self.id, path, lineno, 0,
+                                f"MXNET_FAULTS pattern {site!r} in CI "
+                                f"matches no declared fault site — a "
+                                f"chaos job that tests nothing")
+                        elif mode in _MODES \
+                                and not self._declared(site, mode):
+                            yield Issue(
+                                self.id, path, lineno, 0,
+                                f"MXNET_FAULTS rule {head!r} in CI: no "
+                                f"site matching {site!r} honors mode "
+                                f"{mode!r} — it can never fire")

@@ -1,0 +1,222 @@
+"""telemetry-drift: emitted metric/span names and the observability doc
+must agree — in both directions.
+
+``docs/observability.md`` is the operator contract: its metric table is
+what dashboards and the bench harness key on, its span taxonomy is what
+trace tooling greps for.  Nothing held it to the code: a metric renamed
+in ``runtime_metrics.py`` silently orphans the documented row, and a
+new span added to the decode engine ships undocumented (PR 8 shipped
+``decode.request`` exactly that way).  This pass diffs the two —
+the doc-parsing sibling of ``env-registry``:
+
+- **emitted metric names**: first-argument string literals of
+  ``counter(...)`` / ``gauge(...)`` / ``histogram(...)`` calls
+  (dotted names only, so unrelated APIs like ``add_histogram`` never
+  match);
+- **emitted span names**: first arguments of ``tracing.span(...)`` /
+  ``trace(...)`` / ``record_span(...)`` through the ``tracing`` /
+  ``_tr`` aliases; f-string names (``f"fault.{mode}"``) are matched as
+  globs, so the four documented ``fault.*`` rows cover the one emission
+  site;
+- **documented rows**: full dotted backticked names in the metric table
+  ("### Built-in instrumentation") and the span taxonomy table of
+  ``docs/observability.md``; label suffixes (``{model}``) are
+  stripped.  A *relative* token (`` `.peak` ``) is itself flagged — the
+  drift check can only hold names it can read.
+
+Findings: an emission whose name no documented row covers (anchored at
+the call site), and a documented row no emission covers (anchored at
+the doc line — a dashboard keying on it reads zeros forever).  Tests
+inject ``doc_metrics`` / ``doc_spans`` on the Project; a real run
+parses the repo doc at first use.
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+import os
+import re
+
+from ..core import Issue, LintPass, Project, SourceFile, dotted_name, \
+    register_pass
+
+_METRIC_TERMS = {"counter", "gauge", "histogram"}
+_SPAN_TERMS = {"span", "trace", "record_span"}
+_TRACING_HEADS = {"tracing", "_tr", "tr"}
+_NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
+
+DOC_PATH = os.path.join("docs", "observability.md")
+
+
+def _doc_tables(text):
+    """(metrics {name: line}, spans {name: line}, relative [(tok, line)])
+    from docs/observability.md."""
+    metrics, spans, relative = {}, {}, []
+    section = None
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        if line.startswith("#"):
+            if "Built-in instrumentation" in line:
+                section = "metrics"
+            elif "Span taxonomy" in line:
+                section = "spans"
+            else:
+                section = None
+            continue
+        if section is None or not line.lstrip().startswith("|"):
+            continue
+        cell = line.split("|")[1]
+        for tok in re.findall(r"`([^`]+)`", cell):
+            tok = re.sub(r"\{[^}]*\}", "", tok).strip()
+            if tok.startswith("."):
+                relative.append((tok, lineno))
+                continue
+            if _NAME_RE.match(tok):
+                out = metrics if section == "metrics" else spans
+                out.setdefault(tok, lineno)
+    return metrics, spans, relative
+
+
+def _span_glob(expr):
+    """Span-name expression as literal or glob (f-string parts wild);
+    None = unresolvable, stay quiet."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value
+    if isinstance(expr, ast.JoinedStr):
+        parts = ["*" if not isinstance(p, ast.Constant) else str(p.value)
+                 for p in expr.values]
+        if all(p == "*" for p in parts):
+            return None
+        return "".join(parts)
+    return None
+
+
+@register_pass
+class TelemetryDriftPass(LintPass):
+    id = "telemetry-drift"
+    doc = ("metric names (counter/gauge/histogram registrations) and "
+           "span names (tracing.span/trace/record_span) diffed against "
+           "docs/observability.md — undocumented emissions AND "
+           "documented-but-dead rows both flag")
+
+    def __init__(self, project: Project):
+        super().__init__(project)
+        self._loaded = False
+        self._doc_metrics = project.doc_metrics
+        self._doc_spans = project.doc_spans
+        self._relative = []
+        # (name-or-glob, src, node) emissions seen across check_file
+        self._metric_emissions = []
+        self._span_emissions = []
+
+    def _docs(self):
+        if not self._loaded:
+            self._loaded = True
+            if self._doc_metrics is None or self._doc_spans is None:
+                # per-side fallback (the Project contract): each table
+                # left None parses from the repo doc independently, so
+                # injecting only doc_metrics doesn't zero out the spans
+                path = os.path.join(Project._repo_root(), DOC_PATH)
+                if os.path.exists(path):
+                    with open(path) as fh:
+                        m, s, rel = _doc_tables(fh.read())
+                    if self._doc_metrics is None:
+                        self._doc_metrics = m
+                    if self._doc_spans is None:
+                        self._doc_spans = s
+                    self._relative = rel
+            if self._doc_metrics is None:
+                self._doc_metrics = {}
+            if self._doc_spans is None:
+                self._doc_spans = {}
+        return self._doc_metrics, self._doc_spans
+
+    # ------------------------------------------------------------- checks
+    def check_file(self, src: SourceFile):
+        doc_metrics, doc_spans = self._docs()
+        if not doc_metrics and not doc_spans:
+            return      # no doc to hold the line against
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            name = dotted_name(node.func)
+            term = name.rsplit(".", 1)[-1]
+            if term in _METRIC_TERMS:
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) \
+                        and isinstance(arg.value, str) \
+                        and _NAME_RE.match(arg.value):
+                    self._metric_emissions.append(arg.value)
+                    if arg.value not in doc_metrics:
+                        yield self.issue(
+                            src, node,
+                            f"metric {arg.value!r} is registered here "
+                            f"but undocumented — add its row to "
+                            f"{DOC_PATH} (### Built-in "
+                            f"instrumentation)")
+            elif term in _SPAN_TERMS:
+                head = name.split(".")[0]
+                if "." in name and head not in _TRACING_HEADS:
+                    continue
+                if "." not in name and term != "record_span":
+                    continue
+                glob = _span_glob(node.args[0])
+                if glob is None:
+                    continue
+                self._span_emissions.append(glob)
+                if "*" in glob:
+                    if not any(fnmatch.fnmatchcase(d, glob)
+                               for d in doc_spans):
+                        yield self.issue(
+                            src, node,
+                            f"span name pattern {glob!r} matches no "
+                            f"documented span — add its row(s) to "
+                            f"{DOC_PATH} (### Span taxonomy)")
+                elif glob not in doc_spans:
+                    yield self.issue(
+                        src, node,
+                        f"span {glob!r} is emitted here but "
+                        f"undocumented — add its row to {DOC_PATH} "
+                        f"(### Span taxonomy)")
+
+    # ------------------------------------------------------------ finalize
+    def finalize(self):
+        """The dead-row direction: a documented name nothing emits,
+        and relative doc tokens the parser cannot hold the line on.
+        Each direction runs only when its emission *authority* module
+        was in the scanned set (``runtime_metrics.py`` for metrics,
+        ``tracing.py`` for the span plane): a partial run
+        (``--select ... mxnet_tpu/serving``) must not misread "not
+        scanned" as "emitted nowhere"."""
+        doc_metrics, doc_spans = self._docs()
+        paths = [f.path for f in self.project.files]
+        metrics_authority = any(p.endswith("runtime_metrics.py")
+                                for p in paths)
+        spans_authority = any(p.endswith("tracing.py") for p in paths)
+        emitted = set(self._metric_emissions)
+        for name, line in sorted(doc_metrics.items()
+                                 if metrics_authority else ()):
+            if name not in emitted:
+                yield Issue(
+                    self.id, DOC_PATH, line, 0,
+                    f"documented metric {name!r} is emitted nowhere — "
+                    f"a dashboard keying on it reads zeros forever; "
+                    f"drop the row or restore the emission")
+        span_globs = set(self._span_emissions)
+        for name, line in sorted(doc_spans.items()
+                                 if spans_authority else ()):
+            covered = name in span_globs or any(
+                "*" in g and fnmatch.fnmatchcase(name, g)
+                for g in span_globs)
+            if not covered:
+                yield Issue(
+                    self.id, DOC_PATH, line, 0,
+                    f"documented span {name!r} is emitted nowhere — "
+                    f"drop the row or restore the emission")
+        if not (metrics_authority or spans_authority):
+            return
+        for tok, line in self._relative:
+            yield Issue(
+                self.id, DOC_PATH, line, 0,
+                f"relative metric name {tok!r} in the doc table — "
+                f"write the full dotted name so the drift check can "
+                f"hold it to the code")
