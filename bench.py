@@ -91,29 +91,39 @@ def _time_steps(jax, run_step, steps):
 
 
 def _mfu(n_params, B, L, dt, peak_tflops):
-    return 6.0 * n_params * B * L / dt / (peak_tflops * 1e12)
+    # promoted to the framework (one source of truth shared with the
+    # runtime train.mfu gauge); bench keeps its old entry points
+    from mxnet_tpu import perf_account
+    return perf_account.mfu(n_params, B, L, dt, peak_tflops)
 
 
 def _step_flops(trainer, batch):
-    """Exact per-step model FLOPs from XLA's cost analysis of the
-    compiled train step (fwd+bwd+optimizer as one program).  The 6NBL
-    transformer rule undercounts conv nets badly, so the conv phases
-    need the compiler's own count.  Returns None when the backend's
-    PJRT executable doesn't expose cost analysis (the caller falls back
-    to an analytic estimate)."""
-    import jax
+    """XLA cost-analysis FLOPs of the compiled step — delegates to
+    ``mxnet_tpu.perf_account.step_flops`` (promoted; the conv phases
+    need the compiler's count because 6NBL undercounts convs badly).
+    Returns None when the backend exposes no cost analysis (callers
+    fall back to an analytic estimate)."""
+    from mxnet_tpu import perf_account
+    return perf_account.step_flops(trainer, batch)
+
+
+def _attribution(env, trainer, batch, flops, steps=2):
+    """Per-phase step breakdown for the BENCH JSON: run a few EXTRA
+    attributed steps after the timed loop with tracing toggled on
+    (attribution syncs every step, which would perturb the headline
+    numbers if it ran inside the timed loop).  FLOPs/peak are seeded so
+    no extra program is compiled for the MFU."""
+    from mxnet_tpu import tracing
+    trainer.perf.peak_tflops = env.peak_tflops
+    trainer.perf.note_flops(flops)
+    trainer._flops_noted = True
+    tracing.enable(sample=1.0)
     try:
-        shardb = trainer.shard_batch(
-            *[getattr(b, "_data", b) for b in batch])
-        compiled = trainer._step.lower(
-            trainer.params, trainer.opt_state, *shardb).compile()
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0] if ca else {}
-        f = float(ca.get("flops", 0.0))
-        return f if f > 0 else None
-    except Exception:                            # noqa: BLE001
-        return None
+        for _ in range(steps):
+            env.jax.device_get(trainer.step(*batch))
+    finally:
+        tracing.disable()
+    return trainer.perf.summary()
 
 
 class _Env:
@@ -150,12 +160,13 @@ class _Env:
         self.B = int(os.environ.get("BENCH_BATCH", 32 if on_tpu else 4))
         self.L = int(os.environ.get("BENCH_SEQLEN", 128))
         self.steps = int(os.environ.get("BENCH_STEPS", 8))
-        # per-chip bf16 peak for MFU: v5p 459 TF, v5e ("v5 lite") 197 TF
-        kind = jax.devices()[0].device_kind.lower() if on_tpu else ""
-        default_peak = 197.0 if "lite" in kind or "v5e" in kind else \
-            (459.0 if on_tpu else 0.15)
+        # per-chip bf16 peak for MFU: BENCH_PEAK_TFLOPS wins, else the
+        # framework's detection (MXNET_PEAK_TFLOPS or the device-kind
+        # table: v5p 459 TF, v5e "lite" 197 TF, CPU 0.15)
+        from mxnet_tpu import perf_account
         self.peak_tflops = float(
-            os.environ.get("BENCH_PEAK_TFLOPS", default_peak))
+            os.environ.get("BENCH_PEAK_TFLOPS",
+                           perf_account.detect_peak_tflops(jax.devices())))
 
         if on_tpu:
             self.cfg = dict(model_name="bert_24_1024_16",
@@ -203,6 +214,7 @@ class _Env:
             example_inputs=feats, n_labels=2,
             dtype=jnp.bfloat16 if self.on_tpu else None)
         batch = feats + labels
+        self._last_batch = batch      # phases reuse it for attribution
         dt = _time_steps(jax, lambda: trainer.step(*batch), self.steps)
         n_params = self.n_params_of(trainer)
         loss_val = float(jax.device_get(trainer.step(*batch)))
@@ -213,7 +225,7 @@ class _Env:
 # --------------------------------------------------------------- phases
 def phase_headline(env):
     _model, head = env.build_pretrain()
-    mfu, sps, loss_val, n_params, _tr = env.sharded_phase(
+    mfu, sps, loss_val, n_params, trainer = env.sharded_phase(
         head, env.B, env.L)
     return {
         "metric": "bert_large_pretrain_mfu" if env.on_tpu
@@ -223,6 +235,11 @@ def phase_headline(env):
         "samples_per_sec": round(sps, 2),
         "batch": env.B, "seqlen": env.L, "params": n_params,
         "loss": loss_val,
+        # 6NBL is exact enough for the transformer; avoids an AOT
+        # cost-analysis compile just for the breakdown's MFU
+        "attribution": _attribution(
+            env, trainer, env._last_batch,
+            flops=6.0 * n_params * env.B * env.L),
     }
 
 
@@ -272,7 +289,8 @@ def phase_resnet(env):
     return {"resnet50_mfu": round(mfu, 4),
             "resnet50_imgs_per_sec": round(B / dt, 2),
             "resnet50_batch": B,
-            "resnet50_step_gflops": round(flops / 1e9, 1)}
+            "resnet50_step_gflops": round(flops / 1e9, 1),
+            "attribution": _attribution(env, trainer, batch, flops)}
 
 
 def phase_samebatch(env):
@@ -596,6 +614,7 @@ def _finalize(merged):
              "nmt_compiled_programs", "nmt_params",
              "pipeline_imgs_per_sec", "pipeline_vs_step",
              "pipeline_threads", "pipeline_step_imgs_per_sec",
+             "attribution",
              "compile_cache_hits", "compile_cache_misses",
              "compile_cache_dir"]
     out = {k: out_src[k] for k in order if k in out_src}
@@ -719,6 +738,10 @@ def _orchestrate():
         for k in ("compile_cache_hits", "compile_cache_misses"):
             if k in got:
                 got[k] = merged.get(k, 0) + got[k]
+        # step-breakdown blocks nest per phase instead of clobbering
+        attr = got.pop("attribution", None)
+        if attr is not None:
+            merged.setdefault("attribution", {})[phase] = attr
         merged.update(got)
         emit()
 

@@ -174,10 +174,83 @@ def chaos_phase():
     print(f"timing: twin {twin_s:.1f}s, chaos {chaos_s:.1f}s")
 
 
+def traced_phase():
+    """Traced-step attribution on the REAL machinery: NDArrayIter ->
+    ShardedTrainer under MXNET_TRACE + MXNET_RUNTIME_METRICS.  Asserts
+    the training span chain resolves (train.step -> data.wait / h2d /
+    compute / collective / optimizer), the phase spans tile the root to
+    within 10%, a bottleneck verdict is emitted, and tracing added no
+    XLA program (jit cache unchanged vs the untraced warmup)."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import io, nd, parallel, perf_account
+    from mxnet_tpu import runtime_metrics as rm, tracing
+    from mxnet_tpu.gluon import nn
+
+    mx.random.seed(0)
+    net = nn.Dense(1, in_units=8, prefix="traced_net_")
+    net.initialize(mx.init.Xavier())
+    rs = np.random.RandomState(3)
+    x = rs.randn(48, 8).astype(np.float32)
+    y = (x @ rs.randn(8).astype(np.float32))[:, None]
+    it = io.NDArrayIter(x, y, batch_size=BATCH, shuffle=False)
+    mesh = parallel.make_mesh(dp=1, tp=1, sp=1,
+                              devices=jax.devices()[:1])
+    trainer = parallel.ShardedTrainer(
+        net, lambda out, lab: ((out - lab) ** 2).mean(), mesh,
+        optimizer="sgd", optimizer_params={"learning_rate": 1e-2},
+        example_inputs=(nd.array(x[:BATCH]),), n_labels=1)
+    b = it.next()
+    float(jax.device_get(
+        trainer.step(*b.data, *b.label)))   # warmup compile, untraced
+    baseline = trainer._step._cache_size()
+
+    need = {"train.step", "train.data.wait", "train.h2d",
+            "train.compute", "train.collective", "train.optimizer"}
+    tracing.enable(sample=1.0)
+    rm.enable()
+    try:
+        gaps = []
+        for _ in range(5):
+            try:
+                b = it.next()
+            except StopIteration:
+                it.reset()
+                b = it.next()
+            trainer.step(*b.data, *b.label)
+            trace = tracing.TRACER.last(root="train.step")
+            assert trace is not None, tracing.TRACER.stats()
+            names = {s["name"] for s in trace["spans"]}
+            assert need <= names, (sorted(need - names), sorted(names))
+            ids = {s["span_id"] for s in trace["spans"]}
+            for s in trace["spans"]:
+                assert s["parent_id"] is None or s["parent_id"] in ids
+            root = next(s for s in trace["spans"]
+                        if s["name"] == "train.step")
+            dur = root["t1"] - root["t0"]
+            span_sum = sum(s["t1"] - s["t0"] for s in trace["spans"]
+                           if s["name"] != "train.step")
+            gaps.append(abs(dur - span_sum) / dur)
+        # sub-ms CPU steps jitter; the steady-state step must tile
+        assert min(gaps) <= 0.10, gaps
+        verdict = perf_account.current_verdict()
+        assert verdict is not None
+        assert rm.TRAIN_BOTTLENECK.value() in (0.0, 1.0, 2.0)
+        assert trainer._step._cache_size() == baseline, \
+            "tracing added an XLA program"
+    finally:
+        tracing.disable()
+        rm.disable()
+    print(f"traced: 5 attributed steps, span chain resolved, phase "
+          f"tiling gap min {min(gaps) * 100:.1f}%, verdict={verdict}, "
+          f"jit cache unchanged  OK")
+
+
 def main(argv):
     logging.basicConfig(level=logging.WARNING)
     watchdog_phase()
     chaos_phase()
+    traced_phase()
     print("training resilience smoke: PASS")
     return 0
 

@@ -189,14 +189,20 @@ training_smoke() {
     # injected kills, the corrupt payload detected by the integrity
     # manifest and never restored (verified-step fallback), and a
     # wedged fake collective raising TrainStepTimeoutError within the
-    # configured deadline instead of hanging the job
+    # configured deadline instead of hanging the job.  Its traced
+    # phase is the ISSUE-16 acceptance gate: a ShardedTrainer step
+    # under MXNET_TRACE resolves the train.step span chain, the phase
+    # spans tile the root to within 10%, a bottleneck verdict is
+    # emitted, and the jit cache is unchanged vs untraced
     python benchmark/bench_train_resilience.py --smoke
     # the watchdog/supervisor/checkpoint suites double as race tests:
     # the deadline worker thread, the fault plan's trigger state, and
-    # the incident dumps cross the same locks the sanitizer guards
+    # the incident dumps cross the same locks the sanitizer guards;
+    # test_perf_account covers the attribution plane off-path contract
     MXNET_ENGINE_SANITIZE=1 python -m pytest \
         tests/test_faults_train.py tests/test_faults.py \
-        tests/test_checkpoint_sharded.py -x -q
+        tests/test_checkpoint_sharded.py tests/test_perf_account.py \
+        -x -q
 }
 
 bench_cpu() {

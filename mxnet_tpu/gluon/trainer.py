@@ -18,6 +18,7 @@ import time
 from ..base import MXNetError
 from .. import optimizer as opt
 from .. import runtime_metrics as _rm
+from .. import tracing as _tr
 from ..ndarray import NDArray
 from .parameter import Parameter, ParameterDict
 
@@ -215,7 +216,13 @@ class Trainer:
             try:
                 self._step_impl(batch_size, ignore_stale_grad)
             finally:
-                _rm.TRAINER_STEP_SECONDS.observe(time.perf_counter() - t0)
+                # exemplar: a slow step resolves to its trace when the
+                # loop runs inside a traced span (serving parity —
+                # exemplar_for_quantile(0.99) returns the trace id)
+                ctx = _tr.current_context()
+                _rm.TRAINER_STEP_SECONDS.observe(
+                    time.perf_counter() - t0,
+                    exemplar=ctx.trace_id if ctx is not None else None)
             if _rm.grad_norm_enabled():
                 self._publish_grad_norm()
         from .. import profiler as _prof

@@ -16,6 +16,7 @@ import os
 import queue as _queue
 import struct
 import threading
+import time
 from collections import namedtuple
 
 import numpy as np
@@ -24,8 +25,10 @@ from ..base import MXNetError
 from .. import faults as _faults
 from .. import ndarray as nd
 from ..ndarray import NDArray
+from .. import perf_account as _pa
 from .. import recordio
 from .. import runtime_metrics as _rm
+from .. import tracing as _tr
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "ResizeIter",
            "PrefetchingIter", "NDArrayIter", "CSVIter", "MNISTIter",
@@ -79,11 +82,19 @@ class DataIter:
 
     def next(self) -> DataBatch:
         _faults.inject("train.data.next")
+        # data-wait attribution: the interval this consumer spent in
+        # next() becomes the following step's train.data.wait span
+        # (perf_account.note_data_wait) — only when observing
+        timed = _rm._ENABLED or _tr._ENABLED
+        t0 = time.perf_counter() if timed else 0.0
         if self.iter_next():
             if _rm._ENABLED:
                 _rm.IO_BATCHES.inc()
-            return DataBatch(data=self.getdata(), label=self.getlabel(),
-                             pad=self.getpad(), index=self.getindex())
+            batch = DataBatch(data=self.getdata(), label=self.getlabel(),
+                              pad=self.getpad(), index=self.getindex())
+            if timed:
+                _pa.note_data_wait(t0, time.perf_counter())
+            return batch
         raise StopIteration
 
     def __next__(self):
@@ -227,6 +238,11 @@ class PrefetchingIter(DataIter):
 
     def next(self):
         _faults.inject("train.data.next")
+        # the consumer-visible wait is just the queue take — the
+        # producer thread's own timing never reaches a step (the
+        # data-wait channel is thread-local by design)
+        timed = _rm._ENABLED or _tr._ENABLED
+        t0 = time.perf_counter() if timed else 0.0
         if self._done:
             raise StopIteration
         got = self._queue.get()
@@ -240,11 +256,15 @@ class PrefetchingIter(DataIter):
             self._done = True
             raise got
         if len(self.iters) == 1:
-            return got[0]
-        return DataBatch(
-            data=[d for b in got for d in b.data],
-            label=[l for b in got for l in (b.label or [])],
-            pad=got[0].pad)
+            batch = got[0]
+        else:
+            batch = DataBatch(
+                data=[d for b in got for d in b.data],
+                label=[l for b in got for l in (b.label or [])],
+                pad=got[0].pad)
+        if timed:
+            _pa.note_data_wait(t0, time.perf_counter())
+        return batch
 
     def iter_next(self):
         raise MXNetError("PrefetchingIter supports next() only")
@@ -451,14 +471,19 @@ class NDArrayIter(DataIter):
 
     def next(self):
         _faults.inject("train.data.next")
+        timed = _rm._ENABLED or _tr._ENABLED
+        t0 = time.perf_counter() if timed else 0.0
         if not self.iter_next():
             raise StopIteration
         if _rm._ENABLED:
             _rm.IO_BATCHES.inc()
-        return DataBatch(data=self.getdata(), label=self.getlabel(),
-                         pad=self.getpad(), index=None,
-                         provide_data=self.provide_data,
-                         provide_label=self.provide_label)
+        batch = DataBatch(data=self.getdata(), label=self.getlabel(),
+                          pad=self.getpad(), index=None,
+                          provide_data=self.provide_data,
+                          provide_label=self.provide_label)
+        if timed:
+            _pa.note_data_wait(t0, time.perf_counter())
+        return batch
 
 
 def _jpeg_dims(buf):
@@ -717,6 +742,8 @@ class ImageRecordIter(DataIter):
 
     def next(self):
         _faults.inject("train.data.next")
+        timed = _rm._ENABLED or _tr._ENABLED
+        t0 = time.perf_counter() if timed else 0.0
         if self._done:
             raise StopIteration
         got = self._queue.get()
@@ -730,6 +757,8 @@ class ImageRecordIter(DataIter):
             raise got
         if _rm._ENABLED:
             _rm.IO_BATCHES.inc()
+        if timed:
+            _pa.note_data_wait(t0, time.perf_counter())
         return got
 
     def iter_next(self):

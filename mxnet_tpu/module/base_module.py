@@ -14,6 +14,7 @@ from ..base import MXNetError
 from .. import metric as metric_mod
 from .. import ndarray as nd
 from .. import runtime_metrics as _rm
+from .. import tracing as _tr
 from ..util import as_list as _as_list
 
 __all__ = ["BaseModule"]
@@ -116,8 +117,11 @@ class BaseModule:
                 self.forward_backward(data_batch)
                 self.update()
                 if t_step is not None:
+                    ctx = _tr.current_context()
                     _rm.TRAINER_STEP_SECONDS.observe(
-                        time.perf_counter() - t_step)
+                        time.perf_counter() - t_step,
+                        exemplar=ctx.trace_id if ctx is not None
+                        else None)
                 if monitor is not None:
                     monitor.toc_print()
                 self.update_metric(eval_metric, data_batch.label)

@@ -38,6 +38,14 @@ path — PAPERS.md).  This module is that plane:
   planes: ``observe(..., exemplar=trace_id)`` lets a Prometheus p99
   resolve to the exact trace that caused it.
 
+The TRAINING plane rides the same tracer: ``perf_account`` roots one
+``train.step`` trace per attributed ``ShardedTrainer`` step,
+decomposed into ``train.data.wait`` / ``train.h2d`` /
+``train.compute`` / ``train.collective`` / ``train.optimizer`` spans
+(docs/observability.md span taxonomy), so a training timeline opens in
+Perfetto next to a serving one and a slow ``trainer.step.seconds`` p99
+resolves to its step trace through the same exemplar link.
+
 Overhead contract (mirrors ``runtime_metrics``): tracing is **off by
 default**; every instrumentation site either guards on the module-level
 ``_ENABLED`` bool or goes through :func:`span`/:func:`trace`, which
