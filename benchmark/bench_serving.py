@@ -84,6 +84,7 @@ import mxnet_tpu as mx                                    # noqa: E402
 from mxnet_tpu import compile_cache                       # noqa: E402
 from mxnet_tpu import nd, runtime_metrics as rm, serving  # noqa: E402
 from mxnet_tpu import tracing                             # noqa: E402
+from mxnet_tpu.serving import traffic                     # noqa: E402
 from mxnet_tpu.gluon import nn                            # noqa: E402
 
 
@@ -389,7 +390,10 @@ def run_decode(args):
     for i, t in enumerate(pool[1:], start=1):
         t.start()
         if i + 1 < n_req:
-            time.sleep(float(rng.exponential(1.0 / rate)))
+            # the ONE Poisson-gap primitive (serving.traffic) — same
+            # rng call as before the dedupe, so the seeded draw
+            # sequence (and this bench's arrival schedule) is unchanged
+            time.sleep(traffic.exponential_gap(rng, rate))
     for t in pool:
         t.join(600)
     wall = time.perf_counter() - t0

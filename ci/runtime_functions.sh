@@ -205,6 +205,25 @@ training_smoke() {
         -x -q
 }
 
+traffic_smoke() {
+    # traffic-plane tier (ISSUE-17 acceptance; docs/serving.md §11): a
+    # seed-0 recorded trace (heavy-tailed multi-tenant arrivals, 10x
+    # mid-trace burst, tiered tenants) is saved to JSONL, loaded back,
+    # and replayed by closed-loop retry-after-honoring clients against
+    # a frozen twin (autoscaler budget pinned) and a scaled twin (real
+    # headroom), both losing a replica to a heartbeat stall exactly as
+    # the burst lands — asserts the autoscaler added capacity, SLO
+    # attainment AND goodput beat the frozen twin, p99 TTFT stays
+    # bounded, zero hung requests, and every non-ok outcome is a typed
+    # tier-ordered shed.  Numpy fakes: no XLA compiles in this tier.
+    python benchmark/bench_traffic.py --smoke
+    # the trace replay harness, admission buckets, and autoscale
+    # control loop cross the server's locks from extra threads — run
+    # their suites under the concurrency sanitizer too
+    MXNET_ENGINE_SANITIZE=1 python -m pytest tests/test_traffic.py \
+        tests/test_autoscale_admission.py -x -q
+}
+
 bench_cpu() {
     # tiny-config bench harness end-to-end (no TPU required): the full
     # per-phase orchestrator, not just one child phase

@@ -617,6 +617,20 @@ declare_fault_site(
 declare_fault_site(
     "replica.<rid>.decode.prefix_lookup",
     where="a replica-owned decode engine's prefix-cache lookup")
+declare_fault_site(
+    "autoscale.decide", modes=("fail", "delay"),
+    where="Autoscaler actuation — fires before add/remove_replica "
+          "(docs/serving.md §11)",
+    notes="`fail` is the scale-up-whose-prewarm-dies shape: the loop "
+          "must count an error decision, keep its target, and back "
+          "off, never crash or staircase retries")
+declare_fault_site(
+    "admission.check", modes=("fail", "delay"),
+    where="AdmissionController.check tenant gate (docs/serving.md "
+          "§11)",
+    notes="`fail` models a broken quota/tier lookup; admission "
+          "errors are typed at the caller, never a hang — `delay` "
+          "stresses the deadline budget at the earliest gate")
 
 declare_fault_site(
     "train.step", plane="training",

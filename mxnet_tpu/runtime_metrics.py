@@ -137,6 +137,20 @@ class _Metric:
                 self.name, self.max_label_sets, _OVERFLOW_LABEL)
         return (_OVERFLOW_LABEL,) * len(self.labelnames)
 
+    def _label_values(self, store, labelname):
+        """Distinct recorded values of one label dimension, sorted —
+        call via the subclass ``label_values`` (each owns its store).
+        The enumeration a fleet sensor or doctor tool needs to sum a
+        labeled family without touching private state."""
+        try:
+            i = self.labelnames.index(labelname)
+        except ValueError:
+            raise MXNetError(
+                f"metric {self.name!r} has no label {labelname!r} "
+                f"(labels: {self.labelnames})") from None
+        with self._lock:
+            return sorted({k[i] for k in store})
+
     def _key(self, labels: Dict[str, object]) -> Tuple[str, ...]:
         if not self.labelnames:
             if labels:
@@ -179,6 +193,9 @@ class Counter(_Metric):
     def total(self) -> float:
         with self._lock:
             return sum(self._values.values())
+
+    def label_values(self, labelname):
+        return self._label_values(self._values, labelname)
 
     def _snapshot(self):
         with self._lock:
@@ -231,6 +248,9 @@ class Gauge(_Metric):
     def value(self, **labels) -> float:
         with self._lock:
             return self._values.get(self._key(labels), 0.0)
+
+    def label_values(self, labelname):
+        return self._label_values(self._values, labelname)
 
     def _snapshot(self):
         with self._lock:
@@ -301,6 +321,26 @@ class Histogram(_Metric):
         with self._lock:
             entry = self._data.get(self._key(labels))
             return entry[1] if entry else 0.0
+
+    def bucket_counts(self, **labels):
+        """Cumulative per-bucket observation counts, aligned with
+        ``buckets + (+Inf,)`` — a consistent snapshot.  The raw
+        material for WINDOWED quantiles: diff two snapshots and feed
+        the delta to an interpolator, so a control loop (the serving
+        autoscaler's p99 sensor) reads the last interval instead of
+        the process lifetime."""
+        with self._lock:
+            entry = self._data.get(self._key(labels))
+            return list(entry[0]) if entry \
+                else [0] * (len(self.buckets) + 1)
+
+    def label_values(self, labelname):
+        """Distinct recorded values of one label dimension, sorted —
+        the enumeration a fleet sensor needs: replica-path engines
+        observe under ``model="name/rid"`` while a direct engine uses
+        ``model="name"``, and summing those series' ``bucket_counts``
+        yields the set-wide distribution."""
+        return self._label_values(self._data, labelname)
 
     def quantile(self, q: float, **labels) -> float:
         """Estimate the q-quantile by linear interpolation inside the
@@ -870,6 +910,33 @@ SERVING_REPLICA_FAILOVERS = counter(
     "failed (typed execute failure, quarantine, or engine stop), per "
     "model.  Every failed-over request keeps its ORIGINAL end-to-end "
     "deadline.", labelnames=("model",))
+SERVING_AUTOSCALE_DECISIONS = counter(
+    "serving.autoscale.decisions",
+    "Autoscaler control-loop decisions per tick "
+    "(serving.autoscaler.Autoscaler, docs/serving.md §11), per "
+    "(model, action): up/down actuated a replica change, hold stayed, "
+    "blocked hit the max-replica budget or a cooldown, error had the "
+    "actuator raise (the loop stays alive and backs off).",
+    labelnames=("model", "action"))
+SERVING_AUTOSCALE_REPLICAS_TARGET = gauge(
+    "serving.autoscale.replicas_target",
+    "Replica count the autoscaler last decided the model should run "
+    "at — compare against serving.replica.state for actual vs target.",
+    labelnames=("model",))
+SERVING_TENANT_REQUESTS = counter(
+    "serving.tenant.requests",
+    "Requests ADMITTED by the tiered admission gate "
+    "(serving.admission.AdmissionController, docs/serving.md §11), "
+    "per (tenant, tier) — under the label-cardinality guard, so an "
+    "unbounded tenant id space clamps into the overflow series "
+    "instead of growing memory.", labelnames=("tenant", "tier"))
+SERVING_TENANT_SHED = counter(
+    "serving.tenant.shed",
+    "Requests shed by the tiered admission gate (tenant over its "
+    "quota token bucket, or its tier priority-shed under overload "
+    "pressure — low tier first), per (tenant, tier).  Every shed is "
+    "a typed ServerOverloadedError with a retry-after hint.",
+    labelnames=("tenant", "tier"))
 SERVING_REPLICA_HEARTBEAT_AGE = gauge(
     "serving.replica.heartbeat_age",
     "Seconds since one replica's last heartbeat, per (model, replica) "

@@ -39,6 +39,16 @@ the missing serving tier over it:
   request's original deadline (byte-identical results), and
   prewarm-gated rolling add/remove/rejoin — active whenever
   ``ServingConfig(replicas=N > 1)`` (``MXNET_SERVING_REPLICAS``);
+- the traffic plane (docs/serving.md §11): seed-deterministic
+  multi-tenant workload traces with bit-exact JSONL record/replay
+  (:mod:`~mxnet_tpu.serving.traffic` — heavy-tailed bursty arrivals,
+  shared-prefix clusters, closed-loop retry-after-honoring clients),
+  SLO-driven autoscaling (:class:`Autoscaler` — a control loop over
+  the runtime-metrics signals driving ``ReplicaSet``
+  add/remove_replica with hysteresis, cooldowns, and prewarm-aware
+  lead), and tiered admission (:class:`AdmissionController` — per-
+  tenant quota token buckets plus priority shedding, lowest tier
+  first, active whenever ``MXNET_SERVING_TENANT_TIERS`` is set);
 - the resilience layer (docs/serving.md §8): end-to-end request
   deadlines (:class:`DeadlineExceededError` instead of silent hangs),
   bounded jittered retries for transient execute failures,
@@ -54,6 +64,10 @@ the missing serving tier over it:
 >>> with serving.ModelServer(repo) as srv:
 ...     y = srv.predict("net", x)          # coalesced + shape-bucketed
 """
+from .admission import AdmissionController, TierPolicy, \
+    parse_tier_spec
+from .autoscaler import Autoscaler, AutoscalerConfig, \
+    RuntimeMetricsSource, SLOTargets
 from .batcher import DynamicBatcher, next_bucket, pad_batch, \
     unpad_outputs
 from .config import ServingConfig
@@ -65,6 +79,8 @@ from .repository import ModelEntry, ModelRepository
 from .resilience import (CircuitBreaker, CircuitOpenError, Deadline,
                          DeadlineExceededError, honor_retry_after)
 from .server import ModelServer, ServerOverloadedError
+from .traffic import Trace, TraceConfig, TraceRequest, \
+    generate_trace, replay_trace, summarize
 
 __all__ = ["ModelRepository", "ModelEntry", "ModelServer",
            "DynamicBatcher", "ServingConfig", "ServerOverloadedError",
@@ -74,4 +90,9 @@ __all__ = ["ModelRepository", "ModelEntry", "ModelServer",
            "DeviceKVPool",
            "Deadline", "DeadlineExceededError", "CircuitBreaker",
            "CircuitOpenError", "honor_retry_after",
-           "Replica", "ReplicaSet"]
+           "Replica", "ReplicaSet",
+           "AdmissionController", "TierPolicy", "parse_tier_spec",
+           "Autoscaler", "AutoscalerConfig", "RuntimeMetricsSource",
+           "SLOTargets",
+           "Trace", "TraceConfig", "TraceRequest", "generate_trace",
+           "replay_trace", "summarize"]

@@ -26,6 +26,7 @@ import numpy as np
 
 from .. import engine, runtime_metrics as _rm, tracing as _tr
 from ..base import MXNetError
+from .admission import AdmissionController
 from .batcher import DynamicBatcher
 from .config import ServingConfig
 from .repository import ModelRepository
@@ -121,10 +122,13 @@ class ModelServer:
         # hitting one backend failure do NOT retry in lockstep (the
         # thundering herd jitter exists to break up)
         self._retry_rng = random.Random()
+        # tiered admission gate (docs/serving.md §11), built from
+        # config.tenant_tiers; None = gate off, zero per-request cost
+        self._admission = AdmissionController.from_config(self.config)
         self._stats = {"requests": 0, "completed": 0, "shed": 0,
                        "batches": 0, "errors": 0, "retries": 0,
                        "deadline_exceeded": 0, "bisected": 0,
-                       "circuit_open_rejects": 0}
+                       "circuit_open_rejects": 0, "tenant_sheds": 0}
         if autostart:
             self.start()
 
@@ -291,8 +295,44 @@ class ModelServer:
             _tr.record_incident("serving.shed", self.debug_state)
             raise
 
+    def _admit_tenant(self, entry, tenant):
+        """Tenant-tier gate (docs/serving.md §11): quota token bucket
+        plus priority shedding under overload — low tiers shed first.
+        Runs AFTER the circuit gate and BEFORE the watermark check so
+        a shed tenant never touches the bounded queue.  No-op when
+        ``config.tenant_tiers`` is unset.  Observability mirrors every
+        other shed: stats, serving.shed metric, tagged admit span,
+        debounced incident dump."""
+        if self._admission is None:
+            return
+        # instantaneous queue fraction, read without _cond — a stale
+        # snapshot only skews the pressure one request, and the gate
+        # must not nest the controller's lock inside the server's
+        load = self._depth / float(max(1, self.config.shed_watermark))
+        try:
+            self._admission.check(tenant, model=entry.name, load=load)
+        except ServerOverloadedError as e:
+            with self._cond:
+                self._stats["shed"] += 1
+                self._stats["tenant_sheds"] += 1
+            if _rm._ENABLED:
+                _rm.SERVING_SHED.inc(model=entry.name)
+            sp = _tr.span("serving.admit")
+            sp.set_tag("shed", str(e))
+            sp.set_tag("tenant", "" if tenant is None else str(tenant))
+            sp.end()
+            _tr.record_incident("serving.shed", self.debug_state)
+            raise
+
+    def admission_controller(self):
+        """The tiered :class:`~mxnet_tpu.serving.admission.
+        AdmissionController` (None when ``config.tenant_tiers`` is
+        unset) — the autoscaler publishes SLO pressure into it, tests
+        and ``tools/diagnose.py`` read its stats."""
+        return self._admission
+
     # -------------------------------------------------------------- predict
-    def predict(self, model, *inputs, timeout=None):
+    def predict(self, model, *inputs, timeout=None, tenant=None):
         """Run one inference request; blocks until its slice of a
         coalesced batch is ready.  Inputs are batch-major NDArray /
         numpy arrays validated against the model's serving signature;
@@ -307,6 +347,11 @@ class ModelServer:
         within one scheduling quantum of the deadline — never a hang
         (docs/serving.md §8).
 
+        ``tenant`` ("name" or "name:tier") routes the request through
+        the tiered admission gate when ``config.tenant_tiers`` is set
+        (docs/serving.md §11); None rides the default tier with no
+        quota.
+
         With ``MXNET_TRACE=1`` the request carries one trace identity
         end to end: admission, queue wait, the (shared) batch-assembly
         span with its bucket outcome, and execute — and the latency
@@ -314,9 +359,10 @@ class ModelServer:
         to the exact trace behind it (docs/observability.md).
         """
         with _tr.trace("serving.predict", model=model) as root:
-            return self._predict_impl(model, inputs, timeout, root)
+            return self._predict_impl(model, inputs, timeout, root,
+                                      tenant)
 
-    def _predict_impl(self, model, inputs, timeout, root):
+    def _predict_impl(self, model, inputs, timeout, root, tenant=None):
         from .. import deploy
         entry = self.repository.get(model)
         if entry.decode_model is not None:
@@ -345,8 +391,10 @@ class ModelServer:
         deadline = Deadline.start(timeout)
         # circuit gate AFTER validation (a malformed request says
         # nothing about version health) and BEFORE queueing (an open
-        # circuit must shed instantly, not after a queue wait)
+        # circuit must shed instantly, not after a queue wait); the
+        # tenant-tier gate follows the same rule
         self._admit_circuit(entry)
+        self._admit_tenant(entry, tenant)
 
         req = _Request(entry, np_inputs, rows, deadline=deadline)
         req.trace = root.context
@@ -502,6 +550,20 @@ class ModelServer:
                 raise not_accepting
             return fresh
 
+    def replica_set(self, model, version=None):
+        """The :class:`~mxnet_tpu.serving.replica.ReplicaSet` serving
+        (model, version) — built (every replica prewarmed) on first
+        use.  This is the autoscaler's actuation handle
+        (docs/serving.md §11): ``Autoscaler(server.replica_set("m"),
+        ...)``.  Raises unless ``config.replicas`` > 1."""
+        entry = self.repository._resolve(model, version)
+        if not self._replicated(entry):
+            raise MXNetError(
+                f"replica_set({model!r}): config.replicas="
+                f"{self.config.replicas} — the replica layer needs "
+                f"replicas > 1 (docs/serving.md §10)")
+        return self._replica_set(entry)
+
     def _execute_batch(self, entry, inputs, deadline):
         """One batch execution: through the entry's ReplicaSet
         (least-loaded healthy replica, deadline-preserving failover)
@@ -584,7 +646,8 @@ class ModelServer:
         return eng
 
     def generate(self, model, prompt, *, max_new_tokens=None,
-                 eos_id=None, on_token=None, timeout=None):
+                 eos_id=None, on_token=None, timeout=None,
+                 tenant=None):
         """Autoregressive generation through the continuous-batching
         decode engine (docs/serving.md §6).
 
@@ -606,6 +669,11 @@ class ModelServer:
         reclaimed), so a request can never outlive its timeout inside
         the decode batch (docs/serving.md §8).
 
+        ``tenant`` ("name" or "name:tier") routes the request through
+        the tiered admission gate when ``config.tenant_tiers`` is set
+        (docs/serving.md §11); None rides the default tier with no
+        quota.
+
         With ``MXNET_TRACE=1`` the request is one trace end to end:
         admission, queue wait, prefill, every Nth decode step, and
         eviction, with KV-page counts as tags (docs/observability.md).
@@ -626,6 +694,7 @@ class ModelServer:
             if timeout is None:
                 timeout = self.config.deadline_default
             self._admit_circuit(entry)
+            self._admit_tenant(entry, tenant)
             if self._replicated(entry):
                 # replica path (docs/serving.md §10): the set routes
                 # to the least-loaded healthy replica's engine and
@@ -732,6 +801,8 @@ class ModelServer:
                     key = f"{rset.name}@v{rset.entry.version}"
                 sets[key] = rset.stats()
             out["replica_sets"] = sets
+        if self._admission is not None:
+            out["admission"] = self._admission.stats()
         return out
 
     def debug_state(self):
@@ -779,6 +850,8 @@ class ModelServer:
             "bucket_disk_hits": self.batcher.bucket_disk_hits,
             "bucket_misses": self.batcher.bucket_misses,
         }
+        if self._admission is not None:
+            state["admission"] = self._admission.debug_state()
         state["repository"] = self.repository.debug_state()
         state["tracer"] = _tr.TRACER.stats()
         return state

@@ -209,6 +209,63 @@ def diagnose(metrics_smoke=False):
     except Exception as e:      # noqa: BLE001 — diagnostics best-effort
         print(f"placement    : unavailable ({e})")
 
+    _section("Traffic / Autoscaling / Admission")
+    tiers = get_env("MXNET_SERVING_TENANT_TIERS", typ=str)
+    if tiers:
+        from mxnet_tpu.serving.admission import parse_tier_spec
+        try:
+            parsed = parse_tier_spec(tiers)
+            print(f"tiers        : {len(parsed)} "
+                  f"({', '.join(parsed)})  "
+                  f"(MXNET_SERVING_TENANT_TIERS; docs/serving.md §11)")
+            print(f"shed start   : pressure >= "
+                  f"{get_env('MXNET_SERVING_ADMISSION_SHED_START', typ=float):g}"
+                  f" sheds the lowest tier first (gold-class tiers "
+                  f"hold to 1.0)")
+        except Exception as e:  # noqa: BLE001 — diagnostics best-effort
+            print(f"tiers        : INVALID spec ({e})")
+    else:
+        print("tiers        : (off — set MXNET_SERVING_TENANT_TIERS "
+              "for per-tenant quota buckets + priority shedding; "
+              "docs/serving.md §11)")
+    slo_ttft = get_env("MXNET_SERVING_AUTOSCALE_SLO_TTFT_P99_MS",
+                       typ=float)
+    slo_lat = get_env("MXNET_SERVING_AUTOSCALE_SLO_LATENCY_P99_MS",
+                      typ=float)
+    q_high = get_env("MXNET_SERVING_AUTOSCALE_QUEUE_HIGH", typ=int)
+    targets = [s for s in (
+        f"ttft p99 {slo_ttft:g}ms" if slo_ttft else None,
+        f"latency p99 {slo_lat:g}ms" if slo_lat else None,
+        f"queue >= {q_high}" if q_high else None) if s]
+    print(f"autoscaler   : "
+          f"{get_env('MXNET_SERVING_AUTOSCALE_MIN', typ=int)}"
+          f"-{get_env('MXNET_SERVING_AUTOSCALE_MAX', typ=int)} "
+          f"replicas, tick "
+          f"{get_env('MXNET_SERVING_AUTOSCALE_INTERVAL_MS', typ=float):g}"
+          f"ms, up after "
+          f"{get_env('MXNET_SERVING_AUTOSCALE_BREACH_TICKS', typ=int)} "
+          f"breach tick(s), down after "
+          f"{get_env('MXNET_SERVING_AUTOSCALE_IDLE_TICKS', typ=int)} "
+          f"idle tick(s)")
+    print(f"slo targets  : "
+          + (", ".join(targets) if targets else
+             "(none — pass SLOTargets(...) or set "
+             "MXNET_SERVING_AUTOSCALE_SLO_*)"))
+    if _trm.enabled():
+        dec = _trm.SERVING_AUTOSCALE_DECISIONS
+        models = dec.label_values("model")
+        acts = {a: int(sum(dec.value(model=m, action=a)
+                           for m in models))
+                for a in ("up", "down", "blocked", "error")}
+        if any(acts.values()):
+            print(f"decisions    : " + ", ".join(
+                f"{v} {k}" for k, v in acts.items() if v)
+                + "  (serving.autoscale.decisions this process)")
+        sheds = _trm.SERVING_TENANT_SHED.total()
+        if sheds:
+            print(f"tenant sheds : {sheds:g}  (serving.tenant.shed "
+                  f"this process)")
+
     _section("Tracing / Flight Recorder")
     from mxnet_tpu import tracing
     st = tracing.TRACER.stats()
