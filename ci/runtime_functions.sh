@@ -44,6 +44,12 @@ sanity_lint() {
     # ones do, so a strict new pass can land before a full-tree sweep.
     python -m tools.mxlint --format json \
         --baseline ci/mxlint_baseline.json mxnet_tpu/ tools/
+    # the pre-commit loop must stay usable: a --changed run against
+    # HEAD (no diff in CI -> reports nothing) exercises the
+    # changed-file filter + the .mxlint_cache fallback path and bounds
+    # its latency — the full run above just warmed the cache, so this
+    # must return in seconds (docs/static_analysis.md "result cache")
+    timeout 30 python -m tools.mxlint mxnet_tpu/ tools/ --changed HEAD
     # baseline drift check: re-record and require the committed file
     # byte-identical — a fixed finding whose entry lingered (or a new
     # one argued into the baseline but not committed) fails the job
@@ -63,9 +69,15 @@ sanity_lint() {
     python tools/gen_fault_docs.py --check
     # then the dynamic half: engine+serving tests double as race tests
     # under the concurrency sanitizer (lock-order recording + tracked-
-    # array assertions)
+    # array assertions + the thread registry: every test asserts
+    # check_thread_leaks() at teardown via tests/conftest.py)
     MXNET_ENGINE_SANITIZE=1 python -m pytest tests/test_sanitizer.py \
         tests/test_serving.py tests/test_ndarray.py -x -q
+    # the thread-heaviest suites (replay client pools, autoscaler +
+    # heartbeat loops) exercise the leak check hardest — the runtime
+    # twin of the thread-lifecycle lint pass
+    MXNET_ENGINE_SANITIZE=1 python -m pytest tests/test_traffic.py \
+        tests/test_autoscale_admission.py -x -q
 }
 
 multichip_dryrun() {

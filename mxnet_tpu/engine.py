@@ -28,6 +28,13 @@ return plain ``threading`` primitives, so the production path pays
 nothing.  The existing serving/engine tests double as race tests when
 re-run under the knob — CI's ``sanity_lint`` job does exactly that
 (docs/static_analysis.md §sanitizer).
+
+**Thread-lifecycle sanitizer** (same knob): framework threads are
+created through :func:`make_thread`, which registers each thread with
+its owner and creation site; :func:`check_thread_leaks` raises on any
+registered thread that survives its owner's stop (asserted at test
+teardown by tests/conftest.py under the knob).  The static twin is
+mxlint's thread-lifecycle pass.
 """
 from __future__ import annotations
 
@@ -40,7 +47,8 @@ from . import runtime_metrics as _rm
 
 __all__ = ["Engine", "engine", "waitall", "is_naive", "set_bulk_size",
            "bulk", "Var", "sync_outputs", "make_lock", "make_condition",
-           "sanitizer_active"]
+           "make_thread", "check_thread_leaks", "forget_thread",
+           "thread_registry", "sanitizer_active"]
 
 # ---------------------------------------------------------------------------
 # Concurrency sanitizer (MXNET_ENGINE_SANITIZE=1)
@@ -221,6 +229,158 @@ def make_lock(name: str):
 def make_condition(name: str):
     """Condition-variable sibling of :func:`make_lock`."""
     return _SanCondition(name) if _SANITIZE else threading.Condition()
+
+
+# ---------------------------------------------------------------------------
+# Thread-lifecycle sanitizer (the runtime twin of mxlint's
+# thread-lifecycle pass, docs/static_analysis.md §15)
+# ---------------------------------------------------------------------------
+
+class _ThreadRegistry:
+    """Process-wide table of framework threads created via
+    :func:`make_thread` while ``MXNET_ENGINE_SANITIZE=1``: who owns
+    each thread, where it was created, whether it was deliberately
+    abandoned.  ``check_leaks`` is the teardown assertion; ``rows`` is
+    what tools/diagnose.py prints."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        # Thread -> {owner, site, daemon, created, abandoned}
+        self._threads = {}
+
+    def register(self, t, owner, site):
+        with self._mu:
+            self._threads[t] = {
+                "owner": owner or "<unowned>",
+                "site": site,
+                "daemon": bool(t.daemon),
+                "created": time.monotonic(),
+                "abandoned": None,
+            }
+
+    def forget(self, t, reason):
+        with self._mu:
+            info = self._threads.get(t)
+            if info is not None:
+                info["abandoned"] = reason or "abandoned"
+
+    def _prune(self):
+        # contract: every caller already holds self._mu (rows /
+        # check_leaks take it before calling)
+        for t in [t for t in self._threads if not t.is_alive()]:
+            # mxlint: disable=lock-discipline
+            del self._threads[t]
+
+    def rows(self):
+        now = time.monotonic()
+        with self._mu:
+            self._prune()
+            return [
+                {"name": t.name, "owner": info["owner"],
+                 "site": info["site"], "daemon": info["daemon"],
+                 "age_s": now - info["created"],
+                 "abandoned": info["abandoned"]}
+                for t, info in sorted(self._threads.items(),
+                                      key=lambda kv: kv[1]["created"])]
+
+    def check_leaks(self, grace_s=1.0):
+        """Raise ``MXNetError`` if any registered, non-abandoned thread
+        is still alive after ``grace_s`` (split across the survivors —
+        a stopping thread gets a moment to observe its stop signal, a
+        genuinely leaked one cannot hide behind the grace)."""
+        with self._mu:
+            self._prune()
+            live = [(t, info) for t, info in self._threads.items()
+                    if info["abandoned"] is None]
+        if not live:
+            return
+        deadline = time.monotonic() + max(0.0, grace_s)
+        for t, _ in live:
+            t.join(max(0.0, deadline - time.monotonic()))
+        now = time.monotonic()
+        leaked = [(t, info) for t, info in live if t.is_alive()]
+        if not leaked:
+            with self._mu:
+                self._prune()
+            return
+        lines = [
+            f"  {t.name!r} owner={info['owner']} "
+            f"created at {info['site']} "
+            f"daemon={info['daemon']} age={now - info['created']:.1f}s"
+            for t, info in leaked]
+        raise MXNetError(
+            "MXNET_ENGINE_SANITIZE: thread leak — "
+            f"{len(leaked)} framework thread(s) survived their owner's "
+            "stop:\n" + "\n".join(lines) + "\n"
+            "Every make_thread thread must exit on its owner's "
+            "stop()/close() path (or be explicitly forgotten via "
+            "forget_thread with a documented reason).  Static twin: "
+            "mxlint thread-lifecycle (docs/static_analysis.md)")
+
+    def reset(self):
+        """Drop every record (test isolation helper)."""
+        with self._mu:
+            self._threads.clear()
+
+
+_THREADS = _ThreadRegistry()
+
+
+def _caller_site(depth=2):
+    import sys
+    import os as _os
+    f = sys._getframe(depth)
+    path = f.f_code.co_filename
+    try:
+        rel = _os.path.relpath(path, _os.path.dirname(
+            _os.path.dirname(_os.path.abspath(__file__))))
+        if not rel.startswith(".."):
+            path = rel
+    except ValueError:
+        pass
+    return f"{path}:{f.f_lineno}"
+
+
+def make_thread(target, *, name, owner=None, args=(), kwargs=None,
+                daemon=True):
+    """Factory for every framework-owned thread (mirrors
+    :func:`make_lock`): a plain ``threading.Thread`` normally; under
+    ``MXNET_ENGINE_SANITIZE=1`` the thread is additionally registered
+    with its ``owner`` (``Class.attr``-style identity) and creation
+    site so :func:`check_thread_leaks` can name any thread that
+    survives its owner's stop.  The returned object is always a real
+    ``threading.Thread`` — zero behavioral difference either way."""
+    t = threading.Thread(target=target, name=name, args=args,
+                         kwargs=kwargs or {}, daemon=daemon)
+    if _SANITIZE:
+        _THREADS.register(t, owner, _caller_site())
+    return t
+
+
+def forget_thread(t, reason):
+    """Exempt ``t`` from :func:`check_thread_leaks`: the caller is
+    deliberately abandoning it (e.g. ``run_with_deadline``'s watchdog
+    worker wedged past its deadline — daemonized by construction, and
+    joining it would just relocate the hang).  ``reason`` is recorded
+    and shown by tools/diagnose.py."""
+    if _SANITIZE:
+        _THREADS.forget(t, reason)
+
+
+def check_thread_leaks(grace_s=1.0):
+    """Teardown assertion (no-op when the sanitizer is off): every
+    registered framework thread must have exited — a survivor raises
+    ``MXNetError`` naming its owner and creation site.  The serving /
+    replica / autoscaler / supervisor suites call this at teardown
+    under ``MXNET_ENGINE_SANITIZE=1`` (tests/conftest.py)."""
+    if _SANITIZE:
+        _THREADS.check_leaks(grace_s)
+
+
+def thread_registry():
+    """Live registered-thread rows (owner, site, daemon, age) for
+    tools/diagnose.py; empty when the sanitizer is off."""
+    return _THREADS.rows()
 
 
 class Var:

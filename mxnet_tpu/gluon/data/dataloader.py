@@ -99,3 +99,25 @@ class DataLoader:
 
     def __len__(self):
         return len(self._batch_sampler)
+
+    def close(self):
+        """Shut down the worker pool.  Idempotent; the loader still
+        works single-threaded afterwards.  Found by mxlint
+        thread-lifecycle: the pool's worker threads are non-daemon, so
+        an un-shut-down pool keeps the process alive past the last
+        epoch."""
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -22,6 +22,7 @@ from collections import namedtuple
 import numpy as np
 
 from ..base import MXNetError
+from .. import engine as _engine
 from .. import faults as _faults
 from .. import ndarray as nd
 from ..ndarray import NDArray
@@ -219,7 +220,8 @@ class PrefetchingIter(DataIter):
             except Exception as e:  # propagate to consumer
                 self._queue.put(e)
 
-        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread = _engine.make_thread(
+            worker, name="mxnet-prefetch", owner="PrefetchingIter")
         self._thread.start()
 
     def reset(self):
@@ -725,7 +727,8 @@ class ImageRecordIter(DataIter):
             except Exception as e:
                 self._queue.put(e)
 
-        self._producer = threading.Thread(target=produce, daemon=True)
+        self._producer = _engine.make_thread(
+            produce, name="mxnet-imgrec-producer", owner="ImageRecordIter")
         self._producer.start()
 
     def _stop_producer(self):
@@ -739,6 +742,24 @@ class ImageRecordIter(DataIter):
             pass
         self._producer.join(timeout=5)
         self._producer = None
+
+    def close(self):
+        """Terminal stop: halt the producer and shut down the decode
+        pool (``reset()`` restarts the producer; ``close()`` does not).
+        Found by mxlint thread-lifecycle: the decode pool's workers are
+        non-daemon, so an un-shut-down pool outlives the iterator."""
+        self._stop_producer()
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+            self._nthreads = 1
+        self._done = True
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def next(self):
         _faults.inject("train.data.next")

@@ -27,6 +27,7 @@ import time
 from typing import Optional
 
 from ..base import MXNetError
+from .. import engine as _engine
 
 __all__ = ["initialize", "finalize", "is_initialized", "rank", "size",
            "barrier", "allreduce_host", "broadcast_host", "Watchdog"]
@@ -135,7 +136,6 @@ def finalize():
     # then exits with its ORIGINAL code (a crashed worker's rc reaches
     # the launcher's failure detection, §5.3; a healthy-but-slow
     # shutdown is abandoned, not turned into a failure).
-    import threading
 
     def _shutdown():
         try:
@@ -143,10 +143,14 @@ def finalize():
         except Exception:   # noqa: BLE001 — peers may already be gone
             pass
 
-    t = threading.Thread(target=_shutdown, daemon=True,
-                         name="mxnet-dist-shutdown")
+    t = _engine.make_thread(_shutdown, name="mxnet-dist-shutdown",
+                            owner="dist.finalize")
     t.start()
     t.join(15)
+    if t.is_alive():
+        # a peer that never answers wedges jax.distributed.shutdown();
+        # the launcher owns the process from here
+        _engine.forget_thread(t, "jax.distributed.shutdown() wedged >15s")
     with _STATE_LOCK:
         _state["initialized"] = False
         _state["finalizing"] = False
@@ -247,8 +251,9 @@ class Watchdog:
                         rank() if _state["initialized"] else 0)
                     os._exit(42)
 
-        self._thread = threading.Thread(target=watch, daemon=True,
-                                        name=f"watchdog-{self.name}")
+        self._thread = _engine.make_thread(
+            watch, name=f"watchdog-{self.name}",
+            owner=f"dist.Watchdog({self.name})")
         self._thread.start()
         return self
 

@@ -58,14 +58,14 @@ single-assignment box + Event.
 from __future__ import annotations
 
 import logging
-import random as _pyrandom
 import threading
 import time
 from collections import deque
 
+from .. import engine as _engine
 from .. import perf_account as _pa
 from .. import runtime_metrics as _rm, tracing as _tr
-from ..base import MXNetError, get_env
+from ..base import MXNetError, entropy_rng, get_env
 
 __all__ = ["TrainStepTimeoutError", "CrashLoopError", "StepWatchdog",
            "run_with_deadline", "TrainingSupervisor"]
@@ -130,8 +130,8 @@ def run_with_deadline(fn, timeout_ms, site="train.step"):
         finally:
             done.set()
 
-    worker = threading.Thread(target=_worker, daemon=True,
-                              name=f"mxnet-watchdog-{site}")
+    worker = _engine.make_thread(
+        _worker, name=f"mxnet-watchdog-{site}", owner="run_with_deadline")
     worker.start()
     if not done.wait(timeout_ms / 1e3):
         if _rm._ENABLED:
@@ -139,6 +139,10 @@ def run_with_deadline(fn, timeout_ms, site="train.step"):
         _tr.record_incident(
             f"train.step_timeout: {site}",
             {"site": site, "timeout_ms": timeout_ms})
+        # the wedged step is deliberately abandoned (daemonized by
+        # construction): joining it would just relocate the hang
+        _engine.forget_thread(
+            worker, f"wedged past {timeout_ms}ms deadline at {site}")
         raise TrainStepTimeoutError(site, timeout_ms)
     worker.join()           # done is set: the join is immediate
     if "error" in box:
@@ -274,7 +278,7 @@ class TrainingSupervisor:
             get_env("MXNET_TRAIN_RESTART_BACKOFF_MAX_MS", typ=float)
             if backoff_max_ms is None else backoff_max_ms)
         # jitter only — never correctness; seedable for tests
-        self._rng = rng if rng is not None else _pyrandom.Random()
+        self._rng = rng if rng is not None else entropy_rng()
         self._step = 0                  # completed steps from origin
         self._losses = []
         self._restarts = 0              # lifetime restore+restart count

@@ -39,6 +39,7 @@ import threading
 import time
 from collections import deque
 
+from .. import engine as _engine
 from .. import faults
 from .. import runtime_metrics as _rm
 from .. import tracing as _tr
@@ -530,9 +531,9 @@ class Autoscaler:
             if self._thread is not None and self._thread.is_alive():
                 return self
             self._stop_evt.clear()
-            self._thread = threading.Thread(
-                target=self._loop, name=f"mxnet-autoscale-{self.model}",
-                daemon=True)
+            self._thread = _engine.make_thread(
+                self._loop, name=f"mxnet-autoscale-{self.model}",
+                owner=f"Autoscaler({self.model})")
         self._thread.start()
         return self
 

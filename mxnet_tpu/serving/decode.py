@@ -70,7 +70,6 @@ from __future__ import annotations
 
 import itertools
 import logging
-import random
 import threading
 import time
 
@@ -78,7 +77,7 @@ import numpy as np
 
 from .. import engine as _engine, faults as _faults, \
     runtime_metrics as _rm, tracing as _tr
-from ..base import MXNetError
+from ..base import MXNetError, entropy_rng
 from .batcher import bucket_set, next_bucket
 from .kv_cache import DeviceKVPool, PageAllocator, PageGeometry
 from .resilience import Deadline, DeadlineExceededError, retry_call
@@ -328,7 +327,9 @@ class DecodeEngine:
         # jitter source for transient-retry backoff — instance-owned so
         # tests can inject a seeded one; entropy-seeded by default so
         # replicas do not retry in lockstep against a shared backend
-        self._retry_rng = random.Random()
+        # deliberate jitter for retry backoff — the one sanctioned
+        # ambient-entropy source (determinism-soundness exempts it)
+        self._retry_rng = entropy_rng()
         if autostart:
             self.start()
 
@@ -350,9 +351,9 @@ class DecodeEngine:
                 self._draft_bound = True
             self._started = True
             self._stopping = False
-            self._thread = threading.Thread(
-                target=self._loop, name=f"mxnet-decode-{self.model_name}",
-                daemon=True)
+            self._thread = _engine.make_thread(
+                self._loop, name=f"mxnet-decode-{self.model_name}",
+                owner=f"DecodeEngine({self.model_name})")
         self._thread.start()
         return self
 

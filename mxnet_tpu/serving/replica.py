@@ -286,9 +286,10 @@ class ReplicaSet:
                 self._stats["prewarms"] += 1
             self._publish_state(rep)
         if rep.beat_thread is None or not rep.beat_thread.is_alive():
-            t = threading.Thread(
-                target=self._beat_loop, args=(rep,),
-                name=f"mxnet-replica-{self.name}-{rep.rid}", daemon=True)
+            t = _engine.make_thread(
+                self._beat_loop, args=(rep,),
+                name=f"mxnet-replica-{self.name}-{rep.rid}",
+                owner=f"ReplicaSet({self.name})")
             with self._cond:
                 rep.beat_thread = t
             t.start()

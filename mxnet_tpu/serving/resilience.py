@@ -32,12 +32,11 @@ an overload: back off and retry later.
 """
 from __future__ import annotations
 
-import random
 import time
 from collections import deque
 
 from .. import engine, runtime_metrics as _rm, tracing as _tr
-from ..base import MXNetError
+from ..base import MXNetError, entropy_rng
 
 __all__ = ["Deadline", "DeadlineExceededError", "ServerOverloadedError",
            "CircuitOpenError", "CircuitBreaker", "is_transient",
@@ -140,7 +139,10 @@ def retry_call(fn, *, retries, backoff_ms, deadline=None, rng=None,
     (``backoff_ms * 2^attempt * U[0.5, 1.0)``).  A deadline that cannot
     cover the next backoff stops retrying — better to surface the real
     error than burn the caller's remaining budget sleeping."""
-    rng = rng or random
+    # deliberate nondeterminism, via the one sanctioned source: the
+    # jitter must differ across processes or the retry waves sync up
+    # (mxlint determinism-soundness exempts entropy_rng)
+    rng = rng or entropy_rng()
     attempt = 0
     while True:
         try:
@@ -184,7 +186,7 @@ def honor_retry_after(fn, *, attempts=4, deadline=None, rng=None,
     honors backpressure; it is not a general retry policy
     (:func:`retry_call` is).
     """
-    rng = rng or random
+    rng = rng or entropy_rng()   # sanctioned jitter source — see retry_call
     attempt = 0
     while True:
         try:

@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import itertools
 import logging
-import random
 import threading
 import time
 from collections import OrderedDict, deque
@@ -25,7 +24,7 @@ from collections import OrderedDict, deque
 import numpy as np
 
 from .. import engine, runtime_metrics as _rm, tracing as _tr
-from ..base import MXNetError
+from ..base import MXNetError, entropy_rng
 from .admission import AdmissionController
 from .batcher import DynamicBatcher
 from .config import ServingConfig
@@ -121,7 +120,7 @@ class ModelServer:
         # inject a seeded one; entropy-seeded by default so N replicas
         # hitting one backend failure do NOT retry in lockstep (the
         # thundering herd jitter exists to break up)
-        self._retry_rng = random.Random()
+        self._retry_rng = entropy_rng()
         # tiered admission gate (docs/serving.md §11), built from
         # config.tenant_tiers; None = gate off, zero per-request cost
         self._admission = AdmissionController.from_config(self.config)
@@ -152,8 +151,9 @@ class ModelServer:
                 self._evict_subscribed = True
         with self._cond:
             self._workers = [
-                threading.Thread(target=self._worker_loop,
-                                 name=f"mxnet-serving-{i}", daemon=True)
+                engine.make_thread(self._worker_loop,
+                                   name=f"mxnet-serving-{i}",
+                                   owner=f"ModelServer({self.name})")
                 for i in range(self.config.num_workers)]
         for t in self._workers:
             t.start()

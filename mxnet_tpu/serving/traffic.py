@@ -40,6 +40,7 @@ import time
 import numpy as np
 
 from ..base import MXNetError, get_env
+from .. import engine as _engine
 from .resilience import Deadline, DeadlineExceededError, \
     ServerOverloadedError, honor_retry_after
 
@@ -491,7 +492,8 @@ def replay_trace(trace, call, *, clients=8, speed=None, attempts=4,
                 rec.update(info)
             records[i] = rec
 
-    pool = [threading.Thread(target=worker, args=(tid,), daemon=True)
+    pool = [_engine.make_thread(worker, name=f"mxnet-replay-{tid}",
+                                owner="replay_trace", args=(tid,))
             for tid in range(clients)]
     for th in pool:
         th.start()

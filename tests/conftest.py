@@ -35,6 +35,18 @@ def _fixed_seed():
     yield
 
 
+@pytest.fixture(autouse=True)
+def _no_thread_leaks():
+    """Under MXNET_ENGINE_SANITIZE=1 every test asserts at teardown
+    that no framework thread (engine.make_thread) survived its owner's
+    stop — the runtime twin of mxlint's thread-lifecycle pass.  Zero
+    cost when the sanitizer is off (the tier-1 default): both calls
+    are no-ops behind the module-level _SANITIZE bool."""
+    from mxnet_tpu import engine
+    yield
+    engine.check_thread_leaks()
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",

@@ -32,7 +32,9 @@ def test_pass_catalogue_complete():
                            "collective-soundness", "resource-leak",
                            "shape-soundness", "dtype-promotion",
                            "recompile-churn", "fault-site-soundness",
-                           "deadline-soundness", "telemetry-drift"}
+                           "deadline-soundness", "telemetry-drift",
+                           "determinism-soundness", "thread-lifecycle",
+                           "blocking-in-loop"}
 
 
 # ---------------------------------------------------------------- jit-retrace

@@ -548,8 +548,8 @@ def test_tests_and_benchmarks_fault_specs_clean():
     assert issues == [], "\n".join(str(i) for i in issues)
 
 
-def test_pass_catalogue_is_13():
-    assert len(PASSES) == 13
+def test_pass_catalogue_is_16():
+    assert len(PASSES) == 16
 
 
 def test_fault_doc_tables_fresh():

@@ -1,0 +1,457 @@
+"""ISSUE-18 determinism & thread-lifecycle passes: determinism-soundness,
+thread-lifecycle, blocking-in-loop — pos/neg/suppression fixtures,
+witness chains, registry round-trip, the repo-tree-clean gate, and the
+.mxlint_cache result cache (hit/miss/invalidation/--changed filter).
+
+Pure-AST: no jax, milliseconds per fixture; the one full-tree gate run
+shares a single lint invocation across all three passes.
+"""
+import os
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.mxlint import PASSES, Project, lint_paths, lint_sources  # noqa: E402
+from tools.mxlint import cache as mxcache                           # noqa: E402
+from tools.mxlint.core import iter_py_files                         # noqa: E402
+
+SURFACES = {"mxnet_tpu.serving.fixture.make_trace": "trace replay",
+            "mxnet_tpu.serving.fixture.Ckpt": "checkpoint payload"}
+
+
+def run(src, path="mxnet_tpu/serving/fixture.py", select=None,
+        surfaces=SURFACES, **proj):
+    proj.setdefault("det_surfaces", surfaces)
+    proj.setdefault("fault_sites", {})
+    proj.setdefault("ci_shell_texts", {})
+    return lint_sources({path: textwrap.dedent(src)}, select=select,
+                        project=Project(**proj))
+
+
+def ids(issues):
+    return [i.pass_id for i in issues]
+
+
+# ==================================================== determinism-soundness
+def test_unseeded_np_random_in_surface_fires():
+    issues = run("""
+        import numpy as np
+        def make_trace(cfg):
+            return [np.random.uniform() for _ in range(3)]
+    """, select=["determinism-soundness"])
+    assert ids(issues) == ["determinism-soundness"]
+    assert "np.random.uniform" in issues[0].message
+    assert "make_trace" in issues[0].message
+
+
+def test_witness_chain_through_helper():
+    issues = run("""
+        import random
+        def _gap():
+            return random.random()
+        def _helper():
+            return _gap()
+        def make_trace(cfg):
+            return _helper()
+    """, select=["determinism-soundness"])
+    assert ids(issues) == ["determinism-soundness"]
+    # the chain names each hop with file:line witnesses
+    assert "via" in issues[0].message
+    assert "_helper" in issues[0].message
+    assert "_gap" in issues[0].message
+    assert "mxnet_tpu/serving/fixture.py:" in issues[0].message
+
+
+def test_seeded_rng_is_clean():
+    issues = run("""
+        import numpy as np
+        def make_trace(cfg):
+            rng = np.random.RandomState(cfg.seed)
+            return rng.uniform()
+    """, select=["determinism-soundness"])
+    assert issues == []
+
+
+def test_entropy_rng_helper_is_sanctioned():
+    issues = run("""
+        from mxnet_tpu.base import entropy_rng
+        def make_trace(cfg):
+            rng = entropy_rng()
+            return rng.random()
+    """, select=["determinism-soundness"])
+    assert issues == []
+
+
+def test_clock_seeded_ctor_fires():
+    issues = run("""
+        import time
+        import numpy as np
+        def make_trace(cfg):
+            rng = np.random.RandomState(int(time.time()))
+            return rng.uniform()
+    """, select=["determinism-soundness"])
+    assert ids(issues) == ["determinism-soundness"]
+
+
+def test_uuid4_and_urandom_fire():
+    issues = run("""
+        import os
+        import uuid
+        def make_trace(cfg):
+            return uuid.uuid4().hex, os.urandom(8)
+    """, select=["determinism-soundness"])
+    assert ids(issues) == ["determinism-soundness"] * 2
+
+
+def test_string_hash_and_set_iteration_fire():
+    issues = run("""
+        def make_trace(cfg):
+            order = hash("model-a")
+            out = []
+            for name in {"a", "b", "c"}:
+                out.append(name)
+            return order, out
+    """, select=["determinism-soundness"])
+    assert len(issues) == 2
+    assert all(i.pass_id == "determinism-soundness" for i in issues)
+
+
+def test_class_surface_covers_methods():
+    issues = run("""
+        import random
+        class Ckpt:
+            def save(self):
+                return random.random()
+    """, select=["determinism-soundness"])
+    assert ids(issues) == ["determinism-soundness"]
+
+
+def test_unreachable_entropy_is_clean():
+    issues = run("""
+        import random
+        def unrelated():
+            return random.random()
+        def make_trace(cfg):
+            return 7
+    """, select=["determinism-soundness"])
+    assert issues == []
+
+
+def test_determinism_suppression():
+    issues = run("""
+        import random
+        def make_trace(cfg):
+            # mxlint: disable=determinism-soundness
+            return random.random()
+    """, select=["determinism-soundness"])
+    assert issues == []
+
+
+def test_registry_round_trip_from_sources():
+    # declare_deterministic literals in the scanned tree feed the
+    # registry when no explicit registry is injected
+    issues = run("""
+        from mxnet_tpu.base import declare_deterministic
+        import random
+        declare_deterministic("mxnet_tpu.serving.fixture.gen",
+                              "fixture surface")
+        def gen():
+            return random.random()
+    """, select=["determinism-soundness"], surfaces=None)
+    det = [i for i in issues if i.pass_id == "determinism-soundness"]
+    assert len(det) == 1 and "gen" in det[0].message
+
+
+# ======================================================== thread-lifecycle
+def test_nondaemon_never_joined_fires():
+    issues = run("""
+        import threading
+        class Pump:
+            def start(self):
+                self._t = threading.Thread(target=self._loop)
+                self._t.start()
+            def _loop(self):
+                pass
+            def stop(self):
+                pass
+    """, select=["thread-lifecycle"])
+    assert ids(issues) == ["thread-lifecycle"]
+    assert "never joined" in issues[0].message
+
+
+def test_daemon_thread_is_exempt_from_join():
+    issues = run("""
+        import threading
+        class Pump:
+            def start(self):
+                self._t = threading.Thread(target=self._loop, daemon=True)
+                self._t.start()
+            def _loop(self):
+                pass
+    """, select=["thread-lifecycle"])
+    assert issues == []
+
+
+def test_joined_with_timeout_on_stop_path_is_clean():
+    issues = run("""
+        import threading
+        class Pump:
+            def start(self):
+                self._t = threading.Thread(target=self._loop)
+                self._t.start()
+            def _loop(self):
+                pass
+            def stop(self):
+                self._halt()
+            def _halt(self):
+                self._t.join(timeout=5)
+    """, select=["thread-lifecycle"])
+    assert issues == []
+
+
+def test_untimed_join_fires():
+    issues = run("""
+        import threading
+        class Pump:
+            def start(self):
+                self._t = threading.Thread(target=self._loop)
+                self._t.start()
+            def _loop(self):
+                pass
+            def stop(self):
+                self._t.join()
+    """, select=["thread-lifecycle"])
+    assert ids(issues) == ["thread-lifecycle"]
+    assert "without a timeout" in issues[0].message
+
+
+def test_local_thread_joined_inline_is_clean():
+    issues = run("""
+        import threading
+        def fan_out(work):
+            t = threading.Thread(target=work)
+            t.start()
+            t.join(30)
+    """, select=["thread-lifecycle"])
+    assert issues == []
+
+
+def test_executor_without_shutdown_fires():
+    issues = run("""
+        from concurrent.futures import ThreadPoolExecutor
+        class Loader:
+            def __init__(self):
+                self._pool = ThreadPoolExecutor(4)
+    """, select=["thread-lifecycle"])
+    assert ids(issues) == ["thread-lifecycle"]
+    assert "shut down" in issues[0].message
+
+
+def test_executor_with_shutdown_or_with_is_clean():
+    issues = run("""
+        from concurrent.futures import ThreadPoolExecutor
+        class Loader:
+            def __init__(self):
+                self._pool = ThreadPoolExecutor(4)
+            def close(self):
+                self._pool.shutdown(wait=True)
+        def batch(fn, items):
+            with ThreadPoolExecutor(2) as pool:
+                return list(pool.map(fn, items))
+    """, select=["thread-lifecycle"])
+    assert issues == []
+
+
+def test_make_thread_defaults_are_clean():
+    issues = run("""
+        from mxnet_tpu.engine import make_thread
+        class Pump:
+            def start(self):
+                self._t = make_thread(self._loop, name="pump",
+                                      owner="Pump")
+                self._t.start()
+            def _loop(self):
+                pass
+    """, select=["thread-lifecycle"])
+    assert issues == []
+
+
+def test_orphan_loop_fires():
+    issues = run("""
+        import threading
+        class Pump:
+            def start(self):
+                self._t = threading.Thread(target=self._loop,
+                                           daemon=True)
+                self._t.start()
+            def _loop(self):
+                while True:
+                    self._work()
+            def _work(self):
+                pass
+            def stop(self):
+                self._stopping = True
+    """, select=["thread-lifecycle"])
+    assert ids(issues) == ["thread-lifecycle"]
+    assert "orphan loop" in issues[0].message
+    assert "Pump._loop" in issues[0].message
+
+
+def test_loop_observing_stop_flag_is_clean():
+    issues = run("""
+        import threading
+        class Pump:
+            def start(self):
+                self._t = threading.Thread(target=self._loop,
+                                           daemon=True)
+                self._t.start()
+            def _loop(self):
+                while True:
+                    if self._stopping:
+                        return
+                    self._work()
+            def _work(self):
+                pass
+            def stop(self):
+                self._stopping = True
+                self._t.join(timeout=5)
+    """, select=["thread-lifecycle"])
+    assert issues == []
+
+
+def test_thread_lifecycle_suppression():
+    issues = run("""
+        import threading
+        class Pump:
+            def start(self):
+                # deliberate fire-and-forget: forget_thread at runtime
+                # mxlint: disable=thread-lifecycle
+                self._t = threading.Thread(target=self._loop)
+                self._t.start()
+            def _loop(self):
+                pass
+            def stop(self):
+                pass
+    """, select=["thread-lifecycle"])
+    assert issues == []
+
+
+# ======================================================== blocking-in-loop
+def test_sleep_in_unbreakable_loop_fires():
+    issues = run("""
+        import time
+        class Pump:
+            def _loop(self):
+                while True:
+                    self._work()
+                    time.sleep(1.0)
+            def _work(self):
+                pass
+    """, select=["blocking-in-loop"])
+    assert ids(issues) == ["blocking-in-loop"]
+    assert "time.sleep" in issues[0].message
+
+
+def test_sleep_with_stop_check_is_clean():
+    issues = run("""
+        import time
+        class Pump:
+            def _loop(self):
+                while True:
+                    if self._stopping:
+                        return
+                    time.sleep(1.0)
+    """, select=["blocking-in-loop"])
+    assert issues == []
+
+
+def test_timed_event_wait_is_clean():
+    issues = run("""
+        class Pump:
+            def _loop(self):
+                while True:
+                    if self._evt.wait(0.5):
+                        break
+    """, select=["blocking-in-loop"])
+    assert issues == []
+
+
+def test_bare_condition_wait_fires():
+    issues = run("""
+        class Pump:
+            def _loop(self):
+                while True:
+                    with self._cond:
+                        self._cond.wait()
+    """, select=["blocking-in-loop"])
+    assert ids(issues) == ["blocking-in-loop"]
+
+
+def test_blocking_suppression():
+    issues = run("""
+        import time
+        def burn():
+            while True:
+                # mxlint: disable=blocking-in-loop
+                time.sleep(60)
+    """, select=["blocking-in-loop"])
+    assert issues == []
+
+
+# ====================================================== tree-clean gate
+def test_repo_tree_is_clean_for_new_passes():
+    files = iter_py_files([os.path.join(REPO, "mxnet_tpu"),
+                           os.path.join(REPO, "tools")])
+    issues = lint_paths(files, select=["determinism-soundness",
+                                       "thread-lifecycle",
+                                       "blocking-in-loop"])
+    assert issues == [], [str(i) for i in issues]
+
+
+def test_new_passes_registered():
+    for pid in ("determinism-soundness", "thread-lifecycle",
+                "blocking-in-loop"):
+        assert pid in PASSES
+    assert len(PASSES) == 16
+
+
+# ========================================================== result cache
+def _write(root, rel, text):
+    p = os.path.join(root, rel)
+    os.makedirs(os.path.dirname(p), exist_ok=True)
+    with open(p, "w") as fh:
+        fh.write(text)
+    return p
+
+
+def test_cache_round_trip_and_invalidation(tmp_path):
+    root = str(tmp_path)
+    f = _write(root, "pkg/mod.py", "x = 1\n")
+    key = mxcache.cache_key([f], None, None, root=root)
+    assert mxcache.load(key, root=root) is None          # cold miss
+    from tools.mxlint.core import Issue
+    issues = [Issue("thread-lifecycle", "pkg/mod.py", 3, 0, "msg")]
+    mxcache.store(key, issues, root=root)
+    got = mxcache.load(key, root=root)                   # warm hit
+    assert [str(i) for i in got] == [str(i) for i in issues]
+    _write(root, "pkg/mod.py", "x = 2\n")                # edit → new key
+    assert mxcache.cache_key([f], None, None, root=root) != key
+
+
+def test_cache_key_varies_with_select_and_report(tmp_path):
+    root = str(tmp_path)
+    f = _write(root, "pkg/mod.py", "x = 1\n")
+    base = mxcache.cache_key([f], None, None, root=root)
+    sel = mxcache.cache_key([f], ["thread-lifecycle"], None, root=root)
+    rep = mxcache.cache_key([f], None, {"pkg/mod.py"}, root=root)
+    assert len({base, sel, rep}) == 3
+
+
+def test_cache_key_varies_with_side_inputs(tmp_path):
+    root = str(tmp_path)
+    f = _write(root, "pkg/mod.py", "x = 1\n")
+    before = mxcache.cache_key([f], None, None, root=root)
+    _write(root, "docs/env_vars.md", "MXNET_NEW_KNOB\n")
+    assert mxcache.cache_key([f], None, None, root=root) != before

@@ -219,3 +219,90 @@ def test_sanitizer_active_reports_module_flag(monkeypatch):
     assert engine.sanitizer_active()
     monkeypatch.setattr(engine, "_SANITIZE", False)
     assert not engine.sanitizer_active()
+
+
+# ===================================================== thread sanitizer
+@pytest.fixture
+def thread_sanitize(monkeypatch):
+    monkeypatch.setattr(engine, "_SANITIZE", True)
+    engine._THREADS.reset()
+    yield
+    engine._THREADS.reset()
+
+
+def test_leaked_thread_raises_with_owner_and_site(thread_sanitize):
+    release = threading.Event()
+    t = engine.make_thread(release.wait, name="leaky",
+                           owner="TestOwner")
+    t.start()
+    try:
+        with pytest.raises(MXNetError) as exc:
+            engine.check_thread_leaks(grace_s=0.05)
+        msg = str(exc.value)
+        assert "thread leak" in msg
+        assert "leaky" in msg and "TestOwner" in msg
+        assert "test_sanitizer.py" in msg       # creation site witness
+    finally:
+        release.set()
+        t.join(5)
+
+
+def test_joined_thread_is_clean(thread_sanitize):
+    t = engine.make_thread(lambda: None, name="quick", owner="TestOwner")
+    t.start()
+    t.join(5)
+    engine.check_thread_leaks(grace_s=0.05)     # no raise
+
+
+def test_forgotten_thread_is_exempt(thread_sanitize):
+    release = threading.Event()
+    t = engine.make_thread(release.wait, name="abandoned",
+                           owner="TestOwner")
+    t.start()
+    engine.forget_thread(t, "deliberately abandoned (test)")
+    try:
+        engine.check_thread_leaks(grace_s=0.05)  # no raise
+        rows = engine.thread_registry()
+        assert any(r["name"] == "abandoned" and r["abandoned"]
+                   for r in rows)
+    finally:
+        release.set()
+        t.join(5)
+
+
+def test_grace_covers_a_stopping_thread(thread_sanitize):
+    evt = threading.Event()
+    t = engine.make_thread(lambda: evt.wait(0.1), name="stopping",
+                           owner="TestOwner")
+    t.start()
+    # still alive at call time; exits within the grace window
+    engine.check_thread_leaks(grace_s=5.0)
+    assert not t.is_alive()
+
+
+def test_make_thread_off_path_is_plain_and_unregistered(monkeypatch):
+    monkeypatch.setattr(engine, "_SANITIZE", False)
+    engine._THREADS.reset()
+    t = engine.make_thread(lambda: None, name="plain", owner="X")
+    assert isinstance(t, threading.Thread) and t.daemon
+    t.start()
+    t.join(5)
+    assert engine.thread_registry() == []
+    engine.check_thread_leaks()                  # no-op, no raise
+
+
+def test_thread_registry_rows_shape(thread_sanitize):
+    release = threading.Event()
+    t = engine.make_thread(release.wait, name="rowed", owner="Owner(x)")
+    t.start()
+    try:
+        rows = engine.thread_registry()
+        (row,) = [r for r in rows if r["name"] == "rowed"]
+        assert row["owner"] == "Owner(x)"
+        assert row["daemon"] is True
+        assert row["age_s"] >= 0.0
+        assert "tests/test_sanitizer.py" in row["site"] \
+            or "test_sanitizer.py" in row["site"]
+    finally:
+        release.set()
+        t.join(5)

@@ -286,3 +286,47 @@ class TestSummarize:
         s = summarize(recs, wall_s=wall, latency_slo_s=1.0)
         assert s["attainment"] == pytest.approx(1.0)
         assert set(s["by_tier"]) <= {"gold", "silver", "free"}
+
+
+# ------------------------------------------------- replay determinism
+class TestReplayDeterminism:
+    """ISSUE-18: the BENCH_r07 burst trace replayed twice against
+    identical deterministic twins must score byte-identically —
+    the regression that keeps ambient entropy out of the
+    generate -> replay -> summarize chain (mxlint
+    determinism-soundness is the static twin of this test)."""
+
+    def _bench_r07_config(self):
+        # mirror benchmark/bench_traffic.py run(): the r07 burst shape
+        # at 1/6 duration so the test stays inside the tier-1 budget
+        duration = 1.0
+        return TraceConfig(
+            seed=0, duration_s=duration, base_rate=14.0,
+            process="lognormal", models=("lm",), generate_fraction=1.0,
+            tenants=6, burst_at=0.45, burst_x=10.0,
+            burst_duration_s=duration * 0.25, prompt_max=16,
+            output_max=10, output_mean=5.0)
+
+    @staticmethod
+    def _twin_call(req):
+        # a deterministic server twin: outcome and every measured field
+        # are pure functions of the request, overriding the wall-clock
+        # measurements via the rec.update(info) contract
+        lat = 0.001 + (req.prompt_len + req.max_new_tokens) * 1e-4
+        return {"latency_s": lat, "ttft_s": lat * 0.25,
+                "start_s": req.t}
+
+    def _replay_summary(self, trace):
+        import json
+        recs, _ = replay_trace(trace, self._twin_call, clients=6,
+                               speed=50.0, timeout_s=10.0)
+        s = summarize(recs, wall_s=trace.duration_s,
+                      latency_slo_s=0.05, ttft_slo_s=0.02)
+        return json.dumps(s, sort_keys=True)
+
+    def test_bench_r07_replay_is_byte_identical(self):
+        cfg = self._bench_r07_config()
+        tr_a = generate_trace(cfg)
+        tr_b = generate_trace(cfg)
+        assert tr_a.to_jsonl() == tr_b.to_jsonl()
+        assert self._replay_summary(tr_a) == self._replay_summary(tr_b)

@@ -116,6 +116,29 @@ def diagnose(metrics_smoke=False):
           f"(MXNET_ENGINE_SANITIZE=1 to enable lock-order recording + "
           f"tracked-array assertions; docs/static_analysis.md)")
 
+    _section("Threads")
+    from mxnet_tpu import base as _base
+    rows = engine.thread_registry()
+    if not engine.sanitizer_active():
+        print("registry     : (off — MXNET_ENGINE_SANITIZE=1 records "
+              "every engine.make_thread with owner + spawn site, and "
+              "check_thread_leaks() fails tests whose threads outlive "
+              "their owner's stop)")
+    elif not rows:
+        print("registry     : 0 framework thread(s) registered")
+    else:
+        print(f"registry     : {len(rows)} framework thread(s)")
+        for r in rows:
+            flags = ["daemon" if r["daemon"] else "non-daemon"]
+            if r["abandoned"]:
+                flags.append(f"abandoned: {r['abandoned']}")
+            print(f"  {r['name']:<28s} owner={r['owner']} "
+                  f"site={r['site']} age={r['age_s']:.1f}s "
+                  f"({', '.join(flags)})")
+    print(f"deterministic: {len(_base.list_deterministic())} declared "
+          f"surface(s) (base.declare_deterministic; ambient entropy on "
+          f"them is a lint error — mxlint determinism-soundness)")
+
     _section("Fault Injection")
     from mxnet_tpu import faults
     sites = faults.declared_sites()

@@ -24,6 +24,7 @@ import subprocess
 import sys
 
 from . import PASSES, lint_paths
+from . import cache as _cache
 from .baseline import apply_baseline, load_baseline, save_baseline
 from .core import iter_py_files, path_key
 
@@ -86,6 +87,9 @@ def main(argv=None):
                          "REF (default HEAD; staged+unstaged+untracked)."
                          "  The call graph is still built project-wide,"
                          " so interprocedural findings stay sound")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="bypass the .mxlint_cache/ result cache "
+                         "(reads and writes)")
     args = ap.parse_args(argv)
 
     if args.list_passes:
@@ -141,8 +145,26 @@ def main(argv=None):
                       f"{args.changed}")
             return 0
 
-    # hand the expanded list through so the tree is walked once
-    issues = lint_paths(files, select=select, report=report)
+    # result cache (.mxlint_cache/, docs/static_analysis.md): keyed on
+    # the content sha of every linted file + mxlint's own sources +
+    # pass side-inputs, so any relevant edit misses.  A --changed run
+    # falls back to a stored FULL run over the same tree and filters it
+    # — CI's full lint warms the subsequent --changed smoke.
+    issues = None
+    key = full_key = None
+    if not args.no_cache:
+        key = _cache.cache_key(files, select, report)
+        issues = _cache.load(key)
+        if issues is None and report is not None:
+            full_key = _cache.cache_key(files, select, None)
+            full = _cache.load(full_key)
+            if full is not None:
+                issues = [i for i in full if i.path in report]
+    if issues is None:
+        # hand the expanded list through so the tree is walked once
+        issues = lint_paths(files, select=select, report=report)
+        if key is not None:
+            _cache.store(key, issues)
 
     if args.update_baseline:
         counts = save_baseline(args.baseline, issues)

@@ -1,0 +1,135 @@
+"""Incremental result cache for mxlint (``.mxlint_cache/``).
+
+Design (why a *run*-level issue cache and not pickled ASTs): parsing
+the whole tree costs ~1.0s, but ``pickle.load`` of the same ASTs costs
+~1.3s — AST caching is a net loss, measured, so nothing intermediate
+is persisted.  What *is* worth persisting is the final issue list,
+keyed on everything that can change it:
+
+- the content sha of every linted file (so any edit misses),
+- the content sha of mxlint's own sources (so a pass edit misses),
+- the content sha of the side inputs passes read outside the linted
+  set (``docs/*.md`` for env/telemetry drift, ``ci/*.sh`` for
+  fault-site coverage, ``mxnet_tpu/base.py`` / ``mxnet_tpu/faults.py``
+  fallback registries),
+- the ``--select`` set and the ``--changed`` report filter.
+
+A warm ``--changed`` run first tries its exact key, then falls back to
+a stored *full* run (same files, no report filter) and filters that —
+so CI's full lint warms the subsequent ``--changed`` smoke, and a
+repeated identical invocation (the pre-commit retry loop, CI's
+baseline re-record) returns in well under a second instead of ~11s.
+
+The baseline ratchet is applied *after* the cache layer (cached
+entries hold raw findings), so ``--baseline`` / ``--update-baseline``
+compose with hits.  ``--no-cache`` bypasses reads and writes; the
+directory is gitignored and self-prunes to the newest
+``_MAX_ENTRIES``.
+"""
+from __future__ import annotations
+
+import glob
+import hashlib
+import json
+import os
+from typing import Iterable, List, Optional
+
+from .core import Issue, path_key
+
+__all__ = ["cache_key", "load", "store", "cache_dir"]
+
+_MAX_ENTRIES = 64
+_VERSION = 1        # bump to orphan every existing entry
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def cache_dir(root: Optional[str] = None) -> str:
+    return os.path.join(root or _repo_root(), ".mxlint_cache")
+
+
+def _sha(path: str) -> Optional[str]:
+    try:
+        with open(path, "rb") as fh:
+            return hashlib.sha256(fh.read()).hexdigest()
+    except OSError:
+        return None
+
+
+def _side_inputs(root: str) -> List[str]:
+    """Files passes read that may lie outside the linted set."""
+    out = sorted(glob.glob(os.path.join(root, "docs", "*.md")))
+    out += sorted(glob.glob(os.path.join(root, "ci", "*.sh")))
+    out += sorted(glob.glob(os.path.join(root, "tools", "mxlint",
+                                         "**", "*.py"), recursive=True))
+    for extra in ("mxnet_tpu/base.py", "mxnet_tpu/faults.py"):
+        out.append(os.path.join(root, extra))
+    return out
+
+
+def cache_key(files: Iterable[str], select, report,
+              root: Optional[str] = None) -> str:
+    """Deterministic key over every input that can change the issue
+    list.  ``report=None`` keys a full (unfiltered) run."""
+    root = root or _repo_root()
+    doc = {
+        "v": _VERSION,
+        "files": sorted((path_key(f), _sha(f)) for f in files),
+        "side": [(os.path.relpath(p, root), _sha(p))
+                 for p in _side_inputs(root)],
+        "select": sorted(select) if select else None,
+        "report": sorted(report) if report is not None else None,
+    }
+    blob = json.dumps(doc, sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()
+
+
+def load(key: str, root: Optional[str] = None) -> Optional[List[Issue]]:
+    path = os.path.join(cache_dir(root), f"{key}.json")
+    try:
+        with open(path) as fh:
+            rows = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    try:
+        issues = [Issue(r["pass"], r["file"], r["line"], r["col"],
+                        r["message"]) for r in rows]
+    except (KeyError, TypeError):
+        return None
+    # freshen mtime so pruning is LRU-ish
+    try:
+        os.utime(path)
+    except OSError:
+        pass
+    return issues
+
+
+def store(key: str, issues: Iterable[Issue],
+          root: Optional[str] = None) -> None:
+    d = cache_dir(root)
+    try:
+        os.makedirs(d, exist_ok=True)
+        rows = [{"pass": i.pass_id, "file": i.path, "line": i.line,
+                 "col": i.col, "message": i.message} for i in issues]
+        tmp = os.path.join(d, f".{key}.tmp.{os.getpid()}")
+        with open(tmp, "w") as fh:
+            json.dump(rows, fh)
+        os.replace(tmp, os.path.join(d, f"{key}.json"))
+        _prune(d)
+    except OSError:
+        pass                # cache is best-effort, never fails the lint
+
+
+def _prune(d: str) -> None:
+    entries = glob.glob(os.path.join(d, "*.json"))
+    if len(entries) <= _MAX_ENTRIES:
+        return
+    entries.sort(key=lambda p: os.path.getmtime(p))
+    for p in entries[:len(entries) - _MAX_ENTRIES]:
+        try:
+            os.remove(p)
+        except OSError:
+            pass

@@ -74,6 +74,7 @@ class SourceFile:
         self.source = source
         self.lines = source.splitlines()
         self.tree = ast.parse(source, filename=path)
+        self._nodes = None
         # line -> set of suppressed pass ids ("all" wildcard included)
         self.suppressions: Dict[int, set] = {}
         self.file_suppressions: set = set()
@@ -95,6 +96,15 @@ class SourceFile:
                         self.suppressions.setdefault(
                             j, set()).update(names)
                         break
+
+    def nodes(self):
+        """Every node of the tree in ``ast.walk`` order, computed once
+        and shared — passes that scan the whole file should iterate
+        this instead of re-walking the tree (the walk itself is a
+        measurable slice of a full-tree run)."""
+        if self._nodes is None:
+            self._nodes = list(ast.walk(self.tree))
+        return self._nodes
 
     def suppressed(self, pass_id: str, node_or_line) -> bool:
         if {"all", pass_id} & self.file_suppressions:
@@ -132,7 +142,7 @@ class Project:
 
     def __init__(self, env_declared=None, env_documented=None,
                  fault_sites=None, ci_shell_texts=None,
-                 doc_metrics=None, doc_spans=None):
+                 doc_metrics=None, doc_spans=None, det_surfaces=None):
         self.env_declared = set(env_declared or ())
         self.env_documented = set(env_documented or ())
         self.fault_sites: Dict[str, Optional[tuple]] = dict(
@@ -141,6 +151,12 @@ class Project:
         # otherwise the fault-site pass merges the repo's faults.py
         # catalogue under whatever the scanned files declare
         self.fault_sites_explicit = fault_sites is not None
+        # deterministic surfaces ({qualified name: contract note}) —
+        # same explicit/harvest/repo-fallback discipline, declared via
+        # base.declare_deterministic and enforced by the
+        # determinism-soundness pass
+        self.det_surfaces: Dict[str, str] = dict(det_surfaces or {})
+        self.det_surfaces_explicit = det_surfaces is not None
         self.ci_shell_texts = ci_shell_texts
         self.doc_metrics = doc_metrics
         self.doc_spans = doc_spans
@@ -176,7 +192,7 @@ class Project:
         self._callgraph = None          # rebuilt for the new file set
         self._summaries = None
         for f in self.files:
-            for node in ast.walk(f.tree):
+            for node in f.nodes():
                 if not isinstance(node, ast.Call):
                     continue
                 name = _call_name(node)
@@ -191,6 +207,16 @@ class Project:
                         and isinstance(node.args[0].value, str):
                     self.fault_sites[node.args[0].value] = \
                         _literal_modes(node)
+                elif name.endswith("declare_deterministic") \
+                        and node.args \
+                        and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str):
+                    note = ""
+                    if len(node.args) > 1 \
+                            and isinstance(node.args[1], ast.Constant) \
+                            and isinstance(node.args[1].value, str):
+                        note = node.args[1].value
+                    self.det_surfaces[node.args[0].value] = note
         doc = os.path.join(self._repo_root(), "docs", "env_vars.md")
         if os.path.exists(doc):
             with open(doc) as fh:

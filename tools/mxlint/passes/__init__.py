@@ -18,3 +18,6 @@ from . import recompile_churn       # noqa: F401
 from . import fault_site            # noqa: F401
 from . import deadline_soundness    # noqa: F401
 from . import telemetry_drift       # noqa: F401
+from . import determinism_soundness  # noqa: F401
+from . import thread_lifecycle      # noqa: F401
+from . import blocking_in_loop      # noqa: F401

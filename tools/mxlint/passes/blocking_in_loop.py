@@ -1,0 +1,94 @@
+"""blocking-in-loop: ``time.sleep`` / bare ``.wait()`` inside a
+``while True`` body that never checks a stop signal.
+
+The companion shape to thread-lifecycle's orphan-loop rule: even a
+properly joined thread wedges its owner's ``stop()`` for up to one
+full sleep interval — or forever, on a bare ``Condition.wait()`` with
+no predicate re-check — when the loop blocks without observing any
+stop state.  The fix is mechanical: ``stop_evt.wait(interval)``
+instead of ``time.sleep(interval)``, or a stop-flag check adjacent to
+the blocking call (which is exactly what makes the loop visible to
+the thread-lifecycle pass's orphan analysis).
+
+Stay-quiet rules: only literal-``True`` loops are examined; any
+``break``/``return`` in the body, any ``.is_set()``, any ``.wait(...)``
+*with* a timeout argument, or any name/attribute read whose terminal
+mentions stop/running/closed/done exempts the loop.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import LintPass, SourceFile, dotted_name, register_pass
+
+_STOPISH = ("stop", "stopping", "shutdown", "closed", "close",
+            "running", "alive", "done", "exit", "quit", "draining")
+
+
+def _reads_stopish(body_nodes) -> bool:
+    for node in body_nodes:
+        term = None
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.ctx, ast.Load):
+            term = node.attr
+        elif isinstance(node, ast.Name) \
+                and isinstance(node.ctx, ast.Load):
+            term = node.id
+        if term and any(s in term.lower() for s in _STOPISH):
+            return True
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute):
+            if node.func.attr == "is_set":
+                return True
+            if node.func.attr == "wait" and (node.args or node.keywords):
+                return True             # timed event/condition wait
+    return False
+
+
+def _local_nodes(root):
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+@register_pass
+class BlockingInLoopPass(LintPass):
+    id = "blocking-in-loop"
+    doc = ("time.sleep()/bare .wait() inside a `while True` body with "
+           "no break/return and no stop-flag or is_set()/timed-wait "
+           "check — the loop blocks its owner's stop() for a full "
+           "interval (or forever); use stop_evt.wait(interval) instead")
+
+    def check_file(self, src: SourceFile):
+        for loop in src.nodes():
+            if not (isinstance(loop, ast.While)
+                    and isinstance(loop.test, ast.Constant)
+                    and bool(loop.test.value)):
+                continue
+            body = list(_local_nodes(loop))
+            if any(isinstance(n, (ast.Break, ast.Return)) for n in body):
+                continue
+            if _reads_stopish(body):
+                continue
+            for node in body:
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                blocking = name.endswith("time.sleep") \
+                    or name == "sleep" \
+                    or (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "wait"
+                        and not node.args and not node.keywords)
+                if blocking:
+                    yield self.issue(
+                        src, node,
+                        f"`{name}(...)` blocks inside an unbreakable "
+                        f"`while True` (line {loop.lineno}) that never "
+                        f"checks a stop flag — stop() can't interrupt "
+                        f"it; use a stop event's wait(interval) or "
+                        f"check is_set() in the loop")

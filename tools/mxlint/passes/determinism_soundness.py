@@ -1,0 +1,346 @@
+"""determinism-soundness: no ambient entropy reachable from a declared
+deterministic surface.
+
+Every headline guarantee this repro ships is a determinism contract —
+byte-identical trace generation/replay (docs/serving.md §11), bit-exact
+checkpoint resume (docs/training_resilience.md §3), seeded fault plans,
+key-seeded stochastic quantization — yet nothing *statically* prevented
+one unseeded RNG or wall-clock-derived value from silently breaking
+them.  ``mxnet_tpu.base.declare_deterministic`` is the registry of
+those surfaces (a fully-qualified function, or a class covering every
+method); this pass walks the PR-4 call graph from each declared surface
+and flags every reachable **ambient entropy source**:
+
+- ``random.X(...)`` module-level draws — the process-wide global RNG
+  any other thread/library can advance;
+- unseeded constructors: ``random.Random()``, ``np.random.RandomState()``,
+  ``np.random.default_rng()`` with no seed argument, and
+  ``random.SystemRandom`` (OS entropy by definition);
+- wall-clock-seeded RNGs: ``Random(time.time())`` and
+  ``rng.seed(time.time())`` shapes;
+- ``np.random.X(...)`` module-level draws (the global NumPy RNG);
+- ``uuid.uuid1/uuid4``, ``os.urandom``, ``secrets.*``;
+- builtin ``hash()`` of a string — salted per process
+  (``PYTHONHASHSEED``), so it is a different value on every run;
+- iteration over an unordered ``set`` feeding output
+  (``for x in set(...)``, ``list(set(...))``) — ``sorted(set(...))``
+  is the deterministic form and stays quiet.
+
+Findings carry the ``via helper (file:line)`` witness chain from the
+declared surface, so an entropy source buried N helpers deep is flagged
+*at the source* — and still fires through unchanged helpers in
+``--changed`` mode.  Thread targets count as edges: a worker spawned by
+a surface (``replay_trace``'s client pool) is on the hook too.
+
+**Sanctioned nondeterminism**: retry/backoff jitter must NOT be
+deterministic (replicas retrying in lockstep re-collide forever) — it
+is routed through ``base.entropy_rng()``, the one helper this pass
+exempts (the BFS does not descend into it).  Everything else either
+takes its seed from the surface's config or carries a
+``# mxlint: disable=determinism-soundness`` suppression stating the
+contract.
+
+The registry is harvested from ``declare_deterministic`` literals in
+the scanned files; when the scanned set declares none, the repo's
+``mxnet_tpu/base.py`` is parsed as the authoritative fallback (so
+linting ``benchmark/`` alone still covers the bench twin paths).
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from ..core import (LintPass, Project, SourceFile, dotted_name,
+                    register_pass)
+
+# module-level draws on the process-global python RNG
+_PY_DRAWS = {
+    "random", "randint", "randrange", "uniform", "choice", "choices",
+    "shuffle", "sample", "gauss", "normalvariate", "lognormvariate",
+    "expovariate", "betavariate", "gammavariate", "paretovariate",
+    "vonmisesvariate", "weibullvariate", "triangular", "randbytes",
+    "getrandbits",
+}
+
+# module-level draws on the global NumPy RNG (np.random.X)
+_NP_DRAWS = {
+    "rand", "randn", "randint", "random", "random_sample", "ranf",
+    "sample", "uniform", "choice", "permutation", "shuffle", "normal",
+    "lognormal", "exponential", "pareto", "poisson", "binomial",
+    "beta", "gamma", "standard_normal", "bytes", "random_integers",
+}
+
+# constructors that are unseeded when called with no arguments
+_UNSEEDED_CTORS = {"random.Random", "numpy.random.RandomState",
+                   "numpy.random.default_rng"}
+
+_CLOCKS = {"time.time", "time.time_ns", "time.monotonic",
+           "time.monotonic_ns", "time.perf_counter",
+           "time.perf_counter_ns"}
+
+#: the sanctioned deliberate-nondeterminism helper (its internal
+#: os.urandom IS the point); matching by terminal name keeps fixtures
+#: honest without hard-coding the repo module path
+_SANCTIONED = "entropy_rng"
+
+
+def _is_set_expr(node) -> bool:
+    """Whether ``node`` is an unordered-set expression: a set literal,
+    a set comprehension, or a ``set(...)``/``frozenset(...)`` call."""
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    return isinstance(node, ast.Call) \
+        and dotted_name(node.func) in ("set", "frozenset")
+
+
+class _Source:
+    __slots__ = ("node", "kind", "detail")
+
+    def __init__(self, node, kind, detail):
+        self.node = node
+        self.kind = kind
+        self.detail = detail
+
+
+@register_pass
+class DeterminismSoundnessPass(LintPass):
+    id = "determinism-soundness"
+    doc = ("ambient entropy (global random/np.random state, unseeded "
+           "or wall-clock-seeded RNGs, uuid4, os.urandom, string "
+           "hash(), unordered set iteration) reachable from a surface "
+           "declared deterministic via base.declare_deterministic — "
+           "deliberate jitter goes through base.entropy_rng()")
+
+    def __init__(self, project: Project):
+        super().__init__(project)
+        self._surfaces = dict(project.det_surfaces)
+        if not project.det_surfaces_explicit:
+            # merge under the scanned declarations, repo stays the
+            # authority when linting tests/ or benchmark/ alone
+            for name, note in self._repo_registry().items():
+                self._surfaces.setdefault(name, note)
+        self._reach = None
+
+    # ------------------------------------------------------------ registry
+    @staticmethod
+    def _repo_registry():
+        """Authoritative fallback: ``declare_deterministic`` literals
+        parsed out of the repo's base.py."""
+        path = os.path.join(Project._repo_root(), "mxnet_tpu",
+                            "base.py")
+        if not os.path.exists(path):
+            return {}
+        with open(path) as fh:
+            try:
+                tree = ast.parse(fh.read(), filename=path)
+            except SyntaxError:
+                return {}
+        out = {}
+        from ..core import _call_name
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and _call_name(node).endswith(
+                        "declare_deterministic") \
+                    and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                out[node.args[0].value] = ""
+        return out
+
+    # -------------------------------------------------------- reachability
+    def _entry_qnames(self, graph):
+        """Call-graph qnames covered by the declared surfaces: an exact
+        function match, or every function under a declared class/
+        function prefix (methods, nested defs)."""
+        prefixes = tuple(f"{s}." for s in self._surfaces)
+        out = {}
+        for qname in graph.functions:
+            if qname in self._surfaces:
+                out[qname] = qname
+                continue
+            for s, p in zip(self._surfaces, prefixes):
+                if qname.startswith(p):
+                    out[qname] = s
+                    break
+        return out
+
+    def _reachable(self):
+        """{qname: (surface label, ((fn, path, line), ...))} — BFS from
+        every declared surface; thread ``target=`` references count as
+        call edges; the sanctioned ``entropy_rng`` is never entered."""
+        if self._reach is not None:
+            return self._reach
+        graph = self.project.callgraph()
+        reach = {}
+        frontier = []
+        for qname, label in self._entry_qnames(graph).items():
+            if qname.rsplit(".", 1)[-1] == _SANCTIONED:
+                continue
+            reach[qname] = (label, ())
+            frontier.append(qname)
+        while frontier:
+            nxt = []
+            for qname in frontier:
+                label, hops = reach[qname]
+                fn = graph.functions[qname]
+                callees = [(site.callee, site.node.lineno)
+                           for site in graph.calls.get(qname, ())]
+                # a thread target spawned by a deterministic surface
+                # inherits the contract (replay_trace's worker pool)
+                for node in graph._local_nodes(fn.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    for kw in node.keywords:
+                        if kw.arg != "target":
+                            continue
+                        tgt = graph.resolve_ref(kw.value, fn)
+                        if tgt is not None:
+                            callees.append((tgt, node.lineno))
+                for callee, lineno in callees:
+                    cq = callee.qname
+                    if cq in reach \
+                            or cq.rsplit(".", 1)[-1] == _SANCTIONED:
+                        continue
+                    hop = (callee.node.name, fn.src.path, lineno)
+                    reach[cq] = (label, hops + (hop,))
+                    nxt.append(cq)
+            frontier = nxt
+        self._reach = reach
+        return reach
+
+    # ------------------------------------------------------------- checks
+    def check_file(self, src: SourceFile):
+        if not self._surfaces:
+            return
+        graph = self.project.callgraph()
+        reach = self._reachable()
+        for fn_node in src.nodes():
+            if not isinstance(fn_node, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                continue
+            info = graph.function_at(fn_node)
+            if info is None or info.qname not in reach:
+                continue
+            label, hops = reach[info.qname]
+            for source in self._sources(graph, info):
+                yield self._report(src, source, label, hops)
+
+    def _canon(self, name, fn, graph):
+        """Rewrite the head of a dotted call name through the import
+        tables (``np.random.rand`` -> ``numpy.random.rand``,
+        ``pyrandom.Random`` -> ``random.Random``, bare ``uuid4`` ->
+        ``uuid.uuid4``) so source matching is alias-proof."""
+        if not name:
+            return name
+        head, _, rest = name.partition(".")
+        scope = fn
+        while scope is not None:
+            tab = graph.fn_imports.get(scope.qname)
+            if tab and head in tab:
+                mod, orig = tab[head]
+                base = f"{mod}.{orig}" if orig else mod
+                return f"{base}.{rest}" if rest else base
+            scope = scope.parent
+        tab = graph.imports.get(fn.module, {})
+        if head in tab:
+            mod, orig = tab[head]
+            base = f"{mod}.{orig}" if orig else mod
+            return f"{base}.{rest}" if rest else base
+        return name
+
+    def _sources(self, graph, info):
+        """Ambient entropy sources in one function's own body."""
+        fn = info.node
+        for node in graph._local_nodes(fn):
+            if isinstance(node, ast.For) and _is_set_expr(node.iter):
+                yield _Source(
+                    node, "set iteration",
+                    "iteration order of an unordered set varies "
+                    "across processes; iterate sorted(...) instead")
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            raw = dotted_name(node.func)
+            name = self._canon(raw, info, graph)
+            term = name.rsplit(".", 1)[-1]
+            if name in ("list", "tuple") and node.args \
+                    and _is_set_expr(node.args[0]):
+                yield _Source(
+                    node, f"{name}(set(...))",
+                    "materializes an unordered set in hash order; "
+                    "use sorted(...)")
+            elif name.startswith("random.") and term in _PY_DRAWS:
+                yield _Source(
+                    node, f"{raw}()",
+                    "a module-level draw from the process-global "
+                    "python RNG — any thread or library advances it")
+            elif name.startswith("numpy.random.") and term in _NP_DRAWS:
+                yield _Source(
+                    node, f"{raw}()",
+                    "a module-level draw from the global NumPy RNG — "
+                    "construct np.random.RandomState(seed) instead")
+            elif name in _UNSEEDED_CTORS or name == "random.SystemRandom":
+                clocked = self._clock_seeded(node, info, graph)
+                if clocked:
+                    yield _Source(
+                        node, f"{raw}({clocked})",
+                        "a wall-clock seed differs on every run")
+                elif name == "random.SystemRandom" \
+                        or (not node.args and not node.keywords):
+                    yield _Source(
+                        node, f"{raw}()",
+                        "an unseeded RNG draws OS entropy at "
+                        "construction; seed it from the surface's "
+                        "config, or mark deliberate jitter via "
+                        "base.entropy_rng()")
+            elif term == "seed" and "." in name:
+                clocked = self._clock_seeded(node, info, graph)
+                if clocked:
+                    yield _Source(
+                        node, f"{raw}({clocked})",
+                        "a wall-clock seed differs on every run")
+            elif name in ("uuid.uuid4", "uuid.uuid1"):
+                yield _Source(node, f"{raw}()",
+                              "a fresh UUID on every run")
+            elif name == "os.urandom" or name.startswith("secrets."):
+                yield _Source(node, f"{raw}()", "raw OS entropy")
+            elif name == "hash" and len(node.args) == 1 \
+                    and self._stringish(node.args[0]):
+                yield _Source(
+                    node, "hash(<str>)",
+                    "builtin str hashing is salted per process "
+                    "(PYTHONHASHSEED); use hashlib for a stable "
+                    "digest")
+
+    def _clock_seeded(self, call, info, graph):
+        """The wall-clock call inside a seed argument, or None."""
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Call):
+                    raw = dotted_name(sub.func)
+                    if self._canon(raw, info, graph) in _CLOCKS:
+                        return f"{raw}()"
+        return None
+
+    @staticmethod
+    def _stringish(expr) -> bool:
+        if isinstance(expr, ast.Constant):
+            return isinstance(expr.value, str)
+        if isinstance(expr, ast.JoinedStr):
+            return True
+        return isinstance(expr, ast.Call) \
+            and dotted_name(expr.func) == "str"
+
+    def _report(self, src, source, label, hops):
+        if hops:
+            chain = " -> ".join(f"{name} ({path}:{line})"
+                                for name, path, line in hops)
+            where = f"reachable from deterministic surface {label} " \
+                    f"via {chain}"
+        else:
+            where = f"in deterministic surface {label}"
+        return self.issue(
+            src, source.node,
+            f"ambient entropy {source.kind} {where}: {source.detail} "
+            f"— a declared-deterministic output must not depend on it "
+            f"(registry: mxnet_tpu/base.py declare_deterministic)")

@@ -1,0 +1,419 @@
+"""thread-lifecycle: every framework thread must be stoppable and
+stopped.
+
+The reference MXNet's ThreadedEngine made leaked worker threads an
+endemic bug class (PAPER.md): a thread that outlives its owner keeps
+the process alive, keeps touching freed state, and turns every test
+teardown into a race.  This pass is the static half of the
+``MXNET_ENGINE_SANITIZE=1`` thread sanitizer
+(``engine.make_thread`` / ``engine.check_thread_leaks``):
+
+- every ``threading.Thread`` / ``threading.Timer`` /
+  ``ThreadPoolExecutor`` / ``engine.make_thread`` construction must be
+  **daemonized** (``daemon=True`` literal; ``make_thread`` defaults to
+  daemon) or **joined-with-timeout on a stop path**: a ``.join(...)``
+  (executor: ``.shutdown(...)``, timer: ``.cancel()``) on the stored
+  handle, reachable over the PR-4 call graph from the owner's
+  ``stop()``/``close()``/``shutdown()``/``__exit__``/``reset()``
+  methods (or inline in the constructing function for a local handle);
+- an **untimed** ``.join()`` on a stop path is its own finding — a
+  wedged worker turns stop() into the hang it exists to prevent
+  (``join(timeout)`` + leak-check is the contract);
+- **orphan-loop shape**: a thread whose ``target=`` is a bound method
+  running ``while True`` must observe, inside the loop, at least one
+  attribute its owner's stop path writes (``self._stopping = True``,
+  ``self._stop_evt.set()``, a ``self._q.put(None)`` sentinel) —
+  otherwise no stop() can ever terminate it, daemon or not.
+
+Non-literal ``daemon=`` values, module-level constructions, and
+threads stored on foreign objects stay quiet (stay-quiet direction:
+this pass only fires where it can actually prove the lifecycle
+shape).  Deliberate fire-and-forget threads (``run_with_deadline``'s
+abandoned watchdog) carry a suppression stating the contract and call
+``engine.forget_thread`` at runtime.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import LintPass, SourceFile, dotted_name, register_pass
+
+_THREAD_CTORS = {"threading.Thread": "thread",
+                 "threading.Timer": "timer",
+                 "concurrent.futures.ThreadPoolExecutor": "executor",
+                 "concurrent.futures.thread.ThreadPoolExecutor":
+                     "executor"}
+
+_JOIN_EVIDENCE = {"thread": ("join",),
+                  "timer": ("cancel", "join"),
+                  "executor": ("shutdown",)}
+
+#: method names that begin an owner's stop path
+_STOP_NAMES = {"stop", "close", "shutdown", "join", "reset", "cancel",
+               "terminate", "__exit__", "__del__"}
+
+
+def _is_stop_method(name: str) -> bool:
+    return name in _STOP_NAMES or name.startswith("stop") \
+        or name.startswith("_stop")
+
+
+def _ctor_kind(name: str):
+    """thread/timer/executor/make_thread kind for a canonicalized call
+    name, else None."""
+    if name in _THREAD_CTORS:
+        return _THREAD_CTORS[name]
+    term = name.rsplit(".", 1)[-1]
+    if term == "make_thread":
+        return "make_thread"
+    return None
+
+
+def _daemon_literal(call):
+    """True/False for a literal ``daemon=`` keyword, ``None`` when
+    absent, ``"dynamic"`` when non-literal."""
+    for kw in call.keywords:
+        if kw.arg == "daemon":
+            if isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, bool):
+                return kw.value.value
+            return "dynamic"
+    return None
+
+
+def _while_true_loops(fn_node):
+    """``while True:`` / ``while 1:`` loops in a function's own body."""
+    for node in _local_nodes(fn_node):
+        if isinstance(node, ast.While) \
+                and isinstance(node.test, ast.Constant) \
+                and bool(node.test.value):
+            yield node
+
+
+def _local_nodes(fn_node):
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _self_attr_reads(root):
+    """Attribute names read off ``self`` anywhere under ``root``
+    (covers ``self._stop_evt.is_set()`` — the inner ``self._stop_evt``
+    is a Load)."""
+    out = set()
+    for node in ast.walk(root):
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self" \
+                and isinstance(node.ctx, ast.Load):
+            out.add(node.attr)
+    return out
+
+
+def _stop_writes(cls_info):
+    """Attribute names the class's stop-path methods write: plain
+    assignment, ``self.X.set()``, and ``self.X.put*()`` sentinels."""
+    out = set()
+    for mname, m in cls_info.methods.items():
+        if not _is_stop_method(mname):
+            continue
+        for node in _local_nodes(m.node):
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == "self" \
+                    and isinstance(node.ctx, ast.Store):
+                out.add(node.attr)
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("set", "put", "put_nowait",
+                                           "notify", "notify_all") \
+                    and isinstance(node.func.value, ast.Attribute) \
+                    and isinstance(node.func.value.value, ast.Name) \
+                    and node.func.value.value.id == "self":
+                out.add(node.func.value.attr)
+    return out
+
+
+@register_pass
+class ThreadLifecyclePass(LintPass):
+    id = "thread-lifecycle"
+    doc = ("threading.Thread/Timer/executor constructions must be "
+           "daemonized or joined-with-timeout on a stop path "
+           "reachable from the owner's stop()/close()/__exit__, and "
+           "a bound-method thread target's while-True loop must "
+           "observe state the owner's stop path writes (orphan-loop "
+           "shape) — static twin of engine.check_thread_leaks")
+
+    def check_file(self, src: SourceFile):
+        graph = self.project.callgraph()
+        for enclosing, node, container in self._scoped_calls(src, graph):
+            if not isinstance(node, ast.Call):
+                continue
+            name = self._canon(dotted_name(node.func), enclosing, graph)
+            kind = _ctor_kind(name)
+            if kind is None:
+                continue
+            yield from self._check_ctor(src, node, kind, enclosing,
+                                        container, graph)
+
+    # ------------------------------------------------------------- scoping
+    @staticmethod
+    def _scoped_calls(src, graph):
+        """(enclosing FunctionInfo, node, enclosing statement) for every
+        node — the statement is where storage shape is read from."""
+        def walk(node, fn_info, stmt):
+            for child in ast.iter_child_nodes(node):
+                child_stmt = child if isinstance(child, ast.stmt) \
+                    else stmt
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    yield from walk(child,
+                                    graph.function_at(child) or fn_info,
+                                    None)
+                elif isinstance(child, ast.ClassDef):
+                    yield from walk(child, fn_info, None)
+                else:
+                    yield fn_info, child, child_stmt
+                    yield from walk(child, fn_info, child_stmt)
+        yield from walk(src.tree, None, None)
+
+    def _canon(self, name, fn, graph):
+        if not name:
+            return name
+        head, _, rest = name.partition(".")
+        scope = fn
+        while scope is not None:
+            tab = graph.fn_imports.get(scope.qname)
+            if tab and head in tab:
+                mod, orig = tab[head]
+                base = f"{mod}.{orig}" if orig else mod
+                return f"{base}.{rest}" if rest else base
+            scope = scope.parent
+        module = fn.module if fn is not None else None
+        if module is None:
+            for mod, tab in graph.imports.items():
+                if head in tab:
+                    module = mod
+                    break
+        tab = graph.imports.get(module, {}) if module else {}
+        if head in tab:
+            mod, orig = tab[head]
+            base = f"{mod}.{orig}" if orig else mod
+            return f"{base}.{rest}" if rest else base
+        return name
+
+    # -------------------------------------------------------------- checks
+    def _check_ctor(self, src, call, kind, enclosing, stmt, graph):
+        if enclosing is None:
+            return                      # module-level: stay quiet
+        daemon = _daemon_literal(call)
+        if kind == "make_thread" and daemon is None:
+            daemon = True               # the factory's default
+        storage = self._storage(call, stmt)
+
+        needs_join = daemon is not True and daemon != "dynamic" \
+            and kind in ("thread", "make_thread", "timer")
+        if kind == "executor":
+            needs_join = not self._in_with(src, call)
+        evidence_kind = "thread" if kind == "make_thread" else kind
+
+        if needs_join:
+            joined = None
+            quiet = False
+            if storage and storage[0] == "local":
+                joined = self._join_in(enclosing.node, storage[1],
+                                       evidence_kind)
+            elif storage and storage[0] == "attr":
+                if enclosing.cls is not None:
+                    joined = self._join_on_stop_path(
+                        graph, enclosing.cls, storage[1], evidence_kind)
+                else:
+                    quiet = True        # closure self: can't see owner
+            elif storage and storage[0] == "foreign":
+                quiet = True            # stored elsewhere: stay quiet
+            else:                       # unstored handle
+                joined = self._join_in(enclosing.node, None,
+                                       evidence_kind)
+            if quiet:
+                pass
+            elif joined is None:
+                verb = {"thread": "joined", "make_thread": "joined",
+                        "timer": "cancelled or joined",
+                        "executor": "shut down"}[kind]
+                yield self.issue(
+                    src, call,
+                    f"{'non-daemon ' if kind != 'executor' else ''}"
+                    f"{kind.replace('make_thread', 'thread')} is never "
+                    f"{verb} on any stop path "
+                    f"({self._stop_names_hint(enclosing)}) — it "
+                    f"outlives its owner; daemonize it or join it "
+                    f"with a timeout where the owner stops "
+                    f"(docs/static_analysis.md §15)")
+            elif joined == "untimed":
+                yield self.issue(
+                    src, call,
+                    f"{kind.replace('make_thread', 'thread')} is "
+                    f"joined without a timeout on its stop path — a "
+                    f"wedged worker turns stop() into the hang it "
+                    f"exists to prevent; use join(timeout) and let "
+                    f"engine.check_thread_leaks() name survivors")
+
+        if kind in ("thread", "make_thread"):
+            yield from self._check_orphan_loop(src, call, enclosing,
+                                              graph)
+
+    @staticmethod
+    def _stop_names_hint(enclosing):
+        if enclosing.cls is None:
+            return "no owning class"
+        names = sorted(n for n in enclosing.cls.methods
+                       if _is_stop_method(n))
+        return f"checked {', '.join(names)}" if names \
+            else f"{enclosing.cls.name} has no stop/close method"
+
+    # ------------------------------------------------------------- storage
+    @staticmethod
+    def _storage(call, stmt):
+        """Where the constructed handle lands: ('attr', name) for
+        ``self.X = ...`` / ``self.X.append(...)`` / a list literal
+        assigned to ``self.X``; ('local', name) for ``t = ...``;
+        ('foreign', name) for ``other.X = ...``; None when unstored."""
+        if stmt is None:
+            return None
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            tgt = stmt.targets[0]
+            if isinstance(tgt, ast.Attribute):
+                if isinstance(tgt.value, ast.Name) \
+                        and tgt.value.id == "self":
+                    return ("attr", tgt.attr)
+                return ("foreign", tgt.attr)
+            if isinstance(tgt, ast.Name):
+                return ("local", tgt.id)
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            outer = stmt.value
+            if isinstance(outer.func, ast.Attribute) \
+                    and outer.func.attr in ("append", "add") \
+                    and any(call is a or call in ast.walk(a)
+                            for a in outer.args):
+                recv = outer.func.value
+                if isinstance(recv, ast.Attribute) \
+                        and isinstance(recv.value, ast.Name) \
+                        and recv.value.id == "self":
+                    return ("attr", recv.attr)
+                if isinstance(recv, ast.Name):
+                    return ("local", recv.id)
+        return None
+
+    @staticmethod
+    def _in_with(src, call):
+        for node in src.nodes():
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    if item.context_expr is call:
+                        return True
+        return False
+
+    # ---------------------------------------------------------------- join
+    @staticmethod
+    def _join_calls(fn_node, kind):
+        verbs = _JOIN_EVIDENCE[kind]
+        for node in _local_nodes(fn_node):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in verbs:
+                yield node
+
+    @classmethod
+    def _join_in(cls, fn_node, handle, kind):
+        """'timed'/'untimed' when ``fn_node`` joins ``handle`` (any
+        receiver when ``handle`` is None), else None."""
+        found = None
+        for node in cls._join_calls(fn_node, kind):
+            recv = dotted_name(node.func.value)
+            if handle is not None and handle not in recv.split("."):
+                continue
+            if node.args or node.keywords:
+                return "timed"
+            found = "untimed"
+        return found
+
+    def _join_on_stop_path(self, graph, cls_info, attr, kind):
+        """BFS the owner's stop-path methods over the call graph for a
+        join of ``self.<attr>`` (or a loop/assign alias of it)."""
+        frontier = [m.qname for name, m in cls_info.methods.items()
+                    if _is_stop_method(name)]
+        seen = set(frontier)
+        best = None
+        while frontier:
+            nxt = []
+            for qname in frontier:
+                fn = graph.functions[qname]
+                got = self._join_of_attr(fn.node, attr, kind)
+                if got == "timed":
+                    return "timed"
+                best = best or got
+                for site in graph.calls.get(qname, ()):
+                    cq = site.callee.qname
+                    if cq not in seen:
+                        seen.add(cq)
+                        nxt.append(cq)
+            frontier = nxt
+        return best
+
+    @classmethod
+    def _join_of_attr(cls, fn_node, attr, kind):
+        aliases = {attr}
+        for node in _local_nodes(fn_node):
+            if isinstance(node, ast.For):
+                src_name = dotted_name(node.iter) if not isinstance(
+                    node.iter, ast.Call) else dotted_name(
+                    node.iter.func)
+                if attr in src_name.split(".") \
+                        and isinstance(node.target, ast.Name):
+                    aliases.add(node.target.id)
+            elif isinstance(node, ast.Assign) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and attr in dotted_name(node.value).split("."):
+                aliases.add(node.targets[0].id)
+        found = None
+        for node in cls._join_calls(fn_node, kind):
+            recv = dotted_name(node.func.value)
+            if not (set(recv.split(".")) & aliases):
+                continue
+            if node.args or node.keywords:
+                return "timed"
+            found = "untimed"
+        return found
+
+    # --------------------------------------------------------- orphan loop
+    def _check_orphan_loop(self, src, call, enclosing, graph):
+        target = None
+        for kw in call.keywords:
+            if kw.arg == "target":
+                target = graph.resolve_ref(kw.value, enclosing)
+        if target is None or target.cls is None \
+                or target.parent is not None:
+            return                      # only bound-method targets
+        writes = _stop_writes(target.cls)
+        for loop in _while_true_loops(target.node):
+            observed = _self_attr_reads(loop)
+            if observed & writes:
+                continue
+            stop_names = sorted(n for n in target.cls.methods
+                                if _is_stop_method(n))
+            hint = f"stop path ({', '.join(stop_names)})" if stop_names \
+                else f"{target.cls.name} has no stop/close method at all"
+            yield self.issue(
+                src, call,
+                f"orphan loop: thread target "
+                f"{target.cls.name}.{target.node.name} "
+                f"({target.src.path}:{loop.lineno}) runs `while True` "
+                f"without observing any attribute written by the "
+                f"owner's {hint} — no stop() can ever terminate it; "
+                f"check a stop flag/event in the loop")
