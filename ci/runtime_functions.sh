@@ -59,9 +59,17 @@ sanity_lint() {
     git diff --exit-code -- ci/mxlint_baseline.json
     # chaos specs live in tests/benches too: a typo'd MXNET_FAULTS
     # pattern there is a chaos test that tests nothing — hold them to
-    # the declared fault-site registry (the other 12 passes stay
-    # scoped to the product tree)
+    # the declared fault-site registry (most passes stay scoped to the
+    # product tree)
     python -m tools.mxlint --format json --select fault-site-soundness \
+        tests/ benchmark/
+    # tests/benches also construct meshes, shard_maps, and donating
+    # jits of their own (sharded-trainer suites, serving benches) — a
+    # bad spec or use-after-donate there wedges or corrupts the very
+    # run that was supposed to catch regressions.  Hold them to the
+    # mxshard partition passes (docs/static_analysis.md, passes 17-19)
+    python -m tools.mxlint --format json \
+        --select sharding-soundness,replication-soundness,donation-soundness \
         tests/ benchmark/
     # the fault-site tables in docs/serving.md §8 and
     # docs/training_resilience.md §2 are generated from the registry —

@@ -20,6 +20,18 @@ inserting the ICI/DCN collectives.  This package supplies:
   ``CheckpointManager`` with verified-marker + integrity-manifest
   restore fallback, and ``TrainingSupervisor`` — bounded restarts
   that resume bit-exactly (RNG + data-cursor checkpointing).
+
+Annotating for SPMD (checked statically by mxlint's mxshard passes —
+docs/static_analysis.md, passes 17-19): build meshes with *literal*
+axis names and, where possible, literal extents, so every
+``PartitionSpec`` checks against the real axis set and dim
+divisibility; treat an ``out_specs`` entry of ``P()`` as a *claim*
+that every return path reduced the value (``psum``/``pmean``/...) —
+``shard_map_unchecked`` (_jax_compat) disables the runtime replication
+check, so the static one is the only net; and donate
+(``donate_argnums``) only buffers that flow to a matching output, then
+rebind the host name in the same statement (``params = step(params)``)
+— the old buffer is dead.
 """
 from .mesh import make_mesh, mesh_axis_size
 from .placement import replica_groups, replica_mesh

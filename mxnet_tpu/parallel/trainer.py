@@ -221,8 +221,14 @@ class ShardedTrainer:
                 else:
                     synced[n] = lax.pmean(g, "dp")
             loss = lax.pmean(loss, "dp")
+            # out_specs claims aux replicated (P()): every branch must
+            # reduce, or each device keeps its own value silently
+            # (shard_map_unchecked turns the runtime check off).  pmax
+            # is dtype-preserving for the non-float stats — identity
+            # when devices already agree, deterministic otherwise.
             aux = {n: (lax.pmean(v, "dp")
-                       if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                       if jnp.issubdtype(v.dtype, jnp.floating)
+                       else lax.pmax(v, "dp"))
                    for n, v in aux.items()}
             return synced, new_res, loss, aux
 

@@ -34,7 +34,9 @@ def test_pass_catalogue_complete():
                            "recompile-churn", "fault-site-soundness",
                            "deadline-soundness", "telemetry-drift",
                            "determinism-soundness", "thread-lifecycle",
-                           "blocking-in-loop"}
+                           "blocking-in-loop", "sharding-soundness",
+                           "replication-soundness",
+                           "donation-soundness"}
 
 
 # ---------------------------------------------------------------- jit-retrace

@@ -117,6 +117,28 @@ class TestPlacement:
         with pytest.raises(MXNetError):
             replica_mesh([])
 
+    def test_replica_mesh_shape_tracks_group_size(self):
+        # a tp=4 group yields a (1, 4) device array: dp is always the
+        # degenerate leading axis, tp spans the whole group in order
+        mesh = replica_mesh(["a", "b", "c", "d"])
+        assert mesh.devices.shape == (1, 4)
+        assert list(mesh.devices[0]) == ["a", "b", "c", "d"]
+        assert mesh.shape["dp"] == 1 and mesh.shape["tp"] == 4
+
+    def test_replica_mesh_custom_axis_name(self):
+        mesh = replica_mesh(["a", "b"], axis_name="mp")
+        assert mesh.axis_names == ("dp", "mp")
+        assert mesh.shape["mp"] == 2
+        assert "tp" not in mesh.shape
+
+    def test_replica_meshes_from_groups_are_disjoint(self):
+        devs = [f"d{i}" for i in range(8)]
+        meshes = [replica_mesh(g)
+                  for g in replica_groups(4, devices=devs, tp=2)]
+        seen = [d for m in meshes for d in m.devices.ravel()]
+        assert len(seen) == len(set(seen))      # no device in two meshes
+        assert all(m.axis_names == ("dp", "tp") for m in meshes)
+
 
 # ------------------------------------------- breaker consecutive fast trip
 class TestConsecutiveTrip:

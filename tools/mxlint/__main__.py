@@ -90,6 +90,12 @@ def main(argv=None):
     ap.add_argument("--no-cache", action="store_true",
                     help="bypass the .mxlint_cache/ result cache "
                          "(reads and writes)")
+    ap.add_argument("--profile-passes", action="store_true",
+                    help="print a per-pass wall-time table to stderr "
+                         "at end of run (bypasses cache reads — a "
+                         "cached run executes no passes; lazily built "
+                         "shared engines are attributed to the first "
+                         "pass that demands them)")
     args = ap.parse_args(argv)
 
     if args.list_passes:
@@ -152,7 +158,7 @@ def main(argv=None):
     # — CI's full lint warms the subsequent --changed smoke.
     issues = None
     key = full_key = None
-    if not args.no_cache:
+    if not args.no_cache and not args.profile_passes:
         key = _cache.cache_key(files, select, report)
         issues = _cache.load(key)
         if issues is None and report is not None:
@@ -160,11 +166,23 @@ def main(argv=None):
             full = _cache.load(full_key)
             if full is not None:
                 issues = [i for i in full if i.path in report]
+    timings = {} if args.profile_passes else None
     if issues is None:
         # hand the expanded list through so the tree is walked once
-        issues = lint_paths(files, select=select, report=report)
+        issues = lint_paths(files, select=select, report=report,
+                            timings=timings)
+        if args.profile_passes and not args.no_cache:
+            # profiled runs skip cache READS (a hit executes no
+            # passes) but still warm the cache for the next run
+            key = _cache.cache_key(files, select, report)
         if key is not None:
             _cache.store(key, issues)
+    if timings is not None:
+        total = sum(timings.values())
+        print(f"mxlint: pass timings (wall, total {total:.2f}s):",
+              file=sys.stderr)
+        for pid, dt in sorted(timings.items(), key=lambda kv: -kv[1]):
+            print(f"  {pid:24s} {dt:7.3f}s", file=sys.stderr)
 
     if args.update_baseline:
         counts = save_baseline(args.baseline, issues)

@@ -21,3 +21,6 @@ from . import telemetry_drift       # noqa: F401
 from . import determinism_soundness  # noqa: F401
 from . import thread_lifecycle      # noqa: F401
 from . import blocking_in_loop      # noqa: F401
+from . import sharding_soundness    # noqa: F401
+from . import replication_soundness  # noqa: F401
+from . import donation_soundness    # noqa: F401

@@ -39,78 +39,18 @@ from ..callgraph import CallGraph, module_of
 from ..core import LintPass, dotted_name, register_pass
 from ..dataflow import (COLLECTIVES, COMM_COLLECTIVES,
                         UNIFORM_COLLECTIVES)
+from .. import mxshard
 
 # collectives whose arg 1 (or axis_name=) names the axis; axis_index
 # takes it at position 0
 _AXIS_ARG = {c: (0 if c == "axis_index" else 1) for c in COLLECTIVES}
 _CTRL = {"cond", "while_loop", "switch"}
 
-
-def _is_shard_map(call: ast.Call) -> bool:
-    return dotted_name(call.func).rsplit(".", 1)[-1] in (
-        "shard_map", "shmap")
-
-
-def _mesh_literal_axes(call: ast.Call):
-    """axis_names from a ``Mesh(devices, axis_names=("dp", ...))`` call
-    (positional arg 1 or keyword), or None."""
-    if not dotted_name(call.func).rsplit(".", 1)[-1] == "Mesh":
-        return None
-    cand = None
-    if len(call.args) >= 2:
-        cand = call.args[1]
-    for kw in call.keywords:
-        if kw.arg == "axis_names":
-            cand = kw.value
-    if isinstance(cand, (ast.Tuple, ast.List)) and cand.elts and all(
-            isinstance(e, ast.Constant) and isinstance(e.value, str)
-            for e in cand.elts):
-        return {e.value for e in cand.elts}
-    if isinstance(cand, ast.Constant) and isinstance(cand.value, str):
-        return {cand.value}
-    return None
-
-
-def _const_str(expr, fn_info):
-    """Constant-propagate a string: literal, or a Name resolvable to a
-    parameter default / simple local assignment in the lexical scope
-    chain.  None when unknown."""
-    if isinstance(expr, ast.Constant):
-        return expr.value if isinstance(expr.value, str) else None
-    if not isinstance(expr, ast.Name):
-        return None
-    scope = fn_info
-    while scope is not None:
-        node = scope.node
-        args = node.args
-        pos = list(args.posonlyargs) + list(args.args)
-        for p, d in zip(pos[len(pos) - len(args.defaults):],
-                        args.defaults):
-            if p.arg == expr.id and isinstance(d, ast.Constant) \
-                    and isinstance(d.value, str):
-                return d.value
-        for p, d in zip(args.kwonlyargs, args.kw_defaults):
-            if d is not None and p.arg == expr.id \
-                    and isinstance(d, ast.Constant) \
-                    and isinstance(d.value, str):
-                return d.value
-        all_params = pos + list(args.kwonlyargs) \
-            + [p for p in (args.vararg, args.kwarg) if p is not None]
-        if any(p.arg == expr.id for p in all_params):
-            # a parameter without a constant default is a runtime
-            # value — it shadows any outer binding, stay quiet
-            return None
-        # this scope's own statements only: a same-named local in a
-        # nested sibling def must not constant-propagate out of it
-        for stmt in CallGraph._local_nodes(node):
-            if isinstance(stmt, ast.Assign) \
-                    and isinstance(stmt.value, ast.Constant) \
-                    and isinstance(stmt.value.value, str) \
-                    and any(isinstance(t, ast.Name) and t.id == expr.id
-                            for t in stmt.targets):
-                return stmt.value.value
-        scope = scope.parent
-    return None
+# shared with the SPMD passes (ISSUE-19): shard_map_unchecked is a
+# shard_map site too — it is exactly the variant whose bodies need the
+# static checks most, since the runtime replication check is off there
+_is_shard_map = mxshard.is_shard_map
+_const_str = mxshard.const_str
 
 
 def _axis_names_of(expr, fn_info):
@@ -239,14 +179,7 @@ class CollectiveSoundnessPass(LintPass):
 
     # ------------------------------------------------------------- harvest
     def _axis_universe(self):
-        names = set()
-        for src in self.project.files:
-            for node in ast.walk(src.tree):
-                if isinstance(node, ast.Call):
-                    axes = _mesh_literal_axes(node)
-                    if axes:
-                        names |= axes
-        return names
+        return mxshard.axis_universe(self.project)
 
     def _collect_contexts(self, graph):
         """Map every function reachable from a shard_map body to the
@@ -357,164 +290,33 @@ class CollectiveSoundnessPass(LintPass):
             out = nxt
         return out
 
-    @staticmethod
-    def _body_target(call):
-        """The body expression at a shard_map site, with any
-        ``partial(body, ...)`` wrapper peeled off: returns
-        ``(target, bound_args, bound_kws)``."""
-        target = call.args[0] if call.args else None
-        for kw in call.keywords:
-            if kw.arg in ("f", "fun"):
-                target = kw.value
-        bound_args, bound_kws = (), ()
-        if isinstance(target, ast.Call) and dotted_name(
-                target.func).rsplit(".", 1)[-1] == "partial" \
-                and target.args:
-            bound_args = target.args[1:]
-            bound_kws = target.keywords
-            target = target.args[0]
-        return target, bound_args, bound_kws
-
-    @staticmethod
-    def _bound_uniform(body, bound_args, bound_kws):
-        """Params pre-bound by ``partial`` to a literal constant —
-        identical on every device (config flags), so they must not seed
-        divergence taint; the remaining params receive the shards."""
-        bound = set()
-        for i, a in enumerate(bound_args):
-            if isinstance(a, ast.Constant) and i < len(body.params):
-                bound.add(body.params[i])
-        for kw in bound_kws:
-            if kw.arg is not None and isinstance(kw.value, ast.Constant) \
-                    and kw.arg in body.params:
-                bound.add(kw.arg)
-        return frozenset(bound)
-
+    # the site/body model lives in mxshard (shared with the SPMD
+    # passes, ISSUE-19); mesh resolution there is a strict superset of
+    # the pre-split walk — it also constant-propagates axis-name
+    # variables through helper params (placement.replica_mesh)
     def _body_fn(self, call, within, graph):
-        """Resolve a shard_map site's body function; returns
-        ``(FunctionInfo, bound_uniform_params)``."""
-        target, bound_args, bound_kws = self._body_target(call)
-        if target is None:
-            return None, frozenset()
-        body = graph.resolve_ref(target, within)
-        if body is None:
-            return None, frozenset()
-        return body, self._bound_uniform(body, bound_args, bound_kws)
+        return mxshard.body_fn(call, within, graph)
 
     def _body_fn_module(self, call, module, graph):
-        """Module-scope variant: the body name resolves through the
-        module namespace instead of a lexical scope chain."""
-        target, bound_args, bound_kws = self._body_target(call)
-        if target is None:
-            return None, frozenset()
-        q = graph._lookup(dotted_name(target), module)
-        body = graph.functions.get(q) if q else None
-        if body is None:
-            return None, frozenset()
-        return body, self._bound_uniform(body, bound_args, bound_kws)
-
-    @classmethod
-    def _module_calls(cls, src):
-        """Call nodes in module-scope statements only (function and
-        class bodies are covered by the FunctionInfo walk)."""
-        for n in cls._module_stmts(src):
-            if isinstance(n, ast.Call):
-                yield n
+        return mxshard.body_fn_module(call, module, graph)
 
     @staticmethod
-    def _mesh_expr(call):
-        mesh = None
-        if len(call.args) >= 2:
-            mesh = call.args[1]
-        for kw in call.keywords:
-            if kw.arg == "mesh":
-                mesh = kw.value
-        return mesh
-
-    def _site_axes(self, call, within, graph):
-        """Mesh axes at a shard_map site, or None when unresolvable."""
-        mesh = self._mesh_expr(call)
-        if mesh is None:
-            return None
-        if isinstance(mesh, ast.Call):
-            return self._axes_of_ctor(mesh, within, graph)
-        if isinstance(mesh, ast.Name):
-            # same scope discipline as _const_str: a parameter shadows
-            # any outer binding (runtime value — fall back to the
-            # universe), and each scope's OWN statements only (a
-            # same-named local in a sibling nested def must not bind)
-            scope = within
-            while scope is not None:
-                args = scope.node.args
-                params = set(scope.params) | {
-                    p.arg for p in (args.vararg, args.kwarg)
-                    if p is not None}
-                if mesh.id in params:
-                    return None
-                for stmt in CallGraph._local_nodes(scope.node):
-                    if isinstance(stmt, ast.Assign) \
-                            and isinstance(stmt.value, ast.Call) \
-                            and any(isinstance(t, ast.Name)
-                                    and t.id == mesh.id
-                                    for t in stmt.targets):
-                        return self._axes_of_ctor(stmt.value, scope,
-                                                  graph)
-                scope = scope.parent
-        return None
-
-    def _site_axes_module(self, call, src, module, graph):
-        """Module-scope variant of _site_axes: the mesh name resolves
-        through module-level assignments only."""
-        mesh = self._mesh_expr(call)
-        if mesh is None:
-            return None
-        if isinstance(mesh, ast.Call):
-            return self._axes_of_ctor_module(mesh, module, graph)
-        if isinstance(mesh, ast.Name):
-            for stmt in self._module_stmts(src):
-                if isinstance(stmt, ast.Assign) \
-                        and isinstance(stmt.value, ast.Call) \
-                        and any(isinstance(t, ast.Name)
-                                and t.id == mesh.id
-                                for t in stmt.targets):
-                    return self._axes_of_ctor_module(stmt.value, module,
-                                                     graph)
-        return None
+    def _module_calls(src):
+        return mxshard.module_calls(src)
 
     @staticmethod
     def _module_stmts(src):
-        stack = list(ast.iter_child_nodes(src.tree))
-        while stack:
-            n = stack.pop()
-            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
-                              ast.ClassDef)):
-                continue
-            yield n
-            stack.extend(ast.iter_child_nodes(n))
+        return mxshard.module_stmts(src)
 
-    def _axes_of_ctor(self, call, within, graph):
-        axes = _mesh_literal_axes(call)
-        if axes:
-            return axes
-        maker = graph.resolve_call(call, within)
-        return self._axes_in_maker(maker)
+    def _site_axes(self, call, within, graph):
+        """Mesh axes at a shard_map site, or None when unresolvable."""
+        info = mxshard.mesh_info_at_site(call, within, graph)
+        return set(info.order) if info is not None else None
 
-    def _axes_of_ctor_module(self, call, module, graph):
-        axes = _mesh_literal_axes(call)
-        if axes:
-            return axes
-        q = graph._lookup(dotted_name(call.func), module)
-        return self._axes_in_maker(graph.functions.get(q) if q else None)
-
-    @staticmethod
-    def _axes_in_maker(maker):
-        if maker is not None:       # make_mesh-style helper
-            for node in ast.walk(maker.node):
-                if isinstance(node, ast.Call):
-                    axes = _mesh_literal_axes(node)
-                    if axes:
-                        return axes
-        return None
+    def _site_axes_module(self, call, src, module, graph):
+        info = mxshard.mesh_info_of_module(
+            mxshard.mesh_expr(call), src, module, graph)
+        return set(info.order) if info is not None else None
 
     # ------------------------------------------------------------- checks
     def _check_body(self, fn, graph, summaries, allowed, strict,
@@ -626,9 +428,7 @@ class CollectiveSoundnessPass(LintPass):
                     == "axis_index"
                     for sub in ast.walk(value))
                 for t in node.targets:
-                    for leaf in ast.walk(t):
-                        if not isinstance(leaf, ast.Name):
-                            continue
+                    for leaf in self._written_names(t):
                         if rhs_is_collective:
                             if id(node) not in nested:
                                 washes[leaf.id] = node.lineno
@@ -643,6 +443,23 @@ class CollectiveSoundnessPass(LintPass):
             out[n] = float("inf") if w is None or last_taint[n] > w \
                 else w
         return out
+
+    @classmethod
+    def _written_names(cls, target):
+        """Names an assignment target WRITES: the base of a subscript
+        store (``synced[n] = m`` writes ``synced``) — never the index
+        (``n`` is read, and tainting it made every ``if n in ...:``
+        look per-device, a false positive surfaced when
+        shard_map_unchecked bodies joined the analysis)."""
+        if isinstance(target, ast.Name):
+            yield target
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                yield from cls._written_names(e)
+        elif isinstance(target, ast.Starred):
+            yield from cls._written_names(target.value)
+        elif isinstance(target, (ast.Subscript, ast.Attribute)):
+            yield from cls._written_names(target.value)
 
     def _check_ctrl(self, src, fn, call, term, tainted, graph, summaries):
         """lax.cond/while_loop/switch with a per-device predicate whose

@@ -1,0 +1,330 @@
+"""donation-soundness: donate_argnums that lie, and use-after-donate.
+
+Buffer donation is the difference between fitting and OOMing a
+training step at the ROADMAP's model sizes — and both of its failure
+modes are silent or late:
+
+1. **dropped donation**: XLA only reuses a donated input buffer for an
+   output with *identical* shape and dtype.  A ``donate_argnums``
+   entry whose parameter provably matches no output (under the
+   symbolic Dim lattice: every output leaf differs in rank, a concrete
+   dim, or dtype) is silently ignored — the memory saving the author
+   counted on never happens.  Only *provable* mismatches flag: any
+   unknown rank/dim/dtype stays quiet.
+2. **out-of-range donation**: an index past the jitted callable's
+   positional parameters (or a ``donate_argnames`` name it doesn't
+   have) raises at trace time — flagged here so it fails in lint, not
+   in the first training run.
+3. **use-after-donate**: reading the *host-side binding* that was
+   passed in a donated position after the jit call runs — the buffer
+   is deleted, and jax raises ``buffer has been deleted or donated``
+   at the read.  Checked per function with straight-line line
+   discipline: a read strictly after the application with no
+   intervening rebind of the same name/attribute flags; rebinds
+   (including the application's own ``x = step(x, ...)``) wash.
+
+The jitted body resolves through the PR-4 call graph; output shapes
+come from one interpretation of the body with the PR-5 shape engine
+(:mod:`..shapes`).
+"""
+from __future__ import annotations
+
+import ast
+
+from ..callgraph import CallGraph, module_of
+from ..core import LintPass, dotted_name, register_pass
+from ..shapes import (Arr, TupleV, _Ctx, _Interp, _seed_env, rules,
+                      _as_arr)
+
+
+def _donation(call, require_jit=True):
+    """``(argnums, argnames)`` literals of a jit call, or None when the
+    call donates nothing / donates through a non-literal."""
+    if require_jit \
+            and dotted_name(call.func).rsplit(".", 1)[-1] != "jit":
+        return None
+    nums, names = [], []
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            nums = _int_tuple(kw.value)
+            if nums is None:
+                return None
+        elif kw.arg == "donate_argnames":
+            names = _str_tuple(kw.value)
+            if names is None:
+                return None
+    if not nums and not names:
+        return None
+    return nums, names
+
+
+def _int_tuple(expr):
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, int) \
+            and not isinstance(expr.value, bool):
+        return [expr.value]
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        out = []
+        for e in expr.elts:
+            if not (isinstance(e, ast.Constant)
+                    and isinstance(e.value, int)
+                    and not isinstance(e.value, bool)):
+                return None
+            out.append(e.value)
+        return out
+    return None
+
+
+def _str_tuple(expr):
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return [expr.value]
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        out = []
+        for e in expr.elts:
+            if not (isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)):
+                return None
+            out.append(e.value)
+        return out
+    return None
+
+
+@register_pass
+class DonationSoundnessPass(LintPass):
+    id = "donation-soundness"
+    doc = ("donate_argnums/donate_argnames whose donated parameter "
+           "provably matches no output shape/dtype (the donation is "
+           "silently dropped) or is out of range, and host-side reads "
+           "of a donated binding after the jit call (runtime "
+           "'deleted or donated buffer' error)")
+
+    def check_file(self, src):
+        return ()
+
+    def finalize(self):
+        graph = self.project.callgraph()
+        for fn in graph.functions.values():
+            for call in self._local_calls(fn):
+                d = _donation(call)
+                if d is None:
+                    continue
+                nums, names = d
+                body = None
+                if call.args:
+                    body = graph.resolve_ref(call.args[0], fn)
+                yield from self._check_signature(fn.src, call, body,
+                                                 nums, names)
+                yield from self._check_outputs(fn.src, call, body,
+                                               nums, names)
+                yield from self._check_use_after(fn, call, nums, names)
+            # decorator form: @partial(jax.jit, donate_argnums=...) /
+            # @jax.jit — the decorated function IS the body
+            yield from self._check_decorated(fn)
+
+    def _check_decorated(self, body):
+        for dec in body.node.decorator_list:
+            if not isinstance(dec, ast.Call):
+                continue
+            term = dotted_name(dec.func).rsplit(".", 1)[-1]
+            if term == "partial":
+                inner = dec.args[0] if dec.args else None
+                if inner is None or dotted_name(inner).rsplit(
+                        ".", 1)[-1] != "jit":
+                    continue
+                d = _donation(dec, require_jit=False)
+            elif term == "jit":
+                d = _donation(dec)
+            else:
+                continue
+            if d is None:
+                continue
+            nums, names = d
+            yield from self._check_signature(body.src, dec, body,
+                                             nums, names)
+            yield from self._check_outputs(body.src, dec, body, nums,
+                                           names)
+
+    # -------------------------------------------------- (a) signature
+    def _check_signature(self, src, call, body, nums, names):
+        if body is None:
+            return
+        a = body.node.args
+        if a.vararg is not None:
+            return      # *args absorbs any index
+        offset = 1 if body.is_method else 0
+        n_pos = body.n_positional - offset
+        for idx in nums:
+            if idx >= n_pos or idx < 0:
+                yield self.issue(
+                    src, call,
+                    f"donate_argnums includes {idx} but "
+                    f"{body.node.name} takes only {n_pos} positional "
+                    f"parameter(s) — jax rejects the donation at "
+                    f"trace time")
+        if a.kwarg is None:
+            params = set(body.params[offset:])
+            for nm in names:
+                if nm not in params:
+                    yield self.issue(
+                        src, call,
+                        f"donate_argnames includes {nm!r} but "
+                        f"{body.node.name} has no such parameter — "
+                        f"jax rejects the donation at trace time")
+
+    # ---------------------------------------------- (b) dropped donation
+    def _check_outputs(self, src, call, body, nums, names):
+        """Flag a donated param whose inferred shape/dtype PROVABLY
+        matches no output leaf — XLA then drops the donation
+        silently.  Any unknown leaf or rank keeps us quiet."""
+        if body is None or body.node.args.vararg is not None:
+            return
+        offset = 1 if body.is_method else 0
+        params = body.params[offset:]
+        targets = []
+        for idx in nums:
+            if 0 <= idx < len(params):
+                targets.append(params[idx])
+        targets += [nm for nm in names if nm in params]
+        if not targets:
+            return
+        R = rules()
+        ctx = _Ctx(self.project, body.src)
+        interp = _Interp(ctx, body)
+        interp.mute = True
+        env = _seed_env(ctx, body)
+        try:
+            ret = interp.run(env)
+        except RecursionError:
+            return
+        leaves = self._flatten(ret)
+        if leaves is None:
+            return      # an output leaf is opaque: could match anything
+        for name in targets:
+            arr = _as_arr(env.get(name))
+            if arr is None or arr.shape is None:
+                continue        # param shape unknown: undecidable
+            if all(self._provably_differs(R, arr, leaf)
+                   for leaf in leaves):
+                yield self.issue(
+                    src, call,
+                    f"donated parameter {name!r} (shape "
+                    f"{R.fmt_shape(arr.shape)}) matches no output of "
+                    f"{body.node.name} — XLA only reuses a donated "
+                    f"buffer for an output with identical shape and "
+                    f"dtype, so the donation is silently dropped; "
+                    f"remove it or return a matching array")
+
+    @staticmethod
+    def _flatten(value):
+        """Output leaves as Arr values; None when any leaf is opaque
+        (TOP/dict/unknown) — a provable-mismatch claim then can't be
+        made."""
+        if isinstance(value, TupleV):
+            out = []
+            for item in value.items:
+                sub = DonationSoundnessPass._flatten(item)
+                if sub is None:
+                    return None
+                out.extend(sub)
+            return out
+        arr = _as_arr(value)
+        if arr is not None:
+            return [arr]
+        return None
+
+    @staticmethod
+    def _provably_differs(R, a, b):
+        """True only when ``a`` can NEVER alias ``b``: both ranks
+        known and different, a concrete dim pair provably unequal, or
+        both dtypes known and different."""
+        if a.shape is None or b.shape is None:
+            return False
+        if len(a.shape) != len(b.shape):
+            return True
+        for da, db in zip(a.shape, b.shape):
+            if da is None or db is None:
+                continue
+            if R.dim_eq(da, db) is False:
+                return True
+        if a.dtype is not None and b.dtype is not None \
+                and a.dtype != b.dtype:
+            return True
+        return False
+
+    # ------------------------------------------------ (c) use-after-donate
+    def _check_use_after(self, fn, call, nums, names):
+        """Reads of a binding after it was passed in a donated position
+        of the jitted callable, with no intervening rebind."""
+        binding = None
+        for stmt in CallGraph._local_nodes(fn.node):
+            if isinstance(stmt, ast.Assign) and stmt.value is call \
+                    and len(stmt.targets) == 1:
+                t = stmt.targets[0]
+                if isinstance(t, (ast.Name, ast.Attribute)):
+                    binding = dotted_name(t)
+        if not binding:
+            return
+        apps = [c for c in self._local_calls(fn)
+                if dotted_name(c.func) == binding]
+        if not apps:
+            return
+        reads, stores = self._name_uses(fn)
+        for app in apps:
+            donated = [app.args[i] for i in nums if i < len(app.args)]
+            donated += [kw.value for kw in app.keywords
+                        if kw.arg in names]
+            end = getattr(app, "end_lineno", app.lineno)
+            for arg in donated:
+                if not isinstance(arg, (ast.Name, ast.Attribute)):
+                    continue
+                key = dotted_name(arg)
+                if not key or ("." in key
+                               and not key.startswith("self.")):
+                    # foo.bar where foo isn't self: the attribute may
+                    # be rebound through another alias — stay quiet
+                    continue
+                offender = None
+                for r in reads.get(key, ()):
+                    if r.lineno <= end or r is arg:
+                        continue
+                    if any(end <= s <= r.lineno
+                           for s in stores.get(key, ())):
+                        continue    # rebound in between (the app's own
+                        # `x = step(x)` target counts)
+                    if offender is None or r.lineno < offender.lineno:
+                        offender = r
+                if offender is not None:
+                    yield self.issue(
+                        fn.src, offender,
+                        f"{key!r} is read after being donated to "
+                        f"{binding!r} (applied at line {app.lineno}) — "
+                        f"donation deletes the buffer, so this read "
+                        f"raises jax's 'deleted or donated buffer' "
+                        f"error at runtime; copy the value first or "
+                        f"rebind it from the jit output")
+
+    @staticmethod
+    def _name_uses(fn):
+        """Load sites and store lines per dotted name (plain names and
+        self.attr chains) in the function's own statements."""
+        reads, stores = {}, {}
+        for node in CallGraph._local_nodes(fn.node):
+            if isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Store):
+                    stores.setdefault(node.id, []).append(node.lineno)
+                elif isinstance(node.ctx, ast.Load):
+                    reads.setdefault(node.id, []).append(node)
+            elif isinstance(node, ast.Attribute):
+                key = dotted_name(node)
+                if not key:
+                    continue
+                if isinstance(node.ctx, ast.Store):
+                    stores.setdefault(key, []).append(node.lineno)
+                elif isinstance(node.ctx, ast.Load):
+                    reads.setdefault(key, []).append(node)
+        return reads, stores
+
+    @staticmethod
+    def _local_calls(fn):
+        for node in CallGraph._local_nodes(fn.node):
+            if isinstance(node, ast.Call):
+                yield node

@@ -191,6 +191,10 @@ class _Ctx:
         self.findings: List[ShapeFinding] = []
         self._sym_counts: Dict[str, int] = {}
         self._seen = set()          # dedup (line, col, kind, message)
+        # observer fired at every Call before evaluation — lets the
+        # SPMD passes harvest argument abstract values at a site
+        # (observe_calls) without forking the interpreter
+        self.on_call = None
 
     def fresh_sym(self, name):
         R = rules()
@@ -762,6 +766,8 @@ class _Interp:
 
     # ------------------------------------------------------------- calls
     def _call(self, call, env):
+        if self.ctx.on_call is not None:
+            self.ctx.on_call(call, env, self)
         R = rules()
         func = call.func
         name = dotted_name(func)
@@ -1438,6 +1444,39 @@ def _seed_env(ctx, info: FunctionInfo) -> Dict[str, object]:
     if a.kwarg:
         env[a.kwarg.arg] = TOP
     return env
+
+
+def observe_calls(project: Project, src: SourceFile,
+                  info: FunctionInfo) -> Dict[int, list]:
+    """One *muted* interpretation of ``info`` that records, for every
+    Call node reached, the abstract values of its positional arguments:
+    ``{id(call_node): [av, ...]}``.  The SPMD sharding pass uses this
+    to learn the rank/dims of arrays flowing into ``shard_map``
+    applications and ``with_sharding_constraint`` without re-deriving
+    the interpreter."""
+    ctx = _Ctx(project, src)
+    out: Dict[int, list] = {}
+    busy = set()        # re-entrancy guard: the hook itself evaluates
+
+    def hook(call, env, interp):
+        if id(call) in busy:
+            return
+        busy.add(id(call))
+        try:
+            # shallow env copy: the probe must not pollute the frame
+            out[id(call)] = [interp._eval(a, dict(env))
+                             for a in call.args]
+        finally:
+            busy.discard(id(call))
+
+    ctx.on_call = hook
+    interp = _Interp(ctx, info)
+    interp.mute = True
+    try:
+        interp.run(_seed_env(ctx, info))
+    except RecursionError:      # pathological nesting: no observations
+        return {}
+    return out
 
 
 def file_findings(project: Project, src: SourceFile) -> List[ShapeFinding]:
