@@ -71,10 +71,22 @@ sanity_lint() {
     python -m tools.mxlint --format json \
         --select sharding-soundness,replication-soundness,donation-soundness \
         tests/ benchmark/
+    # the race trio (thread-role x lockset, docs/static_analysis.md
+    # ISSUE-20) runs over tests/benches too: suites and benches spawn
+    # their own worker/client threads against the serving objects, and
+    # an unlocked compound write there is the same lost update the
+    # product tree is held to
+    python -m tools.mxlint --format json \
+        --select shared-state-race,atomicity,condition-discipline \
+        tests/ benchmark/
     # the fault-site tables in docs/serving.md §8 and
     # docs/training_resilience.md §2 are generated from the registry —
     # stale tables fail the job (same discipline as env_vars.md)
     python tools/gen_fault_docs.py --check
+    # the pass-scope table in docs/static_analysis.md is generated from
+    # tools/mxlint/scopes.py — the single source the passes themselves
+    # import, so the docs cannot drift from the predicates
+    python tools/gen_lint_docs.py --check
     # then the dynamic half: engine+serving tests double as race tests
     # under the concurrency sanitizer (lock-order recording + tracked-
     # array assertions + the thread registry: every test asserts
@@ -82,10 +94,13 @@ sanity_lint() {
     MXNET_ENGINE_SANITIZE=1 python -m pytest tests/test_sanitizer.py \
         tests/test_serving.py tests/test_ndarray.py -x -q
     # the thread-heaviest suites (replay client pools, autoscaler +
-    # heartbeat loops) exercise the leak check hardest — the runtime
-    # twin of the thread-lifecycle lint pass
+    # heartbeat loops, replica failover) exercise the leak check and
+    # the Eraser-style lockset race detector (engine.watch_races —
+    # auto-armed on the serving classes) hardest — the runtime twins
+    # of the thread-lifecycle and shared-state-race lint passes
     MXNET_ENGINE_SANITIZE=1 python -m pytest tests/test_traffic.py \
-        tests/test_autoscale_admission.py -x -q
+        tests/test_autoscale_admission.py tests/test_serving_replica.py \
+        -x -q
 }
 
 multichip_dryrun() {

@@ -48,7 +48,7 @@ from . import runtime_metrics as _rm
 __all__ = ["Engine", "engine", "waitall", "is_naive", "set_bulk_size",
            "bulk", "Var", "sync_outputs", "make_lock", "make_condition",
            "make_thread", "check_thread_leaks", "forget_thread",
-           "thread_registry", "sanitizer_active"]
+           "thread_registry", "sanitizer_active", "watch_races"]
 
 # ---------------------------------------------------------------------------
 # Concurrency sanitizer (MXNET_ENGINE_SANITIZE=1)
@@ -211,9 +211,15 @@ class _SanCondition:
             _LOCK_ORDERS.push(self.name)
 
     def notify(self, n=1):
+        # mxlint: disable=condition-discipline (contract: pure
+        # delegation — the caller entered `with cond:` on THIS wrapper,
+        # which acquired the wrapped lock; notifying unlocked raises
+        # RuntimeError in the wrapped Condition itself)
         self._cond.notify(n)
 
     def notify_all(self):
+        # mxlint: disable=condition-discipline (contract: pure
+        # delegation, see notify())
         self._cond.notify_all()
 
 
@@ -381,6 +387,117 @@ def thread_registry():
     """Live registered-thread rows (owner, site, daemon, age) for
     tools/diagnose.py; empty when the sanitizer is off."""
     return _THREADS.rows()
+
+
+# ---------------------------------------------------------------------------
+# Eraser-style lockset race sanitizer (the runtime twin of mxlint's
+# shared-state-race / atomicity passes, docs/static_analysis.md §20-21)
+# ---------------------------------------------------------------------------
+
+# classes whose __setattr__ has been wrapped by watch_races (wrap once
+# per class; per-instance tracking state lives in the instance dict).
+# _RACE_MU serializes the wrap: two threads constructing the first two
+# instances of one class concurrently must not double-wrap __setattr__
+_RACE_MU = threading.Lock()
+_RACE_WATCHED_CLASSES = set()
+
+
+def _race_stack(frame, limit=4):
+    import traceback
+    return "".join(traceback.format_stack(frame, limit=limit)).rstrip()
+
+
+def _note_race_write(obj, fields, name):
+    """The Eraser lockset state machine, write-only: the first writer
+    owns the field (exclusive); the moment a SECOND thread writes, the
+    field's candidate lockset becomes the intersection of the two
+    writers' held locks, and every later write intersects again.  An
+    empty intersection is the proof: two threads wrote this field with
+    no lock in common, so an interleaving that tears a read-modify-
+    write exists — raise naming both writes instead of silently losing
+    an update on some future schedule."""
+    import sys
+    me = threading.current_thread().name
+    locks = frozenset(_LOCK_ORDERS._stack())
+    frame = sys._getframe(2)            # the assignment site
+    st = fields.get(name)
+    if st is None:                      # first write: exclusive owner
+        fields[name] = {
+            "thread": me, "locks": locks, "shared": False,
+            "stack": _race_stack(frame)}
+        return
+    if not st["shared"] and st["thread"] == me:
+        # still exclusive: refresh to the freshest write so the
+        # eventual second-thread intersection uses real evidence
+        st["locks"] = locks
+        st["stack"] = _race_stack(frame)
+        return
+    candidate = st["locks"] & locks
+    if candidate:
+        st.update(shared=True, thread=me, locks=candidate,
+                  stack=_race_stack(frame))
+        return
+    prev_thread, prev_stack = st["thread"], st["stack"]
+    prev_locks = sorted(st["locks"]) or ["<none>"]
+    # re-arm before raising so a caught error does not cascade into a
+    # storm of reports for every later write to the same field
+    fields[name] = {"thread": me, "locks": locks, "shared": False,
+                    "stack": _race_stack(frame)}
+    raise MXNetError(
+        f"MXNET_ENGINE_SANITIZE: data race on "
+        f"{type(obj).__name__}.{name} — no common lock across "
+        f"writers.\n"
+        f"  thread {me!r} writes holding "
+        f"{sorted(locks) or ['<none>']}:\n{_race_stack(frame)}\n"
+        f"  thread {prev_thread!r} wrote holding {prev_locks}:\n"
+        f"{prev_stack}\n"
+        f"Guard both writes with one engine.make_lock lock or confine "
+        f"the field to a single thread.  Static twin: mxlint "
+        f"shared-state-race (docs/static_analysis.md)")
+
+
+def _install_race_hook(cls):
+    with _RACE_MU:
+        if cls in _RACE_WATCHED_CLASSES:
+            return
+        orig = cls.__setattr__
+
+        def __setattr__(self, name, value, _orig=orig):
+            fields = self.__dict__.get("_mx_race_fields_")
+            if fields is not None \
+                    and name not in self.__dict__["_mx_race_exempt_"]:
+                _note_race_write(self, fields, name)
+            _orig(self, name, value)
+
+        cls.__setattr__ = __setattr__
+        _RACE_WATCHED_CLASSES.add(cls)
+
+
+def watch_races(obj, exempt=()):
+    """Arm Eraser-style per-field lockset tracking on ``obj`` (no-op
+    unless ``MXNET_ENGINE_SANITIZE=1``): every attribute write records
+    the writing thread and the locks held (by ``make_lock`` name, via
+    the same per-thread stack the lock-order sanitizer keeps); once two
+    threads have written a field, the field's candidate lockset is the
+    running intersection of the writers' locksets, and an empty
+    intersection raises ``MXNetError`` naming the field, both threads,
+    and both write stacks.  Call at the END of ``__init__`` —
+    construction is single-threaded by contract and stays untracked.
+
+    ``exempt`` names fields deliberately handed between threads by
+    some other protocol (e.g. a field only ever plain-assigned once,
+    published via the GIL's store atomicity).
+
+    The thread-shared serving classes (ModelServer, DecodeEngine,
+    ReplicaSet, Autoscaler, PageAllocator) arm themselves; use this
+    directly when testing new multi-threaded state."""
+    if not _SANITIZE:
+        return obj
+    _install_race_hook(type(obj))
+    # plain dict stores (not setattr) so arming never trips the hook
+    obj.__dict__["_mx_race_exempt_"] = frozenset(exempt)
+    obj.__dict__["_mx_race_fields_"] = {}
+    return obj
 
 
 class Var:

@@ -52,6 +52,9 @@ class MXRecordIO:
         self._is_open = True
 
     def close(self):
+        # mxlint: disable=atomicity (contract: a reader/writer is
+        # owned by one thread; close() only races itself when that
+        # ownership contract is already broken)
         if self._is_open:
             self._f.close()
             self._is_open = False

@@ -125,6 +125,9 @@ class _Metric:
         if not self.labelnames or key in store \
                 or len(store) < self.max_label_sets:
             return key
+        # mxlint: disable=atomicity (contract: callers hold self._lock,
+        # per this method's docstring — the flag check-then-set is
+        # already serialized; and the worst case is one extra warning)
         if not self._cardinality_warned:
             # mxlint: disable=lock-discipline (contract: callers hold
             # self._lock — every call site is inside `with self._lock`)

@@ -294,7 +294,9 @@ class Autoscaler:
         self.source = source
         self.admission = admission
         self.clock = clock
-        self._lock = threading.Lock()
+        # engine.make_lock (not a bare threading.Lock) so the sanitizer
+        # sees it in lock-order and lockset tracking
+        self._lock = _engine.make_lock("serving.Autoscaler._lock")
         self._breach_streak = 0
         self._idle_streak = 0
         self._last_up = None            # clock stamps of last actuation
@@ -310,6 +312,7 @@ class Autoscaler:
         self._stop_evt = threading.Event()
         self._thread = None
         self._in_tick = False
+        _engine.watch_races(self)
 
     # ------------------------------------------------------------- sensing
     def _pressure(self, depth, ttft_s, lat_s):

@@ -330,6 +330,7 @@ class DecodeEngine:
         # deliberate jitter for retry backoff — the one sanctioned
         # ambient-entropy source (determinism-soundness exempts it)
         self._retry_rng = entropy_rng()
+        _engine.watch_races(self)
         if autostart:
             self.start()
 

@@ -46,6 +46,7 @@ from __future__ import annotations
 
 import itertools
 
+from .. import engine
 from .. import faults as _faults
 from ..base import MXNetError
 
@@ -112,9 +113,13 @@ class PageAllocator:
     """Refcounted free-list page allocator with per-sequence block
     tables.
 
-    NOT thread-safe by itself — the decode engine mutates it only from
-    its step loop (one writer); readers go through :meth:`stats`, which
-    callers take under the engine's condition.  All-or-nothing
+    Thread-safe: every mutator and :meth:`stats` holds the internal
+    ``_lock``, so a server thread releasing a cancelled sequence cannot
+    tear the free list under the decode loop's admission.  The lock
+    nests INSIDE the decode engine's condition (``_cond`` ->
+    ``PageAllocator._lock``, never the reverse), and it is
+    non-reentrant — nested work goes through ``_locked``-suffixed
+    helpers.  All-or-nothing
     semantics: an allocation that cannot be fully satisfied changes
     nothing and returns False, so a half-admitted sequence can never
     strand pages.
@@ -138,6 +143,11 @@ class PageAllocator:
         self._refs = {}                 # page -> reference count (>= 1)
         self._cached = {}               # page -> PrefixCache-held refs
         self.peak_used = 0
+        # guards every mutator (and stats()); acquired AFTER the decode
+        # engine's condition when both are held.  engine.make_lock is a
+        # plain non-reentrant Lock, hence the _locked helper split.
+        self._lock = engine.make_lock("serving.PageAllocator._lock")
+        engine.watch_races(self)
 
     # ------------------------------------------------------------ queries
     @property
@@ -191,24 +201,25 @@ class PageAllocator:
         # deadline path downstream is what gets proven
         if n_pages and _faults.check("kv_cache.allocate"):
             return False
-        owned = self._pages.setdefault(seq_id, [])
-        if len(owned) + n_pages > self.geometry.pages_per_seq:
-            raise MXNetError(
-                f"allocate({seq_id!r}): {len(owned)} + {n_pages} pages "
-                f"exceed the block table "
-                f"({self.geometry.pages_per_seq} slots = max_context "
-                f"{self.geometry.max_context} / page_size "
-                f"{self.geometry.page_size})")
-        if n_pages > len(self._free):
-            if not owned:
-                del self._pages[seq_id]
-            return False
-        for _ in range(n_pages):
-            page = self._free.pop()
-            owned.append(page)
-            self._refs[page] = 1
-        self.peak_used = max(self.peak_used, self.used_pages)
-        return True
+        with self._lock:
+            owned = self._pages.setdefault(seq_id, [])
+            if len(owned) + n_pages > self.geometry.pages_per_seq:
+                raise MXNetError(
+                    f"allocate({seq_id!r}): {len(owned)} + {n_pages} "
+                    f"pages exceed the block table "
+                    f"({self.geometry.pages_per_seq} slots = "
+                    f"max_context {self.geometry.max_context} / "
+                    f"page_size {self.geometry.page_size})")
+            if n_pages > len(self._free):
+                if not owned:
+                    del self._pages[seq_id]
+                return False
+            for _ in range(n_pages):
+                page = self._free.pop()
+                owned.append(page)
+                self._refs[page] = 1
+            self.peak_used = max(self.peak_used, self.used_pages)
+            return True
 
     def share(self, seq_id, pages):
         """Alias already-referenced ``pages`` into ``seq_id``'s block
@@ -216,6 +227,13 @@ class PageAllocator:
         The sequence must not re-alias a page it already references.
         Raises on an unreferenced or out-of-range page — sharing hands
         out read-only views, never resurrects a freed page."""
+        with self._lock:
+            return self._share_locked(seq_id, pages)
+
+    def _share_locked(self, seq_id, pages):
+        # mxlint: disable=lock-discipline (contract: callers hold
+        # self._lock — share() and admit() both acquire it; the lock
+        # is non-reentrant, hence this unlocked helper)
         owned = self._pages.setdefault(seq_id, [])
         if len(owned) + len(pages) > self.geometry.pages_per_seq:
             raise MXNetError(
@@ -233,6 +251,7 @@ class PageAllocator:
                     f"share({seq_id!r}): page {p} already in this "
                     f"sequence's block table")
             owned.append(p)
+            # mxlint: disable=lock-discipline (caller holds self._lock)
             self._refs[p] += 1
         return True
 
@@ -244,63 +263,74 @@ class PageAllocator:
         private part — the same refusal contract as :meth:`allocate`,
         so the scheduler's FIFO head-blocking logic needs no new case.
         """
-        if seq_id in self._pages:
-            raise MXNetError(
-                f"admit({seq_id!r}): sequence already admitted")
-        # mirror allocate()'s chaos site BEFORE any mutation so an
-        # injected exhaustion is indistinguishable from a real one
-        if fresh_pages and _faults.check("kv_cache.allocate"):
-            return False
-        if fresh_pages > len(self._free):
-            return False
-        if len(shared_pages) + fresh_pages > self.geometry.pages_per_seq:
-            raise MXNetError(
-                f"admit({seq_id!r}): {len(shared_pages)} shared + "
-                f"{fresh_pages} fresh pages exceed the block table "
-                f"({self.geometry.pages_per_seq} slots)")
-        if shared_pages:
-            self.share(seq_id, shared_pages)
-        owned = self._pages.setdefault(seq_id, [])
-        for _ in range(fresh_pages):
-            page = self._free.pop()
-            owned.append(page)
-            self._refs[page] = 1
-        self.peak_used = max(self.peak_used, self.used_pages)
-        return True
+        with self._lock:
+            if seq_id in self._pages:
+                raise MXNetError(
+                    f"admit({seq_id!r}): sequence already admitted")
+            # mirror allocate()'s chaos site BEFORE any mutation so an
+            # injected exhaustion is indistinguishable from a real one
+            # (faults.check never raises or blocks, so holding _lock
+            # across it is safe)
+            if fresh_pages and _faults.check("kv_cache.allocate"):
+                return False
+            if fresh_pages > len(self._free):
+                return False
+            if len(shared_pages) + fresh_pages \
+                    > self.geometry.pages_per_seq:
+                raise MXNetError(
+                    f"admit({seq_id!r}): {len(shared_pages)} shared + "
+                    f"{fresh_pages} fresh pages exceed the block table "
+                    f"({self.geometry.pages_per_seq} slots)")
+            if shared_pages:
+                self._share_locked(seq_id, shared_pages)
+            owned = self._pages.setdefault(seq_id, [])
+            for _ in range(fresh_pages):
+                page = self._free.pop()
+                owned.append(page)
+                self._refs[page] = 1
+            self.peak_used = max(self.peak_used, self.used_pages)
+            return True
 
     def retain_cached(self, page):
         """The prefix cache takes one reference on a live page (the
         page outlives the sequence that wrote it)."""
-        if self._refs.get(page, 0) < 1 \
-                or not 1 <= page < self.geometry.pool_pages:
-            raise MXNetError(
-                f"retain_cached: page {page} is free or out of range — "
-                f"only live pages can be cached")
-        self._refs[page] += 1
-        self._cached[page] = self._cached.get(page, 0) + 1
+        with self._lock:
+            if self._refs.get(page, 0) < 1 \
+                    or not 1 <= page < self.geometry.pool_pages:
+                raise MXNetError(
+                    f"retain_cached: page {page} is free or out of "
+                    f"range — only live pages can be cached")
+            self._refs[page] += 1
+            self._cached[page] = self._cached.get(page, 0) + 1
 
     def release_cached(self, page):
         """The prefix cache drops its reference on ``page`` (eviction);
         the page returns to the free list when nothing else holds it."""
-        if self._cached.get(page, 0) < 1:
-            raise MXNetError(
-                f"release_cached: page {page} is not cache-held — "
-                f"double eviction, or never retained")
-        self._cached[page] -= 1
-        if not self._cached[page]:
-            del self._cached[page]
-        self._decref(page, f"release_cached({page})")
+        with self._lock:
+            if self._cached.get(page, 0) < 1:
+                raise MXNetError(
+                    f"release_cached: page {page} is not cache-held — "
+                    f"double eviction, or never retained")
+            self._cached[page] -= 1
+            if not self._cached[page]:
+                del self._cached[page]
+            self._decref(page, f"release_cached({page})")
 
     def _decref(self, page, where):
+        # caller holds self._lock (non-reentrant, so no lock here):
+        # release(), release_cached() both acquire it lexically
         refs = self._refs.get(page, 0)
         if refs < 1 or not 1 <= page < self.geometry.pool_pages:
             raise MXNetError(
                 f"{where}: page {page} is already free or out of "
                 f"range — allocator state corrupted")
         if refs == 1:
+            # mxlint: disable=lock-discipline (caller holds self._lock)
             del self._refs[page]
+            # mxlint: disable=lock-discipline (caller holds self._lock)
             self._free.append(page)
         else:
+            # mxlint: disable=lock-discipline (caller holds self._lock)
             self._refs[page] = refs - 1
 
     def release(self, seq_id):
@@ -308,19 +338,20 @@ class PageAllocator:
         free list when its LAST reference drops.  Raises on an unknown
         sequence or a corrupted (double-freed / duplicated) page — the
         leak/double-free guard the scheduler tests lean on."""
-        pages = self._pages.pop(seq_id, None)
-        if pages is None:
-            raise MXNetError(
-                f"release({seq_id!r}): unknown sequence (double "
-                f"release, or never admitted)")
-        free = set(self._free)
-        for p in pages:
-            if p in free:
+        with self._lock:
+            pages = self._pages.pop(seq_id, None)
+            if pages is None:
                 raise MXNetError(
-                    f"release({seq_id!r}): page {p} is already free — "
-                    f"allocator state corrupted")
-            self._decref(p, f"release({seq_id!r})")
-        return len(pages)
+                    f"release({seq_id!r}): unknown sequence (double "
+                    f"release, or never admitted)")
+            free = set(self._free)
+            for p in pages:
+                if p in free:
+                    raise MXNetError(
+                        f"release({seq_id!r}): page {p} is already "
+                        f"free — allocator state corrupted")
+                self._decref(p, f"release({seq_id!r})")
+            return len(pages)
 
     def block_table(self, seq_id):
         """The (pages_per_seq,) int32 block table of ``seq_id`` —
@@ -366,13 +397,14 @@ class PageAllocator:
         return len(owners)
 
     def stats(self):
-        return {"used_pages": self.used_pages,
-                "free_pages": self.free_pages,
-                "peak_used_pages": self.peak_used,
-                "occupancy": self.occupancy,
-                "shared_pages": self.shared_pages,
-                "cached_pages": self.cached_pages,
-                "sequences": len(self._pages)}
+        with self._lock:        # one consistent snapshot
+            return {"used_pages": self.used_pages,
+                    "free_pages": self.free_pages,
+                    "peak_used_pages": self.peak_used,
+                    "occupancy": self.occupancy,
+                    "shared_pages": self.shared_pages,
+                    "cached_pages": self.cached_pages,
+                    "sequences": len(self._pages)}
 
 
 class _PrefixNode:

@@ -181,6 +181,7 @@ class ReplicaSet:
         self._devices = list(devices) if devices else None
         for _ in range(n):
             self._create_replica()
+        _engine.watch_races(self)
         if autostart:
             self.start()
 

@@ -128,6 +128,7 @@ class ModelServer:
                        "batches": 0, "errors": 0, "retries": 0,
                        "deadline_exceeded": 0, "bisected": 0,
                        "circuit_open_rejects": 0, "tenant_sheds": 0}
+        engine.watch_races(self)
         if autostart:
             self.start()
 

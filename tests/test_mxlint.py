@@ -36,7 +36,8 @@ def test_pass_catalogue_complete():
                            "determinism-soundness", "thread-lifecycle",
                            "blocking-in-loop", "sharding-soundness",
                            "replication-soundness",
-                           "donation-soundness"}
+                           "donation-soundness", "shared-state-race",
+                           "atomicity", "condition-discipline"}
 
 
 # ---------------------------------------------------------------- jit-retrace

@@ -549,7 +549,7 @@ def test_tests_and_benchmarks_fault_specs_clean():
 
 
 def test_pass_catalogue_is_16():
-    assert len(PASSES) == 19
+    assert len(PASSES) == 22
 
 
 def test_fault_doc_tables_fresh():

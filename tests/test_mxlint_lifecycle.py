@@ -414,7 +414,7 @@ def test_new_passes_registered():
     for pid in ("determinism-soundness", "thread-lifecycle",
                 "blocking-in-loop"):
         assert pid in PASSES
-    assert len(PASSES) == 19
+    assert len(PASSES) == 22
 
 
 # ========================================================== result cache

@@ -41,8 +41,8 @@ def ids(issues):
     return [i.pass_id for i in issues]
 
 
-def test_catalogue_has_nineteen_passes():
-    assert len(PASSES) == 19
+def test_catalogue_has_twentytwo_passes():
+    assert len(PASSES) == 22
     for pid in SPMD_PASSES:
         assert pid in PASSES
 

@@ -1,0 +1,512 @@
+"""mxthread tests: the thread-role × lockset engine and the race trio
+it powers (shared-state-race, atomicity, condition-discipline), plus
+the ISSUE-20 satellites (SARIF output round trip, scope single-source).
+
+Pure-AST + stdlib: no jax import, so the whole file costs a few
+seconds (tier-1 budget discipline — ROADMAP.md).
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.mxlint import PASSES, lint_sources        # noqa: E402
+from tools.mxlint.core import Project, SourceFile    # noqa: E402
+
+RACE_PASSES = ["shared-state-race", "atomicity", "condition-discipline"]
+
+HDR = """
+    import threading
+"""
+
+
+def run(src, select=None, path="mxnet_tpu/fixture.py", extra=None,
+        report=None):
+    sources = {path: textwrap.dedent(HDR) + textwrap.dedent(src)}
+    for p, s in (extra or {}).items():
+        sources[p] = textwrap.dedent(HDR) + textwrap.dedent(s)
+    return lint_sources(sources, select=select, report=report)
+
+
+def model_of(src, path="mxnet_tpu/fixture.py", extra=None):
+    sources = {path: textwrap.dedent(HDR) + textwrap.dedent(src)}
+    for p, s in (extra or {}).items():
+        sources[p] = textwrap.dedent(HDR) + textwrap.dedent(s)
+    proj = Project()
+    proj.harvest([SourceFile(p, s) for p, s in sources.items()])
+    return proj.threadmodel()
+
+
+def ids(issues):
+    return [i.pass_id for i in issues]
+
+
+def test_catalogue_has_the_race_trio():
+    assert len(PASSES) == 22
+    for pid in RACE_PASSES:
+        assert pid in PASSES
+
+
+# ================================================== the engine's facts
+RACY_BOX = """
+    class Box:
+        def __init__(self):
+            self.n = 0
+            self._t = threading.Thread(target=self._loop)
+            self._t.start()
+
+        def _loop(self):
+            self.n += 1
+
+        def bump(self):
+            self.n += 1
+"""
+
+
+def test_thread_root_becomes_a_role():
+    tm = model_of(RACY_BOX)
+    role_ids = set(tm.roles)
+    assert "main" in role_ids
+    assert any(r.startswith("thread:") and "_loop" in r
+               for r in role_ids)
+
+
+def test_loop_spawn_is_a_pool_role():
+    tm = model_of("""
+        class Pool:
+            def __init__(self, k):
+                self.done = 0
+                for _ in range(k):
+                    threading.Thread(target=self._work).start()
+
+            def _work(self):
+                self.done += 1
+    """)
+    pool = [r for rid, r in tm.roles.items() if "_work" in rid]
+    assert len(pool) == 1 and pool[0].multi
+
+
+def test_shared_keys_need_two_roles():
+    tm = model_of(RACY_BOX)
+    assert "Box.n" in tm.shared_keys()
+    # single-threaded twin: same writes, no thread — nothing escapes
+    tm2 = model_of("""
+        class Solo:
+            def __init__(self):
+                self.n = 0
+
+            def bump(self):
+                self.n += 1
+    """)
+    assert "Solo.n" not in tm2.shared_keys()
+
+
+def test_entry_lockset_is_inherited_from_all_callers():
+    tm = model_of("""
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+                threading.Thread(target=self._loop).start()
+
+            def _loop(self):
+                with self._lock:
+                    self._bump_locked()
+
+            def bump(self):
+                with self._lock:
+                    self._bump_locked()
+
+            def _bump_locked(self):
+                self.n += 1
+    """)
+    accs = [a for a in tm.accesses["Box.n"]
+            if a.fn.qname.endswith("_bump_locked")]
+    assert accs and all("Box._lock" in tm.locks_of(a) for a in accs)
+    # the witness names the inheritance, not just the lock
+    assert "via" in tm.lock_witness(accs[0])
+
+
+# ============================================ pass 20: shared-state-race
+def test_two_role_unlocked_compound_write_fires():
+    issues = run(RACY_BOX, select=["shared-state-race"])
+    assert ids(issues) == ["shared-state-race"]
+    msg = issues[0].message
+    # both sites, both roles, both locksets — in one finding
+    assert "Box.n" in msg and "no lock" in msg
+    assert "_loop" in msg and "main" in msg
+    assert "mxnet_tpu/fixture.py" in msg      # the partner site
+
+
+def test_shared_lock_on_both_sides_is_quiet():
+    issues = run("""
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+                threading.Thread(target=self._loop).start()
+
+            def _loop(self):
+                with self._lock:
+                    self.n += 1
+
+            def bump(self):
+                with self._lock:
+                    self.n += 1
+    """, select=["shared-state-race"])
+    assert issues == []
+
+
+def test_inherited_lock_silences_the_pair():
+    issues = run("""
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+                threading.Thread(target=self._loop).start()
+
+            def _loop(self):
+                with self._lock:
+                    self._bump_locked()
+
+            def bump(self):
+                with self._lock:
+                    self._bump_locked()
+
+            def _bump_locked(self):
+                self.n += 1
+    """, select=["shared-state-race"])
+    assert issues == []
+
+
+def test_non_compound_writes_are_gil_atomic_and_quiet():
+    issues = run("""
+        class Box:
+            def __init__(self):
+                self.flag = False
+                threading.Thread(target=self._loop).start()
+
+            def _loop(self):
+                self.flag = True
+
+            def clear(self):
+                self.flag = False
+    """, select=["shared-state-race"])
+    assert issues == []
+
+
+def test_locked_compound_write_vs_lockfree_read_is_quiet():
+    # the read is one atomic load under the GIL; the locked writer
+    # cannot tear it
+    issues = run("""
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+                threading.Thread(target=self._loop).start()
+
+            def _loop(self):
+                with self._lock:
+                    self.n += 1
+
+            def peek(self):
+                return self.n
+    """, select=["shared-state-race"])
+    assert issues == []
+
+
+def test_suppression_on_either_site_silences_the_pair():
+    issues = run("""
+        class Box:
+            def __init__(self):
+                self.n = 0
+                threading.Thread(target=self._loop).start()
+
+            def _loop(self):
+                self.n += 1  # mxlint: disable=shared-state-race (test contract)
+
+            def bump(self):
+                self.n += 1
+    """, select=["shared-state-race"])
+    assert issues == []
+
+
+# ===================================================== pass 21: atomicity
+def test_rmw_on_shared_state_fires():
+    issues = run(RACY_BOX, select=["atomicity"])
+    assert ids(issues) == ["atomicity", "atomicity"]  # both sites
+    assert "read-modify-write" in issues[0].message
+
+
+def test_rmw_under_lock_is_quiet():
+    issues = run("""
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+                threading.Thread(target=self._loop).start()
+
+            def _loop(self):
+                with self._lock:
+                    self.n += 1
+
+            def bump(self):
+                with self._lock:
+                    self.n += 1
+    """, select=["atomicity"])
+    assert issues == []
+
+
+def test_check_then_act_fires():
+    issues = run("""
+        class Box:
+            def __init__(self):
+                self._d = {}
+                threading.Thread(target=self._loop).start()
+
+            def _loop(self):
+                self._d["k"] = 1
+
+            def take(self):
+                if "k" in self._d:
+                    return self._d.pop("k")
+    """, select=["atomicity"])
+    assert ids(issues) == ["atomicity"]
+    assert "check-then-act" in issues[0].message
+
+
+def test_check_then_act_under_lock_is_quiet():
+    issues = run("""
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._d = {}
+                threading.Thread(target=self._loop).start()
+
+            def _loop(self):
+                with self._lock:
+                    self._d["k"] = 1
+
+            def take(self):
+                with self._lock:
+                    if "k" in self._d:
+                        return self._d.pop("k")
+    """, select=["atomicity"])
+    assert issues == []
+
+
+def test_single_role_state_never_flags_atomicity():
+    issues = run("""
+        class Solo:
+            def __init__(self):
+                self.n = 0
+                self._d = {}
+
+            def bump(self):
+                self.n += 1
+                if "k" in self._d:
+                    self._d.pop("k")
+    """, select=["atomicity"])
+    assert issues == []
+
+
+# ========================================= pass 22: condition-discipline
+def test_wait_under_if_fires_and_while_is_quiet():
+    issues = run("""
+        class Box:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self.ready = False
+
+            def park_if(self):
+                with self._cond:
+                    if not self.ready:
+                        self._cond.wait()
+
+            def park_while(self):
+                with self._cond:
+                    while not self.ready:
+                        self._cond.wait()
+
+            def wake(self):
+                with self._cond:
+                    self.ready = True
+                    self._cond.notify_all()
+    """, select=["condition-discipline"])
+    assert ids(issues) == ["condition-discipline"]
+    assert "while" in issues[0].message
+    assert issues[0].line < 15      # anchored at the if-guarded wait
+
+
+def test_notify_without_the_lock_fires():
+    issues = run("""
+        class Box:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self.ready = False
+
+            def park(self):
+                with self._cond:
+                    while not self.ready:
+                        self._cond.wait()
+
+            def wake(self):
+                self.ready = True
+                self._cond.notify_all()
+    """, select=["condition-discipline"])
+    assert ids(issues) == ["condition-discipline"]
+    assert "notify" in issues[0].message
+
+
+def test_wait_nothing_notifies_fires_cross_file():
+    issues = run("""
+        class Box:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self.ready = False
+
+            def park(self):
+                with self._cond:
+                    while not self.ready:
+                        self._cond.wait()
+    """, select=["condition-discipline"])
+    assert ids(issues) == ["condition-discipline"]
+    assert "notif" in issues[0].message
+
+
+def test_timeout_wait_is_polling_and_quiet():
+    issues = run("""
+        class Box:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self.ready = False
+
+            def park(self):
+                with self._cond:
+                    while not self.ready:
+                        self._cond.wait(0.1)
+    """, select=["condition-discipline"])
+    assert issues == []
+
+
+# ================================================= --changed soundness
+CROSS_A = """
+    class Box:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+
+        def _work(self):
+            self.n += 1
+"""
+CROSS_B = """
+    from mxnet_tpu.fa import Box
+
+    def start():
+        b = Box()
+        t = threading.Thread(target=b._work)
+        t.start()
+        return b
+"""
+
+
+def test_changed_report_keeps_cross_file_roles_sound():
+    # the thread role comes from fb.py; the racing writes live in
+    # fa.py.  A --changed run reporting only fa.py must still see the
+    # role (whole-project harvest) and report the finding there.
+    issues = run(CROSS_A, path="mxnet_tpu/fa.py",
+                 extra={"mxnet_tpu/fb.py": CROSS_B},
+                 select=["shared-state-race"],
+                 report=["mxnet_tpu/fa.py"])
+    assert ids(issues) == ["shared-state-race"]
+    assert issues[0].path == "mxnet_tpu/fa.py"
+    # reporting only the (finding-free) spawner file stays empty
+    issues = run(CROSS_A, path="mxnet_tpu/fa.py",
+                 extra={"mxnet_tpu/fb.py": CROSS_B},
+                 select=["shared-state-race"],
+                 report=["mxnet_tpu/fb.py"])
+    assert issues == []
+
+
+# ============================================ satellite: SARIF round trip
+def test_sarif_cli_round_trip(tmp_path):
+    bad = tmp_path / "serving" / "x.py"
+    bad.parent.mkdir()
+    bad.write_text(textwrap.dedent("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self.n = 0
+                threading.Thread(target=self._loop).start()
+
+            def _loop(self):
+                self.n += 1
+
+            def bump(self):
+                self.n += 1
+    """))
+    sarif = subprocess.run(
+        [sys.executable, "-m", "tools.mxlint", "--no-cache",
+         "--format", "sarif", str(tmp_path)],
+        cwd=REPO, capture_output=True, text=True)
+    assert sarif.returncode == 1
+    doc = json.loads(sarif.stdout)
+    assert doc["version"] == "2.1.0"
+    runobj = doc["runs"][0]
+    rule_ids = [r["id"] for r in runobj["tool"]["driver"]["rules"]]
+    assert sorted(rule_ids) == sorted(PASSES)
+    results = runobj["results"]
+    assert results, "expected findings on the seeded race"
+    # identical finding set to --format json (same suppression /
+    # baseline semantics — only the serialization differs)
+    plain = subprocess.run(
+        [sys.executable, "-m", "tools.mxlint", "--no-cache",
+         "--format", "json", str(tmp_path)],
+        cwd=REPO, capture_output=True, text=True)
+    want = {(j["pass"], j["line"]) for j in
+            (json.loads(l) for l in plain.stdout.splitlines() if l)}
+    got = set()
+    for r in results:
+        assert r["ruleId"] in rule_ids
+        loc = r["locations"][0]["physicalLocation"]
+        line = loc["region"]["startLine"]
+        # SARIF columns are 1-based; mxlint's are 0-based
+        assert loc["region"]["startColumn"] >= 1
+        got.add((r["ruleId"], line))
+    assert got == want
+
+
+def test_sarif_clean_tree_is_an_empty_results_array(tmp_path):
+    good = tmp_path / "ok.py"
+    good.write_text("def f():\n    return 1\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.mxlint", "--no-cache",
+         "--format", "sarif", str(tmp_path)],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 0
+    doc = json.loads(proc.stdout)
+    assert doc["runs"][0]["results"] == []
+
+
+# ======================================= satellite: scope single-source
+def test_scope_tables_single_source_drives_passes_and_docs():
+    from tools.mxlint.scopes import SCOPES
+    ld = SCOPES["lock-discipline"]
+    assert ld.matches("mxnet_tpu/serving/server.py")
+    assert not ld.matches("mxnet_tpu/gluon/block.py")
+    hs = SCOPES["host-sync"]
+    assert hs.match_key("mxnet_tpu/ops/gemm.py") == "ops"
+    assert hs.match_key("mxnet_tpu/serving/batcher.py") == "serving"
+    assert hs.match_key("mxnet_tpu/engine.py") is None
+    # the committed docs table is in sync with the declarations
+    proc = subprocess.run(
+        [sys.executable, "tools/gen_lint_docs.py", "--check"],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr

@@ -306,3 +306,146 @@ def test_thread_registry_rows_shape(thread_sanitize):
     finally:
         release.set()
         t.join(5)
+
+
+# ---------------------------------------------------------------------------
+# Eraser-style lockset race sanitizer (engine.watch_races — ISSUE-20)
+# ---------------------------------------------------------------------------
+
+class _Counter:
+    """Two-thread shared counter the race tests seed; fresh subclass
+    per test so the once-per-class __setattr__ wrap never leaks state
+    between tests."""
+
+    def __init__(self, lock=None):
+        self.n = 0
+        self.flag = False
+        self._lock = lock
+
+
+def _second_thread_write(obj, lock=None, field="n"):
+    """One unlocked (or locked) += from a second thread; returns any
+    MXNetError it raised.  The Eraser state machine flags on the second
+    thread's FIRST write, so no schedule luck is involved."""
+    errs = []
+
+    def work():
+        try:
+            if lock is not None:
+                with lock:
+                    setattr(obj, field, getattr(obj, field) + 1)
+            else:
+                setattr(obj, field, getattr(obj, field) + 1)
+        except MXNetError as e:
+            errs.append(e)
+
+    t = threading.Thread(target=work, name="race-writer")
+    t.start()
+    t.join(10)
+    return errs
+
+
+def test_race_sanitizer_catches_unlocked_two_thread_write(sanitize):
+    class C(_Counter):
+        pass
+
+    obj = engine.watch_races(C())
+    obj.n += 1                          # main thread owns the field
+    errs = _second_thread_write(obj)
+    assert len(errs) == 1
+    msg = str(errs[0])
+    assert "data race on C.n" in msg
+    assert "race-writer" in msg         # the second writer, by name
+    assert "MainThread" in msg          # the first writer, by name
+    assert msg.count("test_sanitizer.py") >= 2   # both write stacks
+    assert "shared-state-race" in msg   # points at the static twin
+
+
+def test_race_sanitizer_silent_on_locked_twin(sanitize):
+    lk = engine.make_lock("race.Counter._lock")
+
+    class C(_Counter):
+        pass
+
+    obj = engine.watch_races(C(lock=lk))
+    with lk:
+        obj.n += 1
+    assert _second_thread_write(obj, lock=lk) == []
+    assert obj.n == 2                   # both updates landed
+
+
+def test_race_sanitizer_lockset_is_running_intersection(sanitize):
+    # writer A holds {L1, L2}, writer B holds {L2}: fine (L2 shared);
+    # a third write holding only {L1} empties the intersection and is
+    # the one that raises
+    l1 = engine.make_lock("race.L1")
+    l2 = engine.make_lock("race.L2")
+
+    class C(_Counter):
+        pass
+
+    obj = engine.watch_races(C())
+    with l1, l2:
+        obj.n += 1
+    assert _second_thread_write(obj, lock=l2) == []
+    with pytest.raises(MXNetError, match="data race on C.n"):
+        with l1:
+            obj.n += 1
+
+
+def test_race_sanitizer_exempt_field_is_untracked(sanitize):
+    class C(_Counter):
+        pass
+
+    obj = engine.watch_races(C(), exempt=("flag",))
+    obj.flag = True
+    assert _second_thread_write(obj, field="flag") == []
+
+
+def test_race_sanitizer_single_thread_never_flags(sanitize):
+    class C(_Counter):
+        pass
+
+    obj = engine.watch_races(C())
+    for _ in range(100):
+        obj.n += 1                      # exclusive owner, no locks: ok
+    assert obj.n == 100
+
+
+def test_watch_races_off_path_is_zero_cost(monkeypatch):
+    monkeypatch.setattr(engine, "_SANITIZE", False)
+
+    class C(_Counter):
+        pass
+
+    obj = engine.watch_races(C())
+    assert "_mx_race_fields_" not in obj.__dict__
+    assert C not in engine._RACE_WATCHED_CLASSES
+    errs = _second_thread_write(obj)
+    assert errs == [] and obj.n == 1
+
+
+def test_serving_classes_auto_arm_under_sanitizer(sanitize):
+    from mxnet_tpu.serving.kv_cache import PageAllocator, PageGeometry
+    geo = PageGeometry(page_size=4, pool_pages=8, max_context=16,
+                      num_layers=1, num_heads=1, head_dim=4)
+    alloc = PageAllocator(geo)
+    assert "_mx_race_fields_" in alloc.__dict__
+    # the allocator's own lock discipline satisfies its sanitizer:
+    # peak_used is written under PageAllocator._lock from any thread
+    assert alloc.allocate("s", 2)
+    errs = []
+
+    def other():
+        try:
+            alloc.allocate("t", 1)
+            alloc.release("t")
+        except MXNetError as e:         # pragma: no cover
+            errs.append(e)
+
+    t = threading.Thread(target=other)
+    t.start()
+    t.join(10)
+    assert errs == []
+    alloc.release("s")
+    assert alloc.check_leaks() == 0

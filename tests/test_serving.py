@@ -528,6 +528,13 @@ class TestBackpressure:
         results = []
         threads = self._spawn_predicts(srv, 4, results)
         assert gate.entered.wait(30)
+        # gate.entered only proves request 1 is executing; the other
+        # three must actually be queued before admission closes, or
+        # stop() races the predict threads and sheds a straggler
+        deadline = time.monotonic() + 30
+        while srv.stats()["queue_depth"] < 3:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
         gate.release.set()
         srv.stop(drain=True)                # waits for every request
         for t in threads:

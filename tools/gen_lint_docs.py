@@ -1,0 +1,57 @@
+"""Regenerate the "Scoped passes" table in docs/static_analysis.md
+from the single-source scope registry (``tools/mxlint/scopes.py`` —
+the same declare-once-render-everywhere discipline as
+tools/gen_fault_docs.py / tools/gen_env_docs.py).
+
+Usage: python tools/gen_lint_docs.py [--check]
+  --check: exit 1 if the committed doc is out of date (CI mode; run by
+  the ``sanity_lint`` job and tests/test_mxlint_contracts.py).
+"""
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOC = os.path.join(REPO, "docs", "static_analysis.md")
+BEGIN = "<!-- BEGIN generated pass-scope table (tools/gen_lint_docs.py) -->"
+END = "<!-- END generated pass-scope table -->"
+
+
+def render_table():
+    sys.path.insert(0, REPO)
+    from tools.mxlint.scopes import SCOPES
+    rows = ["| pass | surface | why it is in scope |", "|---|---|---|"]
+    for pass_id in sorted(SCOPES):
+        scope = SCOPES[pass_id]
+        for rule in scope.rules:
+            rows.append(f"| `{pass_id}` | {rule.where} | {rule.why} |")
+        for where, why in scope.extra_rows:
+            rows.append(f"| `{pass_id}` | {where} | {why} |")
+    return "\n".join(rows)
+
+
+def main(check=False):
+    with open(DOC) as f:
+        text = f.read()
+    if BEGIN not in text:
+        sys.stderr.write(f"{DOC}: missing {BEGIN!r} marker\n")
+        return 2
+    head, rest = text.split(BEGIN, 1)
+    if END not in rest:
+        sys.stderr.write(f"{DOC}: missing {END!r} marker\n")
+        return 2
+    _old, tail = rest.split(END, 1)
+    new = head + BEGIN + "\n" + render_table() + "\n" + END + tail
+    if check:
+        if new != text:
+            sys.stderr.write(
+                f"{os.path.relpath(DOC, REPO)} pass-scope table is "
+                f"stale — run tools/gen_lint_docs.py\n")
+            return 1
+        return 0
+    with open(DOC, "w") as f:
+        f.write(new)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(check="--check" in sys.argv[1:]))

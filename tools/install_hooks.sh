@@ -1,0 +1,60 @@
+#!/bin/sh
+# Opt-in git hook installer (docs/static_analysis.md §"Pre-push hook").
+#
+#   ./tools/install_hooks.sh            # install the pre-push lint hook
+#   ./tools/install_hooks.sh --remove   # uninstall
+#
+# The hook lints ONLY files changed vs the branch's upstream
+# (`mxlint --changed @{u}`) so a warm-cache run returns in well under
+# two seconds; the whole project is still parsed for interprocedural
+# facts, so cross-file findings on your diff stay sound.  Bypass a
+# single push with `git push --no-verify` or `MXLINT_SKIP=1 git push`.
+set -eu
+
+root=$(git rev-parse --show-toplevel 2>/dev/null) || {
+    echo "install_hooks.sh: not inside a git repository" >&2
+    exit 1
+}
+hooks_dir=$(git rev-parse --git-path hooks)
+hook="$hooks_dir/pre-push"
+
+if [ "${1:-}" = "--remove" ]; then
+    if [ -f "$hook" ] && grep -q mxlint "$hook"; then
+        rm -f "$hook"
+        echo "removed $hook"
+    else
+        echo "no mxlint pre-push hook installed"
+    fi
+    exit 0
+fi
+
+if [ -f "$hook" ] && ! grep -q mxlint "$hook"; then
+    echo "install_hooks.sh: $hook exists and is not ours — refusing" \
+         "to overwrite (remove it first)" >&2
+    exit 1
+fi
+
+mkdir -p "$hooks_dir"
+cat > "$hook" <<'HOOK'
+#!/bin/sh
+# mxlint pre-push hook (installed by tools/install_hooks.sh).
+# Lints files changed vs the upstream being pushed to; warm-cache runs
+# are sub-2s.  MXLINT_SKIP=1 or --no-verify bypasses.
+[ "${MXLINT_SKIP:-0}" = "1" ] && exit 0
+cd "$(git rev-parse --show-toplevel)" || exit 1
+# no upstream yet (first push of a branch): diff against HEAD so the
+# hook still covers the uncommitted/staged tail without a hard error
+ref="@{u}"
+git rev-parse --verify --quiet '@{u}' >/dev/null 2>&1 || ref=HEAD
+# same path scope as CI's full lint (ci/runtime_functions.sh
+# sanity_lint), so the hook and the gate agree on what's clean
+python -m tools.mxlint --changed "$ref" --format json \
+    mxnet_tpu/ tools/ || {
+    echo "pre-push: mxlint found new issues (fix, suppress with a" \
+         "'# mxlint: disable=<pass> (reason)' contract note, or" \
+         "bypass once with MXLINT_SKIP=1 / --no-verify)" >&2
+    exit 1
+}
+HOOK
+chmod +x "$hook"
+echo "installed $hook (mxlint --changed @{u}; MXLINT_SKIP=1 bypasses)"

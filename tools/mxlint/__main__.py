@@ -70,12 +70,15 @@ def main(argv=None):
     ap.add_argument("-q", "--quiet", action="store_true",
                     help="suppress the per-issue lines, print the "
                          "summary only")
-    ap.add_argument("--format", choices=("human", "json"),
+    ap.add_argument("--format", choices=("human", "json", "sarif"),
                     default="human",
                     help="output format: 'human' (default, "
-                         "path:line:col: [pass] message) or 'json' "
+                         "path:line:col: [pass] message), 'json' "
                          "(one finding object per line for CI "
-                         "annotation)")
+                         "annotation), or 'sarif' (one SARIF 2.1.0 "
+                         "document — GitHub code scanning / IDE "
+                         "viewers; baseline and suppression semantics "
+                         "identical to json)")
     ap.add_argument("--baseline", metavar="FILE",
                     help="ratchet mode: subtract findings recorded in "
                          "FILE; only new findings fail the run")
@@ -207,7 +210,14 @@ def main(argv=None):
                   f"findings) — re-record with --update-baseline",
                   file=sys.stderr)
 
-    if not args.quiet:
+    if args.format == "sarif":
+        # the document IS the output (findings or not, quiet or not):
+        # an empty results array is how SARIF says "clean", and a
+        # truncated document would poison any ingesting service
+        from .sarif import to_sarif
+        ran = {pid: PASSES[pid] for pid in (select or sorted(PASSES))}
+        print(json.dumps(to_sarif(issues, ran), indent=2))
+    elif not args.quiet:
         for issue in issues:
             if args.format == "json":
                 print(json.dumps({"pass": issue.pass_id,
@@ -227,7 +237,7 @@ def main(argv=None):
               + (f", {baselined} baselined" if baselined else ""),
               file=sys.stderr)
         return 1
-    if args.format != "json":       # keep json output machine-pure
+    if args.format == "human":      # keep json/sarif output machine-pure
         msg = "mxlint: clean"
         if baselined:
             msg += f" ({baselined} baselined finding(s) remain)"

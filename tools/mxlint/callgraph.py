@@ -27,6 +27,7 @@ unsure" lints:
 from __future__ import annotations
 
 import ast
+import builtins
 from typing import Dict, List, Optional
 
 from .core import SourceFile, dotted_name
@@ -392,8 +393,12 @@ class CallGraph:
                 or head in self.module_defs.get(within.module, {}):
             return None
 
-        # 5. project-unique bare name
-        if "." not in name:
+        # 5. project-unique bare name.  A name that is a Python builtin
+        # (setattr, print, ...) stays opaque: unless something above
+        # bound it, a bare `setattr(...)` is the builtin, and matching
+        # it to a same-named project method would leak call edges (and
+        # thread roles) into unrelated classes.
+        if "." not in name and not hasattr(builtins, name):
             cands = self.by_name.get(name, ())
             if len(cands) == 1:
                 return self.functions[cands[0]]

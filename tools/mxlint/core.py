@@ -164,6 +164,7 @@ class Project:
         self.files: List[SourceFile] = []
         self._callgraph = None
         self._summaries = None
+        self._threadmodel = None
 
     def callgraph(self):
         """Project-wide symbol table + call graph (callgraph.py), built
@@ -181,6 +182,14 @@ class Project:
             self._summaries = build_summaries(self.callgraph())
         return self._summaries
 
+    def threadmodel(self):
+        """Thread-role × lockset engine (mxthread.py), lazily built on
+        the call graph and shared by the race passes (20–22)."""
+        if self._threadmodel is None:
+            from .mxthread import ThreadModel
+            self._threadmodel = ThreadModel(self)
+        return self._threadmodel
+
     @staticmethod
     def _repo_root() -> str:
         return os.path.dirname(os.path.dirname(
@@ -192,6 +201,7 @@ class Project:
         self.files = list(files)
         self._callgraph = None          # rebuilt for the new file set
         self._summaries = None
+        self._threadmodel = None
         for f in self.files:
             for node in f.nodes():
                 if not isinstance(node, ast.Call):

@@ -1,0 +1,630 @@
+"""mxthread: the thread-role × lockset engine (docs/static_analysis.md).
+
+The concurrency passes built before ISSUE-20 were either thread-blind
+(`lock-discipline` flags "mutation outside a held lock" but cannot tell
+a single-threaded builder from a worker/heartbeat write-write race) or
+lifecycle-only (`thread-lifecycle` proves threads stop, not that their
+shared state is sound).  This module composes the PR-4 call graph with
+the PR-18 thread harvesting into the three facts the race passes
+(20–22) consume:
+
+1. **Role inference** — a *role* is a thread species: ``main`` plus one
+   role per distinct thread root (the resolved ``target=`` of every
+   ``threading.Thread`` / ``threading.Timer`` / ``engine.make_thread``
+   construction and every ``<pool>.submit(fn, ...)``).  Each role's
+   closure is the set of functions reachable from its root over the
+   call graph; the ``main`` closure is seeded from every function with
+   no in-project caller that is not itself a thread root (public API,
+   entry points) and grown the same way.  Every function therefore
+   carries a **may-run-on role set** — the fact `lock-discipline`
+   never had.  A root constructed inside a loop (or from two sites) is
+   a **pool**: two instances of the same role race each other.
+
+2. **Escape analysis** — an attribute key (``Class.attr``) or module
+   global (``module:name``) is *shared* when its recorded accesses
+   span two distinct roles, or any access runs on a pool role.  The
+   owner ``self`` of a bound-method thread target escapes by
+   construction: its methods are the thread closure.
+
+3. **Interprocedural locksets** — every access records the lexically
+   held ``with``-locks (canonicalized like the runtime sanitizer:
+   ``Class.attr`` so all instances share one identity), and every
+   function gets a **held-at-entry** set: the intersection over all
+   call sites of (locks held at the site ∪ caller's entry set),
+   iterated to fixpoint.  A helper only ever called under
+   ``self._lock`` thus inherits the lock, with a witness chain naming
+   the call site — generalizing `lock-discipline`'s lexical ``with
+   self._lock`` tracking through helper calls.  Thread roots,
+   no-caller entry points, and public methods (callable from outside
+   the scanned tree with nothing held) are pinned to the empty set.
+
+Everything here is stay-quiet-when-unsure: an unresolvable thread
+target contributes no role, an unknown callee breaks no lockset, and
+the race passes additionally gate on *compound* accesses (the GIL makes
+single attribute reads/writes atomic — only read-modify-write and
+multi-op sequences can actually tear).
+
+Built lazily once per run via ``Project.threadmodel()`` and shared by
+passes 20–22; the runtime twin is ``engine.watch_races`` (Eraser-style
+per-field candidate-lockset intersection under
+``MXNET_ENGINE_SANITIZE=1``).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set
+
+from .callgraph import module_of
+from .core import dotted_name
+
+__all__ = ["ThreadModel", "Role", "Access", "lock_key", "is_lockish"]
+
+_LOCKISH = re.compile(r"lock|cond|mutex|_mu$", re.IGNORECASE)
+
+#: thread-constructor call names (canonicalized through import tables)
+_THREAD_CTORS = {"threading.Thread", "threading.Timer"}
+
+#: receivers whose ``.submit(fn, ...)`` spawns ``fn`` on a pool thread
+_POOLISH = re.compile(r"pool|executor", re.IGNORECASE)
+
+#: container-mutating method names (a write to the container)
+_MUTATORS = {"append", "appendleft", "add", "remove", "discard", "pop",
+             "popleft", "popitem", "clear", "update", "extend",
+             "insert", "setdefault", "sort", "reverse"}
+
+_MUTABLE_CTORS = {"dict", "list", "set", "OrderedDict", "defaultdict",
+                  "deque", "WeakValueDictionary", "Counter"}
+
+#: interprocedural witness chains are capped at this many hops
+_MAX_HOPS = 5
+
+_SCOPE_KINDS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                ast.Lambda)
+
+#: loop contexts for the spawn scan — a thread constructed inside any
+#: of these is a pool (role.multi), comprehensions included
+_LOOP_KINDS = (ast.For, ast.AsyncFor, ast.While, ast.ListComp,
+               ast.SetComp, ast.GeneratorExp, ast.DictComp)
+
+#: terminal call names that can possibly spawn — the cheap prefilter
+#: in front of _thread_target_expr's import-table canonicalization
+_SPAWNISH = {"Thread", "Timer", "make_thread", "submit"}
+
+#: exact-type sets for the hot _scan_function dispatch: the tree has
+#: ~600k nodes and AST classes are never subclassed, so `type(n) in
+#: set` replaces a chain of tuple-isinstance checks per node
+_SCOPE_SET = frozenset(_SCOPE_KINDS)
+_LOOP_SET = frozenset(_LOOP_KINDS)
+_WITH_SET = frozenset((ast.With, ast.AsyncWith))
+
+
+def is_lockish(expr) -> bool:
+    return bool(_LOCKISH.search(dotted_name(expr) or ""))
+
+
+def lock_key(expr, class_name: str, module: str) -> str:
+    """Canonical identity of a lock expression — ``Class.attr`` for
+    instance locks (all instances share one contract, exactly the
+    naming scheme ``engine.make_lock`` uses at runtime),
+    ``module:name`` for module-level locks."""
+    name = dotted_name(expr)
+    if name.startswith("self.") and class_name:
+        return f"{class_name}.{name[5:]}"
+    if "." not in name:
+        return f"{module}:{name}"
+    return name
+
+
+def _mutable_value(node) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        term = dotted_name(node.func).rsplit(".", 1)[-1]
+        return term in _MUTABLE_CTORS
+    return False
+
+
+def _self_attr(node) -> Optional[str]:
+    """'x' for an expression rooted at ``self.x`` (subscripts peeled),
+    else None."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _reads_attr(expr, attr: str) -> bool:
+    """Whether ``expr`` contains a Load of ``self.<attr>``."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.ctx, ast.Load) \
+                and node.attr == attr \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            return True
+    return False
+
+
+def _is_public_name(name: str) -> bool:
+    """Public surface: callable from outside the scanned tree (tests,
+    applications) with no locks held — dunders included (``__call__``,
+    ``__len__`` run wherever the object is used)."""
+    return not name.startswith("_") \
+        or (name.startswith("__") and name.endswith("__"))
+
+
+class Role:
+    """One thread species.  ``rid`` is the stable identity
+    (``main`` or ``thread:<target qname>``); ``multi`` marks a pool
+    (constructed in a loop, or from several sites) whose instances
+    race each other."""
+
+    __slots__ = ("rid", "target_qname", "display", "site", "multi")
+
+    def __init__(self, rid, target_qname, display, site, multi):
+        self.rid = rid
+        self.target_qname = target_qname
+        self.display = display
+        self.site = site
+        self.multi = multi
+
+    def describe(self) -> str:
+        if self.rid == "main":
+            return "the main thread"
+        pool = "thread pool" if self.multi else "thread"
+        return f"{pool} {self.display!r} (spawned at {self.site})"
+
+    def __repr__(self):
+        return f"Role({self.rid})"
+
+
+class Access:
+    """One recorded access to a shared-state key."""
+
+    __slots__ = ("fn", "node", "key", "attr", "kind", "compound",
+                 "lex_locks", "desc")
+
+    def __init__(self, fn, node, key, attr, kind, compound, lex_locks,
+                 desc):
+        self.fn = fn                    # FunctionInfo
+        self.node = node
+        self.key = key                  # 'Class.attr' | 'module:name'
+        self.attr = attr
+        self.kind = kind                # 'read' | 'write'
+        self.compound = compound        # multi-op (RMW) access
+        self.lex_locks = lex_locks      # frozenset of lock keys
+        self.desc = desc                # short human form of the site
+
+    @property
+    def is_write(self):
+        return self.kind == "write"
+
+    def site(self) -> str:
+        return f"{self.fn.src.path}:{self.node.lineno}"
+
+
+class ThreadModel:
+    """Project-wide thread-role and lockset facts (module docstring)."""
+
+    def __init__(self, project):
+        self.project = project
+        self.graph = project.callgraph()
+        self.roles: Dict[str, Role] = {}
+        # qname -> frozenset of role ids (may-run-on)
+        self.fn_roles: Dict[str, frozenset] = {}
+        # qname -> held-at-entry lock keys (frozenset); missing = empty
+        self.entry_locks: Dict[str, frozenset] = {}
+        # qname -> ((caller name, path, line), ...) witness for entry
+        self.entry_witness: Dict[str, tuple] = {}
+        # shared-state key -> [Access]
+        self.accesses: Dict[str, List[Access]] = {}
+        # lock/cond/threading.local attribute keys (never "state")
+        self.lock_keys: Set[str] = set()
+        self.cond_keys: Set[str] = set()
+        self.local_keys: Set[str] = set()
+        # per-function resolved call sites [(callee_q, locks, line)] —
+        # feeds the entry-lockset fixpoint
+        self._fn_calls: Dict[str, List[tuple]] = {}
+        self._module_mutables: Dict[str, Set[str]] = {}
+        self._shared = None
+        # spawn sites collected during the per-function scan:
+        # (fn, call node, target expr, display, in_loop)
+        self._spawns: List[tuple] = []
+
+        self._scan_classes()
+        self._harvest_module_mutables()
+        for fn in self.graph.functions.values():
+            self._scan_function(fn)
+        self._build_roles()
+        self._entry_lockset_fixpoint()
+
+    # ------------------------------------------------------------ classes
+    def _scan_classes(self):
+        """Lock / condition / threading.local attribute keys from every
+        class ``__init__`` (the key space the lockset analysis and the
+        escape analysis both exclude from "state")."""
+        for cls in self.graph.classes.values():
+            init = cls.methods.get("__init__")
+            if init is None:
+                continue
+            for node in ast.walk(init.node):
+                if not isinstance(node, ast.Assign):
+                    continue
+                vname = dotted_name(node.value.func) \
+                    if isinstance(node.value, ast.Call) \
+                    else dotted_name(node.value)
+                for tgt in node.targets:
+                    if not (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"):
+                        continue
+                    key = f"{cls.name}.{tgt.attr}"
+                    if vname.endswith("local"):
+                        self.local_keys.add(key)
+                    elif re.search(r"Condition|make_condition", vname):
+                        self.cond_keys.add(key)
+                        self.lock_keys.add(key)
+                    elif _LOCKISH.search(tgt.attr) or re.search(
+                            r"Lock|Semaphore|make_lock", vname):
+                        self.lock_keys.add(key)
+
+    def _harvest_module_mutables(self):
+        for src in self.graph.files:
+            names = set()
+            for stmt in src.tree.body:
+                if isinstance(stmt, ast.Assign):
+                    targets = stmt.targets
+                elif isinstance(stmt, ast.AnnAssign) \
+                        and stmt.value is not None:
+                    targets = [stmt.target]
+                else:
+                    continue
+                for t in targets:
+                    if isinstance(t, ast.Name) \
+                            and _mutable_value(stmt.value) \
+                            and not _LOCKISH.search(t.id):
+                        names.add(t.id)
+            self._module_mutables[module_of(src.path)] = names
+
+    # -------------------------------------------------------------- roles
+    def _canon(self, name: str, fn) -> str:
+        """Canonicalize a dotted call name through the import tables
+        (``th.Thread`` -> ``threading.Thread``)."""
+        if not name:
+            return name
+        head, _, rest = name.partition(".")
+        scope = fn
+        while scope is not None:
+            tab = self.graph.fn_imports.get(scope.qname)
+            if tab and head in tab:
+                mod, orig = tab[head]
+                base = f"{mod}.{orig}" if orig else mod
+                return f"{base}.{rest}" if rest else base
+            scope = scope.parent
+        tab = self.graph.imports.get(fn.module, {})
+        if head in tab:
+            mod, orig = tab[head]
+            base = f"{mod}.{orig}" if orig else mod
+            return f"{base}.{rest}" if rest else base
+        return name
+
+    def _thread_target_expr(self, call: ast.Call, fn):
+        """(target expression, display-name literal) when ``call``
+        constructs a thread/timer/pool task, else (None, None)."""
+        f = call.func
+        term0 = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else "")
+        if term0 not in _SPAWNISH:
+            return None, None
+        name = self._canon(dotted_name(call.func), fn)
+        term = name.rsplit(".", 1)[-1]
+        display = None
+        for kw in call.keywords:
+            if kw.arg == "name" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                display = kw.value.value
+        if name in _THREAD_CTORS or term == "make_thread":
+            for kw in call.keywords:
+                if kw.arg in ("target", "function"):
+                    return kw.value, display
+            if term == "make_thread" and call.args:
+                return call.args[0], display
+            if name == "threading.Timer" and len(call.args) > 1:
+                return call.args[1], display
+            return None, None
+        if term == "submit" and isinstance(call.func, ast.Attribute) \
+                and call.args:
+            # only pool-ish receivers: DecodeEngine.submit(prompt) and
+            # friends are project methods, not spawns — and a receiver
+            # that resolves to a project function is never an executor
+            recv = dotted_name(call.func.value)
+            if _POOLISH.search(recv) \
+                    and self.graph.resolve_call(call, fn) is None:
+                return call.args[0], display
+        return None, None
+
+    def _build_roles(self):
+        """Thread roots from the spawn sites the per-function scan
+        collected, closed over the call graph, plus the ``main``
+        closure grown from the no-caller entry points."""
+        # target qname -> [(target, display, site, in_loop)] per spawn
+        by_target: Dict[str, list] = {}
+        for fn, node, expr, display, in_loop in self._spawns:
+            target = self.graph.resolve_ref(expr, fn)
+            if target is None:
+                continue
+            by_target.setdefault(target.qname, []).append(
+                (target, display,
+                 f"{fn.src.path}:{node.lineno}", in_loop))
+        for qname, sites in by_target.items():
+            target, display, site, _ = sites[0]
+            multi = len(sites) > 1 or any(s[3] for s in sites)
+            rid = f"thread:{qname}"
+            self.roles[rid] = Role(
+                rid, qname, display or target.node.name, site, multi)
+
+        # per-role closure over call edges
+        closures = {rid: self._closure({role.target_qname})
+                    for rid, role in self.roles.items()}
+
+        # main closure: entry points = functions nobody in the project
+        # calls that are not thread roots (public API, CLI mains) —
+        # everything reachable from them may run on the caller's thread
+        called = set()
+        for sites in self.graph.calls.values():
+            for site in sites:
+                called.add(site.callee.qname)
+        root_targets = {r.target_qname for r in self.roles.values()}
+        main_seeds = {q for q in self.graph.functions
+                      if q not in called and q not in root_targets}
+        main_set = self._closure(main_seeds)
+        self.roles["main"] = Role("main", None, "main", "", False)
+
+        roles_of: Dict[str, set] = {}
+        for q in main_set:
+            roles_of.setdefault(q, set()).add("main")
+        for rid, cl in closures.items():
+            for q in cl:
+                roles_of.setdefault(q, set()).add(rid)
+        self.fn_roles = {q: frozenset(rs) for q, rs in roles_of.items()}
+
+    def _closure(self, seeds: Set[str]) -> Set[str]:
+        seen = set(seeds)
+        frontier = list(seeds)
+        while frontier:
+            q = frontier.pop()
+            for site in self.graph.calls.get(q, ()):
+                cq = site.callee.qname
+                if cq not in seen:
+                    seen.add(cq)
+                    frontier.append(cq)
+        return seen
+
+    def roles_of(self, qname: str) -> frozenset:
+        return self.fn_roles.get(qname, frozenset())
+
+    # ----------------------------------------------------------- accesses
+    def _owning_class(self, fn):
+        info = fn
+        while info is not None:
+            if info.cls is not None:
+                return info.cls
+            info = info.parent
+        return None
+
+    def _scan_function(self, fn):
+        """One walk: record self-attr / module-global accesses with the
+        lexically held locks, and resolved call sites with held locks
+        (for the entry-lockset fixpoint)."""
+        cls = self._owning_class(fn)
+        cls_name = cls.name if cls is not None else ""
+        in_init = fn.node.name == "__init__" and fn.cls is not None
+        mutables = self._module_mutables.get(fn.module, set())
+        bound = self.graph._bound_names(fn)
+        calls = self._fn_calls.setdefault(fn.qname, [])
+        method_names = set(cls.methods) if cls is not None else set()
+
+        def attr_key(attr):
+            return f"{cls_name}.{attr}" if cls_name else None
+
+        def record(node, key, attr, kind, compound, locks, desc):
+            if key is None or key in self.lock_keys \
+                    or key in self.local_keys or in_init:
+                return              # construction is single-threaded
+            self.accesses.setdefault(key, []).append(
+                Access(fn, node, key, attr, kind, compound,
+                       frozenset(locks), desc))
+
+        def record_write(stmt, tgt, locks, compound, verb):
+            attr = _self_attr(tgt)
+            if attr is not None:
+                record(stmt, attr_key(attr), attr, "write", compound,
+                       locks, f"{verb} self.{attr}")
+                return
+            base = tgt
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            if isinstance(base, ast.Name) and base.id in mutables \
+                    and base.id not in bound:
+                record(stmt, f"{fn.module}:{base.id}", base.id,
+                       "write", compound, locks, f"{verb} {base.id}")
+
+        def visit(node, locks, in_loop):
+            kind = type(node)
+            if kind in _SCOPE_SET:
+                return              # nested defs scan under their qname
+            if kind in _WITH_SET:
+                held = set(locks)
+                for item in node.items:
+                    expr = item.context_expr
+                    tgt = expr.func if isinstance(expr, ast.Call) \
+                        else expr
+                    if is_lockish(tgt):
+                        held.add(lock_key(tgt, cls_name, fn.module))
+                    visit(item.context_expr, locks, in_loop)
+                for stmt in node.body:
+                    visit(stmt, frozenset(held), in_loop)
+                return
+            if kind is ast.Assign:
+                for tgt in node.targets:
+                    elts = tgt.elts if isinstance(
+                        tgt, ast.Tuple) else [tgt]
+                    for t in elts:
+                        attr = _self_attr(t)
+                        compound = attr is not None \
+                            and _reads_attr(node.value, attr)
+                        record_write(
+                            node, t, locks, compound,
+                            "subscript store on" if isinstance(
+                                t, ast.Subscript) else "assignment to")
+                        if isinstance(t, ast.Subscript):
+                            visit(t.slice, locks, in_loop)
+                visit(node.value, locks, in_loop)
+                return
+            if kind is ast.AugAssign:
+                record_write(node, node.target, locks, True,
+                             "augmented assignment to")
+                if isinstance(node.target, ast.Subscript):
+                    visit(node.target.slice, locks, in_loop)
+                visit(node.value, locks, in_loop)
+                return
+            if kind is ast.Delete:
+                for t in node.targets:
+                    record_write(node, t, locks, False, "del of")
+                    if isinstance(t, ast.Subscript):
+                        visit(t.slice, locks, in_loop)
+                return
+            if kind is ast.Call:
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in _MUTATORS:
+                    record_write(node, node.func.value, locks, False,
+                                 f".{node.func.attr}() on")
+                expr, display = self._thread_target_expr(node, fn)
+                if expr is not None:
+                    self._spawns.append(
+                        (fn, node, expr, display, in_loop))
+                callee = self.graph.resolve_call(node, fn)
+                if callee is not None:
+                    calls.append((callee.qname, frozenset(locks),
+                                  node.lineno))
+                # fall through: receiver chain + args carry reads
+            elif kind is ast.Attribute:
+                if type(node.ctx) is ast.Load \
+                        and type(node.value) is ast.Name \
+                        and node.value.id == "self":
+                    if node.attr not in method_names:
+                        record(node, attr_key(node.attr), node.attr,
+                               "read", False, locks,
+                               f"read of self.{node.attr}")
+                    return
+            elif kind in _LOOP_SET:
+                # comprehensions count: [make_thread(...) for _ in
+                # range(n)] is a pool
+                for child in ast.iter_child_nodes(node):
+                    visit(child, locks, True)
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child, locks, in_loop)
+
+        for child in ast.iter_child_nodes(fn.node):
+            visit(child, frozenset(), False)
+
+    # ----------------------------------------------------------- locksets
+    def _entry_lockset_fixpoint(self):
+        """Held-at-entry per function: intersection over call sites of
+        (locks at the site ∪ caller's entry set), to fixpoint.  Thread
+        roots, no-caller functions, and public methods hold nothing by
+        definition (anything outside the scanned tree may call them
+        lock-free)."""
+        TOP = None          # unknown-yet: identity of intersection
+        callers: Dict[str, List[tuple]] = {}
+        for caller_q, sites in self._fn_calls.items():
+            for callee_q, locks, line in sites:
+                callers.setdefault(callee_q, []).append(
+                    (caller_q, locks, line))
+        root_targets = {r.target_qname for r in self.roles.values()
+                        if r.target_qname}
+        H: Dict[str, Optional[frozenset]] = {}
+        for q, fn in self.graph.functions.items():
+            if q in root_targets or q not in callers \
+                    or _is_public_name(fn.node.name):
+                H[q] = frozenset()
+            else:
+                H[q] = TOP
+        changed = True
+        while changed:
+            changed = False
+            for q, sites in callers.items():
+                if H.get(q) == frozenset():
+                    continue        # pinned / bottom: cannot shrink
+                acc = TOP
+                for caller_q, locks, _line in sites:
+                    hc = H.get(caller_q)
+                    if hc is TOP:
+                        continue    # unknown caller contributes ⊤
+                    contrib = locks | hc
+                    acc = contrib if acc is TOP else (acc & contrib)
+                if acc is not TOP and acc != H.get(q):
+                    H[q] = acc
+                    changed = True
+        self.entry_locks = {q: h for q, h in H.items() if h}
+        # one witness chain per inherited-lockset function
+        for q in self.entry_locks:
+            chain, seen, cur = [], set(), q
+            while cur in callers and cur not in seen \
+                    and len(chain) < _MAX_HOPS:
+                seen.add(cur)
+                caller_q, locks, line = callers[cur][0]
+                cfn = self.graph.functions[caller_q]
+                chain.append((cfn.node.name, cfn.src.path, line))
+                if not self.entry_locks.get(caller_q):
+                    break
+                cur = caller_q
+            self.entry_witness[q] = tuple(chain)
+
+    def locks_of(self, access: Access) -> frozenset:
+        return access.lex_locks | self.entry_locks.get(
+            access.fn.qname, frozenset())
+
+    def lock_witness(self, access: Access) -> str:
+        """' (holds ... via caller chain)' suffix when part of the
+        lockset is inherited from callers rather than lexical."""
+        inherited = self.entry_locks.get(access.fn.qname, frozenset()) \
+            - access.lex_locks
+        if not inherited:
+            return ""
+        chain = self.entry_witness.get(access.fn.qname, ())
+        if not chain:
+            return ""
+        hops = " -> ".join(f"{name} ({path}:{line})"
+                           for name, path, line in chain)
+        return (f" (holds {sorted(inherited)} via caller "
+                f"chain {hops})")
+
+    # ------------------------------------------------------------- shared
+    def shared_keys(self) -> Dict[str, frozenset]:
+        """{key: union of role ids} for every key whose accesses span
+        two distinct roles, or touch any pool role."""
+        if self._shared is not None:
+            return self._shared
+        out = {}
+        for key, accs in self.accesses.items():
+            roles = set()
+            for a in accs:
+                roles |= self.roles_of(a.fn.qname)
+            if len(roles) >= 2 or any(
+                    self.roles[r].multi for r in roles
+                    if r in self.roles):
+                out[key] = frozenset(roles)
+        self._shared = out
+        return out
+
+    def describe_locks(self, locks: frozenset) -> str:
+        return ", ".join(sorted(locks)) if locks else "no lock"
+
+

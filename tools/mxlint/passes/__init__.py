@@ -24,3 +24,6 @@ from . import blocking_in_loop      # noqa: F401
 from . import sharding_soundness    # noqa: F401
 from . import replication_soundness  # noqa: F401
 from . import donation_soundness    # noqa: F401
+from . import shared_state_race     # noqa: F401
+from . import atomicity             # noqa: F401
+from . import condition_discipline  # noqa: F401

@@ -1,0 +1,129 @@
+"""atomicity: read-modify-write and check-then-act on shared state
+outside any lock (docs/static_analysis.md).
+
+`shared-state-race` proves a *pair* of sites races; this pass flags the
+single-site shapes that are unsound the moment the state is reachable
+from a second thread (per the mxthread escape analysis), even when the
+partner site is a future PR:
+
+- **RMW**: ``self.n += 1`` / ``self.n = self.n + 1`` / ``+=`` on a
+  subscript of shared state, with an empty effective lockset.  Under
+  the GIL each load and store is atomic but the read-modify-write
+  sequence is not — two threads interleave and one update is lost.
+  This is the exact shape the runtime twin (``engine.watch_races``,
+  MXNET_ENGINE_SANITIZE=1) catches on a live schedule.
+- **check-then-act**: an ``if`` whose test reads shared state and
+  whose body acts on the same state (write, ``.pop()``, ``del``,
+  subscript index) with no lock across the two steps: ``if k in
+  self.d: self.d.pop(k)`` and len-then-index both throw on the
+  interleaving the test claims to exclude.  (A compound write in the
+  body is left to the RMW arm — one finding per defect.)
+
+Shared-ness is the gate that keeps this tree-wide pass quiet on
+single-threaded code: a counter only ever touched by one non-pool role
+never flags, no matter how lock-free it is.
+"""
+import ast
+
+from ..core import LintPass, register_pass
+from ..mxthread import _self_attr
+
+
+@register_pass
+class AtomicityPass(LintPass):
+    id = "atomicity"
+    doc = ("read-modify-write or check-then-act on thread-shared "
+           "state outside any lock")
+
+    def check_file(self, src):
+        model = self.project.threadmodel()
+        shared = model.shared_keys()
+
+        # --- RMW: compound writes with an empty effective lockset
+        for key in sorted(shared):
+            for a in model.accesses[key]:
+                if a.fn.src.path != src.path or not a.is_write \
+                        or not a.compound or model.locks_of(a):
+                    continue
+                roles = sorted(
+                    model.roles[r].describe()
+                    for r in model.roles_of(a.fn.qname)
+                    if r in model.roles)
+                iss = self.issue(
+                    src, a.node,
+                    f"{a.desc} is a read-modify-write on {key}, "
+                    f"shared state reachable from "
+                    f"{' and '.join(roles) if roles else 'threads'}, "
+                    f"with no lock held — the load/modify/store "
+                    f"sequence is not atomic under the GIL and "
+                    f"concurrent updates are lost; hold a lock across "
+                    f"the update")
+                if iss is not None:
+                    yield iss
+
+        # --- check-then-act, per function of this file
+        by_fn = {}
+        for key in shared:
+            for a in model.accesses[key]:
+                if a.fn.src.path == src.path:
+                    by_fn.setdefault(a.fn.qname, []).append(a)
+        graph = model.graph
+        for qname, accs in sorted(by_fn.items()):
+            fn = graph.functions[qname]
+            for node in graph._local_nodes(fn.node):
+                if isinstance(node, ast.If):
+                    yield from self._check_then_act(
+                        src, model, node, accs)
+
+    def _check_then_act(self, src, model, node, accs):
+        test_end = getattr(node.test, "end_lineno", None) \
+            or node.test.lineno
+        # cheap line-span prefilter; walk the test only when a
+        # candidate read can actually sit inside it
+        cands = [a for a in accs
+                 if not a.is_write
+                 and node.test.lineno <= a.node.lineno <= test_end]
+        if not cands:
+            return
+        test_nodes = set(ast.walk(node.test))
+        test_keys = {a.key for a in cands
+                     if a.node in test_nodes and not model.locks_of(a)}
+        if not test_keys:
+            return
+        body_end = node.body[-1].end_lineno or node.body[0].lineno
+        seen = set()
+        for a in accs:
+            if a.key not in test_keys or a.key in seen:
+                continue
+            if not (node.body[0].lineno <= a.node.lineno <= body_end):
+                continue
+            # compound body writes are the RMW arm's finding; a locked
+            # act means the author thought about the interleaving
+            if model.locks_of(a) or a.compound:
+                continue
+            acted = a.is_write or self._indexed_read_in_body(
+                node, a.attr)
+            if not acted:
+                continue
+            seen.add(a.key)
+            iss = self.issue(
+                src, node,
+                f"check-then-act on {a.key}: the test reads it and "
+                f"the body acts on it ({a.desc}, {a.site()}) with no "
+                f"lock across the two steps — another thread can "
+                f"invalidate the check between test and act; hold one "
+                f"lock over both, or use a single atomic operation "
+                f"(dict.pop(k, default), try/except)")
+            if iss is not None:
+                yield iss
+
+    @staticmethod
+    def _indexed_read_in_body(if_node, attr):
+        """len-then-index: the body indexes ``self.<attr>``."""
+        for stmt in if_node.body:
+            for n in ast.walk(stmt):
+                if isinstance(n, ast.Subscript) \
+                        and isinstance(n.ctx, ast.Load) \
+                        and _self_attr(n) == attr:
+                    return True
+        return False

@@ -1,0 +1,184 @@
+"""condition-discipline: Condition-variable protocol checks
+(docs/static_analysis.md).
+
+Condition variables have a three-rule protocol the interpreter never
+enforces; each rule has a distinct production failure mode this pass
+pins at lint time:
+
+- **wait under ``if`` instead of ``while``** — wakeups are spurious
+  and, with several waiters, a ``notify_all`` wakes threads whose
+  predicate a faster thread already consumed.  An ``if``-guarded
+  ``wait`` proceeds on a false predicate.  Detection is
+  ancestor-shaped: a ``.wait()`` on a condition-ish receiver whose
+  enclosing statement chain (up to the function body) contains an
+  ``If`` but **no** loop — a wait inside any ``while``/``for`` is
+  re-checked by the loop, wherever the ``if`` sits.  ``wait_for``
+  carries its own retry loop and is exempt.
+- **notify without the lock** — ``notify``/``notify_all`` where the
+  effective lockset (lexical ``with``-locks ∪ held-at-entry inherited
+  from callers, with witness chain) does not contain the condition's
+  own key: raises RuntimeError at runtime on a bare Condition, and on
+  the ``engine.make_condition`` wrapper it races the waiter's
+  predicate check.
+- **crossed wait/notify** (cross-file finalize) — a condition some
+  thread waits on (untimed) but nothing in the project ever notifies
+  leaves waiters asleep forever: the signaling state was guarded by a
+  *different* condition object.  Symmetrically, notifies on a
+  condition nothing waits on signal into the void (usually a stale
+  rename).  Timeout'd waits are polling by design and exempt.
+
+The whole project is harvested once (independent of ``--changed``
+report filtering, which only restricts *reporting*), so cross-file
+facts stay sound on partial runs.
+"""
+import ast
+
+from ..core import Issue, LintPass, dotted_name, register_pass
+from ..mxthread import is_lockish, lock_key
+
+_NOTIFYISH = ("notify", "notify_all")
+
+
+@register_pass
+class ConditionDisciplinePass(LintPass):
+    id = "condition-discipline"
+    doc = ("Condition.wait under 'if' instead of 'while', notify "
+           "without the lock, waits nothing notifies (and vice versa)")
+
+    def __init__(self, project):
+        super().__init__(project)
+        self._harvested = False
+        # path -> [(node, message)] per-site findings
+        self._per_file = {}
+        # cond key -> [(src, node, untimed)] / [(src, node)]
+        self._waits = {}
+        self._notifies = {}
+
+    # ------------------------------------------------------------ harvest
+    def _harvest(self):
+        if self._harvested:
+            return
+        self._harvested = True
+        model = self.project.threadmodel()
+        for qname in sorted(model.graph.functions):
+            self._scan_fn(model, model.graph.functions[qname])
+
+    def _scan_fn(self, model, fn):
+        cls = fn.cls
+        info = fn
+        while cls is None and info.parent is not None:
+            info = info.parent
+            cls = info.cls
+        cls_name = cls.name if cls is not None else ""
+
+        def visit(node, locks, anc):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                held = set(locks)
+                for item in node.items:
+                    expr = item.context_expr
+                    tgt = expr.func if isinstance(expr, ast.Call) \
+                        else expr
+                    if is_lockish(tgt):
+                        held.add(lock_key(tgt, cls_name, fn.module))
+                    visit(item.context_expr, locks, anc)
+                for stmt in node.body:
+                    visit(stmt, frozenset(held), anc)
+                return
+            nxt = anc
+            if isinstance(node, (ast.While, ast.For, ast.If)):
+                nxt = anc + (type(node).__name__,)
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute):
+                self._check_call(model, fn, cls_name, node, locks, anc)
+            for child in ast.iter_child_nodes(node):
+                visit(child, locks, nxt)
+
+        for child in ast.iter_child_nodes(fn.node):
+            visit(child, frozenset(), ())
+
+    def _check_call(self, model, fn, cls_name, node, locks, anc):
+        meth = node.func.attr
+        recv = node.func.value
+        name = dotted_name(recv)
+        key = lock_key(recv, cls_name, fn.module)
+        if key not in model.cond_keys and "cond" not in name.lower():
+            return
+        src = fn.src
+        eff = locks | model.entry_locks.get(fn.qname, frozenset())
+        if meth == "wait":
+            untimed = not node.args and not node.keywords
+            self._waits.setdefault(key, []).append((src, node, untimed))
+            if "If" in anc \
+                    and not any(a in ("While", "For") for a in anc):
+                self._per_file.setdefault(src.path, []).append((
+                    src, node,
+                    f"wait on {key} guarded by 'if' with no enclosing "
+                    f"loop: wakeups are spurious and notify_all races "
+                    f"multiple waiters, so the predicate must be "
+                    f"re-checked — use 'while not <predicate>: "
+                    f"{name}.wait()' (or "
+                    f"{name}.wait_for(<predicate>))"))
+        elif meth == "wait_for":
+            self._waits.setdefault(key, []).append((src, node, True))
+        elif meth in _NOTIFYISH:
+            self._notifies.setdefault(key, []).append((src, node))
+            if key not in eff:
+                held = ", ".join(sorted(eff)) if eff else "nothing"
+                wit = ""
+                if model.entry_locks.get(fn.qname):
+                    chain = model.entry_witness.get(fn.qname, ())
+                    if chain:
+                        hops = " -> ".join(
+                            f"{n} ({p}:{ln})" for n, p, ln in chain)
+                        wit = f" (entry locks via {hops})"
+                self._per_file.setdefault(src.path, []).append((
+                    src, node,
+                    f"{meth}() on {key} without holding it (held: "
+                    f"{held}{wit}): a bare Condition raises "
+                    f"RuntimeError and a wrapper notify races the "
+                    f"waiter's predicate check — call inside "
+                    f"'with {name}:'"))
+
+    # ------------------------------------------------------------ results
+    def check_file(self, src):
+        self._harvest()
+        for fsrc, node, message in self._per_file.get(src.path, ()):
+            iss = self.issue(fsrc, node, message)
+            if iss is not None:
+                yield iss
+
+    def finalize(self):
+        self._harvest()
+        model = self.project.threadmodel()
+        # crossed wait/notify is only meaningful for class-attribute
+        # conditions declared in an __init__ (locals and parameters
+        # are aliasing games this syntactic pass stays quiet on)
+        for key in sorted(self._waits):
+            if key not in model.cond_keys or key in self._notifies:
+                continue
+            untimed = [(s, n) for s, n, u in self._waits[key] if u]
+            if not untimed:
+                continue        # timeout'd waits poll by design
+            src, node = untimed[0]
+            if src.suppressed(self.id, node):
+                continue
+            yield Issue(
+                self.id, src.path, node.lineno, node.col_offset,
+                f"untimed wait on {key} but nothing in the project "
+                f"ever notifies it — the waiter sleeps forever; if "
+                f"another condition guards this state, wait and "
+                f"notify must share one condition object")
+        for key in sorted(self._notifies):
+            if key not in model.cond_keys or key in self._waits:
+                continue
+            src, node = self._notifies[key][0]
+            if src.suppressed(self.id, node):
+                continue
+            yield Issue(
+                self.id, src.path, node.lineno, node.col_offset,
+                f"notify on {key} but nothing in the project ever "
+                f"waits on it — dead signal (stale rename?) or the "
+                f"waiter uses a different condition object")

@@ -30,20 +30,19 @@ import ast
 
 from ..core import LintPass, dotted_name, register_pass
 from ..dataflow import _sanctioned
+from ..scopes import HOST_SYNC_HOT_FUNCS as _HOT_FUNCS, SCOPES
 
-_HOT_FUNCS = {"_worker_loop", "_next_batch", "run_batch", "program_for"}
-
-
-def _path_parts(path: str):
-    return path.replace("\\", "/").split("/")
+# single-source scope declaration (tools/mxlint/scopes.py renders the
+# same rules into docs/static_analysis.md via tools/gen_lint_docs.py)
+_SCOPE = SCOPES["host-sync"]
 
 
 def _in_ops(path: str) -> bool:
-    return "ops" in _path_parts(path)[:-1]
+    return _SCOPE.match_key(path) == "ops"
 
 
 def _in_serving(path: str) -> bool:
-    return "serving" in _path_parts(path)[:-1]
+    return _SCOPE.match_key(path) == "serving"
 
 
 @register_pass

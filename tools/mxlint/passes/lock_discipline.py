@@ -35,20 +35,11 @@ import os
 import re
 
 from ..core import Issue, LintPass, dotted_name, register_pass
+from ..scopes import SCOPES
 
-_SCOPE_RES = [re.compile(p) for p in (
-    r"(^|/)engine\.py$",
-    r"(^|/)runtime_metrics\.py$",
-    r"(^|/)tracing\.py$",
-    r"(^|/)serving/[^/]+\.py$",
-    r"(^|/)parallel/dist\.py$",
-    # the fault-injection plan is mutated from every serving thread
-    # that hits an injection point — same discipline as serving/*
-    r"(^|/)faults\.py$",
-    # the training supervisor's watchdog crosses threads (the deadline
-    # worker vs the train loop) — same discipline
-    r"(^|/)parallel/supervisor\.py$",
-)]
+# single-source scope declaration (tools/mxlint/scopes.py renders the
+# same rules into docs/static_analysis.md via tools/gen_lint_docs.py)
+_SCOPE = SCOPES["lock-discipline"]
 
 _LOCKISH = re.compile(r"lock|cond|mutex|_mu$", re.IGNORECASE)
 _MUTATORS = {"append", "appendleft", "add", "remove", "discard", "pop",
@@ -61,8 +52,7 @@ _BLOCKING = re.compile(
 
 
 def _in_scope(path: str) -> bool:
-    p = path.replace("\\", "/")
-    return any(r.search(p) for r in _SCOPE_RES)
+    return _SCOPE.matches(path)
 
 
 def _is_lockish(expr) -> bool:

@@ -1,0 +1,110 @@
+"""shared-state-race: cross-thread write/write and write/read pairs on
+shared state with provably-disjoint locksets (docs/static_analysis.md).
+
+The whole-tree data-race pass the lock-discipline scope list could
+never be: using the thread-role × lockset engine (mxthread.py), flag an
+attribute or module global that is
+
+- *shared* — its accesses span two distinct thread roles, or any pool
+  role (two workers of one pool race each other); and
+- *torn-able* — at least one access is a **compound** write (``+=``,
+  read-modify-write assign): the GIL makes single attribute loads and
+  stores atomic, so only multi-op accesses can actually lose updates;
+  and
+- *provably unprotected* — the compound write's effective lockset
+  (lexical ``with``-locks ∪ held-at-entry inherited from callers) is
+  disjoint from the partner access's.  A shared lock on either side,
+  even an inherited one, silences the pair.
+
+One finding per key, anchored at the compound write, naming **both**
+sites, both roles, and both locksets (with the caller-chain witness
+when a lockset is inherited) — a race is a property of the pair, and a
+reader should not have to reconstruct the partner site by hand.
+
+Write/write pairs (lost updates) are preferred as evidence; a
+write/read pair is reported only when no write pair exists and BOTH
+sides are lock-free — a torn lock-free writer is a bug whoever reads
+it, but a *locked* compound write against a plain lock-free read is
+fine under the GIL (the read is one atomic load and observes a
+consistent before-or-after value; a stale-read-then-act on the reader
+side is the atomicity pass's finding, not a race pair).
+
+Suppressions on *either* site silence the pair (the contract note
+belongs wherever the invariant lives); the pass then tries the next
+pair for the key, so suppressing one benign pairing does not hide a
+second, real one.
+"""
+import ast   # noqa: F401  (parity with the pass-module template)
+
+from ..core import Issue, LintPass, register_pass
+
+
+@register_pass
+class SharedStateRacePass(LintPass):
+    id = "shared-state-race"
+    doc = ("compound write to shared state reachable from two thread "
+           "roles with disjoint locksets (both sites named)")
+
+    def finalize(self):
+        model = self.project.threadmodel()
+        shared = model.shared_keys()
+        for key in sorted(shared):
+            accs = model.accesses[key]
+            writes = [a for a in accs if a.is_write]
+            compound = [a for a in writes if a.compound]
+            if not compound:
+                continue
+            reads = [a for a in accs if not a.is_write
+                     and not model.locks_of(a)]
+            # write/write evidence first, then write/read with BOTH
+            # sides lock-free (a locked compound write is one atomic
+            # before-or-after value to a plain GIL-atomic read)
+            pairs = [(w, b) for w in compound for b in writes
+                     if b.node is not w.node] \
+                + [(w, b) for w in compound
+                   if not model.locks_of(w) for b in reads]
+            issue = None
+            for w, b in pairs:
+                conflict = self._role_conflict(model, w, b)
+                if conflict is None:
+                    continue
+                if model.locks_of(w) & model.locks_of(b):
+                    continue
+                if w.fn.src.suppressed(self.id, w.node) \
+                        or b.fn.src.suppressed(self.id, b.node):
+                    continue
+                issue = self._report(model, key, w, b, conflict)
+                break
+            if issue is not None:
+                yield issue
+
+    @staticmethod
+    def _role_conflict(model, a, b):
+        """(role_a, role_b) that can run concurrently, or None.  Two
+        distinct roles always can; one pool role races itself."""
+        ra = model.roles_of(a.fn.qname)
+        rb = model.roles_of(b.fn.qname)
+        for r1 in sorted(ra):
+            for r2 in sorted(rb):
+                if r1 != r2:
+                    return (r1, r2)
+                role = model.roles.get(r1)
+                if role is not None and role.multi:
+                    return (r1, r2)
+        return None
+
+    def _report(self, model, key, w, b, conflict):
+        r1 = model.roles[conflict[0]].describe()
+        r2 = model.roles[conflict[1]].describe()
+        verb = "written" if b.is_write else "read"
+        return Issue(
+            self.id, w.fn.src.path, w.node.lineno, w.node.col_offset,
+            f"{key} is written by {r1} here ({w.desc} holding "
+            f"{model.describe_locks(model.locks_of(w))}"
+            f"{model.lock_witness(w)}) and {verb} by {r2} at "
+            f"{b.site()} ({b.desc} holding "
+            f"{model.describe_locks(model.locks_of(b))}"
+            f"{model.lock_witness(b)}): the locksets are disjoint and "
+            f"the write is compound (not atomic under the GIL) — "
+            f"updates can be lost; guard both sites with one lock or "
+            f"confine the state to a single thread")

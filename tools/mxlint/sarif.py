@@ -1,0 +1,62 @@
+"""SARIF 2.1.0 serialization of mxlint findings (``--format sarif``).
+
+One document per run (unlike ``--format json``'s line-per-finding
+stream): GitHub code scanning, VS Code SARIF viewers, and most CI
+annotation services ingest this directly.  Baseline subtraction and
+inline suppressions are applied BEFORE serialization — a SARIF run
+carries exactly the findings a json run of the same invocation would
+print, so the two formats never disagree about what fails CI.
+
+Coordinate contract: mxlint lines are 1-based and columns 0-based
+(``ast`` node offsets, what ``path:line:col`` prints); SARIF regions
+are 1-based in both, so ``startColumn = col + 1``.
+"""
+
+_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+           "master/Schemata/sarif-schema-2.1.0.json")
+_INFO_URI = "https://github.com/apache/incubator-mxnet"
+
+
+def to_sarif(issues, passes):
+    """The SARIF 2.1.0 document (a plain dict, ready for json.dumps)
+    for ``issues``.  ``passes`` is the pass catalogue in effect for the
+    run (id -> pass class): every pass that RAN becomes a rule, so a
+    clean run still declares what it checked for."""
+    rule_ids = sorted(passes)
+    rule_index = {pid: i for i, pid in enumerate(rule_ids)}
+    rules = [{
+        "id": pid,
+        "shortDescription": {"text": passes[pid].doc},
+        "helpUri": _INFO_URI + "/blob/master/docs/static_analysis.md",
+        "defaultConfiguration": {"level": "error"},
+    } for pid in rule_ids]
+    results = [{
+        "ruleId": i.pass_id,
+        "ruleIndex": rule_index[i.pass_id],
+        "level": "error",
+        "message": {"text": i.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {
+                    # repo-relative (path_key output), forward slashes
+                    "uri": i.path.replace("\\", "/"),
+                },
+                "region": {
+                    "startLine": i.line,
+                    "startColumn": i.col + 1,
+                },
+            },
+        }],
+    } for i in issues]
+    return {
+        "$schema": _SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "mxlint",
+                "informationUri": _INFO_URI,
+                "rules": rules,
+            }},
+            "results": results,
+        }],
+    }

@@ -1,0 +1,109 @@
+"""Single-source per-pass *scope* declarations.
+
+A scoped pass (one that does not run over the whole tree) declares
+WHERE it looks exactly once, here.  The pass module imports its
+declaration for the runtime predicate, and the "Scoped passes" table
+in docs/static_analysis.md is generated from the same objects by
+``tools/gen_lint_docs.py`` (``--check`` in CI's sanity_lint) — the
+declare-once-render-everywhere discipline of
+``faults.declare_fault_site`` / ``tools/gen_fault_docs.py``.  Before
+this module the lock-discipline and host-sync surface lists lived in
+the pass sources AND in docs prose, and the two had already drifted
+once (supervisor/faults joined the pass but not the doc).
+
+Whole-tree passes do not appear here: an absent entry *is* the
+declaration that a pass scans everything it is handed.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, Optional, Tuple
+
+
+class ScopeRule:
+    """One path surface of a pass's scope.
+
+    ``key`` names the rule so a pass can branch on *which* surface
+    matched (host-sync treats ``ops`` and ``serving`` differently);
+    ``where``/``why`` are the markdown cells the docs table renders.
+    """
+
+    __slots__ = ("key", "pattern", "where", "why")
+
+    def __init__(self, key: str, pattern: str, where: str, why: str):
+        self.key = key
+        self.pattern = re.compile(pattern)
+        self.where = where
+        self.why = why
+
+
+class PassScope:
+    """A pass's full scope: path rules plus any non-path surface facts
+    (rendered as extra table rows, e.g. host-sync's hot dispatch
+    functions)."""
+
+    def __init__(self, pass_id: str, rules: Tuple[ScopeRule, ...],
+                 extra_rows: Tuple[Tuple[str, str], ...] = ()):
+        self.pass_id = pass_id
+        self.rules = rules
+        self.extra_rows = extra_rows        # (where-md, why-md) pairs
+
+    def match_key(self, path: str) -> Optional[str]:
+        p = path.replace("\\", "/")
+        for r in self.rules:
+            if r.pattern.search(p):
+                return r.key
+        return None
+
+    def matches(self, path: str) -> bool:
+        return self.match_key(path) is not None
+
+
+# Functions forming the serving dispatch path: between batch formation
+# and program dispatch every host stall serializes the whole pipeline.
+# host_sync.py consumes this set directly; the docs row renders it.
+HOST_SYNC_HOT_FUNCS = frozenset(
+    {"_worker_loop", "_next_batch", "run_batch", "program_for"})
+
+
+SCOPES: Dict[str, PassScope] = {
+    "lock-discipline": PassScope("lock-discipline", (
+        ScopeRule("engine", r"(^|/)engine\.py$", "`engine.py`",
+                  "worker pool, lock-order sanitizer, thread registry"),
+        ScopeRule("runtime_metrics", r"(^|/)runtime_metrics\.py$",
+                  "`runtime_metrics.py`",
+                  "metrics registry mutated from every instrumented "
+                  "thread (shipped the histogram-registry race fix)"),
+        ScopeRule("tracing", r"(^|/)tracing\.py$", "`tracing.py`",
+                  "span tracer crosses request worker threads"),
+        ScopeRule("serving", r"(^|/)serving/[^/]+\.py$", "`serving/*`",
+                  "batcher, decode engine, replica router, autoscaler "
+                  "— heartbeat/worker/caller threads all cross here"),
+        ScopeRule("dist", r"(^|/)parallel/dist\.py$",
+                  "`parallel/dist.py`",
+                  "multi-process shutdown path (shipped a race fix)"),
+        ScopeRule("faults", r"(^|/)faults\.py$", "`faults.py`",
+                  "fault-plan trigger state is mutated from every "
+                  "serving thread that hits an injection point"),
+        ScopeRule("supervisor", r"(^|/)parallel/supervisor\.py$",
+                  "`parallel/supervisor.py`",
+                  "step-watchdog deadline worker vs the train loop"),
+    )),
+    "host-sync": PassScope("host-sync", (
+        ScopeRule("ops", r"(^|/)ops/", "any `ops/` directory",
+                  "op implementations run under the engine's sync-point "
+                  "accounting; every ad-hoc stall is invisible to it"),
+        ScopeRule("serving", r"(^|/)serving/", "`serving/*` (dispatch "
+                  "surfaces only — see the rows below)",
+                  "admission-side input conversion on the caller's "
+                  "thread is legitimate host work, so only the dispatch "
+                  "path is scoped"),
+    ), extra_rows=(
+        ("`*Batcher` methods",
+         "batch formation: a stall here serializes every queued "
+         "request behind one device drain"),
+        (", ".join(f"`{f}`" for f in sorted(HOST_SYNC_HOT_FUNCS)),
+         "the worker-loop / batch-forming / program-dispatch functions "
+         "— the serving hot path proper"),
+    )),
+}
